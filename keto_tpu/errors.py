@@ -1,0 +1,133 @@
+"""Error values mirroring Keto's public error surface.
+
+Reference: ketoapi/public_api_definitions.go:14-21 (herodot-wrapped error
+values) and internal/x errors. Each error carries an HTTP status so the REST
+layer can map it the same way herodot does in the reference.
+"""
+
+from __future__ import annotations
+
+
+class KetoError(Exception):
+    """Base error. `status` is the HTTP status code the REST layer returns."""
+
+    status = 500
+    code = "internal_server_error"
+
+    def __init__(self, message: str | None = None, *, debug: str | None = None):
+        super().__init__(message or self.__class__.default_message)
+        self.message = message or self.__class__.default_message
+        self.debug = debug
+
+    default_message = "internal server error"
+
+    def to_dict(self) -> dict:
+        body = {
+            "code": self.status,
+            "status": self.code,
+            "message": self.message,
+        }
+        if self.debug:
+            body["debug"] = self.debug
+        return {"error": body}
+
+
+class MalformedInputError(KetoError):
+    # ref: ketoapi/enc_string.go:11 ErrMalformedInput
+    status = 400
+    code = "bad_request"
+    default_message = "malformed string input"
+
+
+class DroppedSubjectKeyError(KetoError):
+    # ref: ketoapi/public_api_definitions.go:15 ErrDroppedSubjectKey
+    status = 400
+    code = "bad_request"
+    default_message = (
+        'provide "subject_id" or "subject_set.*"; support for "subject" was dropped'
+    )
+
+
+class DuplicateSubjectError(KetoError):
+    # ref: ketoapi/public_api_definitions.go:16 ErrDuplicateSubject
+    status = 400
+    code = "bad_request"
+    default_message = "exactly one of subject_set or subject_id has to be provided"
+
+
+class IncompleteSubjectError(KetoError):
+    # ref: ketoapi/public_api_definitions.go:17 ErrIncompleteSubject
+    status = 400
+    code = "bad_request"
+    default_message = (
+        'incomplete subject, provide "subject_id" or a complete "subject_set.*"'
+    )
+
+
+class NilSubjectError(KetoError):
+    # ref: ketoapi/public_api_definitions.go:18 ErrNilSubject
+    status = 400
+    code = "bad_request"
+    default_message = "subject is not allowed to be nil"
+
+
+class IncompleteTupleError(KetoError):
+    # ref: ketoapi/public_api_definitions.go:19 ErrIncompleteTuple
+    status = 400
+    code = "bad_request"
+    default_message = (
+        'incomplete tuple, provide "namespace", "object", "relation", and a subject'
+    )
+
+
+class UnknownNodeTypeError(KetoError):
+    # ref: ketoapi/public_api_definitions.go:20 ErrUnknownNodeType
+    status = 400
+    code = "bad_request"
+    default_message = "unknown node type"
+
+
+class NotFoundError(KetoError):
+    status = 404
+    code = "not_found"
+    default_message = "resource not found"
+
+
+class NamespaceNotFoundError(NotFoundError):
+    default_message = "namespace not found"
+
+    def __init__(self, namespace: str):
+        super().__init__(f"namespace {namespace!r} not found")
+        self.namespace = namespace
+
+
+class RelationNotFoundError(KetoError):
+    # Engine error when a namespace config exists but the relation is absent
+    # (ref: internal/check/engine.go:228 `relation %q not found`).
+    status = 400
+    code = "bad_request"
+    default_message = "relation not found"
+
+    def __init__(self, relation: str):
+        super().__init__(f"relation {relation!r} not found")
+        self.relation = relation
+
+
+class MaxDepthExceededError(KetoError):
+    status = 400
+    code = "bad_request"
+    default_message = "max depth exceeded"
+
+
+class InvalidPageTokenError(KetoError):
+    # ref: internal/persistence/sql/persister.go (x.ErrInvalidToken analog)
+    status = 400
+    code = "bad_request"
+    default_message = "invalid page token"
+
+
+class NotImplementedYetError(KetoError):
+    # ref: snaptokens: "not yet implemented" (internal/check/handler.go:273)
+    status = 501
+    code = "not_implemented"
+    default_message = "not yet implemented"

@@ -1,0 +1,169 @@
+"""Tests for public API types and encodings.
+
+Mirrors the reference's ketoapi tests (enc_string round-trips, URL-query
+error cases from ketoapi/enc_url_query.go, subject exclusivity)."""
+
+import pytest
+
+from keto_tpu import errors, ketoapi
+from keto_tpu.ketoapi import (
+    RelationQuery,
+    RelationTuple,
+    SubjectSet,
+    Tree,
+    TreeNodeType,
+    subject_from_string,
+)
+
+
+class TestStringEncoding:
+    def test_subject_id_round_trip(self):
+        t = RelationTuple.from_string("videos:/cats/1.mp4#view@felix")
+        assert t.namespace == "videos"
+        assert t.object == "/cats/1.mp4"
+        assert t.relation == "view"
+        assert t.subject_id == "felix"
+        assert t.subject_set is None
+        assert str(t) == "videos:/cats/1.mp4#view@felix"
+
+    def test_subject_set_round_trip(self):
+        s = "videos:/cats/1.mp4#view@(videos:/cats#owner)"
+        t = RelationTuple.from_string(s)
+        assert t.subject_set == SubjectSet("videos", "/cats", "owner")
+        assert str(t) == s
+
+    def test_subject_set_without_parens(self):
+        t = RelationTuple.from_string("n:o#r@x:y#z")
+        assert t.subject_set == SubjectSet("x", "y", "z")
+        # canonical form always adds parens
+        assert str(t) == "n:o#r@(x:y#z)"
+
+    @pytest.mark.parametrize(
+        "bad",
+        ["no-colon#r@s", "n:no-hash@s", "n:o#no-at", ""],
+    )
+    def test_malformed(self, bad):
+        with pytest.raises(errors.MalformedInputError):
+            RelationTuple.from_string(bad)
+
+    def test_empty_parts_allowed(self):
+        # the reference parser does not reject empty components
+        t = RelationTuple.from_string(":#@")
+        assert t.namespace == "" and t.object == "" and t.relation == ""
+        assert t.subject_id == ""
+
+    def test_subject_parsing(self):
+        assert subject_from_string("user") == "user"
+        assert subject_from_string("(a:b#c)") == SubjectSet("a", "b", "c")
+        assert subject_from_string("a:b#c") == SubjectSet("a", "b", "c")
+
+    def test_wildcard_subject(self):
+        t = RelationTuple.from_string("videos:/cats/1.mp4#view@*")
+        assert t.subject_id == "*"
+
+
+class TestURLQuery:
+    def test_query_round_trip_subject_id(self):
+        q = RelationQuery.make(namespace="n", object="o", relation="r", subject="s")
+        v = q.to_url_query()
+        assert v == {
+            "namespace": "n",
+            "object": "o",
+            "relation": "r",
+            "subject_id": "s",
+        }
+        q2 = RelationQuery.from_url_query(v)
+        assert q2 == q
+
+    def test_query_round_trip_subject_set(self):
+        q = RelationQuery.make(namespace="n", subject=SubjectSet("a", "b", "c"))
+        v = q.to_url_query()
+        assert v["subject_set.namespace"] == "a"
+        q2 = RelationQuery.from_url_query(v)
+        assert q2.subject_set == SubjectSet("a", "b", "c")
+        assert q2.namespace == "n" and q2.object is None
+
+    def test_dropped_subject_key(self):
+        with pytest.raises(errors.DroppedSubjectKeyError):
+            RelationQuery.from_url_query({"subject": "s"})
+
+    def test_duplicate_subject(self):
+        with pytest.raises(errors.DuplicateSubjectError):
+            RelationQuery.from_url_query(
+                {"subject_id": "s", "subject_set.namespace": "n"}
+            )
+
+    def test_incomplete_subject_set(self):
+        with pytest.raises(errors.IncompleteSubjectError):
+            RelationQuery.from_url_query({"subject_set.namespace": "n"})
+
+    def test_tuple_requires_subject(self):
+        with pytest.raises(errors.NilSubjectError):
+            RelationTuple.from_url_query({"namespace": "n", "object": "o", "relation": "r"})
+
+    def test_tuple_requires_all_fields(self):
+        with pytest.raises(errors.IncompleteTupleError):
+            RelationTuple.from_url_query({"namespace": "n", "subject_id": "s"})
+
+
+class TestJSON:
+    def test_tuple_dict_round_trip(self):
+        t = RelationTuple.make("n", "o", "r", SubjectSet("a", "b", "c"))
+        assert RelationTuple.from_dict(t.to_dict()) == t
+
+    def test_exclusive_subject(self):
+        with pytest.raises(errors.DuplicateSubjectError):
+            RelationTuple.from_dict(
+                {
+                    "namespace": "n",
+                    "object": "o",
+                    "relation": "r",
+                    "subject_id": "s",
+                    "subject_set": {"namespace": "a", "object": "b", "relation": "c"},
+                }
+            )
+
+    def test_dropped_subject(self):
+        with pytest.raises(errors.DroppedSubjectKeyError):
+            RelationTuple.from_dict(
+                {"namespace": "n", "object": "o", "relation": "r", "subject": "s"}
+            )
+
+
+class TestQueryMatch:
+    def test_wildcards(self):
+        t = RelationTuple.make("n", "o", "r", "s")
+        assert RelationQuery().matches(t)
+        assert RelationQuery(namespace="n").matches(t)
+        assert not RelationQuery(namespace="m").matches(t)
+        assert RelationQuery.make(subject="s").matches(t)
+        assert not RelationQuery.make(subject=SubjectSet("n", "o", "r")).matches(t)
+
+
+class TestTree:
+    def test_round_trip(self):
+        t = Tree(
+            type=TreeNodeType.UNION,
+            tuple=RelationTuple.make("n", "o", "r", "s"),
+            children=[
+                Tree(type=TreeNodeType.LEAF, tuple=RelationTuple.make("n", "o", "r", "x"))
+            ],
+        )
+        assert Tree.from_dict(t.to_dict()).to_dict() == t.to_dict()
+
+    def test_unknown_node_type(self):
+        with pytest.raises(errors.UnknownNodeTypeError):
+            Tree.from_dict({"type": "bogus"})
+
+    def test_render(self):
+        t = Tree(
+            type=TreeNodeType.UNION,
+            tuple=RelationTuple.make("n", "o", "r", "s"),
+            children=[
+                Tree(type=TreeNodeType.LEAF, tuple=RelationTuple.make("n", "o", "r", "x")),
+                Tree(type=TreeNodeType.LEAF, tuple=RelationTuple.make("n", "o", "r", "y")),
+            ],
+        )
+        out = str(t)
+        assert out.startswith("or n:o#r@s")
+        assert "∋ n:o#r@x" in out and "∋ n:o#r@y" in out
