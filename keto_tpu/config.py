@@ -1,0 +1,285 @@
+"""Configuration provider and namespace managers.
+
+Parity with internal/driver/config/provider.go (keys dsn, serve.*,
+limit.max_read_depth, namespaces, log, tracing) and namespace_watcher.go
+(file/dir namespace sources with hot reload and rollback-on-parse-error).
+
+Namespace sources supported (superset of the reference, closing the
+SURVEY.md §2.6 gap — OPL is wired directly into the config path):
+  - inline list of namespace dicts (name/id/relations AST)
+  - "file://path" or bare path to a yaml/json/toml file or a directory of
+    such files (one namespace per file, as the reference's watcher expects)
+  - .ts files parsed as Ory Permission Language
+  - a dict {"location": "..."} like later Keto versions
+
+Default limits mirror embedx/config.schema.json: limit.max_read_depth = 5,
+read :4466, write :4467, metrics :4468.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tomllib
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Optional
+
+import yaml
+
+from .errors import KetoError, NamespaceNotFoundError
+from .namespace.definitions import MemoryNamespaceManager, Namespace
+from .opl import parser as opl_parser
+
+logger = logging.getLogger("keto_tpu.config")
+
+DEFAULT_MAX_READ_DEPTH = 5  # ref: embedx/config.schema.json limit.max_read_depth
+DEFAULT_READ_PORT = 4466
+DEFAULT_WRITE_PORT = 4467
+DEFAULT_METRICS_PORT = 4468
+DEFAULT_PAGE_SIZE = 100  # ref: internal/persistence/sql/persister.go:37-39
+
+
+class ConfigError(KetoError):
+    status = 500
+    code = "internal_server_error"
+    default_message = "invalid configuration"
+
+
+@dataclass
+class ServeAddress:
+    host: str = "0.0.0.0"
+    port: int = 0
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+class NamespaceFileManager:
+    """Loads namespaces from a file or directory, hot-reloading on mtime
+    change with rollback-on-parse-error.
+    ref: internal/driver/config/namespace_watcher.go:118-239"""
+
+    def __init__(self, location: str):
+        self.location = location.removeprefix("file://")
+        self._namespaces: dict[str, Namespace] = {}
+        self._mtimes: dict[str, float] = {}
+        self.last_error: Optional[Exception] = None
+        self._load(initial=True)
+
+    # -- loading --------------------------------------------------------------
+
+    def _files(self) -> list[str]:
+        loc = self.location
+        if os.path.isdir(loc):
+            out = []
+            for name in sorted(os.listdir(loc)):
+                p = os.path.join(loc, name)
+                if os.path.isfile(p) and name.rsplit(".", 1)[-1] in (
+                    "yaml", "yml", "json", "toml", "ts",
+                ):
+                    out.append(p)
+            return out
+        return [loc]
+
+    @staticmethod
+    def parse_file(path: str) -> list[Namespace]:
+        """Parse one namespace file by extension.
+        ref: namespace_watcher.go:228-239 (yaml/json/toml by extension)."""
+        ext = path.rsplit(".", 1)[-1].lower()
+        if ext == "ts":
+            with open(path, "r") as f:
+                namespaces, errs = opl_parser.parse(f.read())
+            if errs:
+                raise ConfigError(
+                    f"could not parse {path}: " + "; ".join(e.msg for e in errs)
+                )
+            return namespaces
+        with open(path, "rb") as f:
+            if ext in ("yaml", "yml"):
+                raw = yaml.safe_load(f)
+            elif ext == "json":
+                raw = json.load(f)
+            elif ext == "toml":
+                raw = tomllib.load(f)
+            else:
+                raise ConfigError(f"unknown namespace file extension: {path}")
+        if raw is None:
+            return []
+        if isinstance(raw, list):
+            return [Namespace.from_dict(d) for d in raw]
+        return [Namespace.from_dict(raw)]
+
+    def _load(self, initial: bool = False) -> None:
+        new: dict[str, Namespace] = {}
+        mtimes: dict[str, float] = {}
+        try:
+            files = self._files()
+            # .ts (OPL) files may reference namespaces declared in sibling
+            # files, so all OPL sources are parsed as one merged document
+            # before the per-file formats.
+            opl_sources = []
+            for path in files:
+                mtimes[path] = os.stat(path).st_mtime
+                if path.rsplit(".", 1)[-1].lower() == "ts":
+                    with open(path, "r") as f:
+                        opl_sources.append(f.read())
+                else:
+                    for ns in self.parse_file(path):
+                        new[ns.name] = ns
+            if opl_sources:
+                namespaces, errs = opl_parser.parse("\n".join(opl_sources))
+                if errs:
+                    raise ConfigError(
+                        "could not parse OPL namespaces: "
+                        + "; ".join(e.msg for e in errs)
+                    )
+                for ns in namespaces:
+                    new[ns.name] = ns
+        except Exception as e:  # any parse/shape error must not kill serving
+            if initial:
+                raise ConfigError(f"could not load namespaces: {e}")
+            # rollback-on-parse-error: keep serving the previous set, but
+            # record and log why the new config never applied
+            # (ref: namespace_watcher.go:118-137 logs the parse error).
+            if type(self.last_error) is not type(e) or str(self.last_error) != str(e):
+                logger.warning("namespace reload failed, keeping previous set: %s", e)
+            self.last_error = e
+            return
+        self._namespaces = new
+        self._mtimes = mtimes
+        self.last_error = None
+
+    def _maybe_reload(self) -> None:
+        try:
+            current = {p: os.stat(p).st_mtime for p in self._files()}
+        except OSError:
+            return
+        if current != self._mtimes:
+            self._load()
+
+    # -- namespace.Manager protocol -------------------------------------------
+
+    def get_namespace_by_name(self, name: str) -> Namespace:
+        self._maybe_reload()
+        try:
+            return self._namespaces[name]
+        except KeyError:
+            raise NamespaceNotFoundError(name)
+
+    def get_namespace_by_config_id(self, id: int) -> Namespace:
+        self._maybe_reload()
+        for ns in self._namespaces.values():
+            if ns.id == id:
+                return ns
+        raise NamespaceNotFoundError(str(id))
+
+    def namespaces(self) -> list[Namespace]:
+        self._maybe_reload()
+        return list(self._namespaces.values())
+
+    def should_reload(self, namespaces: object) -> bool:
+        return True
+
+
+class Config:
+    """Config provider. ref: internal/driver/config/provider.go.
+
+    Immutable keys (dsn, serve) follow the reference (provider.go:84);
+    `set()` refuses to change them after construction."""
+
+    IMMUTABLE_KEYS = ("dsn", "serve")
+
+    def __init__(self, values: Optional[Mapping[str, Any]] = None):
+        self._values: dict[str, Any] = dict(values or {})
+        self._namespace_manager = None
+
+    @classmethod
+    def from_file(cls, path: str) -> "Config":
+        with open(path, "rb") as f:
+            if path.endswith((".yaml", ".yml")):
+                values = yaml.safe_load(f) or {}
+            elif path.endswith(".json"):
+                values = json.load(f)
+            elif path.endswith(".toml"):
+                values = tomllib.load(f)
+            else:
+                raise ConfigError(f"unknown config file extension: {path}")
+        return cls(values)
+
+    # -- generic access -------------------------------------------------------
+
+    def get(self, key: str, default: Any = None) -> Any:
+        """Dotted-path lookup, e.g. 'limit.max_read_depth'."""
+        cur: Any = self._values
+        for part in key.split("."):
+            if not isinstance(cur, Mapping) or part not in cur:
+                return default
+            cur = cur[part]
+        return cur
+
+    def set(self, key: str, value: Any) -> None:
+        root = key.split(".")[0]
+        if root in self.IMMUTABLE_KEYS:
+            raise ConfigError(f"config key {root!r} is immutable")
+        parts = key.split(".")
+        cur = self._values
+        for part in parts[:-1]:
+            cur = cur.setdefault(part, {})
+        cur[parts[-1]] = value
+        if root == "namespaces":
+            self._namespace_manager = None  # invalidate, like the watcher reset
+
+    # -- typed accessors (ref: provider.go) -----------------------------------
+
+    @property
+    def dsn(self) -> str:
+        return self.get("dsn", "memory")
+
+    def max_read_depth(self) -> int:
+        return int(self.get("limit.max_read_depth", DEFAULT_MAX_READ_DEPTH))
+
+    def read_api_address(self) -> ServeAddress:
+        return ServeAddress(
+            host=self.get("serve.read.host", "0.0.0.0"),
+            port=int(self.get("serve.read.port", DEFAULT_READ_PORT)),
+        )
+
+    def write_api_address(self) -> ServeAddress:
+        return ServeAddress(
+            host=self.get("serve.write.host", "0.0.0.0"),
+            port=int(self.get("serve.write.port", DEFAULT_WRITE_PORT)),
+        )
+
+    def metrics_api_address(self) -> ServeAddress:
+        return ServeAddress(
+            host=self.get("serve.metrics.host", "0.0.0.0"),
+            port=int(self.get("serve.metrics.port", DEFAULT_METRICS_PORT)),
+        )
+
+    def page_size(self) -> int:
+        return int(self.get("limit.page_size", DEFAULT_PAGE_SIZE))
+
+    def namespace_manager(self):
+        """Build (and cache) the namespace manager from the `namespaces` key.
+        ref: provider.go:107-150 (watcher reset on change)."""
+        if self._namespace_manager is not None:
+            return self._namespace_manager
+        raw = self.get("namespaces", [])
+        if isinstance(raw, str):
+            self._namespace_manager = NamespaceFileManager(raw)
+        elif isinstance(raw, Mapping) and "location" in raw:
+            self._namespace_manager = NamespaceFileManager(raw["location"])
+        elif isinstance(raw, list):
+            self._namespace_manager = MemoryNamespaceManager(
+                Namespace.from_dict(d) if isinstance(d, Mapping) else d for d in raw
+            )
+        else:
+            raise ConfigError("invalid `namespaces` config value")
+        return self._namespace_manager
+
+    def set_namespaces(self, namespaces: list[Namespace]) -> None:
+        """Programmatic namespace injection (the embedders' path; mirrors
+        tests in the reference setting Namespace.Relations directly)."""
+        self._namespace_manager = MemoryNamespaceManager(namespaces)

@@ -1,0 +1,93 @@
+"""Namespace model and manager protocol.
+
+Parity with internal/namespace/definitions.go:10-30: Namespace{id
+(deprecated), name, relations} and the Manager interface
+(GetNamespaceByName / GetNamespaceByConfigID / Namespaces / ShouldReload).
+
+Unlike the reference snapshot — where the OPL parser output is never wired
+into the serve path (SURVEY.md §2.6 gap) — our config layer populates
+`relations` from OPL or JSON directly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterable, Mapping, Optional, Protocol
+
+from ..errors import NamespaceNotFoundError
+from .ast import Relation, relation_from_dict
+
+
+@dataclass
+class Namespace:
+    name: str
+    id: Optional[int] = None  # deprecated numeric id, kept for config parity
+    relations: list[Relation] = field(default_factory=list)
+
+    def relation(self, name: str) -> Optional[Relation]:
+        for r in self.relations:
+            if r.name == name:
+                return r
+        return None
+
+    def to_dict(self) -> dict:
+        d: dict = {"name": self.name}
+        if self.id is not None:
+            d["id"] = self.id
+        if self.relations:
+            d["relations"] = [r.to_dict() for r in self.relations]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Mapping) -> "Namespace":
+        return cls(
+            name=d["name"],
+            id=d.get("id"),
+            relations=[relation_from_dict(r) for r in d.get("relations", [])],
+        )
+
+
+class Manager(Protocol):
+    """ref: internal/namespace/definitions.go:20-26"""
+
+    def get_namespace_by_name(self, name: str) -> Namespace: ...
+
+    def get_namespace_by_config_id(self, id: int) -> Namespace: ...
+
+    def namespaces(self) -> list[Namespace]: ...
+
+    def should_reload(self, namespaces: object) -> bool: ...
+
+
+class MemoryNamespaceManager:
+    """In-memory namespace set, built from inline config.
+    ref: internal/driver/config/namespace_memory.go"""
+
+    def __init__(self, namespaces: Iterable[Namespace] = ()):  # noqa: D401
+        self._by_name: dict[str, Namespace] = {}
+        self._by_id: dict[int, Namespace] = {}
+        for ns in namespaces:
+            self.add(ns)
+
+    def add(self, ns: Namespace) -> None:
+        self._by_name[ns.name] = ns
+        if ns.id is not None:
+            self._by_id[ns.id] = ns
+
+    def get_namespace_by_name(self, name: str) -> Namespace:
+        try:
+            return self._by_name[name]
+        except KeyError:
+            raise NamespaceNotFoundError(name)
+
+    def get_namespace_by_config_id(self, id: int) -> Namespace:
+        try:
+            return self._by_id[id]
+        except KeyError:
+            raise NamespaceNotFoundError(str(id))
+
+    def namespaces(self) -> list[Namespace]:
+        return list(self._by_name.values())
+
+    def should_reload(self, namespaces: object) -> bool:
+        return namespaces is not self._by_name

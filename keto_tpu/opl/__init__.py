@@ -1,0 +1,5 @@
+from .parser import parse
+from .lexer import tokenize, Token, TokenType
+from .errors import ParseError
+
+__all__ = ["parse", "tokenize", "Token", "TokenType", "ParseError"]

@@ -1,0 +1,119 @@
+"""Config provider + namespace manager tests (hot reload, OPL wiring,
+immutable keys). Mirrors internal/driver/config behaviors."""
+
+import os
+import time
+
+import pytest
+
+from keto_tpu.config import Config, ConfigError, NamespaceFileManager
+from keto_tpu.errors import NamespaceNotFoundError
+from keto_tpu.namespace import Namespace
+from keto_tpu.namespace.ast import ComputedSubjectSet, Relation
+
+
+class TestConfig:
+    def test_defaults(self):
+        c = Config()
+        assert c.max_read_depth() == 5
+        assert c.read_api_address().port == 4466
+        assert c.write_api_address().port == 4467
+        assert c.metrics_api_address().port == 4468
+        assert c.page_size() == 100
+        assert c.dsn == "memory"
+
+    def test_inline_namespaces(self):
+        c = Config(
+            {
+                "namespaces": [
+                    {"name": "videos", "id": 0},
+                    {
+                        "name": "files",
+                        "relations": [
+                            {"name": "owner"},
+                            {
+                                "name": "view",
+                                "rewrite": {
+                                    "operator": "or",
+                                    "children": [{"relation": "owner"}],
+                                },
+                            },
+                        ],
+                    },
+                ]
+            }
+        )
+        nm = c.namespace_manager()
+        assert nm.get_namespace_by_name("videos").name == "videos"
+        assert nm.get_namespace_by_config_id(0).name == "videos"
+        files = nm.get_namespace_by_name("files")
+        rw = files.relation("view").subject_set_rewrite
+        assert isinstance(rw.children[0], ComputedSubjectSet)
+        with pytest.raises(NamespaceNotFoundError):
+            nm.get_namespace_by_name("nope")
+
+    def test_immutable_keys(self):
+        c = Config({"dsn": "memory"})
+        with pytest.raises(ConfigError):
+            c.set("dsn", "other")
+        c.set("limit.max_read_depth", 10)
+        assert c.max_read_depth() == 10
+
+    def test_set_namespaces_programmatically(self):
+        c = Config()
+        c.set_namespaces([Namespace(name="n", relations=[Relation(name="r")])])
+        assert c.namespace_manager().get_namespace_by_name("n").relation("r")
+
+
+class TestNamespaceFiles:
+    def test_yaml_file(self, tmp_path):
+        p = tmp_path / "ns.yml"
+        p.write_text("name: videos\nid: 3\n")
+        m = NamespaceFileManager(str(p))
+        assert m.get_namespace_by_name("videos").id == 3
+
+    def test_directory_and_opl(self, tmp_path):
+        (tmp_path / "a.json").write_text('{"name": "a"}')
+        (tmp_path / "b.ts").write_text(
+            """
+            class User implements Namespace {}
+            class Doc implements Namespace {
+              related: { owners: User[] }
+              permits = { view: (ctx) => this.related.owners.includes(ctx.subject) }
+            }
+            """
+        )
+        m = NamespaceFileManager(str(tmp_path))
+        names = sorted(n.name for n in m.namespaces())
+        assert names == ["Doc", "User", "a"]
+        doc = m.get_namespace_by_name("Doc")
+        assert doc.relation("view").subject_set_rewrite is not None
+
+    def test_hot_reload_and_rollback(self, tmp_path):
+        p = tmp_path / "ns.json"
+        p.write_text('{"name": "one"}')
+        m = NamespaceFileManager(str(p))
+        assert m.get_namespace_by_name("one")
+
+        # hot reload on mtime change
+        p.write_text('{"name": "two"}')
+        os.utime(p, (time.time() + 5, time.time() + 5))
+        assert m.get_namespace_by_name("two")
+        with pytest.raises(NamespaceNotFoundError):
+            m.get_namespace_by_name("one")
+
+        # parse error → rollback to previous set (namespace_watcher.go:118-137)
+        p.write_text("{not json")
+        os.utime(p, (time.time() + 10, time.time() + 10))
+        assert m.get_namespace_by_name("two")
+
+    def test_config_file_namespace_location(self, tmp_path):
+        ns = tmp_path / "ns.yml"
+        ns.write_text("name: videos\n")
+        cfg = tmp_path / "keto.yml"
+        cfg.write_text(
+            f"namespaces: file://{ns}\nlimit:\n  max_read_depth: 7\ndsn: memory\n"
+        )
+        c = Config.from_file(str(cfg))
+        assert c.max_read_depth() == 7
+        assert c.namespace_manager().get_namespace_by_name("videos")

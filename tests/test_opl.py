@@ -1,0 +1,232 @@
+"""OPL lexer/parser tests, mirroring internal/schema/{lexer,parser}_test.go
+cases (the full_example fixture, error cases, typechecks)."""
+
+import textwrap
+
+import pytest
+
+from keto_tpu.namespace.ast import (
+    ComputedSubjectSet,
+    InvertResult,
+    Operator,
+    SubjectSetRewrite,
+    TupleToSubjectSet,
+)
+from keto_tpu.opl import parse, tokenize
+from keto_tpu.opl.lexer import TokenType
+
+FULL_EXAMPLE = """
+class User implements Namespace {
+  related: {
+    manager: User[]
+  }
+}
+
+class Group implements Namespace {
+  related: {
+    members: (User | Group)[]
+  }
+}
+
+class Folder implements Namespace {
+  related: {
+    parents: File[]
+    viewers: SubjectSet<Group, "members">[]
+  }
+
+  permits = {
+    view: (ctx: Context): boolean => this.related.viewers.includes(ctx.subject),
+  }
+}
+
+class File implements Namespace {
+  related: {
+    parents: (File | Folder)[]
+    viewers: (User | SubjectSet<Group, "members">)[]
+    owners: (User | SubjectSet<Group, "members">)[]
+    siblings: File[]
+  }
+
+  // Some comment
+  permits = {
+    view: (ctx: Context): boolean =>
+      (
+      this.related.parents.traverse((p) =>
+        p.related.viewers.includes(ctx.subject),
+      ) &&
+      this.related.parents.traverse(p => p.permits.view(ctx)) ) ||
+      (this.related.viewers.includes(ctx.subject) ||
+      this.related.viewers.includes(ctx.subject) ||
+      this.related.viewers.includes(ctx.subject) ) ||
+      this.related.owners.includes(ctx.subject),
+
+    edit: (ctx: Context) => this.related.owners.includes(ctx.subject),
+
+    not: (ctx: Context) => !this.related.owners.includes(ctx.subject),
+
+    rename: (ctx: Context) =>
+      this.related.siblings.traverse(s => s.permits.edit(ctx)),
+  }
+}
+"""
+
+
+class TestLexer:
+    def test_tokens(self):
+        toks = tokenize("class X implements Namespace { } // c")
+        types = [t.typ for t in toks]
+        assert types == [
+            TokenType.IDENT,
+            TokenType.IDENT,
+            TokenType.IDENT,
+            TokenType.IDENT,
+            TokenType.BRACE_L,
+            TokenType.BRACE_R,
+            TokenType.COMMENT,
+            TokenType.EOF,
+        ]
+
+    def test_string_literal(self):
+        toks = tokenize('SubjectSet<Group, "members">')
+        assert toks[4].typ == TokenType.STRING and toks[4].val == "members"
+
+    def test_two_char_operators(self):
+        toks = tokenize("a && b || !c => d")
+        assert [t.typ for t in toks[:8]] == [
+            TokenType.IDENT, TokenType.AND, TokenType.IDENT, TokenType.OR,
+            TokenType.NOT, TokenType.IDENT, TokenType.ARROW, TokenType.IDENT,
+        ]
+
+    def test_unclosed_comment_is_error(self):
+        toks = tokenize("/* unclosed comment")
+        assert toks[-1].typ == TokenType.ERROR
+
+
+class TestParser:
+    def test_full_example(self):
+        namespaces, errs = parse(FULL_EXAMPLE)
+        assert errs == []
+        assert [n.name for n in namespaces] == ["User", "Group", "Folder", "File"]
+
+        group = namespaces[1]
+        members = group.relation("members")
+        assert [t.namespace for t in members.types] == ["User", "Group"]
+
+        folder = namespaces[2]
+        viewers = folder.relation("viewers")
+        assert viewers.types[0].namespace == "Group"
+        assert viewers.types[0].relation == "members"
+        view = folder.relation("view")
+        assert isinstance(view.subject_set_rewrite, SubjectSetRewrite)
+        assert isinstance(view.subject_set_rewrite.children[0], ComputedSubjectSet)
+
+        file_ns = namespaces[3]
+        view = file_ns.relation("view").subject_set_rewrite
+        # top level is an OR of [AND(ttu, ttu), computed x3, computed]
+        assert view.operation == Operator.OR
+        assert len(view.children) == 5
+        inner_and = view.children[0]
+        assert isinstance(inner_and, SubjectSetRewrite)
+        assert inner_and.operation == Operator.AND
+        # matches reference snapshot full_example.json: the AND's first child
+        # is a singleton OR wrapper (AsRewrite), the second a bare TTU
+        first, second = inner_and.children
+        assert isinstance(first, SubjectSetRewrite) and first.operation == Operator.OR
+        assert isinstance(first.children[0], TupleToSubjectSet)
+        assert first.children[0].relation == "parents"
+        assert first.children[0].computed_subject_set_relation == "viewers"
+        assert isinstance(second, TupleToSubjectSet)
+        assert second.computed_subject_set_relation == "view"
+
+        not_rel = file_ns.relation("not").subject_set_rewrite
+        assert isinstance(not_rel.children[0], InvertResult)
+        assert isinstance(not_rel.children[0].child, ComputedSubjectSet)
+
+        rename = file_ns.relation("rename").subject_set_rewrite
+        assert isinstance(rename.children[0], TupleToSubjectSet)
+        assert rename.children[0].computed_subject_set_relation == "edit"
+
+    def test_lexer_error_is_fatal(self):
+        _, errs = parse("/* unclosed comment")
+        assert len(errs) == 1
+        assert "fatal" in errs[0].msg
+
+    def test_left_fold_no_precedence(self):
+        ns, errs = parse(
+            """
+        class U implements Namespace {}
+        class D implements Namespace {
+          related: { a: U[]  b: U[]  c: U[] }
+          permits = {
+            p: (ctx) => this.related.a.includes(ctx.subject) &&
+                        this.related.b.includes(ctx.subject) ||
+                        this.related.c.includes(ctx.subject),
+          }
+        }
+        """
+        )
+        assert errs == []
+        rw = ns[1].relation("p").subject_set_rewrite
+        # (a && b) || c — operator rebinding is a left fold
+        assert rw.operation == Operator.OR
+        assert isinstance(rw.children[0], SubjectSetRewrite)
+        assert rw.children[0].operation == Operator.AND
+        assert isinstance(rw.children[1], ComputedSubjectSet)
+
+    def test_unknown_namespace_typecheck(self):
+        _, errs = parse(
+            """
+        class D implements Namespace {
+          related: { viewers: Nonexistent[] }
+        }
+        """
+        )
+        assert any("namespace 'Nonexistent' was not declared" in e.msg for e in errs)
+
+    def test_subject_set_relation_typecheck(self):
+        _, errs = parse(
+            """
+        class G implements Namespace {}
+        class D implements Namespace {
+          related: { viewers: SubjectSet<G, "members">[] }
+        }
+        """
+        )
+        assert any("did not declare relation 'members'" in e.msg for e in errs)
+
+    def test_ttu_types_typecheck(self):
+        # parents has type G which lacks the computed relation "view"
+        _, errs = parse(
+            """
+        class G implements Namespace {}
+        class D implements Namespace {
+          related: { parents: G[] }
+          permits = { view: (ctx) => this.related.parents.traverse(p => p.permits.view(ctx)) }
+        }
+        """
+        )
+        assert any(
+            "relation 'view' was not declared in namespace 'G'" in e.msg for e in errs
+        )
+
+    def test_nesting_depth_cap(self):
+        expr = "(" * 11 + "this.related.a.includes(ctx.subject)" + ")" * 11
+        _, errs = parse(
+            "class U implements Namespace {}\n"
+            "class D implements Namespace {\n"
+            "  related: { a: U[] }\n"
+            "  permits = { p: (ctx) => " + expr + " }\n"
+            "}\n"
+        )
+        assert any("nested too deeply" in e.msg for e in errs)
+
+    def test_error_position_rendering(self):
+        _, errs = parse("class D implements Namespace { bogus }")
+        assert errs
+        rendered = str(errs[0])
+        assert "error from 1:" in rendered
+        assert "^" in rendered
+
+    def test_empty_input(self):
+        ns, errs = parse("")
+        assert ns == [] and errs == []
