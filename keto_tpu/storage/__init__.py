@@ -1,0 +1,13 @@
+from .definitions import Manager, DEFAULT_PAGE_SIZE
+from .memory import MemoryManager
+from .sqlite import SQLitePersister
+from .mapping import UUIDMappingManager, Mapper
+
+__all__ = [
+    "Manager",
+    "MemoryManager",
+    "SQLitePersister",
+    "UUIDMappingManager",
+    "Mapper",
+    "DEFAULT_PAGE_SIZE",
+]

@@ -1,0 +1,104 @@
+"""Relation-tuple storage protocol.
+
+Parity with the reference's relationtuple.Manager
+(internal/relationtuple/definitions.go:19-25) and the persister contract
+(internal/persistence/definitions.go:15-21):
+
+  - GetRelationTuples(query, page opts) -> (tuples, next_page_token)
+  - WriteRelationTuples / DeleteRelationTuples / DeleteAllRelationTuples
+  - TransactRelationTuples (atomic insert+delete)
+
+All operations are scoped by a network id (nid) for multi-tenancy, the way
+every reference query is QueryWithNetwork-scoped
+(internal/persistence/sql/persister.go:85-95). Pagination is keyset-based:
+rows are ordered by a deterministic per-tuple shard id and the page token
+is the last-seen shard id (persister.go:97-125), with an N+1 probe for the
+next-page indicator (relationtuples.go:203-244).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Iterable, Protocol, Sequence
+
+from ..errors import InvalidPageTokenError
+from ..ketoapi import RelationQuery, RelationTuple
+
+DEFAULT_PAGE_SIZE = 100  # ref: internal/persistence/sql/persister.go:37-39
+DEFAULT_NETWORK = "default"
+
+# Namespace UUID for deterministic shard ids (UUIDv5 over the canonical
+# tuple string, scoped per network). Plays the role of the reference's
+# random shard_id while keeping inserts idempotent and orderings stable.
+_SHARD_NS = uuid.UUID("5a4e8f9e-0c2d-4b3a-9f21-6d1f2a7c8e11")
+
+
+def shard_id(nid: str, t: RelationTuple) -> str:
+    """Deterministic row id for keyset pagination ordering.
+
+    Derived from the structured fields with an unambiguous separator and a
+    subject-kind tag — NOT from the display string, which is not injective
+    (a subject_id that looks like "(a:b#c)" must not collide with the
+    subject set a:b#c)."""
+    if t.subject_set is not None:
+        s = t.subject_set
+        subject = f"set\x1f{s.namespace}\x1f{s.object}\x1f{s.relation}"
+    else:
+        subject = f"id\x1f{t.subject_id}"
+    key = "\x1f".join((nid, t.namespace, t.object, t.relation, subject))
+    return str(uuid.uuid5(_SHARD_NS, key))
+
+
+def validate_page_token(token: str) -> str:
+    """Page tokens are shard ids (UUID strings); '' means first page."""
+    if not token:
+        return ""
+    try:
+        return str(uuid.UUID(token))
+    except ValueError:
+        raise InvalidPageTokenError(debug=f"invalid pagination token {token!r}")
+
+
+class Manager(Protocol):
+    """ref: internal/relationtuple/definitions.go:19-25"""
+
+    def get_relation_tuples(
+        self,
+        query: RelationQuery,
+        page_token: str = "",
+        page_size: int = DEFAULT_PAGE_SIZE,
+        nid: str = DEFAULT_NETWORK,
+    ) -> tuple[list[RelationTuple], str]: ...
+
+    def write_relation_tuples(
+        self, tuples: Sequence[RelationTuple], nid: str = DEFAULT_NETWORK
+    ) -> None: ...
+
+    def delete_relation_tuples(
+        self, tuples: Sequence[RelationTuple], nid: str = DEFAULT_NETWORK
+    ) -> None: ...
+
+    def delete_all_relation_tuples(
+        self, query: RelationQuery, nid: str = DEFAULT_NETWORK
+    ) -> None: ...
+
+    def transact_relation_tuples(
+        self,
+        insert: Sequence[RelationTuple],
+        delete: Sequence[RelationTuple],
+        nid: str = DEFAULT_NETWORK,
+    ) -> None: ...
+
+    def relation_tuple_exists(
+        self, t: RelationTuple, nid: str = DEFAULT_NETWORK
+    ) -> bool:
+        """Single-row existence probe (checkDirect's WithSize(1) query,
+        internal/check/engine.go:159-163)."""
+        ...
+
+    def all_relation_tuples(
+        self, nid: str = DEFAULT_NETWORK
+    ) -> Iterable[RelationTuple]:
+        """Bulk scan for snapshot builds (no reference equivalent; the TPU
+        mirror's ingest path)."""
+        ...

@@ -1,0 +1,232 @@
+"""In-memory authoritative tuple store.
+
+The host-side source of truth for the TPU device mirror. Equivalent role to
+the reference's SQL persister with dsn=memory (shared-cache SQLite,
+internal/driver/config/provider.go:187-193) but implemented as indexed
+dicts: the engine's hot queries — forward (namespace, object, relation) →
+subjects and existence probes — are O(1) lookups instead of SQL round
+trips.
+
+Semantics matched to internal/persistence/sql/relationtuples.go:
+  - keyset pagination ordered by shard id with N+1 next-page probe (:203-244)
+  - insert is idempotent per (nid, tuple) like the UUID-keyed upsert
+  - delete-by-query supports all subject predicates incl. the NULL-aware
+    subject shapes (:124-144)
+  - per-nid isolation (QueryWithNetwork, persister.go:85-87)
+
+Thread safety: a single RLock guards all mutation; reads take it too
+(the REST/gRPC front is multi-threaded).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import defaultdict
+from typing import Optional, Sequence
+
+from ..ketoapi import RelationQuery, RelationTuple
+from .definitions import (
+    DEFAULT_NETWORK,
+    DEFAULT_PAGE_SIZE,
+    shard_id,
+    validate_page_token,
+)
+
+
+class _NetworkStore:
+    """All tuples of one network id."""
+
+    __slots__ = ("by_shard", "order", "forward", "by_subject", "version")
+
+    def __init__(self):
+        # shard id -> tuple
+        self.by_shard: dict[str, RelationTuple] = {}
+        # sorted list of shard ids (keyset pagination order)
+        self.order: list[str] = []
+        # (ns, obj, rel) -> {shard ids}
+        self.forward: dict[tuple[str, str, str], set[str]] = defaultdict(set)
+        # subject unique id -> {shard ids} (reverse index, mirroring the
+        # reference's reverse_subject indexes in the final schema migration)
+        self.by_subject: dict[str, set[str]] = defaultdict(set)
+        # monotonically increasing write version (device mirror staleness)
+        self.version: int = 0
+
+
+def _subject_key(t: RelationTuple) -> str:
+    return str(t.subject_set) if t.subject_set is not None else f"id:{t.subject_id}"
+
+
+class MemoryManager:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._networks: dict[str, _NetworkStore] = defaultdict(_NetworkStore)
+
+    # An empty store served to read paths for unknown nids, so arbitrary
+    # per-request tenant ids can't grow self._networks unboundedly.
+    _EMPTY = _NetworkStore()
+
+    def _net(self, nid: str) -> _NetworkStore:
+        """Write path: allocates the network store on first use."""
+        return self._networks[nid]
+
+    def _net_ro(self, nid: str) -> _NetworkStore:
+        """Read path: never allocates."""
+        return self._networks.get(nid, self._EMPTY)
+
+    # -- reads ---------------------------------------------------------------
+
+    def get_relation_tuples(
+        self,
+        query: RelationQuery,
+        page_token: str = "",
+        page_size: int = DEFAULT_PAGE_SIZE,
+        nid: str = DEFAULT_NETWORK,
+    ) -> tuple[list[RelationTuple], str]:
+        token = validate_page_token(page_token)
+        if page_size <= 0:
+            page_size = DEFAULT_PAGE_SIZE
+        with self._lock:
+            net = self._net_ro(nid)
+            shards = self._candidate_shards(net, query)
+            # keyset pagination: shard_id > token, ordered ascending
+            if shards is None:
+                ordered = net.order
+            else:
+                ordered = sorted(shards)
+            start = bisect.bisect_right(ordered, token) if token else 0
+            out: list[RelationTuple] = []
+            next_token = ""
+            i = start
+            n = len(ordered)
+            while i < n and len(out) < page_size:
+                sid = ordered[i]
+                t = net.by_shard[sid]
+                if query.matches(t):
+                    out.append(t)
+                    last_sid = sid
+                i += 1
+            # N+1 probe: is there any further match?
+            while i < n:
+                if query.matches(net.by_shard[ordered[i]]):
+                    next_token = last_sid
+                    break
+                i += 1
+            return out, next_token
+
+    def _candidate_shards(
+        self, net: _NetworkStore, query: RelationQuery
+    ) -> Optional[set[str]]:
+        """Use the most selective index available; None = full scan order."""
+        candidates: Optional[set[str]] = None
+        if (
+            query.namespace is not None
+            and query.object is not None
+            and query.relation is not None
+        ):
+            candidates = net.forward.get(
+                (query.namespace, query.object, query.relation), set()
+            )
+        elif query.subject is not None:
+            key = (
+                str(query.subject_set)
+                if query.subject_set is not None
+                else f"id:{query.subject_id}"
+            )
+            candidates = net.by_subject.get(key, set())
+        return candidates
+
+    def relation_tuple_exists(
+        self, t: RelationTuple, nid: str = DEFAULT_NETWORK
+    ) -> bool:
+        with self._lock:
+            return shard_id(nid, t) in self._net_ro(nid).by_shard
+
+    def all_relation_tuples(
+        self, nid: str = DEFAULT_NETWORK
+    ) -> list[RelationTuple]:
+        with self._lock:
+            net = self._net_ro(nid)
+            return [net.by_shard[sid] for sid in net.order]
+
+    def version(self, nid: str = DEFAULT_NETWORK) -> int:
+        with self._lock:
+            return self._net_ro(nid).version
+
+    # -- writes --------------------------------------------------------------
+
+    def write_relation_tuples(
+        self, tuples: Sequence[RelationTuple], nid: str = DEFAULT_NETWORK
+    ) -> None:
+        with self._lock:
+            net = self._net(nid)
+            for t in tuples:
+                self._insert(net, nid, t)
+            net.version += 1
+
+    def delete_relation_tuples(
+        self, tuples: Sequence[RelationTuple], nid: str = DEFAULT_NETWORK
+    ) -> None:
+        with self._lock:
+            net = self._net(nid)
+            for t in tuples:
+                self._delete(net, nid, t)
+            net.version += 1
+
+    def delete_all_relation_tuples(
+        self, query: RelationQuery, nid: str = DEFAULT_NETWORK
+    ) -> None:
+        with self._lock:
+            net = self._net(nid)
+            doomed = [
+                t for t in (net.by_shard[sid] for sid in net.order) if query.matches(t)
+            ]
+            for t in doomed:
+                self._delete(net, nid, t)
+            net.version += 1
+
+    def transact_relation_tuples(
+        self,
+        insert: Sequence[RelationTuple],
+        delete: Sequence[RelationTuple],
+        nid: str = DEFAULT_NETWORK,
+    ) -> None:
+        # atomic under the lock, like popx.Transaction
+        # (internal/persistence/sql/relationtuples.go:260-270)
+        with self._lock:
+            net = self._net(nid)
+            for t in insert:
+                self._insert(net, nid, t)
+            for t in delete:
+                self._delete(net, nid, t)
+            net.version += 1
+
+    # -- internals -----------------------------------------------------------
+
+    def _insert(self, net: _NetworkStore, nid: str, t: RelationTuple) -> None:
+        sid = shard_id(nid, t)
+        if sid in net.by_shard:
+            return  # idempotent
+        net.by_shard[sid] = t
+        bisect.insort(net.order, sid)
+        net.forward[(t.namespace, t.object, t.relation)].add(sid)
+        net.by_subject[_subject_key(t)].add(sid)
+
+    def _delete(self, net: _NetworkStore, nid: str, t: RelationTuple) -> None:
+        sid = shard_id(nid, t)
+        if sid not in net.by_shard:
+            return
+        del net.by_shard[sid]
+        idx = bisect.bisect_left(net.order, sid)
+        if idx < len(net.order) and net.order[idx] == sid:
+            net.order.pop(idx)
+        fwd = net.forward.get((t.namespace, t.object, t.relation))
+        if fwd is not None:
+            fwd.discard(sid)
+            if not fwd:
+                del net.forward[(t.namespace, t.object, t.relation)]
+        sub = net.by_subject.get(_subject_key(t))
+        if sub is not None:
+            sub.discard(sid)
+            if not sub:
+                del net.by_subject[_subject_key(t)]

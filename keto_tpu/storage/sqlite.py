@@ -1,0 +1,418 @@
+"""Durable SQLite persister.
+
+Mirrors the reference's final SQL schema (internal/persistence/sql/
+migrations/sql/20220513200300000000_create-intermediary-uuid-table.*):
+  - keto_relation_tuples_uuid: composite PK (shard_id, nid), UUID-encoded
+    object / subject_id / subject_set_object columns (dictionary encoding
+    via keto_uuid_mappings), string namespace / relation columns, CHECK
+    subject exclusivity, forward index on (nid, namespace, object,
+    relation) plus reverse subject indexes (partial, NULL-aware like the
+    reference's `…_reverse_subject_{ids,sets}_idx`)
+  - keto_uuid_mappings(id PK, string_representation): deterministic
+    UUIDv5 ids (see mapping.py), INSERT OR IGNORE idempotency
+    (uuid_mapping.go:31-66)
+
+plus a minimal migration box (versioned up/down/status) standing in for
+popx (internal/driver/registry_default.go:194-217, cmd/migrate).
+
+The persister speaks the public string Manager protocol; UUID encoding is
+internal, with JOINs against the mapping table on read — the same
+traffic shape as the reference's Mapper-wrapped SQL store.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+import uuid
+from typing import Iterable, Sequence
+
+from ..errors import NotFoundError
+from ..ketoapi import RelationQuery, RelationTuple, SubjectSet
+from .definitions import (
+    DEFAULT_NETWORK,
+    DEFAULT_PAGE_SIZE,
+    shard_id,
+    validate_page_token,
+)
+from .mapping import map_string_to_uuid
+
+MIGRATIONS: list[tuple[str, list[str], list[str]]] = [
+    (
+        "20220513200300_create_uuid_mappings",
+        [
+            # The reference table has no nid column (uuid_mapping.go); we
+            # add one so reverse lookups are tenant-scoped like the
+            # in-memory UUIDMappingManager — UUIDv5 already embeds the nid,
+            # so the composite key costs nothing and prevents cross-tenant
+            # string disclosure.
+            """
+            CREATE TABLE keto_uuid_mappings (
+                id TEXT NOT NULL,
+                nid TEXT NOT NULL,
+                string_representation TEXT NOT NULL,
+                PRIMARY KEY (id, nid)
+            )
+            """
+        ],
+        ["DROP TABLE keto_uuid_mappings"],
+    ),
+    (
+        "20220513200302_create_store_version",
+        [
+            """
+            CREATE TABLE keto_store_version (
+                nid TEXT PRIMARY KEY,
+                version INTEGER NOT NULL DEFAULT 0
+            )
+            """
+        ],
+        ["DROP TABLE keto_store_version"],
+    ),
+    (
+        "20220513200301_create_relation_tuples_uuid",
+        [
+            """
+            CREATE TABLE keto_relation_tuples_uuid (
+                shard_id TEXT NOT NULL,
+                nid TEXT NOT NULL,
+                namespace TEXT NOT NULL,
+                object TEXT NOT NULL,
+                relation TEXT NOT NULL,
+                subject_id TEXT NULL,
+                subject_set_namespace TEXT NULL,
+                subject_set_object TEXT NULL,
+                subject_set_relation TEXT NULL,
+                commit_time REAL NOT NULL DEFAULT (strftime('%s','now')),
+                PRIMARY KEY (shard_id, nid),
+                CHECK (
+                    (subject_id IS NOT NULL AND subject_set_namespace IS NULL
+                        AND subject_set_object IS NULL AND subject_set_relation IS NULL)
+                    OR
+                    (subject_id IS NULL AND subject_set_namespace IS NOT NULL
+                        AND subject_set_object IS NOT NULL AND subject_set_relation IS NOT NULL)
+                )
+            )
+            """,
+            """
+            CREATE INDEX keto_relation_tuples_uuid_full_idx
+                ON keto_relation_tuples_uuid (nid, namespace, object, relation)
+            """,
+            """
+            CREATE INDEX keto_relation_tuples_uuid_reverse_subject_ids_idx
+                ON keto_relation_tuples_uuid (nid, subject_id, relation, namespace)
+                WHERE subject_id IS NOT NULL
+            """,
+            """
+            CREATE INDEX keto_relation_tuples_uuid_reverse_subject_sets_idx
+                ON keto_relation_tuples_uuid
+                   (nid, subject_set_namespace, subject_set_object, subject_set_relation)
+                WHERE subject_set_namespace IS NOT NULL
+            """,
+        ],
+        ["DROP TABLE keto_relation_tuples_uuid"],
+    ),
+]
+
+_SELECT = """
+SELECT t.namespace, mo.string_representation, t.relation,
+       ms.string_representation, t.subject_set_namespace,
+       mss.string_representation, t.subject_set_relation, t.shard_id
+  FROM keto_relation_tuples_uuid t
+  JOIN keto_uuid_mappings mo ON mo.id = t.object AND mo.nid = t.nid
+  LEFT JOIN keto_uuid_mappings ms ON ms.id = t.subject_id AND ms.nid = t.nid
+  LEFT JOIN keto_uuid_mappings mss ON mss.id = t.subject_set_object AND mss.nid = t.nid
+"""
+
+
+class SQLitePersister:
+    """dsn: a filesystem path, or 'memory' / ':memory:' for in-process."""
+
+    def __init__(self, dsn: str = "memory", auto_migrate: bool = True):
+        path = ":memory:" if dsn in ("memory", ":memory:") else dsn
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute("PRAGMA foreign_keys=ON")
+        self._lock = threading.RLock()
+        if auto_migrate:
+            self.migrate_up()
+
+    # -- migration box (popx stand-in) ----------------------------------------
+
+    def _ensure_migration_table(self) -> None:
+        self._conn.execute(
+            """CREATE TABLE IF NOT EXISTS keto_migrations (
+                   version TEXT PRIMARY KEY,
+                   applied_at REAL NOT NULL DEFAULT (strftime('%s','now'))
+               )"""
+        )
+
+    def migration_status(self) -> list[tuple[str, str]]:
+        """[(version, 'Applied'|'Pending')], the `keto migrate status` view."""
+        with self._lock:
+            self._ensure_migration_table()
+            applied = {
+                row[0]
+                for row in self._conn.execute("SELECT version FROM keto_migrations")
+            }
+        return [
+            (version, "Applied" if version in applied else "Pending")
+            for version, _, _ in MIGRATIONS
+        ]
+
+    def migrate_up(self) -> None:
+        with self._lock:
+            self._ensure_migration_table()
+            applied = {
+                row[0]
+                for row in self._conn.execute("SELECT version FROM keto_migrations")
+            }
+            for version, ups, _ in MIGRATIONS:
+                if version in applied:
+                    continue
+                for stmt in ups:
+                    self._conn.execute(stmt)
+                self._conn.execute(
+                    "INSERT INTO keto_migrations (version) VALUES (?)", (version,)
+                )
+            self._conn.commit()
+
+    def migrate_down(self, steps: int = 1) -> None:
+        with self._lock:
+            self._ensure_migration_table()
+            applied = [
+                row[0]
+                for row in self._conn.execute(
+                    "SELECT version FROM keto_migrations ORDER BY version"
+                )
+            ]
+            by_version = {v: downs for v, _, downs in MIGRATIONS}
+            for version in reversed(applied[-steps:] if steps > 0 else []):
+                for stmt in by_version.get(version, []):
+                    self._conn.execute(stmt)
+                self._conn.execute(
+                    "DELETE FROM keto_migrations WHERE version = ?", (version,)
+                )
+            self._conn.commit()
+
+    # -- mapping helpers ------------------------------------------------------
+
+    def _ensure_mappings(self, nid: str, strings: Iterable[str]) -> dict[str, str]:
+        """Idempotently persist string→UUID mappings; returns str→uuid-str."""
+        out: dict[str, str] = {}
+        rows = []
+        for s in set(strings):
+            u = str(map_string_to_uuid(nid, s))
+            out[s] = u
+            rows.append((u, nid, s))
+        self._conn.executemany(
+            "INSERT OR IGNORE INTO keto_uuid_mappings (id, nid, string_representation)"
+            " VALUES (?, ?, ?)",
+            rows,
+        )
+        return out
+
+    # -- row (de)construction -------------------------------------------------
+
+    @staticmethod
+    def _row_to_tuple(row) -> RelationTuple:
+        ns, obj, rel, sid, ssn, sso, ssr = row[:7]
+        if sid is not None:
+            return RelationTuple(ns, obj, rel, subject_id=sid)
+        return RelationTuple(ns, obj, rel, subject_set=SubjectSet(ssn, sso, ssr))
+
+    def _tuple_row(self, nid: str, t: RelationTuple, m: dict[str, str]):
+        if t.subject_set is not None:
+            s = t.subject_set
+            return (
+                shard_id(nid, t), nid, t.namespace, m[t.object], t.relation,
+                None, s.namespace, m[s.object], s.relation,
+            )
+        return (
+            shard_id(nid, t), nid, t.namespace, m[t.object], t.relation,
+            m[t.subject_id or ""], None, None, None,
+        )
+
+    def _tuple_strings(self, t: RelationTuple) -> list[str]:
+        out = [t.object]
+        if t.subject_set is not None:
+            out.append(t.subject_set.object)
+        else:
+            out.append(t.subject_id or "")
+        return out
+
+    # -- query building -------------------------------------------------------
+
+    def _where(self, nid: str, query: RelationQuery):
+        clauses = ["t.nid = ?"]
+        params: list = [nid]
+        if query.namespace is not None:
+            clauses.append("t.namespace = ?")
+            params.append(query.namespace)
+        if query.object is not None:
+            clauses.append("t.object = ?")
+            params.append(str(map_string_to_uuid(nid, query.object)))
+        if query.relation is not None:
+            clauses.append("t.relation = ?")
+            params.append(query.relation)
+        # NULL-aware subject predicates hitting the partial reverse indexes
+        # (ref: internal/persistence/sql/relationtuples.go:124-144)
+        if query.subject_id is not None:
+            clauses.append("t.subject_id IS NOT NULL AND t.subject_id = ?")
+            params.append(str(map_string_to_uuid(nid, query.subject_id)))
+        elif query.subject_set is not None:
+            s = query.subject_set
+            clauses.append(
+                "t.subject_set_namespace IS NOT NULL"
+                " AND t.subject_set_namespace = ?"
+                " AND t.subject_set_object = ?"
+                " AND t.subject_set_relation = ?"
+            )
+            params.extend(
+                (s.namespace, str(map_string_to_uuid(nid, s.object)), s.relation)
+            )
+        return " AND ".join(clauses), params
+
+    # -- Manager protocol -----------------------------------------------------
+
+    def get_relation_tuples(
+        self,
+        query: RelationQuery,
+        page_token: str = "",
+        page_size: int = DEFAULT_PAGE_SIZE,
+        nid: str = DEFAULT_NETWORK,
+    ) -> tuple[list[RelationTuple], str]:
+        token = validate_page_token(page_token)
+        if page_size <= 0:
+            page_size = DEFAULT_PAGE_SIZE
+        where, params = self._where(nid, query)
+        sql = _SELECT + f" WHERE {where}"
+        if token:
+            sql += " AND t.shard_id > ?"
+            params.append(token)
+        # N+1 probe for the next-page indicator (relationtuples.go:203-244)
+        sql += " ORDER BY t.shard_id LIMIT ?"
+        params.append(page_size + 1)
+        with self._lock:
+            rows = self._conn.execute(sql, params).fetchall()
+        next_token = ""
+        if len(rows) > page_size:
+            rows = rows[:page_size]
+            next_token = rows[-1][7]
+        return [self._row_to_tuple(r) for r in rows], next_token
+
+    def relation_tuple_exists(
+        self, t: RelationTuple, nid: str = DEFAULT_NETWORK
+    ) -> bool:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT 1 FROM keto_relation_tuples_uuid WHERE shard_id = ? AND nid = ?",
+                (shard_id(nid, t), nid),
+            ).fetchone()
+        return row is not None
+
+    def all_relation_tuples(self, nid: str = DEFAULT_NETWORK) -> list[RelationTuple]:
+        with self._lock:
+            rows = self._conn.execute(
+                _SELECT + " WHERE t.nid = ? ORDER BY t.shard_id", (nid,)
+            ).fetchall()
+        return [self._row_to_tuple(r) for r in rows]
+
+    def version(self, nid: str = DEFAULT_NETWORK) -> int:
+        """Durable per-nid write counter (device-mirror staleness signal);
+        survives reopen, unaffected by other tenants' writes."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT version FROM keto_store_version WHERE nid = ?", (nid,)
+            ).fetchone()
+        return row[0] if row else 0
+
+    def _bump_version(self, nid: str) -> None:
+        self._conn.execute(
+            "INSERT INTO keto_store_version (nid, version) VALUES (?, 1) "
+            "ON CONFLICT(nid) DO UPDATE SET version = version + 1",
+            (nid,),
+        )
+
+    def write_relation_tuples(
+        self, tuples: Sequence[RelationTuple], nid: str = DEFAULT_NETWORK
+    ) -> None:
+        self.transact_relation_tuples(tuples, (), nid=nid)
+
+    def delete_relation_tuples(
+        self, tuples: Sequence[RelationTuple], nid: str = DEFAULT_NETWORK
+    ) -> None:
+        self.transact_relation_tuples((), tuples, nid=nid)
+
+    def delete_all_relation_tuples(
+        self, query: RelationQuery, nid: str = DEFAULT_NETWORK
+    ) -> None:
+        where, params = self._where(nid, query)
+        # the WHERE clause (incl. its nid guard) applies directly to the
+        # DELETE; "t" aliases the deleted table itself
+        with self._lock, self._conn:
+            self._conn.execute(
+                f"DELETE FROM keto_relation_tuples_uuid AS t WHERE {where}", params
+            )
+            self._bump_version(nid)
+
+    def transact_relation_tuples(
+        self,
+        insert: Sequence[RelationTuple],
+        delete: Sequence[RelationTuple],
+        nid: str = DEFAULT_NETWORK,
+    ) -> None:
+        with self._lock, self._conn:  # one transaction, like popx.Transaction
+            strings: list[str] = []
+            for t in insert:
+                strings.extend(self._tuple_strings(t))
+            m = self._ensure_mappings(nid, strings)
+            self._conn.executemany(
+                "INSERT OR IGNORE INTO keto_relation_tuples_uuid "
+                "(shard_id, nid, namespace, object, relation, subject_id, "
+                " subject_set_namespace, subject_set_object, subject_set_relation) "
+                "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                [self._tuple_row(nid, t, m) for t in insert],
+            )
+            self._conn.executemany(
+                "DELETE FROM keto_relation_tuples_uuid WHERE shard_id = ? AND nid = ?",
+                [(shard_id(nid, t), nid) for t in delete],
+            )
+            self._bump_version(nid)
+
+    # -- mapping manager protocol (durable) -----------------------------------
+
+    def map_strings_to_uuids(
+        self, strings: Sequence[str], nid: str = DEFAULT_NETWORK
+    ) -> list[uuid.UUID]:
+        with self._lock, self._conn:
+            m = self._ensure_mappings(nid, strings)
+        return [uuid.UUID(m[s]) for s in strings]
+
+    def map_uuids_to_strings(
+        self, uuids: Sequence[uuid.UUID], nid: str = DEFAULT_NETWORK
+    ) -> list[str]:
+        # one batched IN-query per call, like the reference's paginated
+        # batch with duplicate-index fixup (uuid_mapping.go:68-114)
+        distinct = list({str(u) for u in uuids})
+        found: dict[str, str] = {}
+        with self._lock:
+            for i in range(0, len(distinct), 500):  # stay under host-param cap
+                chunk = distinct[i : i + 500]
+                placeholders = ",".join("?" * len(chunk))
+                rows = self._conn.execute(
+                    "SELECT id, string_representation FROM keto_uuid_mappings"
+                    f" WHERE nid = ? AND id IN ({placeholders})",
+                    [nid, *chunk],
+                ).fetchall()
+                found.update(rows)
+        out = []
+        for u in uuids:
+            try:
+                out.append(found[str(u)])
+            except KeyError:
+                raise NotFoundError(f"no mapping for uuid {u}")
+        return out
+
+    def close(self) -> None:
+        self._conn.close()

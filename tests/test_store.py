@@ -1,0 +1,257 @@
+"""Storage conformance suite run against every backend, mirroring the
+reference's reusable suites: ManagerTest (internal/relationtuple/
+manager_requirements.go:20-444), IsolationTest (manager_isolation.go:41-129),
+and MappingManagerTest (uuid_mapping.go:358-397)."""
+
+import uuid
+
+import pytest
+
+from keto_tpu import errors
+from keto_tpu.ketoapi import RelationQuery, RelationTuple, SubjectSet
+from keto_tpu.storage import MemoryManager, SQLitePersister
+from keto_tpu.storage.mapping import Mapper, UUIDMappingManager, map_string_to_uuid
+
+
+def ts(*strs):
+    return [RelationTuple.from_string(s) for s in strs]
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def store(request):
+    if request.param == "memory":
+        return MemoryManager()
+    return SQLitePersister("memory")
+
+
+class TestManagerConformance:
+    def test_write_and_get(self, store):
+        tuples = ts(
+            "n:obj#rel@user1",
+            "n:obj#rel@user2",
+            "n:obj#rel2@(n:obj2#rel)",
+            "n2:obj#rel@user1",
+        )
+        store.write_relation_tuples(tuples)
+        got, token = store.get_relation_tuples(RelationQuery())
+        assert token == ""
+        assert set(got) == set(tuples)
+
+    def test_query_shapes(self, store):
+        tuples = ts(
+            "n:o#r@u1", "n:o#r@u2", "n:o#r2@u1", "n:o2#r@u1",
+            "n:o#r@(x:y#z)", "m:o#r@u1",
+        )
+        store.write_relation_tuples(tuples)
+        cases = [
+            (RelationQuery(namespace="n"), 5),
+            (RelationQuery(namespace="n", object="o"), 4),
+            (RelationQuery(namespace="n", object="o", relation="r"), 3),
+            (RelationQuery.make(namespace="n", object="o", relation="r", subject="u1"), 1),
+            (RelationQuery.make(subject="u1"), 4),
+            (RelationQuery.make(subject=SubjectSet("x", "y", "z")), 1),
+            (RelationQuery(relation="r2"), 1),
+            (RelationQuery(namespace="missing"), 0),
+        ]
+        for q, want in cases:
+            got, _ = store.get_relation_tuples(q)
+            assert len(got) == want, f"query {q} -> {got}"
+
+    def test_exists(self, store):
+        t = ts("n:o#r@u")[0]
+        assert not store.relation_tuple_exists(t)
+        store.write_relation_tuples([t])
+        assert store.relation_tuple_exists(t)
+        assert not store.relation_tuple_exists(ts("n:o#r@v")[0])
+
+    def test_idempotent_insert(self, store):
+        t = ts("n:o#r@u")[0]
+        store.write_relation_tuples([t])
+        store.write_relation_tuples([t])
+        got, _ = store.get_relation_tuples(RelationQuery())
+        assert len(got) == 1
+
+    def test_pagination(self, store):
+        tuples = ts(*[f"n:o#r@user-{i}" for i in range(25)])
+        store.write_relation_tuples(tuples)
+        seen = []
+        token = ""
+        pages = 0
+        while True:
+            got, token = store.get_relation_tuples(
+                RelationQuery(namespace="n"), page_token=token, page_size=10
+            )
+            seen.extend(got)
+            pages += 1
+            if not token:
+                break
+        assert pages == 3
+        assert len(seen) == 25
+        assert set(seen) == set(tuples)
+        # exact page boundary: 25 items / 25 page size -> one page, no token
+        got, token = store.get_relation_tuples(
+            RelationQuery(namespace="n"), page_size=25
+        )
+        assert len(got) == 25 and token == ""
+
+    def test_invalid_page_token(self, store):
+        with pytest.raises(errors.InvalidPageTokenError):
+            store.get_relation_tuples(RelationQuery(), page_token="not-a-uuid")
+
+    def test_delete(self, store):
+        tuples = ts("n:o#r@u1", "n:o#r@u2", "n:o#r@u3")
+        store.write_relation_tuples(tuples)
+        store.delete_relation_tuples([tuples[0]])
+        got, _ = store.get_relation_tuples(RelationQuery())
+        assert set(got) == set(tuples[1:])
+        # deleting a non-existent tuple is a no-op
+        store.delete_relation_tuples(ts("nope:o#r@u"))
+
+    def test_delete_all_by_query(self, store):
+        tuples = ts("n:o#r@u1", "n:o#r@u2", "n:o2#r@u1", "n:o#r@(x:y#z)")
+        store.write_relation_tuples(tuples)
+        store.delete_all_relation_tuples(RelationQuery(namespace="n", object="o"))
+        got, _ = store.get_relation_tuples(RelationQuery())
+        assert got == [tuples[2]]
+
+    def test_delete_all_by_subject(self, store):
+        tuples = ts("n:o#r@u1", "n:o2#r@u1", "n:o#r@u2")
+        store.write_relation_tuples(tuples)
+        store.delete_all_relation_tuples(RelationQuery.make(subject="u1"))
+        got, _ = store.get_relation_tuples(RelationQuery())
+        assert got == [tuples[2]]
+
+    def test_transact(self, store):
+        a, b, c = ts("n:o#r@a", "n:o#r@b", "n:o#r@c")
+        store.write_relation_tuples([a, b])
+        store.transact_relation_tuples(insert=[c], delete=[a])
+        got, _ = store.get_relation_tuples(RelationQuery())
+        assert set(got) == {b, c}
+
+    def test_all_relation_tuples(self, store):
+        tuples = ts("n:o#r@u1", "m:o#r@(a:b#c)")
+        store.write_relation_tuples(tuples)
+        assert set(store.all_relation_tuples()) == set(tuples)
+
+
+class TestIsolation:
+    """Two network ids never leak into each other.
+    ref: internal/relationtuple/manager_isolation.go:41-129"""
+
+    def test_nid_isolation(self, store):
+        t1, t2 = ts("n:o#r@u1", "n:o#r@u2")
+        store.write_relation_tuples([t1], nid="net-a")
+        store.write_relation_tuples([t2], nid="net-b")
+        got_a, _ = store.get_relation_tuples(RelationQuery(), nid="net-a")
+        got_b, _ = store.get_relation_tuples(RelationQuery(), nid="net-b")
+        assert got_a == [t1] and got_b == [t2]
+        assert store.relation_tuple_exists(t1, nid="net-a")
+        assert not store.relation_tuple_exists(t1, nid="net-b")
+        store.delete_all_relation_tuples(RelationQuery(), nid="net-a")
+        assert store.all_relation_tuples(nid="net-b") == [t2]
+
+
+@pytest.fixture(params=["memory-mapping", "sqlite-mapping"])
+def mapping(request):
+    if request.param == "memory-mapping":
+        return UUIDMappingManager()
+    return SQLitePersister("memory")
+
+
+class TestMapping:
+    """ref: internal/relationtuple/uuid_mapping.go:358-397 (determinism,
+    batching) + internal/persistence/sql/uuid_mapping.go (idempotency)."""
+
+    def test_deterministic(self, mapping):
+        u1 = mapping.map_strings_to_uuids(["hello"])
+        u2 = mapping.map_strings_to_uuids(["hello"])
+        assert u1 == u2
+        assert u1[0] == map_string_to_uuid("default", "hello")
+
+    def test_nid_scoped(self, mapping):
+        a = mapping.map_strings_to_uuids(["x"], nid="a")[0]
+        b = mapping.map_strings_to_uuids(["x"], nid="b")[0]
+        assert a != b
+
+    def test_round_trip_batch(self, mapping):
+        strings = [f"s{i}" for i in range(10)] + ["s0"]  # with duplicate
+        uuids = mapping.map_strings_to_uuids(strings)
+        assert uuids[0] == uuids[-1]
+        back = mapping.map_uuids_to_strings(uuids)
+        assert back == strings
+
+    def test_unknown_uuid(self, mapping):
+        with pytest.raises(errors.NotFoundError):
+            mapping.map_uuids_to_strings([uuid.uuid4()])
+
+
+class TestMapper:
+    def test_tuple_round_trip(self):
+        mapper = Mapper(UUIDMappingManager())
+        tuples = ts("n:o#r@u", "n:o#r@(a:b#c)")
+        internal = mapper.from_tuples(tuples)
+        assert internal[0].subject_id is not None
+        assert internal[1].subject_set is not None
+        back = mapper.to_tuples(internal)
+        assert back == tuples
+
+
+class TestMigrations:
+    def test_status_and_down(self):
+        p = SQLitePersister("memory", auto_migrate=False)
+        assert all(s == "Pending" for _, s in p.migration_status())
+        p.migrate_up()
+        assert all(s == "Applied" for _, s in p.migration_status())
+        p.migrate_down(2)
+        status = dict(p.migration_status())
+        assert status["20220513200302_create_store_version"] == "Pending"
+        assert status["20220513200301_create_relation_tuples_uuid"] == "Pending"
+        assert status["20220513200300_create_uuid_mappings"] == "Applied"
+        p.migrate_up()
+        p.write_relation_tuples(ts("n:o#r@u"))
+        assert p.relation_tuple_exists(ts("n:o#r@u")[0])
+
+    def test_check_constraint(self):
+        p = SQLitePersister("memory")
+        import sqlite3
+
+        with pytest.raises(sqlite3.IntegrityError):
+            p._conn.execute(
+                "INSERT INTO keto_relation_tuples_uuid "
+                "(shard_id, nid, namespace, object, relation) "
+                "VALUES ('x', 'n', 'ns', 'obj', 'rel')"
+            )
+
+
+class TestRegressions:
+    """Cases from review findings."""
+
+    def test_shard_id_not_fooled_by_display_string(self, store):
+        # subject_id that *looks like* a subject set must not alias one
+        a = RelationTuple("n", "o", "r", subject_id="(a:b#c)")
+        b = RelationTuple("n", "o", "r", subject_set=SubjectSet("a", "b", "c"))
+        store.write_relation_tuples([a])
+        assert not store.relation_tuple_exists(b)
+        store.write_relation_tuples([b])
+        got, _ = store.get_relation_tuples(RelationQuery())
+        assert len(got) == 2
+        store.delete_relation_tuples([a])
+        assert store.relation_tuple_exists(b)
+
+    def test_separator_chars_in_fields(self, store):
+        a = RelationTuple.make("n", "b#c", "r", "u")
+        b = RelationTuple.make("n", "b", "c#r", "u")
+        store.write_relation_tuples([a, b])
+        got, _ = store.get_relation_tuples(RelationQuery())
+        assert len(got) == 2
+
+    def test_mapping_reverse_lookup_is_nid_scoped(self, mapping):
+        u = mapping.map_strings_to_uuids(["secret-doc"], nid="tenant-a")
+        with pytest.raises(errors.NotFoundError):
+            mapping.map_uuids_to_strings(u, nid="tenant-b")
+
+    def test_version_per_nid(self, store):
+        v0 = store.version(nid="a")
+        store.write_relation_tuples(ts("n:o#r@u"), nid="a")
+        assert store.version(nid="a") == v0 + 1
+        assert store.version(nid="b") == 0
