@@ -22,7 +22,7 @@ import json
 import logging
 import os
 import tomllib
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Mapping, Optional
 
 import yaml
@@ -33,11 +33,12 @@ from .opl import parser as opl_parser
 
 logger = logging.getLogger("keto_tpu.config")
 
+from .storage.definitions import DEFAULT_PAGE_SIZE
+
 DEFAULT_MAX_READ_DEPTH = 5  # ref: embedx/config.schema.json limit.max_read_depth
 DEFAULT_READ_PORT = 4466
 DEFAULT_WRITE_PORT = 4467
 DEFAULT_METRICS_PORT = 4468
-DEFAULT_PAGE_SIZE = 100  # ref: internal/persistence/sql/persister.go:37-39
 
 
 class ConfigError(KetoError):
@@ -84,18 +85,23 @@ class NamespaceFileManager:
         return [loc]
 
     @staticmethod
-    def parse_file(path: str) -> list[Namespace]:
+    def parse_opl(source: str, origin: str) -> list[Namespace]:
+        """Parse OPL source; `origin` names the file(s) in errors."""
+        namespaces, errs = opl_parser.parse(source)
+        if errs:
+            raise ConfigError(
+                f"could not parse {origin}: " + "; ".join(e.msg for e in errs)
+            )
+        return namespaces
+
+    @classmethod
+    def parse_file(cls, path: str) -> list[Namespace]:
         """Parse one namespace file by extension.
         ref: namespace_watcher.go:228-239 (yaml/json/toml by extension)."""
         ext = path.rsplit(".", 1)[-1].lower()
         if ext == "ts":
             with open(path, "r") as f:
-                namespaces, errs = opl_parser.parse(f.read())
-            if errs:
-                raise ConfigError(
-                    f"could not parse {path}: " + "; ".join(e.msg for e in errs)
-                )
-            return namespaces
+                return cls.parse_opl(f.read(), path)
         with open(path, "rb") as f:
             if ext in ("yaml", "yml"):
                 raw = yaml.safe_load(f)
@@ -120,22 +126,20 @@ class NamespaceFileManager:
             # files, so all OPL sources are parsed as one merged document
             # before the per-file formats.
             opl_sources = []
+            opl_paths = []
             for path in files:
                 mtimes[path] = os.stat(path).st_mtime
                 if path.rsplit(".", 1)[-1].lower() == "ts":
+                    opl_paths.append(path)
                     with open(path, "r") as f:
                         opl_sources.append(f.read())
                 else:
                     for ns in self.parse_file(path):
                         new[ns.name] = ns
             if opl_sources:
-                namespaces, errs = opl_parser.parse("\n".join(opl_sources))
-                if errs:
-                    raise ConfigError(
-                        "could not parse OPL namespaces: "
-                        + "; ".join(e.msg for e in errs)
-                    )
-                for ns in namespaces:
+                for ns in self.parse_opl(
+                    "\n".join(opl_sources), ", ".join(opl_paths)
+                ):
                     new[ns.name] = ns
         except Exception as e:  # any parse/shape error must not kill serving
             if initial:
@@ -180,7 +184,9 @@ class NamespaceFileManager:
         return list(self._namespaces.values())
 
     def should_reload(self, namespaces: object) -> bool:
-        return True
+        # file-backed manager reloads itself on access; callers never need
+        # to rebuild it
+        return False
 
 
 class Config:

@@ -1,0 +1,54 @@
+"""Engine result types: the three-valued membership lattice and check
+results with proof trees.
+
+Parity with internal/check/checkgroup/definitions.go:46-74:
+Membership ∈ {Unknown, IsMember, NotMember} (iota order preserved),
+Result{Membership, Tree, Err}.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import IntEnum
+from typing import Optional
+
+from ..ketoapi import RelationTuple, Tree, TreeNodeType
+
+
+class Membership(IntEnum):
+    # ref: checkgroup/definitions.go:65-69 (iota: Unknown, IsMember, NotMember)
+    UNKNOWN = 0
+    IS_MEMBER = 1
+    NOT_MEMBER = 2
+
+
+@dataclass
+class CheckResult:
+    membership: Membership
+    tree: Optional[Tree] = None
+    error: Optional[Exception] = None
+
+    @property
+    def allowed(self) -> bool:
+        """Unknown at the top is reported as not-a-member
+        (ref: internal/check/engine.go:54-60)."""
+        return self.membership == Membership.IS_MEMBER
+
+
+RESULT_IS_MEMBER = CheckResult(Membership.IS_MEMBER)
+RESULT_NOT_MEMBER = CheckResult(Membership.NOT_MEMBER)
+RESULT_UNKNOWN = CheckResult(Membership.UNKNOWN)
+
+
+def leaf(t: RelationTuple) -> Tree:
+    return Tree(type=TreeNodeType.LEAF, tuple=t)
+
+
+def with_edge(edge_type: TreeNodeType, edge_tuple: RelationTuple, result: CheckResult) -> CheckResult:
+    """Wrap a child result's tree in an edge node, mirroring
+    checkgroup.WithEdge (checkgroup/definitions.go:101-124)."""
+    if result.tree is None:
+        tree = leaf(edge_tuple)
+    else:
+        tree = Tree(type=edge_type, tuple=edge_tuple, children=[result.tree])
+    return CheckResult(result.membership, tree, result.error)

@@ -1,0 +1,429 @@
+"""Host reference engine: exact Keto check/expand semantics, sequentially.
+
+This is (a) the differential-test oracle for the TPU kernel and (b) the
+fallback evaluator for the non-monotone rewrite fragment (AND/NOT islands)
+and for queries whose graph region has pending deltas.
+
+Semantics replicated precisely from the reference:
+  - checkIsAllowed = OR{checkDirect(d-1), checkExpandSubject(d),
+    rewrite(d)} with short-circuit on IsMember/error and Unknown swallowed
+    to NotMember by the OR (internal/check/engine.go:183-207,
+    checkgroup/concurrent_checkgroup.go:110-120, binop.go:15-36)
+  - depth bookkeeping: guard `restDepth < 0 -> Unknown` at every entry
+    point; direct gets d-1, expand-subject recurses with d-1, computed
+    subject set recurses with the SAME d, tuple-to-subject-set recurses
+    with d-1 (engine.go:87-177, rewrites.go:30-260)
+  - visited-set cycle cut threaded through the whole check, marking every
+    expanded subject (plain or set) and pruning re-visits; applies only to
+    the expand-subject path (engine.go:106-121, x/graph/graph_utils.go)
+  - wildcard relation "..." is never expanded via expand-subject but IS
+    traversed by tuple-to-subject-set (engine.go:124, rewrites.go:242-256)
+  - and: first non-IsMember -> NotMember (errors propagate); or: first
+    IsMember wins, else NotMember; not: flips IsMember/NotMember, keeps
+    Unknown (binop.go:38-70, rewrites.go:142-159)
+  - unknown namespace -> no rewrite, no error; namespace with relations
+    but missing relation -> error (engine.go:209-241)
+  - proof trees: direct hits are leaves; rewrite children wrapped in edge
+    nodes; and collects an intersection tree (checkgroup definitions)
+
+The evaluation order (direct, expand-subject, rewrite) is one legal
+schedule of the reference's concurrent checkgroup, making results
+deterministic here.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..config import Config
+from ..errors import RelationNotFoundError, NamespaceNotFoundError
+from ..ketoapi import (
+    RelationQuery,
+    RelationTuple,
+    Subject,
+    SubjectSet,
+    Tree,
+    TreeNodeType,
+    subject_unique_id,
+)
+from ..namespace import ast
+from ..storage.definitions import DEFAULT_NETWORK, Manager
+from .definitions import (
+    RESULT_NOT_MEMBER,
+    RESULT_UNKNOWN,
+    CheckResult,
+    Membership,
+    leaf,
+    with_edge,
+)
+
+WILDCARD_RELATION = "..."  # ref: internal/check/engine.go:40
+
+
+class ReferenceEngine:
+    """Check + Expand over a tuple Manager, exact reference semantics."""
+
+    def __init__(self, manager: Manager, config: Config, *, visited_pruning: bool = True):
+        self.manager = manager
+        self.config = config
+        # visited_pruning=False disables the reference's visited-set pruning
+        # (which can miss members when a subject is first reached at an
+        # exhausted depth); the TPU kernel explores more completely, so
+        # differential tests on cyclic graphs compare against this mode.
+        self.visited_pruning = visited_pruning
+
+    # -- public API -----------------------------------------------------------
+
+    def check_relation_tuple(
+        self, r: RelationTuple, max_depth: int = 0, nid: str = DEFAULT_NETWORK
+    ) -> CheckResult:
+        """ref: engine.go:65-80 (global max-depth precedence)."""
+        rest_depth = self._clamp_depth(max_depth)
+        try:
+            return self._check_is_allowed(r, rest_depth, set(), nid)
+        except Exception as e:  # error-as-value at the top, like Result.Err
+            return CheckResult(Membership.UNKNOWN, error=e)
+
+    def check_is_member(
+        self, r: RelationTuple, max_depth: int = 0, nid: str = DEFAULT_NETWORK
+    ) -> bool:
+        res = self.check_relation_tuple(r, max_depth, nid)
+        if res.error is not None:
+            raise res.error
+        return res.membership == Membership.IS_MEMBER
+
+    def expand(
+        self, subject: Subject, max_depth: int = 0, nid: str = DEFAULT_NETWORK
+    ) -> Optional[Tree]:
+        """ref: internal/expand/engine.go:35-104."""
+        rest_depth = self._clamp_depth(max_depth)
+        return self._build_tree(subject, rest_depth, set(), nid)
+
+    def _clamp_depth(self, requested: int) -> int:
+        global_max = self.config.max_read_depth()
+        if requested <= 0 or global_max < requested:
+            return global_max
+        return requested
+
+    # -- check ----------------------------------------------------------------
+
+    def _check_is_allowed(
+        self, r: RelationTuple, rest_depth: int, visited: set[str], nid: str
+    ) -> CheckResult:
+        # ref: engine.go:183-207
+        if rest_depth < 0:
+            return RESULT_UNKNOWN
+
+        # OR group, sequentially: direct, expand-subject, rewrite.
+        res = self._check_direct(r, rest_depth - 1, nid)
+        if res.membership == Membership.IS_MEMBER:
+            return res
+
+        res = self._check_expand_subject(r, rest_depth, visited, nid)
+        if res.membership == Membership.IS_MEMBER:
+            return res
+
+        relation = self._ast_relation_for(r, nid)
+        if relation is not None and relation.subject_set_rewrite is not None:
+            res = self._check_subject_set_rewrite(
+                r, relation.subject_set_rewrite, rest_depth, visited, nid
+            )
+            if res.error is not None:
+                raise res.error
+            if res.membership == Membership.IS_MEMBER:
+                return res
+
+        # Unknowns swallowed: the checkgroup returns NotMember when all
+        # children finished without IsMember (concurrent_checkgroup.go:97-120)
+        return RESULT_NOT_MEMBER
+
+    def _check_direct(
+        self, r: RelationTuple, rest_depth: int, nid: str
+    ) -> CheckResult:
+        # ref: engine.go:148-177
+        if rest_depth < 0:
+            return RESULT_UNKNOWN
+        if self.manager.relation_tuple_exists(r, nid=nid):
+            return CheckResult(Membership.IS_MEMBER, tree=leaf(r))
+        return RESULT_NOT_MEMBER
+
+    def _check_expand_subject(
+        self, r: RelationTuple, rest_depth: int, visited: set[str], nid: str
+    ) -> CheckResult:
+        # ref: engine.go:87-145
+        if rest_depth < 0:
+            return RESULT_UNKNOWN
+        query = RelationQuery(
+            namespace=r.namespace, object=r.object, relation=r.relation
+        )
+        page_token = ""
+        while True:
+            subjects, page_token = self.manager.get_relation_tuples(
+                query, page_token=page_token, nid=nid
+            )
+            for s in subjects:
+                uid = subject_unique_id(s.subject)
+                if self.visited_pruning:
+                    if uid in visited:
+                        continue
+                    visited.add(uid)
+                sset = s.subject_set
+                if sset is None or sset.relation == WILDCARD_RELATION:
+                    continue
+                res = self._check_is_allowed(
+                    RelationTuple(
+                        namespace=sset.namespace,
+                        object=sset.object,
+                        relation=sset.relation,
+                        subject_id=r.subject_id,
+                        subject_set=r.subject_set,
+                    ),
+                    rest_depth - 1,
+                    visited,
+                    nid,
+                )
+                if res.membership == Membership.IS_MEMBER:
+                    return res
+            if not page_token:
+                break
+        return RESULT_NOT_MEMBER
+
+    def _ast_relation_for(
+        self, r: RelationTuple, nid: str
+    ) -> Optional[ast.Relation]:
+        # ref: engine.go:209-241 — unknown namespace is NOT an error (the
+        # answer should be "not allowed", not "not found"); a namespace with
+        # a non-empty relation config but a missing relation IS an error.
+        try:
+            ns = self.config.namespace_manager().get_namespace_by_name(r.namespace)
+        except NamespaceNotFoundError:
+            return None
+        if not ns.relations:
+            return None
+        rel = ns.relation(r.relation)
+        if rel is None:
+            raise RelationNotFoundError(r.relation)
+        return rel
+
+    # -- userset rewrites (ref: internal/check/rewrites.go) -------------------
+
+    def _check_subject_set_rewrite(
+        self,
+        r: RelationTuple,
+        rewrite: ast.SubjectSetRewrite,
+        rest_depth: int,
+        visited: set[str],
+        nid: str,
+    ) -> CheckResult:
+        # ref: rewrites.go:30-93
+        if rest_depth < 0:
+            return RESULT_UNKNOWN
+        checks = [
+            lambda c=child: self._check_rewrite_child(r, c, rest_depth, visited, nid)
+            for child in rewrite.children
+        ]
+        if rewrite.operation == ast.Operator.AND:
+            return self._and(checks)
+        return self._or(checks)
+
+    def _check_rewrite_child(
+        self,
+        r: RelationTuple,
+        child: ast.Child,
+        rest_depth: int,
+        visited: set[str],
+        nid: str,
+    ) -> CheckResult:
+        if isinstance(child, ast.TupleToSubjectSet):
+            return with_edge(
+                TreeNodeType.TUPLE_TO_SUBJECT_SET, r,
+                self._check_ttu(r, child, rest_depth, visited, nid),
+            )
+        if isinstance(child, ast.ComputedSubjectSet):
+            return with_edge(
+                TreeNodeType.COMPUTED_SUBJECT_SET, r,
+                self._check_computed(r, child, rest_depth, visited, nid),
+            )
+        if isinstance(child, ast.SubjectSetRewrite):
+            edge = (
+                TreeNodeType.INTERSECTION
+                if child.operation == ast.Operator.AND
+                else TreeNodeType.UNION
+            )
+            return with_edge(
+                edge, r,
+                self._check_subject_set_rewrite(r, child, rest_depth, visited, nid),
+            )
+        if isinstance(child, ast.InvertResult):
+            return with_edge(
+                TreeNodeType.NOT, r,
+                self._check_inverted(r, child, rest_depth, visited, nid),
+            )
+        raise NotImplementedError(f"unknown rewrite child {type(child)}")
+
+    def _check_inverted(
+        self,
+        r: RelationTuple,
+        inverted: ast.InvertResult,
+        rest_depth: int,
+        visited: set[str],
+        nid: str,
+    ) -> CheckResult:
+        # ref: rewrites.go:95-159 — flip IsMember/NotMember, Unknown stays
+        if rest_depth < 0:
+            return RESULT_UNKNOWN
+        res = self._check_rewrite_child(r, inverted.child, rest_depth, visited, nid)
+        if res.membership == Membership.IS_MEMBER:
+            return CheckResult(Membership.NOT_MEMBER, res.tree, res.error)
+        if res.membership == Membership.NOT_MEMBER:
+            return CheckResult(Membership.IS_MEMBER, res.tree, res.error)
+        return res
+
+    def _check_computed(
+        self,
+        r: RelationTuple,
+        computed: ast.ComputedSubjectSet,
+        rest_depth: int,
+        visited: set[str],
+        nid: str,
+    ) -> CheckResult:
+        # ref: rewrites.go:161-193 — NOTE: recurses with the SAME depth
+        if rest_depth < 0:
+            return RESULT_UNKNOWN
+        return self._check_is_allowed(
+            RelationTuple(
+                namespace=r.namespace,
+                object=r.object,
+                relation=computed.relation,
+                subject_id=r.subject_id,
+                subject_set=r.subject_set,
+            ),
+            rest_depth,
+            visited,
+            nid,
+        )
+
+    def _check_ttu(
+        self,
+        r: RelationTuple,
+        ttu: ast.TupleToSubjectSet,
+        rest_depth: int,
+        visited: set[str],
+        nid: str,
+    ) -> CheckResult:
+        # ref: rewrites.go:195-260 — query obj#<ttu.relation>, and for each
+        # subject-SET subject check <set.ns>:<set.obj>#<computed>@subject
+        # with depth-1. Plain subject ids are skipped; wildcard-relation
+        # sets are traversed (no filter here, unlike expand-subject).
+        if rest_depth < 0:
+            return RESULT_UNKNOWN
+        query = RelationQuery(
+            namespace=r.namespace, object=r.object, relation=ttu.relation
+        )
+        page_token = ""
+        while True:
+            tuples, page_token = self.manager.get_relation_tuples(
+                query, page_token=page_token, nid=nid
+            )
+            for t in tuples:
+                sset = t.subject_set
+                if sset is None:
+                    continue
+                res = self._check_is_allowed(
+                    RelationTuple(
+                        namespace=sset.namespace,
+                        object=sset.object,
+                        relation=ttu.computed_subject_set_relation,
+                        subject_id=r.subject_id,
+                        subject_set=r.subject_set,
+                    ),
+                    rest_depth - 1,
+                    visited,
+                    nid,
+                )
+                if res.membership == Membership.IS_MEMBER:
+                    return res
+            if not page_token:
+                break
+        return RESULT_NOT_MEMBER
+
+    # -- binary operators (ref: internal/check/binop.go) ----------------------
+
+    def _or(self, checks) -> CheckResult:
+        if not checks:
+            return RESULT_NOT_MEMBER
+        for check in checks:
+            res = check()
+            if res.error is not None or res.membership == Membership.IS_MEMBER:
+                return res
+        return RESULT_NOT_MEMBER
+
+    def _and(self, checks) -> CheckResult:
+        if not checks:
+            return RESULT_NOT_MEMBER
+        tree = Tree(type=TreeNodeType.INTERSECTION, children=[])
+        for check in checks:
+            res = check()
+            if res.error is not None or res.membership != Membership.IS_MEMBER:
+                return CheckResult(Membership.NOT_MEMBER, error=res.error)
+            tree.children.append(res.tree)
+        return CheckResult(Membership.IS_MEMBER, tree=tree)
+
+    # -- expand (ref: internal/expand/engine.go) ------------------------------
+
+    def _build_tree(
+        self, subject: Subject, rest_depth: int, visited: set[str], nid: str
+    ) -> Optional[Tree]:
+        if not isinstance(subject, SubjectSet):
+            # a plain SubjectID is always a leaf (engine.go:99-103)
+            return Tree(
+                type=TreeNodeType.LEAF,
+                tuple=RelationTuple(
+                    namespace="", object="", relation="", subject_id=subject
+                ),
+            )
+        uid = subject_unique_id(subject)
+        if uid in visited:
+            return None
+        visited.add(uid)
+
+        sub_tree = Tree(
+            type=TreeNodeType.UNION,
+            tuple=RelationTuple(
+                namespace="", object="", relation="", subject_set=subject
+            ),
+        )
+        query = RelationQuery(
+            namespace=subject.namespace,
+            object=subject.object,
+            relation=subject.relation,
+        )
+        page_token = ""
+        first_page = True
+        while True:
+            rels, page_token = self.manager.get_relation_tuples(
+                query, page_token=page_token, nid=nid
+            )
+            if first_page and not rels:
+                return None  # engine.go:70-71: no matching tuples -> nil
+            first_page = False
+            if rest_depth <= 1:
+                sub_tree.type = TreeNodeType.LEAF
+                return sub_tree
+            for rel in rels:
+                child = self._build_tree(rel.subject, rest_depth - 1, visited, nid)
+                if child is None:
+                    child = Tree(
+                        type=TreeNodeType.LEAF,
+                        tuple=RelationTuple(
+                            namespace="",
+                            object="",
+                            relation="",
+                            subject_id=rel.subject_id,
+                            subject_set=rel.subject_set,
+                        ),
+                    )
+                sub_tree.children.append(child)
+            if not page_token:
+                break
+        return sub_tree

@@ -16,10 +16,9 @@ internal/persistence/sql/relationtuples.go).
 
 from __future__ import annotations
 
-import dataclasses
 from dataclasses import dataclass, field
 from enum import Enum
-from typing import Iterable, Mapping, Optional, Sequence, Union
+from typing import Mapping, Optional, Union
 
 from .errors import (
     DroppedSubjectKeyError,

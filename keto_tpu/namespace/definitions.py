@@ -90,4 +90,15 @@ class MemoryNamespaceManager:
         return list(self._by_name.values())
 
     def should_reload(self, namespaces: object) -> bool:
-        return namespaces is not self._by_name
+        """Deep-equality like the reference's reflect.DeepEqual-based
+        ShouldReload (namespace_memory.go): only a content change triggers
+        a rebuild."""
+        current = [ns.to_dict() for ns in self._by_name.values()]
+        try:
+            incoming = [
+                ns.to_dict() if isinstance(ns, Namespace) else dict(ns)
+                for ns in namespaces  # type: ignore[union-attr]
+            ]
+        except TypeError:
+            return True
+        return incoming != current

@@ -160,18 +160,22 @@ class MemoryManager:
     ) -> None:
         with self._lock:
             net = self._net(nid)
+            changed = False
             for t in tuples:
-                self._insert(net, nid, t)
-            net.version += 1
+                changed |= self._insert(net, nid, t)
+            if changed:  # no-op batches must not signal mirror staleness
+                net.version += 1
 
     def delete_relation_tuples(
         self, tuples: Sequence[RelationTuple], nid: str = DEFAULT_NETWORK
     ) -> None:
         with self._lock:
             net = self._net(nid)
+            changed = False
             for t in tuples:
-                self._delete(net, nid, t)
-            net.version += 1
+                changed |= self._delete(net, nid, t)
+            if changed:
+                net.version += 1
 
     def delete_all_relation_tuples(
         self, query: RelationQuery, nid: str = DEFAULT_NETWORK
@@ -181,9 +185,11 @@ class MemoryManager:
             doomed = [
                 t for t in (net.by_shard[sid] for sid in net.order) if query.matches(t)
             ]
+            changed = False
             for t in doomed:
-                self._delete(net, nid, t)
-            net.version += 1
+                changed |= self._delete(net, nid, t)
+            if changed:
+                net.version += 1
 
     def transact_relation_tuples(
         self,
@@ -195,27 +201,30 @@ class MemoryManager:
         # (internal/persistence/sql/relationtuples.go:260-270)
         with self._lock:
             net = self._net(nid)
+            changed = False
             for t in insert:
-                self._insert(net, nid, t)
+                changed |= self._insert(net, nid, t)
             for t in delete:
-                self._delete(net, nid, t)
-            net.version += 1
+                changed |= self._delete(net, nid, t)
+            if changed:
+                net.version += 1
 
     # -- internals -----------------------------------------------------------
 
-    def _insert(self, net: _NetworkStore, nid: str, t: RelationTuple) -> None:
+    def _insert(self, net: _NetworkStore, nid: str, t: RelationTuple) -> bool:
         sid = shard_id(nid, t)
         if sid in net.by_shard:
-            return  # idempotent
+            return False  # idempotent
         net.by_shard[sid] = t
         bisect.insort(net.order, sid)
         net.forward[(t.namespace, t.object, t.relation)].add(sid)
         net.by_subject[_subject_key(t)].add(sid)
+        return True
 
-    def _delete(self, net: _NetworkStore, nid: str, t: RelationTuple) -> None:
+    def _delete(self, net: _NetworkStore, nid: str, t: RelationTuple) -> bool:
         sid = shard_id(nid, t)
         if sid not in net.by_shard:
-            return
+            return False
         del net.by_shard[sid]
         idx = bisect.bisect_left(net.order, sid)
         if idx < len(net.order) and net.order[idx] == sid:
@@ -230,3 +239,4 @@ class MemoryManager:
             sub.discard(sid)
             if not sub:
                 del net.by_subject[_subject_key(t)]
+        return True

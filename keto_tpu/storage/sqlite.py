@@ -351,10 +351,11 @@ class SQLitePersister:
         # the WHERE clause (incl. its nid guard) applies directly to the
         # DELETE; "t" aliases the deleted table itself
         with self._lock, self._conn:
-            self._conn.execute(
+            cur = self._conn.execute(
                 f"DELETE FROM keto_relation_tuples_uuid AS t WHERE {where}", params
             )
-            self._bump_version(nid)
+            if cur.rowcount:
+                self._bump_version(nid)
 
     def transact_relation_tuples(
         self,
@@ -367,6 +368,7 @@ class SQLitePersister:
             for t in insert:
                 strings.extend(self._tuple_strings(t))
             m = self._ensure_mappings(nid, strings)
+            before = self._conn.total_changes
             self._conn.executemany(
                 "INSERT OR IGNORE INTO keto_relation_tuples_uuid "
                 "(shard_id, nid, namespace, object, relation, subject_id, "
@@ -378,7 +380,8 @@ class SQLitePersister:
                 "DELETE FROM keto_relation_tuples_uuid WHERE shard_id = ? AND nid = ?",
                 [(shard_id(nid, t), nid) for t in delete],
             )
-            self._bump_version(nid)
+            if self._conn.total_changes != before:
+                self._bump_version(nid)
 
     # -- mapping manager protocol (durable) -----------------------------------
 

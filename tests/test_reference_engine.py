@@ -1,0 +1,443 @@
+"""Host reference engine tests, porting the reference's engine_test.go
+(max-depth precedence, direct/indirect, transitivity rejection, wide and
+circular graphs) and rewrites_test.go (the full namespace fixture set and
+query->expected table, incl. and/not)."""
+
+import pytest
+
+from keto_tpu.config import Config
+from keto_tpu.engine import Membership, ReferenceEngine
+from keto_tpu.errors import RelationNotFoundError
+from keto_tpu.ketoapi import RelationTuple, SubjectSet, TreeNodeType
+from keto_tpu.namespace import Namespace
+from keto_tpu.namespace.ast import (
+    ComputedSubjectSet,
+    InvertResult,
+    Operator,
+    Relation,
+    SubjectSetRewrite,
+    TupleToSubjectSet,
+)
+from keto_tpu.storage import MemoryManager
+
+
+def make_engine(namespaces, tuples, max_depth=5):
+    cfg = Config({"limit": {"max_read_depth": max_depth}})
+    cfg.set_namespaces(namespaces)
+    m = MemoryManager()
+    m.write_relation_tuples([RelationTuple.from_string(s) for s in tuples])
+    return ReferenceEngine(m, cfg), cfg
+
+
+def check(e, s, depth=0):
+    return e.check_is_member(RelationTuple.from_string(s), depth)
+
+
+class TestEngine:
+    """ref: internal/check/engine_test.go:69-520"""
+
+    def test_respects_max_depth(self):
+        # ref: engine_test.go:72-116 — access via owner via admin needs
+        # depth 3; global default 5
+        e, cfg = make_engine(
+            [Namespace(name="test")],
+            [
+                "test:object#admin@user",
+                "test:object#owner@(test:object#admin)",
+                "test:object#access@(test:object#owner)",
+            ],
+        )
+        assert cfg.max_read_depth() == 5
+        # request depth takes precedence; 2 is not enough, 3 is
+        assert not check(e, "test:object#access@user", 2)
+        assert check(e, "test:object#access@user", 3)
+        # global max-depth takes precedence when lesser
+        cfg.set("limit.max_read_depth", 2)
+        assert not check(e, "test:object#access@user", 3)
+        cfg.set("limit.max_read_depth", 3)
+        assert check(e, "test:object#access@user", 0)
+
+    def test_direct_inclusion(self):
+        e, _ = make_engine([Namespace(name="n")], ["n:obj#access@user"])
+        assert check(e, "n:obj#access@user")
+
+    def test_indirect_inclusion_level_1(self):
+        # ref: engine_test.go:136-173 (producer-of-dust subject set)
+        e, _ = make_engine(
+            [Namespace(name="sofa")],
+            [
+                "sofa:dust#remove@(sofa:dust#producer)",
+                "sofa:dust#producer@mark",
+            ],
+        )
+        assert check(e, "sofa:dust#remove@mark")
+
+    def test_direct_exclusion(self):
+        e, _ = make_engine([Namespace(name="n")], ["n:obj#rel@user"])
+        assert not check(e, "n:obj#rel@other-user")
+
+    def test_wrong_object(self):
+        e, _ = make_engine([Namespace(name="n")], ["n:obj#rel@user"])
+        assert not check(e, "n:other-obj#rel@user")
+
+    def test_wrong_relation(self):
+        e, _ = make_engine([Namespace(name="n")], ["n:obj#rel@user"])
+        assert not check(e, "n:obj#other-rel@user")
+
+    def test_indirect_inclusion_level_2(self):
+        # ref: engine_test.go:267-331 (org -> dir -> file chains)
+        e, _ = make_engine(
+            [Namespace(name="obj")],
+            [
+                "obj:file#parent@(obj:directory#parent)",
+                "obj:directory#parent@(obj:org#member)",
+                "obj:org#member@user",
+            ],
+        )
+        assert check(e, "obj:file#parent@user")
+        assert check(e, "obj:directory#parent@user")
+
+    def test_rejects_transitive_relation(self):
+        # ref: engine_test.go:333-371 — access via "no relation" must not
+        # leak: tuples obj#tr@(obj2#some) and obj2#not_some@user
+        e, _ = make_engine(
+            [Namespace(name="n")],
+            [
+                "n:obj#rel@(n:obj2#some-rel)",
+                "n:obj2#not-some-rel@user",
+            ],
+        )
+        assert not check(e, "n:obj#rel@user")
+
+    def test_subject_id_next_to_subject_set(self):
+        # ref: engine_test.go:373-424 — both a direct subject id and a
+        # subject set on the same (obj, rel)
+        e, _ = make_engine(
+            [Namespace(name="n")],
+            [
+                "n:o#r@direct-user",
+                "n:o#r@(n:o2#r2)",
+                "n:o2#r2@indirect-user",
+            ],
+        )
+        assert check(e, "n:o#r@direct-user")
+        assert check(e, "n:o#r@indirect-user")
+        # a subject-set subject checks directly (exact match)
+        assert e.check_is_member(
+            RelationTuple.make("n", "o", "r", SubjectSet("n", "o2", "r2"))
+        )
+
+    def test_wide_tuple_graph(self):
+        # ref: engine_test.go:426-466
+        tuples = []
+        for i in range(10):
+            tuples.append(f"n:o#r@(n:o-{i}#r-{i})")
+        tuples.append("n:o-7#r-7@user")
+        e, _ = make_engine([Namespace(name="n")], tuples)
+        assert check(e, "n:o#r@user")
+        assert not check(e, "n:o#r@other")
+
+    def test_circular_tuples(self):
+        # ref: engine_test.go:468-520 — a cycle user-a <-> user-b must
+        # terminate and answer correctly
+        e, _ = make_engine(
+            [Namespace(name="n")],
+            [
+                "n:user-a#friend@(n:user-b#friend)",
+                "n:user-b#friend@(n:user-a#friend)",
+                "n:user-a#friend@user-x",
+            ],
+            max_depth=10,
+        )
+        assert check(e, "n:user-a#friend@user-x")
+        assert check(e, "n:user-b#friend@user-x")
+        assert not check(e, "n:user-a#friend@nobody")
+
+    def test_wildcard_relation_not_expanded(self):
+        # subject sets with relation "..." are not expanded by
+        # expand-subject (engine.go:124)
+        e, _ = make_engine(
+            [Namespace(name="n")],
+            [
+                "n:o#r@(n:o2#...)",
+                "n:o2#any@user",
+            ],
+        )
+        assert not check(e, "n:o#r@user")
+
+    def test_unknown_namespace_is_not_member_not_error(self):
+        e, _ = make_engine([Namespace(name="n")], [])
+        assert not check(e, "other:o#r@user")
+
+    def test_missing_relation_with_config_is_error(self):
+        e, _ = make_engine(
+            [Namespace(name="n", relations=[Relation(name="known")])], []
+        )
+        with pytest.raises(RelationNotFoundError):
+            check(e, "n:o#unknown@user")
+
+
+# The rewrites fixture set, ported from rewrites_test.go:20-128
+REWRITE_NAMESPACES = [
+    Namespace(
+        name="doc",
+        relations=[
+            Relation(name="owner"),
+            Relation(
+                name="editor",
+                subject_set_rewrite=SubjectSetRewrite(
+                    children=[ComputedSubjectSet(relation="owner")]
+                ),
+            ),
+            Relation(
+                name="viewer",
+                subject_set_rewrite=SubjectSetRewrite(
+                    children=[
+                        ComputedSubjectSet(relation="editor"),
+                        TupleToSubjectSet(
+                            relation="parent",
+                            computed_subject_set_relation="viewer",
+                        ),
+                    ]
+                ),
+            ),
+        ],
+    ),
+    Namespace(name="group", relations=[Relation(name="member")]),
+    Namespace(name="level", relations=[Relation(name="member")]),
+    Namespace(
+        name="resource",
+        relations=[
+            Relation(name="level"),
+            Relation(
+                name="viewer",
+                subject_set_rewrite=SubjectSetRewrite(
+                    children=[
+                        TupleToSubjectSet(
+                            relation="owner", computed_subject_set_relation="member"
+                        )
+                    ]
+                ),
+            ),
+            Relation(
+                name="owner",
+                subject_set_rewrite=SubjectSetRewrite(
+                    children=[
+                        TupleToSubjectSet(
+                            relation="owner", computed_subject_set_relation="member"
+                        )
+                    ]
+                ),
+            ),
+            Relation(
+                name="read",
+                subject_set_rewrite=SubjectSetRewrite(
+                    children=[
+                        ComputedSubjectSet(relation="viewer"),
+                        ComputedSubjectSet(relation="owner"),
+                    ]
+                ),
+            ),
+            Relation(
+                name="update",
+                subject_set_rewrite=SubjectSetRewrite(
+                    children=[ComputedSubjectSet(relation="owner")]
+                ),
+            ),
+            Relation(
+                name="delete",
+                subject_set_rewrite=SubjectSetRewrite(
+                    operation=Operator.AND,
+                    children=[
+                        ComputedSubjectSet(relation="owner"),
+                        TupleToSubjectSet(
+                            relation="level", computed_subject_set_relation="member"
+                        ),
+                    ],
+                ),
+            ),
+        ],
+    ),
+    Namespace(
+        name="acl",
+        relations=[
+            Relation(name="allow"),
+            Relation(name="deny"),
+            Relation(
+                name="access",
+                subject_set_rewrite=SubjectSetRewrite(
+                    operation=Operator.AND,
+                    children=[
+                        ComputedSubjectSet(relation="allow"),
+                        InvertResult(child=ComputedSubjectSet(relation="deny")),
+                    ],
+                ),
+            ),
+        ],
+    ),
+]
+
+REWRITE_TUPLES = [
+    "doc:document#owner@user",
+    "doc:doc_in_folder#parent@(doc:folder#...)",
+    "doc:folder#owner@user",
+    "doc:file#parent@(doc:folder_c#...)",
+    "doc:folder_c#parent@(doc:folder_b#...)",
+    "doc:folder_b#parent@(doc:folder_a#...)",
+    "doc:folder_a#owner@user",
+    "group:editors#member@mark",
+    "level:superadmin#member@mark",
+    "level:superadmin#member@sandy",
+    "resource:topsecret#owner@(group:editors#...)",
+    "resource:topsecret#level@(level:superadmin#...)",
+    "resource:topsecret#owner@mike",
+    "acl:document#allow@alice",
+    "acl:document#allow@bob",
+    "acl:document#allow@mallory",
+    "acl:document#deny@mallory",
+]
+
+# (query, expected-is-member), ported from rewrites_test.go:130-215
+REWRITE_CASES = [
+    ("doc:document#owner@user", True),
+    ("doc:document#editor@user", True),
+    ("doc:document#viewer@user", True),
+    ("doc:document#editor@nobody", False),
+    ("doc:folder#viewer@user", True),
+    ("doc:doc_in_folder#viewer@user", True),
+    ("doc:doc_in_folder#viewer@nobody", False),
+    ("doc:another_doc#viewer@user", False),
+    ("doc:file#viewer@user", True),
+    ("level:superadmin#member@mark", True),
+    ("resource:topsecret#owner@mark", True),
+    ("resource:topsecret#delete@mark", True),
+    ("resource:topsecret#update@mike", True),
+    ("level:superadmin#member@mike", False),
+    ("resource:topsecret#delete@mike", False),
+    ("resource:topsecret#delete@sandy", False),
+    ("acl:document#access@alice", True),
+    ("acl:document#access@bob", True),
+    ("acl:document#allow@mallory", True),
+    ("acl:document#access@mallory", False),
+]
+
+
+@pytest.fixture(scope="module")
+def rewrite_engine():
+    cfg = Config({"limit": {"max_read_depth": 100}})
+    cfg.set_namespaces(REWRITE_NAMESPACES)
+    m = MemoryManager()
+    m.write_relation_tuples([RelationTuple.from_string(s) for s in REWRITE_TUPLES])
+    return ReferenceEngine(m, cfg)
+
+
+class TestUsersetRewrites:
+    @pytest.mark.parametrize("query,expected", REWRITE_CASES)
+    def test_cases(self, rewrite_engine, query, expected):
+        res = rewrite_engine.check_relation_tuple(
+            RelationTuple.from_string(query), 100
+        )
+        assert res.error is None
+        assert (res.membership == Membership.IS_MEMBER) == expected, query
+
+    def test_proof_tree_for_intersection(self, rewrite_engine):
+        # ported path assertion: delete@mark -> {level member, owner->editors}
+        res = rewrite_engine.check_relation_tuple(
+            RelationTuple.from_string("resource:topsecret#delete@mark"), 100
+        )
+        assert res.membership == Membership.IS_MEMBER
+        labels = tree_labels(res.tree)
+        assert "level:superadmin#member@mark" in labels
+        assert "group:editors#member@mark" in labels
+
+    def test_proof_tree_direct(self, rewrite_engine):
+        res = rewrite_engine.check_relation_tuple(
+            RelationTuple.from_string("acl:document#access@alice"), 100
+        )
+        assert res.membership == Membership.IS_MEMBER
+        assert "acl:document#allow@alice" in tree_labels(res.tree)
+
+
+def tree_labels(tree):
+    if tree is None:
+        return []
+    out = [tree.label()]
+    for c in tree.children:
+        out.extend(tree_labels(c))
+    return out
+
+
+class TestExpand:
+    """ref: internal/expand engine + handler behavior."""
+
+    def test_expand_union_tree(self):
+        e, _ = make_engine(
+            [Namespace(name="n")],
+            [
+                "n:o#r@u1",
+                "n:o#r@u2",
+                "n:o#r@(n:o2#r)",
+                "n:o2#r@nested",
+            ],
+            max_depth=10,
+        )
+        tree = e.expand(SubjectSet("n", "o", "r"), 10)
+        assert tree.type == TreeNodeType.UNION
+        subjects = set()
+        for child in tree.children:
+            t = child.tuple
+            subjects.add(t.subject_id or str(t.subject_set))
+        assert subjects == {"u1", "u2", "n:o2#r"}
+        nested = [c for c in tree.children if c.type == TreeNodeType.UNION]
+        assert len(nested) == 1
+        assert nested[0].children[0].tuple.subject_id == "nested"
+
+    def test_expand_depth_cap_leaf(self):
+        e, _ = make_engine(
+            [Namespace(name="n")], ["n:o#r@u1", "n:o#r@(n:o2#r)"], max_depth=10
+        )
+        tree = e.expand(SubjectSet("n", "o", "r"), 1)
+        assert tree.type == TreeNodeType.LEAF
+
+    def test_expand_no_tuples_is_none(self):
+        e, _ = make_engine([Namespace(name="n")], [])
+        assert e.expand(SubjectSet("n", "o", "r"), 5) is None
+
+    def test_expand_subject_id_is_leaf(self):
+        e, _ = make_engine([Namespace(name="n")], [])
+        tree = e.expand("just-a-user", 5)
+        assert tree.type == TreeNodeType.LEAF
+
+    def test_expand_cycle_terminates(self):
+        e, _ = make_engine(
+            [Namespace(name="n")],
+            [
+                "n:a#r@(n:b#r)",
+                "n:b#r@(n:a#r)",
+                "n:a#r@direct",
+            ],
+            max_depth=10,
+        )
+        tree = e.expand(SubjectSet("n", "a", "r"), 10)
+        assert tree is not None
+        labels = tree_labels(tree)
+        assert any("direct" in l for l in labels)
+
+
+class TestVisitedPruningModes:
+    def test_prune_free_mode_explores_more(self):
+        # graph where visited pruning can matter: diamond reaching the same
+        # subject set twice
+        namespaces = [Namespace(name="n")]
+        tuples = [
+            "n:root#r@(n:mid1#r)",
+            "n:root#r@(n:mid2#r)",
+            "n:mid1#r@(n:deep#r)",
+            "n:mid2#r@(n:deep#r)",
+            "n:deep#r@user",
+        ]
+        e1, _ = make_engine(namespaces, tuples, max_depth=10)
+        e2, _ = make_engine(namespaces, tuples, max_depth=10)
+        e2.visited_pruning = False
+        assert check(e1, "n:root#r@user")
+        assert check(e2, "n:root#r@user")
