@@ -15,6 +15,24 @@ from typing import Optional
 from ..ketoapi import RelationTuple, Tree, TreeNodeType
 
 
+# Subject sets whose relation is the wildcard are never expanded via
+# expand-subject (ref: internal/check/engine.go:40, :124); shared by the
+# host engine and the snapshot compiler so both paths stay in lockstep.
+WILDCARD_RELATION = "..."
+
+
+def subject_visited_key(sub) -> str:
+    """Injective visited-set key. The reference keys visited subjects by
+    UUID (SubjectID/SubjectSet UniqueID), which cannot collide across
+    subject kinds; a display-string key would let a plain subject_id that
+    textually equals a subject set's canonical form wrongly prune it."""
+    from ..ketoapi import SubjectSet
+
+    if isinstance(sub, SubjectSet):
+        return f"set:{sub}"
+    return f"id:{sub}"
+
+
 class Membership(IntEnum):
     # ref: checkgroup/definitions.go:65-69 (iota: Unknown, IsMember, NotMember)
     UNKNOWN = 0

@@ -1,0 +1,333 @@
+"""Batched BFS check kernel (single device).
+
+The TPU replacement for the reference's goroutine-per-branch recursive
+walk (internal/check/engine.go:183-207 + checkgroup): all branches of all
+in-flight checks advance together as one frontier of tasks
+(query, object-slot, relation, remaining-depth), inside one
+`jax.lax.while_loop` with static shapes:
+
+  per step:
+    1. direct-probe every task against the edge hash table (the batched
+       analog of checkDirect's single-row SELECT) and OR hits into the
+       per-query member mask (short-circuit = done-mask)
+    2. expand every task: subject-set CSR row (checkExpandSubject), plus
+       its compiled rewrite instructions (COMPUTED relation swap at the
+       SAME depth, rewrites.go:161-193; TTU row traversal at depth-1,
+       rewrites.go:195-260); expansion counts → exclusive scan →
+       vectorized segmented gather into the next frontier
+    3. dedupe the next frontier on (query, object, relation) keeping the
+       deepest remaining-depth instance (safe: more depth explores more)
+
+Depth bookkeeping matches the reference exactly: direct probes need
+depth ≥ 1 (restDepth-1 ≥ 0), expand-subject and TTU children are enqueued
+at depth-1 (only when ≥ 0), computed children keep their depth.
+
+Tasks touching host-only programs (AND/NOT islands), config-missing
+relations, or overflowing the frontier raise the per-query needs_host
+flag; the engine facade re-runs those queries on the exact host engine.
+
+All arrays int32/uint32/bool — no 64-bit emulation on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .snapshot import (
+    EMPTY,
+    FLAG_CONFIG_MISSING,
+    FLAG_HOST_ONLY,
+    INSTR_COMPUTED,
+    INSTR_NONE,
+    INSTR_TTU,
+    GraphSnapshot,
+)
+
+_GOLDEN = jnp.uint32(0x9E3779B9)
+
+
+def _mix32(x: jnp.ndarray) -> jnp.ndarray:
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> jnp.uint32(16))
+    return x
+
+
+def _hash_combine(*parts: jnp.ndarray) -> jnp.ndarray:
+    h = jnp.full_like(parts[0].astype(jnp.uint32), _GOLDEN)
+    for p in parts:
+        h = _mix32(h ^ p.astype(jnp.uint32))
+    return h
+
+
+def _direct_lookup(tables, obj, rel, skind, sa, sb, probes: int):
+    """Vectorized open-addressing probe of the direct-edge table."""
+    cap_mask = jnp.uint32(tables["dh_obj"].shape[0] - 1)
+    h1 = _hash_combine(obj, rel, skind, sa, sb)
+    h2 = _mix32(h1 ^ _GOLDEN) | jnp.uint32(1)
+    found = jnp.zeros(obj.shape, dtype=bool)
+    for j in range(probes):  # static unroll; probes is the build-time max
+        slot = ((h1 + jnp.uint32(j) * h2) & cap_mask).astype(jnp.int32)
+        match = (
+            (tables["dh_obj"][slot] == obj)
+            & (tables["dh_rel"][slot] == rel)
+            & (tables["dh_skind"][slot] == skind)
+            & (tables["dh_sa"][slot] == sa)
+            & (tables["dh_sb"][slot] == sb)
+        )
+        found = found | match
+    return found
+
+
+def _row_lookup(tables, obj, rel, probes: int):
+    """(obj, rel) -> CSR row index, or -1."""
+    cap_mask = jnp.uint32(tables["rh_obj"].shape[0] - 1)
+    h1 = _hash_combine(obj, rel)
+    h2 = _mix32(h1 ^ _GOLDEN) | jnp.uint32(1)
+    row = jnp.full(obj.shape, EMPTY, dtype=jnp.int32)
+    for j in range(probes):
+        slot = ((h1 + jnp.uint32(j) * h2) & cap_mask).astype(jnp.int32)
+        match = (tables["rh_obj"][slot] == obj) & (tables["rh_rel"][slot] == rel)
+        row = jnp.where(match & (row == EMPTY), tables["rh_row"][slot], row)
+    return row
+
+
+class _State(NamedTuple):
+    t_q: jnp.ndarray  # [F] owning query index
+    t_obj: jnp.ndarray  # [F] object slot
+    t_rel: jnp.ndarray  # [F] relation id
+    t_depth: jnp.ndarray  # [F] remaining depth
+    n_tasks: jnp.ndarray  # scalar int32
+    member: jnp.ndarray  # [B] bool
+    needs_host: jnp.ndarray  # [B] bool
+    step: jnp.ndarray  # scalar int32
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "K", "dh_probes", "rh_probes", "max_steps",
+        "wildcard_rel", "n_config_rels", "frontier_cap",
+    ),
+)
+def check_kernel(
+    tables: dict,
+    q_obj: jnp.ndarray,  # [B] seed object slots
+    q_rel: jnp.ndarray,  # [B] seed relation ids
+    q_depth: jnp.ndarray,  # [B] clamped max depths
+    q_skind: jnp.ndarray,  # [B] subject kind (0 plain, 1 set)
+    q_sa: jnp.ndarray,  # [B]
+    q_sb: jnp.ndarray,  # [B]
+    q_valid: jnp.ndarray,  # [B] bool: evaluate on device
+    *,
+    K: int,
+    dh_probes: int,
+    rh_probes: int,
+    max_steps: int,
+    wildcard_rel: int,
+    n_config_rels: int,
+    frontier_cap: int,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (member[B], needs_host[B])."""
+    B = q_obj.shape[0]
+    F = frontier_cap
+    S = K + 1  # expansion slots per task: CSR row + K instructions
+
+    row_len_total = tables["row_ptr"].shape[0] - 1
+    n_edges = tables["e_obj"].shape[0]
+
+    def row_span(row):
+        start = jnp.where(row == EMPTY, 0, tables["row_ptr"][jnp.maximum(row, 0)])
+        end = jnp.where(
+            row == EMPTY, 0, tables["row_ptr"][jnp.minimum(row + 1, row_len_total)]
+        )
+        return start, end - start
+
+    def step_fn(st: _State) -> _State:
+        idx = jnp.arange(F, dtype=jnp.int32)
+        q = st.t_q
+        alive_q = ~(st.member | st.needs_host)
+        live = (idx < st.n_tasks) & alive_q[q]
+
+        obj, rel, depth = st.t_obj, st.t_rel, st.t_depth
+
+        # 1. direct probe (needs depth >= 1: checkDirect gets restDepth-1)
+        hit = _direct_lookup(
+            tables, obj, rel, q_skind[q], q_sa[q], q_sb[q], dh_probes
+        ) & live & (depth >= 1)
+        member = st.member.at[q].max(hit)
+
+        # 2. rewrite program of (ns, rel)
+        ns = tables["objslot_ns"][jnp.clip(obj, 0, None)]
+        has_prog = (rel < n_config_rels) & live
+        pid = jnp.where(has_prog, ns * n_config_rels + rel, 0)
+        flags = jnp.where(has_prog, tables["prog_flags"][pid], 0)
+        flagged = (flags & (FLAG_HOST_ONLY | FLAG_CONFIG_MISSING)) != 0
+        # a data-only relation (id >= n_config_rels) visited inside a
+        # namespace that HAS a relation config is the reference's
+        # "relation not found" error (engine.go:219-228): host replay
+        flagged = flagged | (
+            (rel >= n_config_rels) & tables["ns_has_config"][ns].astype(bool)
+        )
+        needs_host = st.needs_host.at[q].max(flagged & live)
+
+        # refresh liveness after membership updates (short-circuit)
+        alive_q2 = ~(member | needs_host)
+        live = live & alive_q2[q]
+
+        # 3. expansion counts per (task, slot)
+        counts = jnp.zeros((F, S), dtype=jnp.int32)
+        starts = jnp.zeros((F, S), dtype=jnp.int32)
+        kinds = jnp.zeros((F, S), dtype=jnp.int32)
+        crel = jnp.zeros((F, S), dtype=jnp.int32)
+
+        # slot 0: subject-set expansion at depth-1
+        row0 = _row_lookup(tables, obj, rel, rh_probes)
+        s0, c0 = row_span(row0)
+        can_expand = live & (depth >= 1)
+        counts = counts.at[:, 0].set(jnp.where(can_expand, c0, 0))
+        starts = starts.at[:, 0].set(s0)
+
+        # slots 1..K: rewrite instructions
+        for k in range(K):
+            ik = jnp.where(has_prog, tables["instr_kind"][pid, k], INSTR_NONE)
+            ir = tables["instr_rel"][pid, k]
+            ir2 = tables["instr_rel2"][pid, k]
+            is_comp = live & (ik == INSTR_COMPUTED)
+            is_ttu = live & (ik == INSTR_TTU) & (depth >= 1)
+            rowk = _row_lookup(tables, obj, ir, rh_probes)
+            sk, ck = row_span(rowk)
+            counts = counts.at[:, k + 1].set(
+                jnp.where(is_comp, 1, jnp.where(is_ttu, ck, 0))
+            )
+            starts = starts.at[:, k + 1].set(sk)
+            kinds = kinds.at[:, k + 1].set(ik)
+            # for computed: child relation = ir; for ttu: child rel = ir2
+            crel = crel.at[:, k + 1].set(jnp.where(ik == INSTR_COMPUTED, ir, ir2))
+
+        flat_counts = counts.reshape(-1)
+        offsets = jnp.cumsum(flat_counts) - flat_counts  # exclusive scan
+        total = offsets[-1] + flat_counts[-1]
+
+        # queries whose expansions overflow the frontier need host replay
+        truncated_seg = (offsets + flat_counts) > F
+        seg_q = jnp.repeat(q, S, total_repeat_length=F * S)
+        needs_host = needs_host.at[seg_q].max(truncated_seg & (flat_counts > 0))
+
+        # 4. build next frontier by segmented gather
+        j = jnp.arange(F, dtype=jnp.int32)
+        seg = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32) - 1
+        seg = jnp.clip(seg, 0, F * S - 1)
+        within = j - offsets[seg]
+        in_range = j < jnp.minimum(total, F)
+        ti = seg // S  # source task
+        sk = seg % S  # slot
+
+        src_kind = kinds[ti, sk]  # INSTR_NONE for slot 0
+        is_slot0 = sk == 0
+        is_comp = (~is_slot0) & (src_kind == INSTR_COMPUTED)
+        is_ttu = (~is_slot0) & (src_kind == INSTR_TTU)
+
+        e = jnp.clip(starts[ti, sk] + within, 0, max(n_edges - 1, 0))
+        edge_obj = tables["e_obj"][e] if n_edges else jnp.zeros(F, jnp.int32)
+        edge_rel = tables["e_rel"][e] if n_edges else jnp.zeros(F, jnp.int32)
+
+        child_q = q[ti]
+        child_obj = jnp.where(is_comp, obj[ti], edge_obj)
+        child_rel = jnp.where(
+            is_slot0, edge_rel, crel[ti, sk]
+        )
+        child_depth = jnp.where(is_comp, depth[ti], depth[ti] - 1)
+        child_valid = in_range & ~(is_slot0 & (edge_rel == wildcard_rel))
+
+        # 5. dedupe on (q, obj, rel), keep deepest; invalid sorts last
+        invalid = ~child_valid
+        order = jnp.lexsort(
+            (-child_depth, child_rel, child_obj, child_q, invalid)
+        )
+        sq = child_q[order]
+        so = child_obj[order]
+        sr = child_rel[order]
+        sd = child_depth[order]
+        sv = child_valid[order]
+        first = jnp.ones(F, dtype=bool)
+        same = (sq[1:] == sq[:-1]) & (so[1:] == so[:-1]) & (sr[1:] == sr[:-1])
+        first = first.at[1:].set(~same)
+        keep = sv & first
+        pos = jnp.cumsum(keep) - 1
+        n_new = keep.sum().astype(jnp.int32)
+        dest = jnp.where(keep, pos, F - 1)  # parked writes are overwritten
+        nt_q = jnp.zeros(F, jnp.int32).at[dest].set(jnp.where(keep, sq, 0))
+        nt_obj = jnp.zeros(F, jnp.int32).at[dest].set(jnp.where(keep, so, 0))
+        nt_rel = jnp.zeros(F, jnp.int32).at[dest].set(jnp.where(keep, sr, 0))
+        nt_depth = jnp.zeros(F, jnp.int32).at[dest].set(jnp.where(keep, sd, 0))
+
+        return _State(
+            nt_q, nt_obj, nt_rel, nt_depth, n_new,
+            member, needs_host, st.step + 1,
+        )
+
+    def cond_fn(st: _State) -> jnp.ndarray:
+        return (
+            (st.step < max_steps)
+            & (st.n_tasks > 0)
+            & ~jnp.all(st.member | st.needs_host)
+        )
+
+    # seed frontier: one task per valid query (F >= B required)
+    pad = F - B
+    init = _State(
+        t_q=jnp.pad(jnp.arange(B, dtype=jnp.int32), (0, pad)),
+        t_obj=jnp.pad(q_obj.astype(jnp.int32), (0, pad)),
+        t_rel=jnp.pad(q_rel.astype(jnp.int32), (0, pad)),
+        t_depth=jnp.pad(q_depth.astype(jnp.int32), (0, pad)),
+        n_tasks=jnp.int32(B),
+        member=jnp.zeros(B, dtype=bool),
+        needs_host=jnp.zeros(B, dtype=bool),
+        step=jnp.int32(0),
+    )
+    # invalid queries contribute inert tasks (depth -1 ⇒ no probes/expansion)
+    init = init._replace(
+        t_depth=jnp.where(
+            jnp.pad(q_valid, (0, pad), constant_values=False),
+            init.t_depth,
+            -jnp.ones(F, jnp.int32),
+        )
+    )
+
+    final = jax.lax.while_loop(cond_fn, step_fn, init)
+    # step-budget exhaustion with live tasks means the device did NOT
+    # finish exploring: those queries must go to the host, not be
+    # reported NotMember (silent false denials otherwise)
+    exhausted = (final.step >= max_steps) & (final.n_tasks > 0)
+    live = jnp.arange(F, dtype=jnp.int32) < final.n_tasks
+    needs_host = final.needs_host.at[final.t_q].max(exhausted & live)
+    return final.member, needs_host
+
+
+def snapshot_tables(snapshot: GraphSnapshot) -> dict:
+    """Device-resident table dict for check_kernel (uploads once)."""
+    return {k: jnp.asarray(v) for k, v in snapshot.device_arrays().items()}
+
+
+def kernel_static_config(
+    snapshot: GraphSnapshot, max_depth: int, frontier_cap: int
+) -> dict:
+    """The static kwargs for check_kernel, derived from a snapshot."""
+    return dict(
+        K=snapshot.K,
+        dh_probes=snapshot.dh_probes,
+        rh_probes=snapshot.rh_probes,
+        # depth decrements bound chain steps; computed hops at constant
+        # depth are bounded by the relation count before cycling
+        max_steps=int(max_depth + snapshot.n_config_rels + 4),
+        wildcard_rel=snapshot.wildcard_rel,
+        n_config_rels=max(snapshot.n_config_rels, 1),
+        frontier_cap=frontier_cap,
+    )

@@ -44,20 +44,19 @@ from ..ketoapi import (
     SubjectSet,
     Tree,
     TreeNodeType,
-    subject_unique_id,
 )
 from ..namespace import ast
 from ..storage.definitions import DEFAULT_NETWORK, Manager
 from .definitions import (
     RESULT_NOT_MEMBER,
     RESULT_UNKNOWN,
+    WILDCARD_RELATION,
     CheckResult,
     Membership,
     leaf,
+    subject_visited_key,
     with_edge,
 )
-
-WILDCARD_RELATION = "..."  # ref: internal/check/engine.go:40
 
 
 class ReferenceEngine:
@@ -162,7 +161,7 @@ class ReferenceEngine:
                 query, page_token=page_token, nid=nid
             )
             for s in subjects:
-                uid = subject_unique_id(s.subject)
+                uid = subject_visited_key(s.subject)
                 if self.visited_pruning:
                     if uid in visited:
                         continue
@@ -382,7 +381,7 @@ class ReferenceEngine:
                     namespace="", object="", relation="", subject_id=subject
                 ),
             )
-        uid = subject_unique_id(subject)
+        uid = subject_visited_key(subject)
         if uid in visited:
             return None
         visited.add(uid)

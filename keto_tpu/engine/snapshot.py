@@ -1,0 +1,444 @@
+"""Graph snapshot compiler: relation tuples + namespace configs → device
+arrays for the batched BFS check kernel.
+
+This replaces the reference's SQL-roundtrip-per-edge traversal
+(internal/check/engine.go:109-141, one paginated SELECT per node) with an
+HBM-resident mirror:
+
+  - dictionary encoding: namespaces, relation strings, scoped objects
+    ((ns, object) pairs → dense int32 "object slots") and plain subject
+    ids each get dense int32 vocabularies — the TPU analog of the
+    reference's UUID mapping (internal/persistence/sql/uuid_mapping.go)
+  - direct-edge hash table: open-addressing, double-hashed, 32-bit keys
+    (obj_slot, rel, subject) for O(1) existence probes (the reference's
+    checkDirect single-row SELECT, engine.go:148-177)
+  - subject-set CSR: per (obj_slot, rel) row of subject-set edges for
+    frontier expansion (the reference's paginated n:obj#rel@* scan,
+    engine.go:109-141); rows are addressed through a second hash table
+  - rewrite programs: each namespace relation's userset-rewrite AST
+    compiled to ≤ K flat instructions {COMPUTED(rel'), TTU(rel, rel')}
+    executed per task inside the kernel. The monotone (pure-union)
+    fragment runs on device; AND/NOT islands and oversized programs are
+    flagged host_only and re-evaluated exactly by the ReferenceEngine
+    (mirroring the reference's synchronous checkInverted islands,
+    internal/check/rewrites.go:142-159)
+
+All arrays are int32 (TPU-native); hashes are uint32 murmur3 finalizers.
+Everything is built vectorized in numpy so 1e8-edge ingest stays feasible.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..ketoapi import RelationTuple
+from ..namespace import ast
+from ..namespace.definitions import Namespace
+from .definitions import WILDCARD_RELATION
+
+EMPTY = np.int32(-1)
+
+# rewrite instruction kinds
+INSTR_NONE = 0
+INSTR_COMPUTED = 1
+INSTR_TTU = 2
+
+# per-(ns,rel) flags
+FLAG_HOST_ONLY = 1  # rewrite has AND/NOT or exceeds K instructions
+FLAG_CONFIG_MISSING = 2  # namespace declares relations but not this one
+
+_GOLDEN = np.uint32(0x9E3779B9)
+
+
+def mix32(x: np.ndarray) -> np.ndarray:
+    """murmur3 fmix32, vectorized over uint32."""
+    x = np.asarray(x, dtype=np.uint32).copy()
+    x ^= x >> np.uint32(16)
+    x *= np.uint32(0x85EBCA6B)
+    x ^= x >> np.uint32(13)
+    x *= np.uint32(0xC2B2AE35)
+    x ^= x >> np.uint32(16)
+    return x
+
+
+def hash_combine(*parts: np.ndarray) -> np.ndarray:
+    h = np.zeros_like(np.asarray(parts[0], dtype=np.uint32)) + _GOLDEN
+    for p in parts:
+        h = mix32(h ^ np.asarray(p, dtype=np.uint32))
+    return h
+
+
+def _build_hash_table(
+    keys: tuple[np.ndarray, ...], values: np.ndarray, min_capacity: int = 64
+) -> tuple[np.ndarray, ...]:
+    """Build an open-addressing table (double hashing, power-of-two size,
+    load ≤ 0.5). Returns (slot arrays for each key column..., value array,
+    probe_limit). Insertion is vectorized: per probe round, first-comer
+    wins a slot via np.unique; the rest advance to their next probe slot.
+    """
+    n = len(values)
+    cap = max(min_capacity, 1)
+    while cap < 2 * n:
+        cap *= 2
+    while True:
+        table_keys = [np.full(cap, EMPTY, dtype=np.int32) for _ in keys]
+        table_vals = np.full(cap, EMPTY, dtype=np.int32)
+        h1 = hash_combine(*keys)
+        h2 = mix32(h1 ^ _GOLDEN) | np.uint32(1)  # odd stride for pow2 table
+        mask = np.uint32(cap - 1)
+        pending = np.arange(n)
+        probe = np.zeros(n, dtype=np.uint32)
+        max_probes = 0
+        while len(pending):
+            max_probes += 1
+            if max_probes > 64:
+                break  # extremely clustered: grow and retry
+            slots = (h1[pending] + probe[pending] * h2[pending]) & mask
+            occupied = table_vals[slots] != EMPTY
+            free = ~occupied
+            # among pending rows probing the same free slot, lowest index wins
+            order = np.argsort(slots[free], kind="stable")
+            free_idx = pending[free][order]
+            free_slots = slots[free][order]
+            uniq_slots, first = np.unique(free_slots, return_index=True)
+            winners = free_idx[first]
+            table_vals[uniq_slots] = values[winners]
+            for col, key in zip(table_keys, keys):
+                col[uniq_slots] = key[winners]
+            placed = np.zeros(n, dtype=bool)
+            placed[winners] = True
+            lost = pending[~placed[pending]]
+            probe[lost] += 1
+            pending = lost
+        if not len(pending):
+            return (*table_keys, table_vals, max(max_probes, 1))
+        cap *= 2  # grow on pathological clustering
+
+
+@dataclass
+class GraphSnapshot:
+    """Immutable device-ready mirror of one network's relation graph."""
+
+    # vocabularies (host-side dicts for query encoding)
+    ns_ids: dict[str, int]
+    rel_ids: dict[str, int]
+    obj_slots: dict[tuple[int, str], int]  # (ns_id, object) -> slot
+    subj_ids: dict[str, int]  # plain subject string -> id
+    n_config_rels: int  # rel ids < this may have rewrite programs
+    wildcard_rel: int  # rel id of "..."
+
+    # obj_slot -> ns_id
+    objslot_ns: np.ndarray
+    # ns_id -> 1 iff the namespace declares a non-empty relation config
+    # (then any undeclared relation visited there is an engine error)
+    ns_has_config: np.ndarray
+
+    # direct-edge hash table: key (obj, rel, skind, sa, sb) -> 1
+    dh_obj: np.ndarray
+    dh_rel: np.ndarray
+    dh_skind: np.ndarray
+    dh_sa: np.ndarray
+    dh_sb: np.ndarray
+    dh_val: np.ndarray
+    dh_probes: int
+
+    # row hash table: key (obj, rel) -> row index
+    rh_obj: np.ndarray
+    rh_rel: np.ndarray
+    rh_row: np.ndarray
+    rh_probes: int
+
+    # subject-set CSR
+    row_ptr: np.ndarray  # [n_rows + 1]
+    e_obj: np.ndarray  # [n_edges] subject-set object slot
+    e_rel: np.ndarray  # [n_edges] subject-set relation id
+
+    # rewrite programs, dense [n_ns * n_config_rels, K]
+    instr_kind: np.ndarray
+    instr_rel: np.ndarray
+    instr_rel2: np.ndarray
+    prog_flags: np.ndarray  # [n_ns * n_config_rels]
+    K: int
+
+    version: int = 0
+    n_tuples: int = 0
+
+    # -- query encoding helpers ----------------------------------------------
+
+    def encode_node(self, namespace: str, obj: str, relation: str):
+        """(obj_slot, rel_id) or None if unknown to the graph+config."""
+        ns_id = self.ns_ids.get(namespace)
+        if ns_id is None:
+            return None
+        slot = self.obj_slots.get((ns_id, obj))
+        rel = self.rel_ids.get(relation)
+        if slot is None or rel is None:
+            return None
+        return slot, rel
+
+    def encode_subject(self, t: RelationTuple):
+        """(skind, sa, sb) or None if the subject never occurs in the data."""
+        if t.subject_set is not None:
+            s = t.subject_set
+            ns_id = self.ns_ids.get(s.namespace)
+            if ns_id is None:
+                return None
+            slot = self.obj_slots.get((ns_id, s.object))
+            rel = self.rel_ids.get(s.relation)
+            if slot is None or rel is None:
+                return None
+            return 1, slot, rel
+        sid = self.subj_ids.get(t.subject_id or "")
+        if sid is None:
+            return None
+        return 0, sid, 0
+
+    def prog_index(self, ns_id: int, rel_id: int) -> int:
+        if rel_id >= self.n_config_rels:
+            return -1
+        return ns_id * self.n_config_rels + rel_id
+
+    def device_arrays(self) -> dict[str, np.ndarray]:
+        """The arrays the kernel closes over (ready for jnp.asarray)."""
+        return {
+            "objslot_ns": self.objslot_ns,
+            "ns_has_config": self.ns_has_config,
+            "dh_obj": self.dh_obj, "dh_rel": self.dh_rel,
+            "dh_skind": self.dh_skind, "dh_sa": self.dh_sa,
+            "dh_sb": self.dh_sb, "dh_val": self.dh_val,
+            "rh_obj": self.rh_obj, "rh_rel": self.rh_rel, "rh_row": self.rh_row,
+            "row_ptr": self.row_ptr, "e_obj": self.e_obj, "e_rel": self.e_rel,
+            "instr_kind": self.instr_kind, "instr_rel": self.instr_rel,
+            "instr_rel2": self.instr_rel2, "prog_flags": self.prog_flags,
+        }
+
+
+def _compile_rewrite(
+    rewrite: Optional[ast.SubjectSetRewrite], rel_ids: dict[str, int], K: int
+) -> tuple[list[tuple[int, int, int]], bool]:
+    """Flatten a pure-union rewrite into instructions; host_only if the
+    tree contains AND/NOT/unknown nodes or exceeds K instructions."""
+    if rewrite is None:
+        return [], False
+    instrs: list[tuple[int, int, int]] = []
+
+    def walk(rw: ast.SubjectSetRewrite) -> bool:
+        if rw.operation != ast.Operator.OR:
+            return False
+        for child in rw.children:
+            if isinstance(child, ast.ComputedSubjectSet):
+                instrs.append((INSTR_COMPUTED, rel_ids[child.relation], 0))
+            elif isinstance(child, ast.TupleToSubjectSet):
+                instrs.append(
+                    (
+                        INSTR_TTU,
+                        rel_ids[child.relation],
+                        rel_ids[child.computed_subject_set_relation],
+                    )
+                )
+            elif isinstance(child, ast.SubjectSetRewrite):
+                if not walk(child):
+                    return False
+            else:
+                return False  # InvertResult / unknown: host island
+        return True
+
+    monotone = walk(rewrite)
+    if not monotone or len(instrs) > K:
+        return [], True
+    return instrs, False
+
+
+def build_snapshot(
+    tuples: Sequence[RelationTuple],
+    namespaces: Sequence[Namespace],
+    K: int = 8,
+    version: int = 0,
+) -> GraphSnapshot:
+    # ---- vocabularies -------------------------------------------------------
+    ns_ids: dict[str, int] = {}
+    rel_ids: dict[str, int] = {}
+    obj_slots: dict[tuple[int, str], int] = {}
+    subj_ids: dict[str, int] = {}
+
+    def ns_id(name: str) -> int:
+        return ns_ids.setdefault(name, len(ns_ids))
+
+    def rel_id(name: str) -> int:
+        return rel_ids.setdefault(name, len(rel_ids))
+
+    def obj_slot(ns: int, obj: str) -> int:
+        return obj_slots.setdefault((ns, obj), len(obj_slots))
+
+    def subj_id(s: str) -> int:
+        return subj_ids.setdefault(s, len(subj_ids))
+
+    # config-referenced relations first, so rewrite-capable rel ids are
+    # dense in [0, n_config_rels) and the program table stays small
+    rel_id(WILDCARD_RELATION)
+    for ns in namespaces:
+        ns_id(ns.name)
+        for rel in ns.relations:
+            rel_id(rel.name)
+            if rel.subject_set_rewrite is not None:
+                for kind, a, b in _walk_rewrite_relations(rel.subject_set_rewrite):
+                    rel_id(a)
+                    if b:
+                        rel_id(b)
+    n_config_rels = len(rel_ids)
+
+    for t in tuples:
+        n = ns_id(t.namespace)
+        obj_slot(n, t.object)
+        rel_id(t.relation)
+        if t.subject_set is not None:
+            s = t.subject_set
+            sn = ns_id(s.namespace)
+            obj_slot(sn, s.object)
+            rel_id(s.relation)
+        else:
+            subj_id(t.subject_id or "")
+
+    n_ns = max(len(ns_ids), 1)
+    n_objslots = max(len(obj_slots), 1)
+
+    objslot_ns = np.zeros(n_objslots, dtype=np.int32)
+    for (ns, _obj), slot in obj_slots.items():
+        objslot_ns[slot] = ns
+    ns_has_config = np.zeros(n_ns, dtype=np.int32)
+    for ns in namespaces:
+        if ns.relations:
+            ns_has_config[ns_ids[ns.name]] = 1
+
+    # ---- edges --------------------------------------------------------------
+    n_t = len(tuples)
+    t_obj = np.zeros(n_t, dtype=np.int32)
+    t_rel = np.zeros(n_t, dtype=np.int32)
+    t_skind = np.zeros(n_t, dtype=np.int32)
+    t_sa = np.zeros(n_t, dtype=np.int32)
+    t_sb = np.zeros(n_t, dtype=np.int32)
+    for i, t in enumerate(tuples):
+        n = ns_ids[t.namespace]
+        t_obj[i] = obj_slots[(n, t.object)]
+        t_rel[i] = rel_ids[t.relation]
+        if t.subject_set is not None:
+            s = t.subject_set
+            t_skind[i] = 1
+            t_sa[i] = obj_slots[(ns_ids[s.namespace], s.object)]
+            t_sb[i] = rel_ids[s.relation]
+        else:
+            t_sa[i] = subj_ids[t.subject_id or ""]
+
+    # direct-edge hash table over all edges (plain and subject-set)
+    dh = _build_hash_table(
+        (t_obj, t_rel, t_skind, t_sa, t_sb),
+        np.ones(n_t, dtype=np.int32),
+    )
+    dh_obj, dh_rel, dh_skind, dh_sa, dh_sb, dh_val, dh_probes = dh
+
+    # subject-set CSR grouped by (obj, rel); wildcard-relation subject sets
+    # are kept (TTU traverses them; the kernel filters them for the
+    # expand-subject slot)
+    is_set = t_skind == 1
+    ss_obj = t_obj[is_set]
+    ss_rel = t_rel[is_set]
+    ss_sa = t_sa[is_set]
+    ss_sb = t_sb[is_set]
+    if len(ss_obj):
+        order = np.lexsort((ss_sb, ss_sa, ss_rel, ss_obj))
+        ss_obj, ss_rel = ss_obj[order], ss_rel[order]
+        ss_sa, ss_sb = ss_sa[order], ss_sb[order]
+        row_change = np.empty(len(ss_obj), dtype=bool)
+        row_change[0] = True
+        row_change[1:] = (ss_obj[1:] != ss_obj[:-1]) | (ss_rel[1:] != ss_rel[:-1])
+        row_starts = np.flatnonzero(row_change)
+        n_rows = len(row_starts)
+        row_ptr = np.append(row_starts, len(ss_obj)).astype(np.int32)
+        row_keys_obj = ss_obj[row_starts]
+        row_keys_rel = ss_rel[row_starts]
+        rh = _build_hash_table(
+            (row_keys_obj, row_keys_rel), np.arange(n_rows, dtype=np.int32)
+        )
+        rh_obj, rh_rel, rh_row, rh_probes = rh
+        e_obj, e_rel = ss_sa.astype(np.int32), ss_sb.astype(np.int32)
+    else:
+        row_ptr = np.zeros(1, dtype=np.int32)
+        rh_obj, rh_rel, rh_row, rh_probes = (
+            np.full(64, EMPTY, np.int32),
+            np.full(64, EMPTY, np.int32),
+            np.full(64, EMPTY, np.int32),
+            1,
+        )
+        e_obj = np.zeros(0, dtype=np.int32)
+        e_rel = np.zeros(0, dtype=np.int32)
+
+    # ---- rewrite programs ---------------------------------------------------
+    NR = n_ns * max(n_config_rels, 1)
+    instr_kind = np.zeros((NR, K), dtype=np.int32)
+    instr_rel = np.zeros((NR, K), dtype=np.int32)
+    instr_rel2 = np.zeros((NR, K), dtype=np.int32)
+    prog_flags = np.zeros(NR, dtype=np.int32)
+
+    for ns in namespaces:
+        nsid = ns_ids[ns.name]
+        if not ns.relations:
+            continue
+        declared = {rel.name for rel in ns.relations}
+        # any (ns, rel) not declared is an engine error when visited
+        # (ref: internal/check/engine.go:219-228)
+        for rel_name, rid in rel_ids.items():
+            if rid >= n_config_rels:
+                continue
+            if rel_name not in declared:
+                prog_flags[nsid * n_config_rels + rid] |= FLAG_CONFIG_MISSING
+        for rel in ns.relations:
+            rid = rel_ids[rel.name]
+            pidx = nsid * n_config_rels + rid
+            instrs, host_only = _compile_rewrite(rel.subject_set_rewrite, rel_ids, K)
+            if host_only:
+                prog_flags[pidx] |= FLAG_HOST_ONLY
+            for k, (kind, a, b) in enumerate(instrs):
+                instr_kind[pidx, k] = kind
+                instr_rel[pidx, k] = a
+                instr_rel2[pidx, k] = b
+
+    return GraphSnapshot(
+        ns_ids=ns_ids,
+        rel_ids=rel_ids,
+        obj_slots=obj_slots,
+        subj_ids=subj_ids,
+        n_config_rels=n_config_rels,
+        wildcard_rel=rel_ids[WILDCARD_RELATION],
+        objslot_ns=objslot_ns,
+        ns_has_config=ns_has_config,
+        dh_obj=dh_obj, dh_rel=dh_rel, dh_skind=dh_skind,
+        dh_sa=dh_sa, dh_sb=dh_sb, dh_val=dh_val, dh_probes=dh_probes,
+        rh_obj=rh_obj, rh_rel=rh_rel, rh_row=rh_row, rh_probes=rh_probes,
+        row_ptr=row_ptr, e_obj=e_obj, e_rel=e_rel,
+        instr_kind=instr_kind, instr_rel=instr_rel, instr_rel2=instr_rel2,
+        prog_flags=prog_flags, K=K,
+        version=version, n_tuples=n_t,
+    )
+
+
+def _walk_rewrite_relations(rw: ast.SubjectSetRewrite):
+    """Yield (kind, relation, relation2) for every leaf referenced by a
+    rewrite tree (used only to pre-register relation names in the vocab)."""
+    for child in rw.children:
+        if isinstance(child, ast.ComputedSubjectSet):
+            yield ("computed", child.relation, "")
+        elif isinstance(child, ast.TupleToSubjectSet):
+            yield ("ttu", child.relation, child.computed_subject_set_relation)
+        elif isinstance(child, ast.SubjectSetRewrite):
+            yield from _walk_rewrite_relations(child)
+        elif isinstance(child, ast.InvertResult):
+            sub = child.child
+            if isinstance(sub, ast.SubjectSetRewrite):
+                yield from _walk_rewrite_relations(sub)
+            elif isinstance(sub, ast.ComputedSubjectSet):
+                yield ("computed", sub.relation, "")
+            elif isinstance(sub, ast.TupleToSubjectSet):
+                yield ("ttu", sub.relation, sub.computed_subject_set_relation)

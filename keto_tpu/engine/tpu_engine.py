@@ -1,0 +1,188 @@
+"""TPU check engine facade.
+
+Owns the device mirror lifecycle and the batched check path:
+
+  - snapshot management: rebuilds the GraphSnapshot (engine/snapshot.py)
+    when the store's write version moves — the device analog of the
+    reference's "stateless replicas over one authoritative DB"; writes
+    stay host-authoritative, checks read the mirror (read-your-writes is
+    preserved because every write bumps the version and the next check
+    batch refreshes)
+  - batching front: single checks ride in padded buckets so the jitted
+    kernel compiles once per (bucket, static-config) pair — the
+    goroutine-per-branch concurrency of the reference becomes batch-
+    dimension parallelism
+  - exact-semantics fallback: queries flagged needs_host (AND/NOT rewrite
+    islands, config-missing-relation errors, frontier overflow) and
+    queries whose namespace/object/relation never occur in the graph are
+    re-evaluated by the host ReferenceEngine; proof trees and expand
+    always come from the host engine
+
+The public surface mirrors check.Engine (CheckIsMember/CheckRelationTuple,
+internal/check/engine.go:54-80) plus a batch entry point the RPC layer's
+micro-batcher feeds.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from ..ketoapi import RelationTuple, Subject, Tree
+from ..storage.definitions import DEFAULT_NETWORK, Manager
+from .definitions import CheckResult, Membership
+from .kernel import check_kernel, kernel_static_config, snapshot_tables
+from .reference import ReferenceEngine
+from .snapshot import GraphSnapshot, build_snapshot
+
+_BUCKETS = (16, 256, 1024, 4096)
+
+
+class TPUCheckEngine:
+    def __init__(
+        self,
+        manager: Manager,
+        config: Config,
+        nid: str = DEFAULT_NETWORK,
+        frontier_cap: int = 1 << 14,
+        rewrite_instr_cap: int = 8,
+    ):
+        self.manager = manager
+        self.config = config
+        self.nid = nid
+        # the frontier must hold at least one task per batched query
+        self.frontier_cap = max(frontier_cap, _BUCKETS[0])
+        self._allowed_buckets = [b for b in _BUCKETS if b <= self.frontier_cap]
+        self.rewrite_instr_cap = rewrite_instr_cap
+        self.reference = ReferenceEngine(manager, config)
+        self._lock = threading.Lock()
+        self._snapshot: Optional[GraphSnapshot] = None
+        self._tables = None
+        # device-path observability (served vs host-fallback checks)
+        self.stats = {"device_checks": 0, "host_checks": 0, "snapshot_builds": 0}
+
+    # -- snapshot lifecycle ---------------------------------------------------
+
+    def _ensure_snapshot(self) -> tuple[GraphSnapshot, dict]:
+        # staleness key covers BOTH the store write version and the
+        # namespace-config content: a rewrite change with no tuple writes
+        # must also rebuild the compiled rewrite programs
+        store_version = self.manager.version(nid=self.nid)
+        namespaces = self.config.namespace_manager().namespaces()
+        config_fp = hash(
+            json.dumps([ns.to_dict() for ns in namespaces], sort_keys=True)
+        )
+        version = hash((store_version, config_fp))
+        with self._lock:
+            snap = self._snapshot
+            if snap is None or snap.version != version:
+                tuples = self.manager.all_relation_tuples(nid=self.nid)
+                snap = build_snapshot(
+                    tuples, namespaces, K=self.rewrite_instr_cap, version=version
+                )
+                self._snapshot = snap
+                self._tables = snapshot_tables(snap)
+                self.stats["snapshot_builds"] += 1
+            return snap, self._tables
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self._snapshot = None
+            self._tables = None
+
+    # -- check API ------------------------------------------------------------
+
+    def check_is_member(
+        self, r: RelationTuple, max_depth: int = 0
+    ) -> bool:
+        res = self.check_batch([r], max_depth)[0]
+        if res.error is not None:
+            raise res.error
+        return res.membership == Membership.IS_MEMBER
+
+    def check_relation_tuple(
+        self, r: RelationTuple, max_depth: int = 0
+    ) -> CheckResult:
+        """Single check; proof trees come from the host engine, so this
+        delegates entirely (the RPC check path wants only `allowed` and
+        uses check_batch)."""
+        return self.reference.check_relation_tuple(r, max_depth, self.nid)
+
+    def expand(self, subject: Subject, max_depth: int = 0) -> Optional[Tree]:
+        return self.reference.expand(subject, max_depth, self.nid)
+
+    def check_batch(
+        self, tuples: Sequence[RelationTuple], max_depth: int = 0
+    ) -> list[CheckResult]:
+        """Batched membership checks (no proof trees)."""
+        n = len(tuples)
+        if n == 0:
+            return []
+        snap, tables = self._ensure_snapshot()
+        global_max = self.config.max_read_depth()
+        depth = max_depth if 0 < max_depth <= global_max else global_max
+
+        B = next((b for b in self._allowed_buckets if b >= n), None)
+        if B is None:
+            # split oversized batches along the largest allowed bucket
+            out: list[CheckResult] = []
+            step = self._allowed_buckets[-1]
+            for i in range(0, n, step):
+                out.extend(self.check_batch(tuples[i : i + step], max_depth))
+            return out
+
+        q_obj = np.zeros(B, dtype=np.int32)
+        q_rel = np.zeros(B, dtype=np.int32)
+        q_depth = np.full(B, depth, dtype=np.int32)
+        q_skind = np.zeros(B, dtype=np.int32)
+        q_sa = np.full(B, -2, dtype=np.int32)  # sentinel: matches nothing
+        q_sb = np.zeros(B, dtype=np.int32)
+        q_valid = np.zeros(B, dtype=bool)
+        host_idx: list[int] = []
+
+        for i, t in enumerate(tuples):
+            node = snap.encode_node(t.namespace, t.object, t.relation)
+            if node is None:
+                # namespace/object/relation absent from graph+config: no
+                # edge can match, but error semantics (missing relation in
+                # a configured namespace) still apply -> exact host eval
+                host_idx.append(i)
+                continue
+            q_obj[i], q_rel[i] = node
+            subject = snap.encode_subject(t)
+            if subject is not None:
+                q_skind[i], q_sa[i], q_sb[i] = subject
+            # unknown subject keeps the sentinel: traversal still runs so
+            # error flags surface, but no direct probe can hit
+            q_valid[i] = True
+
+        cfg = kernel_static_config(snap, global_max, self.frontier_cap)
+        member, needs_host = check_kernel(
+            tables,
+            q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid,
+            **cfg,
+        )
+        member = np.asarray(member)
+        needs_host = np.asarray(needs_host)
+
+        results: list[CheckResult] = []
+        n_host = 0
+        for i, t in enumerate(tuples):
+            if i < B and q_valid[i] and not needs_host[i]:
+                results.append(
+                    CheckResult(
+                        Membership.IS_MEMBER if member[i] else Membership.NOT_MEMBER
+                    )
+                )
+            else:
+                n_host += 1
+                results.append(
+                    self.reference.check_relation_tuple(t, max_depth, self.nid)
+                )
+        self.stats["device_checks"] += n - n_host
+        self.stats["host_checks"] += n_host
+        return results

@@ -1,15 +1,23 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Multi-chip TPU hardware is not available in CI; sharding correctness is
-validated on host CPU devices (the driver separately dry-run-compiles the
-multi-chip path via __graft_entry__.dryrun_multichip)."""
+The container's sitecustomize registers the axon TPU PJRT plugin at
+interpreter startup and force-selects it via
+jax.config.update("jax_platforms", "axon,cpu"), overriding the
+JAX_PLATFORMS env var; initializing that backend blocks on the TPU
+tunnel. Tests must run on host CPU with 8 virtual devices, so we set the
+XLA flags before any backend is created and flip the platform config
+back to cpu. Benches (bench.py) run outside pytest and keep the real TPU.
+"""
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (must come after the env setup above)
+
+jax.config.update("jax_platforms", "cpu")

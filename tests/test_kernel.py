@@ -1,0 +1,397 @@
+"""Differential tests: the batched BFS kernel against the exact host
+reference engine, on the ported fixture sets and randomized graphs.
+Runs on the virtual CPU backend (conftest.py); the same code path runs
+on TPU."""
+
+import random
+
+import numpy as np
+import pytest
+
+from keto_tpu.config import Config
+from keto_tpu.engine import Membership, ReferenceEngine
+from keto_tpu.engine.snapshot import build_snapshot
+from keto_tpu.engine.tpu_engine import TPUCheckEngine
+from keto_tpu.ketoapi import RelationTuple, SubjectSet
+from keto_tpu.namespace import Namespace
+from keto_tpu.namespace.ast import (
+    ComputedSubjectSet,
+    Relation,
+    SubjectSetRewrite,
+    TupleToSubjectSet,
+)
+from keto_tpu.storage import MemoryManager
+
+from test_reference_engine import (
+    REWRITE_CASES,
+    REWRITE_NAMESPACES,
+    REWRITE_TUPLES,
+)
+
+
+def make_tpu_engine(namespaces, tuples, max_depth=5):
+    cfg = Config({"limit": {"max_read_depth": max_depth}})
+    cfg.set_namespaces(namespaces)
+    m = MemoryManager()
+    m.write_relation_tuples([RelationTuple.from_string(s) for s in tuples])
+    return TPUCheckEngine(m, cfg)
+
+
+@pytest.fixture(scope="module")
+def rewrite_tpu_engine():
+    # one snapshot build + kernel compile for all 20 fixture cases
+    return make_tpu_engine(REWRITE_NAMESPACES, REWRITE_TUPLES, max_depth=100)
+
+
+class TestSnapshot:
+    def test_build_and_encode(self):
+        tuples = [
+            RelationTuple.from_string("n:o#r@u"),
+            RelationTuple.from_string("n:o#r@(n:o2#r2)"),
+        ]
+        snap = build_snapshot(tuples, [Namespace(name="n")])
+        assert snap.n_tuples == 2
+        node = snap.encode_node("n", "o", "r")
+        assert node is not None
+        assert snap.encode_node("missing", "o", "r") is None
+        assert snap.encode_subject(tuples[0]) == (0, snap.subj_ids["u"], 0)
+        skind, sa, sb = snap.encode_subject(tuples[1])
+        assert skind == 1
+
+    def test_hash_table_holds_all_edges(self):
+        # build a snapshot with enough edges to force collisions
+        tuples = [
+            RelationTuple.from_string(f"n:o{i % 97}#r{i % 11}@u{i}")
+            for i in range(2000)
+        ]
+        snap = build_snapshot(tuples, [])
+        assert (snap.dh_val != -1).sum() == 2000
+
+
+class TestKernelDifferential:
+    def test_cat_videos(self):
+        import glob
+        import json
+
+        tuples = []
+        for f in sorted(
+            glob.glob(
+                "/root/reference/contrib/cat-videos-example/relation-tuples/*.json"
+            )
+        ):
+            d = json.load(open(f))
+            d.pop("$schema", None)
+            tuples.append(str(RelationTuple.from_dict(d)))
+        e = make_tpu_engine([Namespace(name="videos")], tuples)
+        queries = [
+            "videos:/cats/1.mp4#view@*",
+            "videos:/cats/1.mp4#view@cat lady",
+            "videos:/cats/2.mp4#view@cat lady",
+            "videos:/cats/2.mp4#view@john",
+            "videos:/cats#view@cat lady",
+            "videos:/cats#owner@cat lady",
+            "videos:/cats/1.mp4#owner@cat lady",
+        ]
+        rts = [RelationTuple.from_string(q) for q in queries]
+        got = e.check_batch(rts)
+        want = [e.reference.check_relation_tuple(t, 0) for t in rts]
+        for q, g, w in zip(queries, got, want):
+            assert g.membership == w.membership, q
+        # all these are monotone: the device must have answered them
+        assert e.stats["host_checks"] == 0
+
+    @pytest.mark.parametrize("query,expected", REWRITE_CASES)
+    def test_rewrite_fixtures(self, rewrite_tpu_engine, query, expected):
+        res = rewrite_tpu_engine.check_batch(
+            [RelationTuple.from_string(query)], 100
+        )[0]
+        assert res.error is None
+        assert (res.membership == Membership.IS_MEMBER) == expected, query
+
+    def test_and_not_islands_fall_back_to_host(self):
+        e = make_tpu_engine(REWRITE_NAMESPACES, REWRITE_TUPLES, max_depth=100)
+        # acl uses AND + NOT: must be host-evaluated
+        e.check_batch([RelationTuple.from_string("acl:document#access@alice")], 100)
+        assert e.stats["host_checks"] >= 1
+        # doc uses pure unions: must run on device
+        e.stats["host_checks"] = 0
+        e.check_batch([RelationTuple.from_string("doc:document#viewer@user")], 100)
+        assert e.stats["host_checks"] == 0
+
+    def test_deep_chain_topology(self):
+        # the reference benchmark's "deep" namespace (bench_test.go:56-86)
+        max_depth = 32
+        namespaces = [
+            Namespace(
+                name="deep",
+                relations=[
+                    Relation(name="owner"),
+                    Relation(name="parent"),
+                    Relation(
+                        name="editor",
+                        subject_set_rewrite=SubjectSetRewrite(
+                            children=[ComputedSubjectSet(relation="owner")]
+                        ),
+                    ),
+                    Relation(
+                        name="viewer",
+                        subject_set_rewrite=SubjectSetRewrite(
+                            children=[
+                                ComputedSubjectSet(relation="editor"),
+                                TupleToSubjectSet(
+                                    relation="parent",
+                                    computed_subject_set_relation="viewer",
+                                ),
+                            ]
+                        ),
+                    ),
+                ],
+            )
+        ]
+        tuples = ["deep:deep_file#parent@(deep:folder_1#...)"]
+        for i in range(1, max_depth):
+            tuples.append(f"deep:folder_{i}#parent@(deep:folder_{i + 1}#...)")
+        for d in (2, 4, 8, 16, 32):
+            tuples.append(f"deep:folder_{d}#owner@user_{d}")
+        e = make_tpu_engine(namespaces, tuples, max_depth=100 * max_depth)
+        for d in (2, 4, 8, 16, 32):
+            q = RelationTuple.from_string(f"deep:deep_file#viewer@user_{d}")
+            res = e.check_batch([q], 2 * d)[0]
+            ref = e.reference.check_relation_tuple(q, 2 * d)
+            assert res.membership == ref.membership, f"depth {d}"
+            assert res.membership == Membership.IS_MEMBER
+        # not enough depth: reference and kernel agree on the miss
+        q = RelationTuple.from_string("deep:deep_file#viewer@user_32")
+        res = e.check_batch([q], 3)[0]
+        assert res.membership == Membership.NOT_MEMBER
+        assert e.stats["host_checks"] == 0
+
+    def test_wide_union_topology(self):
+        # the reference benchmark's wide namespace (bench_test.go:19-46)
+        width = 40
+        relations = [Relation(name="editor")]
+        children = []
+        for i in range(width):
+            relations.append(Relation(name=f"relation-{i}"))
+            children.append(ComputedSubjectSet(relation=f"relation-{i}"))
+        children.append(ComputedSubjectSet(relation="editor"))
+        relations.append(
+            Relation(name="viewer", subject_set_rewrite=SubjectSetRewrite(children=children))
+        )
+        ns = Namespace(name="wide", relations=relations)
+        e = make_tpu_engine([ns], ["wide:file#editor@user"], max_depth=80)
+        q = RelationTuple.from_string("wide:file#viewer@user")
+        res = e.check_batch([q], 80)[0]
+        assert res.membership == Membership.IS_MEMBER
+        # width exceeds the instruction cap K=8: korrectly host-flagged
+        assert e.stats["host_checks"] == 1
+
+    def test_circular_graph(self):
+        e = make_tpu_engine(
+            [Namespace(name="n")],
+            [
+                "n:a#r@(n:b#r)",
+                "n:b#r@(n:c#r)",
+                "n:c#r@(n:a#r)",
+                "n:c#r@deep-user",
+            ],
+            max_depth=10,
+        )
+        for q, want in [
+            ("n:a#r@deep-user", True),
+            ("n:b#r@deep-user", True),
+            ("n:a#r@nobody", False),
+        ]:
+            res = e.check_batch([RelationTuple.from_string(q)], 10)[0]
+            assert (res.membership == Membership.IS_MEMBER) == want, q
+
+    def test_subject_set_query_subject(self):
+        # query whose subject is itself a subject set: direct probe must
+        # match subject-set edges exactly
+        e = make_tpu_engine(
+            [Namespace(name="n")],
+            ["n:o#r@(n:o2#r2)"],
+        )
+        q = RelationTuple.make("n", "o", "r", SubjectSet("n", "o2", "r2"))
+        assert e.check_batch([q])[0].membership == Membership.IS_MEMBER
+        q2 = RelationTuple.make("n", "o", "r", SubjectSet("n", "o2", "other"))
+        assert e.check_batch([q2])[0].membership == Membership.NOT_MEMBER
+
+    def test_randomized_differential(self):
+        rng = random.Random(42)
+        n_objects = 30
+        n_users = 10
+        relations = ["r0", "r1", "r2"]
+        namespaces = [
+            Namespace(
+                name="rnd",
+                relations=[
+                    Relation(name="r0"),
+                    Relation(name="r1"),
+                    Relation(
+                        name="r2",
+                        subject_set_rewrite=SubjectSetRewrite(
+                            children=[
+                                ComputedSubjectSet(relation="r0"),
+                                TupleToSubjectSet(
+                                    relation="r1",
+                                    computed_subject_set_relation="r2",
+                                ),
+                            ]
+                        ),
+                    ),
+                ],
+            )
+        ]
+        for trial in range(5):
+            tuples = set()
+            for _ in range(120):
+                obj = f"o{rng.randrange(n_objects)}"
+                rel = rng.choice(relations)
+                if rng.random() < 0.45:
+                    sub = f"(rnd:o{rng.randrange(n_objects)}#{rng.choice(relations)})"
+                else:
+                    sub = f"u{rng.randrange(n_users)}"
+                tuples.add(f"rnd:{obj}#{rel}@{sub}")
+            # generous depth so visited-pruning order effects vanish
+            e = make_tpu_engine(namespaces, sorted(tuples), max_depth=12)
+            queries = []
+            for _ in range(64):
+                queries.append(
+                    RelationTuple.from_string(
+                        f"rnd:o{rng.randrange(n_objects)}#"
+                        f"{rng.choice(relations)}@u{rng.randrange(n_users)}"
+                    )
+                )
+            got = e.check_batch(queries, 12)
+            for q, g in zip(queries, got):
+                ref = e.reference.check_relation_tuple(q, 12)
+                assert g.membership == ref.membership, f"trial {trial}: {q}"
+
+    def test_read_your_writes(self):
+        cfg = Config({"limit": {"max_read_depth": 5}})
+        cfg.set_namespaces([Namespace(name="n")])
+        m = MemoryManager()
+        e = TPUCheckEngine(m, cfg)
+        q = RelationTuple.from_string("n:o#r@u")
+        assert e.check_batch([q])[0].membership == Membership.NOT_MEMBER
+        m.write_relation_tuples([q])
+        assert e.check_batch([q])[0].membership == Membership.IS_MEMBER
+        m.delete_relation_tuples([q])
+        assert e.check_batch([q])[0].membership == Membership.NOT_MEMBER
+        assert e.stats["snapshot_builds"] == 3
+
+    def test_large_batch_spans_buckets(self):
+        tuples = [f"n:o{i}#r@u{i}" for i in range(50)]
+        e = make_tpu_engine([Namespace(name="n")], tuples)
+        queries = [RelationTuple.from_string(f"n:o{i}#r@u{i}") for i in range(50)]
+        queries += [RelationTuple.from_string(f"n:o{i}#r@u{i + 1}") for i in range(50)]
+        got = e.check_batch(queries)
+        assert all(r.membership == Membership.IS_MEMBER for r in got[:50])
+        assert all(r.membership == Membership.NOT_MEMBER for r in got[50:])
+
+
+class TestReviewRegressions:
+    def test_data_only_relation_in_configured_namespace_errors(self):
+        # reference: namespace has a relation config, queried relation not
+        # declared -> error (engine.go:219-228). A directly-matching tuple
+        # instead wins the OR race (one legal schedule) -> IsMember.
+        e = make_tpu_engine(
+            [Namespace(name="n", relations=[Relation(name="known")])],
+            ["n:o#rogue@u"],
+        )
+        # direct hit: both paths say IsMember, no error
+        hit = e.check_batch([RelationTuple.from_string("n:o#rogue@u")])[0]
+        assert hit.membership == Membership.IS_MEMBER and hit.error is None
+        # miss: the undeclared relation surfaces as an error on both paths
+        res = e.check_batch([RelationTuple.from_string("n:o#rogue@v")])[0]
+        ref = e.reference.check_relation_tuple(
+            RelationTuple.from_string("n:o#rogue@v")
+        )
+        assert res.error is not None and ref.error is not None
+        assert type(res.error) is type(ref.error)
+
+    def test_namespace_config_change_invalidates_snapshot(self):
+        cfg = Config({"limit": {"max_read_depth": 5}})
+        cfg.set_namespaces([
+            Namespace(name="n", relations=[Relation(name="owner"), Relation(name="editor")])
+        ])
+        m = MemoryManager()
+        m.write_relation_tuples([RelationTuple.from_string("n:o#owner@u")])
+        e = TPUCheckEngine(m, cfg)
+        q = RelationTuple.from_string("n:o#editor@u")
+        assert e.check_batch([q])[0].membership == Membership.NOT_MEMBER
+        # add a rewrite (editor includes owner) WITHOUT any tuple write
+        cfg.set_namespaces([
+            Namespace(
+                name="n",
+                relations=[
+                    Relation(name="owner"),
+                    Relation(
+                        name="editor",
+                        subject_set_rewrite=SubjectSetRewrite(
+                            children=[ComputedSubjectSet(relation="owner")]
+                        ),
+                    ),
+                ],
+            )
+        ])
+        assert e.check_batch([q])[0].membership == Membership.IS_MEMBER
+
+    def test_step_exhaustion_falls_back_to_host(self):
+        # interleaved computed+TTU chain: ~2 BFS steps per level; depth
+        # clamp 100 over 60 levels exceeds the kernel step budget, which
+        # must flag needs_host instead of silently denying
+        ns = Namespace(
+            name="d",
+            relations=[
+                Relation(name="owner"),
+                Relation(name="parent"),
+                Relation(
+                    name="w",
+                    subject_set_rewrite=SubjectSetRewrite(
+                        children=[
+                            ComputedSubjectSet(relation="owner"),
+                            TupleToSubjectSet(
+                                relation="parent",
+                                computed_subject_set_relation="v",
+                            ),
+                        ]
+                    ),
+                ),
+                Relation(
+                    name="v",
+                    subject_set_rewrite=SubjectSetRewrite(
+                        children=[ComputedSubjectSet(relation="w")]
+                    ),
+                ),
+            ],
+        )
+        levels = 60
+        tuples = [f"d:f0#parent@(d:f1#...)"]
+        for i in range(1, levels):
+            tuples.append(f"d:f{i}#parent@(d:f{i + 1}#...)")
+        tuples.append(f"d:f{levels}#owner@user")
+        e = make_tpu_engine([ns], tuples, max_depth=100)
+        q = RelationTuple.from_string("d:f0#v@user")
+        res = e.check_batch([q], 100)[0]
+        ref = e.reference.check_relation_tuple(q, 100)
+        assert res.membership == ref.membership == Membership.IS_MEMBER
+        assert e.stats["host_checks"] == 1  # exhaustion was flagged
+
+    def test_small_frontier_cap_splits_batches(self):
+        e = TPUCheckEngine(
+            MemoryManager(),
+            _cfg_with([Namespace(name="n")]),
+            frontier_cap=16,
+        )
+        queries = [RelationTuple.from_string(f"n:o{i}#r@u") for i in range(40)]
+        res = e.check_batch(queries)
+        assert len(res) == 40
+        assert all(r.membership == Membership.NOT_MEMBER for r in res)
+
+
+def _cfg_with(namespaces):
+    cfg = Config({"limit": {"max_read_depth": 5}})
+    cfg.set_namespaces(namespaces)
+    return cfg

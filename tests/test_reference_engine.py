@@ -441,3 +441,22 @@ class TestVisitedPruningModes:
         e2.visited_pruning = False
         assert check(e1, "n:root#r@user")
         assert check(e2, "n:root#r@user")
+
+
+class TestVisitedKeyInjectivity:
+    def test_plain_id_textually_equal_to_subject_set_does_not_prune(self):
+        # a plain subject_id that LOOKS like a subject set's canonical
+        # string must not poison the visited set (reference keys by UUID,
+        # which cannot collide across subject kinds)
+        e, _ = make_engine(
+            [Namespace(name="n")],
+            [],
+            max_depth=10,
+        )
+        e.manager.write_relation_tuples([
+            RelationTuple("n", "root", "r", subject_id="n:deep0#r"),
+            RelationTuple("n", "root", "r",
+                          subject_set=SubjectSet("n", "deep0", "r")),
+            RelationTuple("n", "deep0", "r", subject_id="user"),
+        ])
+        assert check(e, "n:root#r@user")
