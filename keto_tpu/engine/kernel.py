@@ -1,4 +1,4 @@
-"""Batched BFS check kernel (single device).
+"""Batched BFS check kernel (single device) + shared step phases.
 
 The TPU replacement for the reference's goroutine-per-branch recursive
 walk (internal/check/engine.go:183-207 + checkgroup): all branches of all
@@ -7,16 +7,23 @@ in-flight checks advance together as one frontier of tasks
 `jax.lax.while_loop` with static shapes:
 
   per step:
-    1. direct-probe every task against the edge hash table (the batched
+    1. flag tasks whose (ns, rel) program needs host evaluation (AND/NOT
+       islands, missing relation config — engine.go:219-228)
+    2. direct-probe every task against the edge hash table (the batched
        analog of checkDirect's single-row SELECT) and OR hits into the
-       per-query member mask (short-circuit = done-mask)
-    2. expand every task: subject-set CSR row (checkExpandSubject), plus
+       per-query member mask (short-circuit = per-query done-mask)
+    3. expand every task: subject-set CSR row (checkExpandSubject), plus
        its compiled rewrite instructions (COMPUTED relation swap at the
        SAME depth, rewrites.go:161-193; TTU row traversal at depth-1,
        rewrites.go:195-260); expansion counts → exclusive scan →
        vectorized segmented gather into the next frontier
-    3. dedupe the next frontier on (query, object, relation) keeping the
+    4. dedupe the next frontier on (query, object, relation) keeping the
        deepest remaining-depth instance (safe: more depth explores more)
+
+The phases are factored as standalone functions so the sharded multi-chip
+kernel (keto_tpu/parallel/kernel.py) can interleave them with mesh
+collectives: probe hits are psum-OR-merged across edge shards and local
+expansions are all-gathered before the shared dedupe.
 
 Depth bookkeeping matches the reference exactly: direct probes need
 depth ≥ 1 (restDepth-1 ≥ 0), expand-subject and TTU children are enqueued
@@ -109,6 +116,232 @@ class _State(NamedTuple):
     step: jnp.ndarray  # scalar int32
 
 
+class Expansion(NamedTuple):
+    """Candidate children of one expansion phase (pre-dedupe)."""
+
+    q: jnp.ndarray
+    obj: jnp.ndarray
+    rel: jnp.ndarray
+    depth: jnp.ndarray
+    valid: jnp.ndarray
+
+
+def flag_phase(tables, obj, rel, live, *, n_config_rels: int):
+    """Per-task host-island flags; pure function of replicated tables, so
+    every shard computes the identical result (no collective needed).
+    ref: engine.go:219-228 (relation-not-found), snapshot FLAG_* bits."""
+    ns = tables["objslot_ns"][jnp.clip(obj, 0, None)]
+    has_prog = (rel < n_config_rels) & live
+    pid = jnp.where(has_prog, ns * n_config_rels + rel, 0)
+    flags = jnp.where(has_prog, tables["prog_flags"][pid], 0)
+    flagged = (flags & (FLAG_HOST_ONLY | FLAG_CONFIG_MISSING)) != 0
+    # a data-only relation (id >= n_config_rels) visited inside a
+    # namespace that HAS a relation config is the reference's
+    # "relation not found" error (engine.go:219-228): host replay
+    flagged = flagged | (
+        (rel >= n_config_rels) & tables["ns_has_config"][ns].astype(bool)
+    )
+    return flagged & live
+
+
+def probe_phase(tables, obj, rel, skind, sa, sb, depth, live, *, dh_probes: int):
+    """Direct-edge probe; needs depth >= 1 (checkDirect gets restDepth-1)."""
+    return (
+        _direct_lookup(tables, obj, rel, skind, sa, sb, dh_probes)
+        & live
+        & (depth >= 1)
+    )
+
+
+def expand_phase(
+    tables,
+    q,
+    obj,
+    rel,
+    depth,
+    live,
+    *,
+    K: int,
+    rh_probes: int,
+    n_config_rels: int,
+    wildcard_rel: int,
+    n_queries: int,
+) -> tuple[Expansion, jnp.ndarray]:
+    """Expand every live task through its CSR row + rewrite instructions.
+
+    Returns (candidate children [F], per-query overflow flag [B]): children
+    beyond the frontier capacity are truncated and their owning queries
+    flagged for host replay.
+    """
+    F = q.shape[0]
+    S = K + 1  # expansion slots per task: CSR row + K instructions
+    row_len_total = tables["row_ptr"].shape[0] - 1
+    n_edges = tables["e_obj"].shape[0]
+
+    def row_span(row):
+        start = jnp.where(row == EMPTY, 0, tables["row_ptr"][jnp.maximum(row, 0)])
+        end = jnp.where(
+            row == EMPTY, 0, tables["row_ptr"][jnp.minimum(row + 1, row_len_total)]
+        )
+        return start, end - start
+
+    ns = tables["objslot_ns"][jnp.clip(obj, 0, None)]
+    has_prog = (rel < n_config_rels) & live
+    pid = jnp.where(has_prog, ns * n_config_rels + rel, 0)
+
+    counts = jnp.zeros((F, S), dtype=jnp.int32)
+    starts = jnp.zeros((F, S), dtype=jnp.int32)
+    kinds = jnp.zeros((F, S), dtype=jnp.int32)
+    crel = jnp.zeros((F, S), dtype=jnp.int32)
+
+    # slot 0: subject-set expansion at depth-1
+    row0 = _row_lookup(tables, obj, rel, rh_probes)
+    s0, c0 = row_span(row0)
+    can_expand = live & (depth >= 1)
+    counts = counts.at[:, 0].set(jnp.where(can_expand, c0, 0))
+    starts = starts.at[:, 0].set(s0)
+
+    # slots 1..K: rewrite instructions
+    for k in range(K):
+        ik = jnp.where(has_prog, tables["instr_kind"][pid, k], INSTR_NONE)
+        ir = tables["instr_rel"][pid, k]
+        ir2 = tables["instr_rel2"][pid, k]
+        is_comp = live & (ik == INSTR_COMPUTED)
+        is_ttu = live & (ik == INSTR_TTU) & (depth >= 1)
+        rowk = _row_lookup(tables, obj, ir, rh_probes)
+        sk, ck = row_span(rowk)
+        counts = counts.at[:, k + 1].set(
+            jnp.where(is_comp, 1, jnp.where(is_ttu, ck, 0))
+        )
+        starts = starts.at[:, k + 1].set(sk)
+        kinds = kinds.at[:, k + 1].set(ik)
+        # for computed: child relation = ir; for ttu: child rel = ir2
+        crel = crel.at[:, k + 1].set(jnp.where(ik == INSTR_COMPUTED, ir, ir2))
+
+    flat_counts = counts.reshape(-1)
+    offsets = jnp.cumsum(flat_counts) - flat_counts  # exclusive scan
+    total = offsets[-1] + flat_counts[-1]
+
+    # queries whose expansions overflow the frontier need host replay
+    truncated_seg = (offsets + flat_counts) > F
+    seg_q = jnp.repeat(q, S, total_repeat_length=F * S)
+    overflow_q = (
+        jnp.zeros(n_queries, dtype=bool)
+        .at[seg_q]
+        .max(truncated_seg & (flat_counts > 0))
+    )
+
+    # build candidate children by segmented gather
+    j = jnp.arange(F, dtype=jnp.int32)
+    seg = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32) - 1
+    seg = jnp.clip(seg, 0, F * S - 1)
+    within = j - offsets[seg]
+    in_range = j < jnp.minimum(total, F)
+    ti = seg // S  # source task
+    sk = seg % S  # slot
+
+    src_kind = kinds[ti, sk]  # INSTR_NONE for slot 0
+    is_slot0 = sk == 0
+    is_comp = (~is_slot0) & (src_kind == INSTR_COMPUTED)
+
+    e = jnp.clip(starts[ti, sk] + within, 0, max(n_edges - 1, 0))
+    edge_obj = tables["e_obj"][e] if n_edges else jnp.zeros(F, jnp.int32)
+    edge_rel = tables["e_rel"][e] if n_edges else jnp.zeros(F, jnp.int32)
+
+    child_q = q[ti]
+    child_obj = jnp.where(is_comp, obj[ti], edge_obj)
+    child_rel = jnp.where(is_slot0, edge_rel, crel[ti, sk])
+    child_depth = jnp.where(is_comp, depth[ti], depth[ti] - 1)
+    child_valid = in_range & ~(is_slot0 & (edge_rel == wildcard_rel))
+    return Expansion(child_q, child_obj, child_rel, child_depth, child_valid), overflow_q
+
+
+def dedupe_phase(
+    children: Expansion, F: int, n_queries: int
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Dedupe candidates on (q, obj, rel) keeping the deepest instance and
+    pack the first F survivors into the next frontier. Candidates may be
+    longer than F (multi-shard gather); survivors beyond F flag their
+    queries for host replay.
+
+    Returns (t_q, t_obj, t_rel, t_depth, n_new, overflow_q[B]).
+    """
+    G = children.q.shape[0]
+    invalid = ~children.valid
+    order = jnp.lexsort(
+        (-children.depth, children.rel, children.obj, children.q, invalid)
+    )
+    sq = children.q[order]
+    so = children.obj[order]
+    sr = children.rel[order]
+    sd = children.depth[order]
+    sv = children.valid[order]
+    first = jnp.ones(G, dtype=bool)
+    same = (sq[1:] == sq[:-1]) & (so[1:] == so[:-1]) & (sr[1:] == sr[:-1])
+    first = first.at[1:].set(~same)
+    keep = sv & first
+    pos = jnp.cumsum(keep) - 1
+    n_keep = keep.sum().astype(jnp.int32)
+    kept_in_cap = keep & (pos < F)
+    # survivors that don't fit in the frontier: their queries go to host
+    overflow_q = (
+        jnp.zeros(n_queries, dtype=bool).at[sq].max(keep & (pos >= F))
+    )
+    # non-kept entries park at index F: out-of-bounds scatter drops them
+    dest = jnp.where(kept_in_cap, pos, F)
+    nt_q = jnp.zeros(F, jnp.int32).at[dest].set(sq, mode="drop")
+    nt_obj = jnp.zeros(F, jnp.int32).at[dest].set(so, mode="drop")
+    nt_rel = jnp.zeros(F, jnp.int32).at[dest].set(sr, mode="drop")
+    nt_depth = jnp.zeros(F, jnp.int32).at[dest].set(sd, mode="drop")
+    n_new = jnp.minimum(n_keep, F)
+    return nt_q, nt_obj, nt_rel, nt_depth, n_new, overflow_q
+
+
+def seed_state(q_obj, q_rel, q_depth, q_valid, frontier_cap: int) -> _State:
+    """Initial frontier: one task per valid query (frontier_cap >= B)."""
+    B = q_obj.shape[0]
+    pad = frontier_cap - B
+    depth0 = jnp.pad(q_depth.astype(jnp.int32), (0, pad))
+    # invalid queries contribute inert tasks (depth -1 ⇒ no probes/expansion)
+    depth0 = jnp.where(
+        jnp.pad(q_valid, (0, pad), constant_values=False),
+        depth0,
+        -jnp.ones(frontier_cap, jnp.int32),
+    )
+    return _State(
+        t_q=jnp.pad(jnp.arange(B, dtype=jnp.int32), (0, pad)),
+        t_obj=jnp.pad(q_obj.astype(jnp.int32), (0, pad)),
+        t_rel=jnp.pad(q_rel.astype(jnp.int32), (0, pad)),
+        t_depth=depth0,
+        n_tasks=jnp.int32(B),
+        member=jnp.zeros(B, dtype=bool),
+        needs_host=jnp.zeros(B, dtype=bool),
+        step=jnp.int32(0),
+    )
+
+
+def loop_cond(max_steps: int):
+    def cond_fn(st: _State) -> jnp.ndarray:
+        return (
+            (st.step < max_steps)
+            & (st.n_tasks > 0)
+            & ~jnp.all(st.member | st.needs_host)
+        )
+
+    return cond_fn
+
+
+def finalize(final: _State, max_steps: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Step-budget exhaustion with live tasks means the device did NOT
+    finish exploring: those queries must go to the host, not be reported
+    NotMember (silent false denials otherwise)."""
+    F = final.t_q.shape[0]
+    exhausted = (final.step >= max_steps) & (final.n_tasks > 0)
+    live = jnp.arange(F, dtype=jnp.int32) < final.n_tasks
+    needs_host = final.needs_host.at[final.t_q].max(exhausted & live)
+    return final.member, needs_host
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -137,178 +370,44 @@ def check_kernel(
     """Returns (member[B], needs_host[B])."""
     B = q_obj.shape[0]
     F = frontier_cap
-    S = K + 1  # expansion slots per task: CSR row + K instructions
-
-    row_len_total = tables["row_ptr"].shape[0] - 1
-    n_edges = tables["e_obj"].shape[0]
-
-    def row_span(row):
-        start = jnp.where(row == EMPTY, 0, tables["row_ptr"][jnp.maximum(row, 0)])
-        end = jnp.where(
-            row == EMPTY, 0, tables["row_ptr"][jnp.minimum(row + 1, row_len_total)]
-        )
-        return start, end - start
 
     def step_fn(st: _State) -> _State:
         idx = jnp.arange(F, dtype=jnp.int32)
         q = st.t_q
         alive_q = ~(st.member | st.needs_host)
         live = (idx < st.n_tasks) & alive_q[q]
-
         obj, rel, depth = st.t_obj, st.t_rel, st.t_depth
 
-        # 1. direct probe (needs depth >= 1: checkDirect gets restDepth-1)
-        hit = _direct_lookup(
-            tables, obj, rel, q_skind[q], q_sa[q], q_sb[q], dh_probes
-        ) & live & (depth >= 1)
-        member = st.member.at[q].max(hit)
-
-        # 2. rewrite program of (ns, rel)
-        ns = tables["objslot_ns"][jnp.clip(obj, 0, None)]
-        has_prog = (rel < n_config_rels) & live
-        pid = jnp.where(has_prog, ns * n_config_rels + rel, 0)
-        flags = jnp.where(has_prog, tables["prog_flags"][pid], 0)
-        flagged = (flags & (FLAG_HOST_ONLY | FLAG_CONFIG_MISSING)) != 0
-        # a data-only relation (id >= n_config_rels) visited inside a
-        # namespace that HAS a relation config is the reference's
-        # "relation not found" error (engine.go:219-228): host replay
-        flagged = flagged | (
-            (rel >= n_config_rels) & tables["ns_has_config"][ns].astype(bool)
+        flagged = flag_phase(tables, obj, rel, live, n_config_rels=n_config_rels)
+        hit = probe_phase(
+            tables, obj, rel, q_skind[q], q_sa[q], q_sb[q], depth, live,
+            dh_probes=dh_probes,
         )
-        needs_host = st.needs_host.at[q].max(flagged & live)
+        member = st.member.at[q].max(hit)
+        needs_host = st.needs_host.at[q].max(flagged)
 
         # refresh liveness after membership updates (short-circuit)
-        alive_q2 = ~(member | needs_host)
-        live = live & alive_q2[q]
+        live = live & ~(member | needs_host)[q]
 
-        # 3. expansion counts per (task, slot)
-        counts = jnp.zeros((F, S), dtype=jnp.int32)
-        starts = jnp.zeros((F, S), dtype=jnp.int32)
-        kinds = jnp.zeros((F, S), dtype=jnp.int32)
-        crel = jnp.zeros((F, S), dtype=jnp.int32)
-
-        # slot 0: subject-set expansion at depth-1
-        row0 = _row_lookup(tables, obj, rel, rh_probes)
-        s0, c0 = row_span(row0)
-        can_expand = live & (depth >= 1)
-        counts = counts.at[:, 0].set(jnp.where(can_expand, c0, 0))
-        starts = starts.at[:, 0].set(s0)
-
-        # slots 1..K: rewrite instructions
-        for k in range(K):
-            ik = jnp.where(has_prog, tables["instr_kind"][pid, k], INSTR_NONE)
-            ir = tables["instr_rel"][pid, k]
-            ir2 = tables["instr_rel2"][pid, k]
-            is_comp = live & (ik == INSTR_COMPUTED)
-            is_ttu = live & (ik == INSTR_TTU) & (depth >= 1)
-            rowk = _row_lookup(tables, obj, ir, rh_probes)
-            sk, ck = row_span(rowk)
-            counts = counts.at[:, k + 1].set(
-                jnp.where(is_comp, 1, jnp.where(is_ttu, ck, 0))
-            )
-            starts = starts.at[:, k + 1].set(sk)
-            kinds = kinds.at[:, k + 1].set(ik)
-            # for computed: child relation = ir; for ttu: child rel = ir2
-            crel = crel.at[:, k + 1].set(jnp.where(ik == INSTR_COMPUTED, ir, ir2))
-
-        flat_counts = counts.reshape(-1)
-        offsets = jnp.cumsum(flat_counts) - flat_counts  # exclusive scan
-        total = offsets[-1] + flat_counts[-1]
-
-        # queries whose expansions overflow the frontier need host replay
-        truncated_seg = (offsets + flat_counts) > F
-        seg_q = jnp.repeat(q, S, total_repeat_length=F * S)
-        needs_host = needs_host.at[seg_q].max(truncated_seg & (flat_counts > 0))
-
-        # 4. build next frontier by segmented gather
-        j = jnp.arange(F, dtype=jnp.int32)
-        seg = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32) - 1
-        seg = jnp.clip(seg, 0, F * S - 1)
-        within = j - offsets[seg]
-        in_range = j < jnp.minimum(total, F)
-        ti = seg // S  # source task
-        sk = seg % S  # slot
-
-        src_kind = kinds[ti, sk]  # INSTR_NONE for slot 0
-        is_slot0 = sk == 0
-        is_comp = (~is_slot0) & (src_kind == INSTR_COMPUTED)
-        is_ttu = (~is_slot0) & (src_kind == INSTR_TTU)
-
-        e = jnp.clip(starts[ti, sk] + within, 0, max(n_edges - 1, 0))
-        edge_obj = tables["e_obj"][e] if n_edges else jnp.zeros(F, jnp.int32)
-        edge_rel = tables["e_rel"][e] if n_edges else jnp.zeros(F, jnp.int32)
-
-        child_q = q[ti]
-        child_obj = jnp.where(is_comp, obj[ti], edge_obj)
-        child_rel = jnp.where(
-            is_slot0, edge_rel, crel[ti, sk]
+        children, overflow_q = expand_phase(
+            tables, q, obj, rel, depth, live,
+            K=K, rh_probes=rh_probes, n_config_rels=n_config_rels,
+            wildcard_rel=wildcard_rel, n_queries=B,
         )
-        child_depth = jnp.where(is_comp, depth[ti], depth[ti] - 1)
-        child_valid = in_range & ~(is_slot0 & (edge_rel == wildcard_rel))
+        needs_host = needs_host | overflow_q
 
-        # 5. dedupe on (q, obj, rel), keep deepest; invalid sorts last
-        invalid = ~child_valid
-        order = jnp.lexsort(
-            (-child_depth, child_rel, child_obj, child_q, invalid)
+        nt_q, nt_obj, nt_rel, nt_depth, n_new, overflow2 = dedupe_phase(
+            children, F, B
         )
-        sq = child_q[order]
-        so = child_obj[order]
-        sr = child_rel[order]
-        sd = child_depth[order]
-        sv = child_valid[order]
-        first = jnp.ones(F, dtype=bool)
-        same = (sq[1:] == sq[:-1]) & (so[1:] == so[:-1]) & (sr[1:] == sr[:-1])
-        first = first.at[1:].set(~same)
-        keep = sv & first
-        pos = jnp.cumsum(keep) - 1
-        n_new = keep.sum().astype(jnp.int32)
-        dest = jnp.where(keep, pos, F - 1)  # parked writes are overwritten
-        nt_q = jnp.zeros(F, jnp.int32).at[dest].set(jnp.where(keep, sq, 0))
-        nt_obj = jnp.zeros(F, jnp.int32).at[dest].set(jnp.where(keep, so, 0))
-        nt_rel = jnp.zeros(F, jnp.int32).at[dest].set(jnp.where(keep, sr, 0))
-        nt_depth = jnp.zeros(F, jnp.int32).at[dest].set(jnp.where(keep, sd, 0))
-
+        needs_host = needs_host | overflow2
         return _State(
             nt_q, nt_obj, nt_rel, nt_depth, n_new,
             member, needs_host, st.step + 1,
         )
 
-    def cond_fn(st: _State) -> jnp.ndarray:
-        return (
-            (st.step < max_steps)
-            & (st.n_tasks > 0)
-            & ~jnp.all(st.member | st.needs_host)
-        )
-
-    # seed frontier: one task per valid query (F >= B required)
-    pad = F - B
-    init = _State(
-        t_q=jnp.pad(jnp.arange(B, dtype=jnp.int32), (0, pad)),
-        t_obj=jnp.pad(q_obj.astype(jnp.int32), (0, pad)),
-        t_rel=jnp.pad(q_rel.astype(jnp.int32), (0, pad)),
-        t_depth=jnp.pad(q_depth.astype(jnp.int32), (0, pad)),
-        n_tasks=jnp.int32(B),
-        member=jnp.zeros(B, dtype=bool),
-        needs_host=jnp.zeros(B, dtype=bool),
-        step=jnp.int32(0),
-    )
-    # invalid queries contribute inert tasks (depth -1 ⇒ no probes/expansion)
-    init = init._replace(
-        t_depth=jnp.where(
-            jnp.pad(q_valid, (0, pad), constant_values=False),
-            init.t_depth,
-            -jnp.ones(F, jnp.int32),
-        )
-    )
-
-    final = jax.lax.while_loop(cond_fn, step_fn, init)
-    # step-budget exhaustion with live tasks means the device did NOT
-    # finish exploring: those queries must go to the host, not be
-    # reported NotMember (silent false denials otherwise)
-    exhausted = (final.step >= max_steps) & (final.n_tasks > 0)
-    live = jnp.arange(F, dtype=jnp.int32) < final.n_tasks
-    needs_host = final.needs_host.at[final.t_q].max(exhausted & live)
-    return final.member, needs_host
+    init = seed_state(q_obj, q_rel, q_depth, q_valid, F)
+    final = jax.lax.while_loop(loop_cond(max_steps), step_fn, init)
+    return finalize(final, max_steps)
 
 
 def snapshot_tables(snapshot: GraphSnapshot) -> dict:

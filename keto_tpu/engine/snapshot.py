@@ -71,6 +71,14 @@ def hash_combine(*parts: np.ndarray) -> np.ndarray:
     return h
 
 
+def hash_table_capacity(n: int, min_capacity: int = 64) -> int:
+    """Power-of-two capacity at load factor ≤ 0.5 for n entries."""
+    cap = max(min_capacity, 1)
+    while cap < 2 * n:
+        cap *= 2
+    return cap
+
+
 def _build_hash_table(
     keys: tuple[np.ndarray, ...], values: np.ndarray, min_capacity: int = 64
 ) -> tuple[np.ndarray, ...]:
@@ -80,9 +88,7 @@ def _build_hash_table(
     wins a slot via np.unique; the rest advance to their next probe slot.
     """
     n = len(values)
-    cap = max(min_capacity, 1)
-    while cap < 2 * n:
-        cap *= 2
+    cap = hash_table_capacity(n, min_capacity)
     while True:
         table_keys = [np.full(cap, EMPTY, dtype=np.int32) for _ in keys]
         table_vals = np.full(cap, EMPTY, dtype=np.int32)
@@ -116,6 +122,107 @@ def _build_hash_table(
         if not len(pending):
             return (*table_keys, table_vals, max(max_probes, 1))
         cap *= 2  # grow on pathological clustering
+
+
+def encode_edge_arrays(
+    tuples: Sequence[RelationTuple],
+    ns_ids: dict[str, int],
+    rel_ids: dict[str, int],
+    obj_slots: dict[tuple[int, str], int],
+    subj_ids: dict[str, int],
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Encode tuples to (obj, rel, skind, sa, sb) int32 arrays under a
+    pre-built vocabulary (every name must already be registered)."""
+    n_t = len(tuples)
+    t_obj = np.zeros(n_t, dtype=np.int32)
+    t_rel = np.zeros(n_t, dtype=np.int32)
+    t_skind = np.zeros(n_t, dtype=np.int32)
+    t_sa = np.zeros(n_t, dtype=np.int32)
+    t_sb = np.zeros(n_t, dtype=np.int32)
+    for i, t in enumerate(tuples):
+        n = ns_ids[t.namespace]
+        t_obj[i] = obj_slots[(n, t.object)]
+        t_rel[i] = rel_ids[t.relation]
+        if t.subject_set is not None:
+            s = t.subject_set
+            t_skind[i] = 1
+            t_sa[i] = obj_slots[(ns_ids[s.namespace], s.object)]
+            t_sb[i] = rel_ids[s.relation]
+        else:
+            t_sa[i] = subj_ids[t.subject_id or ""]
+    return t_obj, t_rel, t_skind, t_sa, t_sb
+
+
+def build_edge_tables(
+    t_obj: np.ndarray,
+    t_rel: np.ndarray,
+    t_skind: np.ndarray,
+    t_sa: np.ndarray,
+    t_sb: np.ndarray,
+    dh_min_cap: int = 64,
+    rh_min_cap: int = 64,
+) -> dict:
+    """Direct-edge hash table + subject-set CSR from encoded edge arrays.
+
+    `dh_min_cap`/`rh_min_cap` force minimum table capacities so multiple
+    shards of one graph can be built with identical shapes and stacked
+    along a device axis (the slot sequence of an open-addressing probe
+    depends on capacity, so stacked tables must share it).
+    """
+    n_t = len(t_obj)
+    # direct-edge hash table over all edges (plain and subject-set)
+    dh = _build_hash_table(
+        (t_obj, t_rel, t_skind, t_sa, t_sb),
+        np.ones(n_t, dtype=np.int32),
+        min_capacity=dh_min_cap,
+    )
+    dh_obj, dh_rel, dh_skind, dh_sa, dh_sb, dh_val, dh_probes = dh
+
+    # subject-set CSR grouped by (obj, rel); wildcard-relation subject sets
+    # are kept (TTU traverses them; the kernel filters them for the
+    # expand-subject slot)
+    is_set = t_skind == 1
+    ss_obj = t_obj[is_set]
+    ss_rel = t_rel[is_set]
+    ss_sa = t_sa[is_set]
+    ss_sb = t_sb[is_set]
+    if len(ss_obj):
+        order = np.lexsort((ss_sb, ss_sa, ss_rel, ss_obj))
+        ss_obj, ss_rel = ss_obj[order], ss_rel[order]
+        ss_sa, ss_sb = ss_sa[order], ss_sb[order]
+        row_change = np.empty(len(ss_obj), dtype=bool)
+        row_change[0] = True
+        row_change[1:] = (ss_obj[1:] != ss_obj[:-1]) | (ss_rel[1:] != ss_rel[:-1])
+        row_starts = np.flatnonzero(row_change)
+        n_rows = len(row_starts)
+        row_ptr = np.append(row_starts, len(ss_obj)).astype(np.int32)
+        row_keys_obj = ss_obj[row_starts]
+        row_keys_rel = ss_rel[row_starts]
+        rh = _build_hash_table(
+            (row_keys_obj, row_keys_rel),
+            np.arange(n_rows, dtype=np.int32),
+            min_capacity=rh_min_cap,
+        )
+        rh_obj, rh_rel, rh_row, rh_probes = rh
+        e_obj, e_rel = ss_sa.astype(np.int32), ss_sb.astype(np.int32)
+    else:
+        row_ptr = np.zeros(1, dtype=np.int32)
+        cap = max(rh_min_cap, 64)
+        rh_obj = np.full(cap, EMPTY, np.int32)
+        rh_rel = np.full(cap, EMPTY, np.int32)
+        rh_row = np.full(cap, EMPTY, np.int32)
+        rh_probes = 1
+        e_obj = np.zeros(0, dtype=np.int32)
+        e_rel = np.zeros(0, dtype=np.int32)
+
+    return {
+        "dh_obj": dh_obj, "dh_rel": dh_rel, "dh_skind": dh_skind,
+        "dh_sa": dh_sa, "dh_sb": dh_sb, "dh_val": dh_val,
+        "dh_probes": dh_probes,
+        "rh_obj": rh_obj, "rh_rel": rh_rel, "rh_row": rh_row,
+        "rh_probes": rh_probes,
+        "row_ptr": row_ptr, "e_obj": e_obj, "e_rel": e_rel,
+    }
 
 
 @dataclass
@@ -257,7 +364,12 @@ def build_snapshot(
     namespaces: Sequence[Namespace],
     K: int = 8,
     version: int = 0,
+    with_edge_tables: bool = True,
 ) -> GraphSnapshot:
+    """`with_edge_tables=False` builds only the vocabulary + rewrite
+    programs (placeholder edge tables): the sharded builder re-builds the
+    edge tables per shard and would otherwise pay the global O(edges)
+    hash-table construction twice."""
     # ---- vocabularies -------------------------------------------------------
     ns_ids: dict[str, int] = {}
     rel_ids: dict[str, int] = {}
@@ -315,65 +427,23 @@ def build_snapshot(
 
     # ---- edges --------------------------------------------------------------
     n_t = len(tuples)
-    t_obj = np.zeros(n_t, dtype=np.int32)
-    t_rel = np.zeros(n_t, dtype=np.int32)
-    t_skind = np.zeros(n_t, dtype=np.int32)
-    t_sa = np.zeros(n_t, dtype=np.int32)
-    t_sb = np.zeros(n_t, dtype=np.int32)
-    for i, t in enumerate(tuples):
-        n = ns_ids[t.namespace]
-        t_obj[i] = obj_slots[(n, t.object)]
-        t_rel[i] = rel_ids[t.relation]
-        if t.subject_set is not None:
-            s = t.subject_set
-            t_skind[i] = 1
-            t_sa[i] = obj_slots[(ns_ids[s.namespace], s.object)]
-            t_sb[i] = rel_ids[s.relation]
-        else:
-            t_sa[i] = subj_ids[t.subject_id or ""]
-
-    # direct-edge hash table over all edges (plain and subject-set)
-    dh = _build_hash_table(
-        (t_obj, t_rel, t_skind, t_sa, t_sb),
-        np.ones(n_t, dtype=np.int32),
-    )
-    dh_obj, dh_rel, dh_skind, dh_sa, dh_sb, dh_val, dh_probes = dh
-
-    # subject-set CSR grouped by (obj, rel); wildcard-relation subject sets
-    # are kept (TTU traverses them; the kernel filters them for the
-    # expand-subject slot)
-    is_set = t_skind == 1
-    ss_obj = t_obj[is_set]
-    ss_rel = t_rel[is_set]
-    ss_sa = t_sa[is_set]
-    ss_sb = t_sb[is_set]
-    if len(ss_obj):
-        order = np.lexsort((ss_sb, ss_sa, ss_rel, ss_obj))
-        ss_obj, ss_rel = ss_obj[order], ss_rel[order]
-        ss_sa, ss_sb = ss_sa[order], ss_sb[order]
-        row_change = np.empty(len(ss_obj), dtype=bool)
-        row_change[0] = True
-        row_change[1:] = (ss_obj[1:] != ss_obj[:-1]) | (ss_rel[1:] != ss_rel[:-1])
-        row_starts = np.flatnonzero(row_change)
-        n_rows = len(row_starts)
-        row_ptr = np.append(row_starts, len(ss_obj)).astype(np.int32)
-        row_keys_obj = ss_obj[row_starts]
-        row_keys_rel = ss_rel[row_starts]
-        rh = _build_hash_table(
-            (row_keys_obj, row_keys_rel), np.arange(n_rows, dtype=np.int32)
+    if with_edge_tables:
+        t_obj, t_rel, t_skind, t_sa, t_sb = encode_edge_arrays(
+            tuples, ns_ids, rel_ids, obj_slots, subj_ids
         )
-        rh_obj, rh_rel, rh_row, rh_probes = rh
-        e_obj, e_rel = ss_sa.astype(np.int32), ss_sb.astype(np.int32)
+        tables = build_edge_tables(t_obj, t_rel, t_skind, t_sa, t_sb)
     else:
-        row_ptr = np.zeros(1, dtype=np.int32)
-        rh_obj, rh_rel, rh_row, rh_probes = (
-            np.full(64, EMPTY, np.int32),
-            np.full(64, EMPTY, np.int32),
-            np.full(64, EMPTY, np.int32),
-            1,
-        )
-        e_obj = np.zeros(0, dtype=np.int32)
-        e_rel = np.zeros(0, dtype=np.int32)
+        z = np.zeros(0, dtype=np.int32)
+        tables = build_edge_tables(z, z, z, z, z)
+    dh_obj, dh_rel, dh_skind, dh_sa, dh_sb = (
+        tables["dh_obj"], tables["dh_rel"], tables["dh_skind"],
+        tables["dh_sa"], tables["dh_sb"],
+    )
+    dh_val, dh_probes = tables["dh_val"], tables["dh_probes"]
+    rh_obj, rh_rel, rh_row = tables["rh_obj"], tables["rh_rel"], tables["rh_row"]
+    rh_probes = tables["rh_probes"]
+    row_ptr = tables["row_ptr"]
+    e_obj, e_rel = tables["e_obj"], tables["e_rel"]
 
     # ---- rewrite programs ---------------------------------------------------
     NR = n_ns * max(n_config_rels, 1)

@@ -50,6 +50,7 @@ class TPUCheckEngine:
         nid: str = DEFAULT_NETWORK,
         frontier_cap: int = 1 << 14,
         rewrite_instr_cap: int = 8,
+        mesh=None,
     ):
         self.manager = manager
         self.config = config
@@ -58,16 +59,22 @@ class TPUCheckEngine:
         self.frontier_cap = max(frontier_cap, _BUCKETS[0])
         self._allowed_buckets = [b for b in _BUCKETS if b <= self.frontier_cap]
         self.rewrite_instr_cap = rewrite_instr_cap
+        # multi-chip: a 1-D jax.sharding.Mesh shards the edge tables and
+        # runs the SPMD kernel (keto_tpu/parallel); None = single device
+        self.mesh = mesh
         self.reference = ReferenceEngine(manager, config)
         self._lock = threading.Lock()
         self._snapshot: Optional[GraphSnapshot] = None
+        self._sharded = None
         self._tables = None
         # device-path observability (served vs host-fallback checks)
         self.stats = {"device_checks": 0, "host_checks": 0, "snapshot_builds": 0}
 
     # -- snapshot lifecycle ---------------------------------------------------
 
-    def _ensure_snapshot(self) -> tuple[GraphSnapshot, dict]:
+    def _ensure_snapshot(self):
+        """Returns (snapshot, sharded_snapshot_or_None, tables) as one
+        consistent triple (concurrent rebuild/invalidate safe)."""
         # staleness key covers BOTH the store write version and the
         # namespace-config content: a rewrite change with no tuple writes
         # must also rebuild the compiled rewrite programs
@@ -81,17 +88,33 @@ class TPUCheckEngine:
             snap = self._snapshot
             if snap is None or snap.version != version:
                 tuples = self.manager.all_relation_tuples(nid=self.nid)
-                snap = build_snapshot(
-                    tuples, namespaces, K=self.rewrite_instr_cap, version=version
-                )
+                if self.mesh is not None:
+                    from ..parallel import build_sharded_snapshot
+                    from ..parallel.kernel import place_sharded_tables
+
+                    sharded = build_sharded_snapshot(
+                        tuples,
+                        namespaces,
+                        n_shards=self.mesh.devices.size,
+                        K=self.rewrite_instr_cap,
+                        version=version,
+                    )
+                    snap = sharded.base
+                    self._sharded = sharded
+                    self._tables = place_sharded_tables(sharded, self.mesh)
+                else:
+                    snap = build_snapshot(
+                        tuples, namespaces, K=self.rewrite_instr_cap, version=version
+                    )
+                    self._tables = snapshot_tables(snap)
                 self._snapshot = snap
-                self._tables = snapshot_tables(snap)
                 self.stats["snapshot_builds"] += 1
-            return snap, self._tables
+            return snap, self._sharded, self._tables
 
     def invalidate(self) -> None:
         with self._lock:
             self._snapshot = None
+            self._sharded = None
             self._tables = None
 
     # -- check API ------------------------------------------------------------
@@ -122,7 +145,7 @@ class TPUCheckEngine:
         n = len(tuples)
         if n == 0:
             return []
-        snap, tables = self._ensure_snapshot()
+        snap, sharded_snap, tables = self._ensure_snapshot()
         global_max = self.config.max_read_depth()
         depth = max_depth if 0 < max_depth <= global_max else global_max
 
@@ -160,12 +183,25 @@ class TPUCheckEngine:
             # error flags surface, but no direct probe can hit
             q_valid[i] = True
 
-        cfg = kernel_static_config(snap, global_max, self.frontier_cap)
-        member, needs_host = check_kernel(
-            tables,
-            q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid,
-            **cfg,
-        )
+        if self.mesh is not None:
+            from ..parallel.kernel import sharded_check_kernel, sharded_static_config
+
+            statics = sharded_static_config(
+                sharded_snap, global_max, self.frontier_cap
+            )
+            sharded_tables, replicated_tables = tables
+            member, needs_host = sharded_check_kernel(
+                self.mesh, sharded_tables, replicated_tables,
+                q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid,
+                statics=statics,
+            )
+        else:
+            cfg = kernel_static_config(snap, global_max, self.frontier_cap)
+            member, needs_host = check_kernel(
+                tables,
+                q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid,
+                **cfg,
+            )
         member = np.asarray(member)
         needs_host = np.asarray(needs_host)
 

@@ -1,0 +1,18 @@
+"""Multi-chip parallelism: edge-sharded graph mirror + SPMD check kernel.
+
+The reference scales by pointing N stateless server replicas at one SQL
+database (SURVEY.md §2.11); the TPU-native analog is ONE logical engine
+whose edge tables are sharded over a `jax.sharding.Mesh` and whose BFS
+steps merge per-shard results with ICI collectives (psum for membership,
+all_gather for frontier candidates).
+"""
+
+from .sharding import ShardedSnapshot, build_sharded_snapshot, default_mesh
+from .kernel import sharded_check_kernel
+
+__all__ = [
+    "ShardedSnapshot",
+    "build_sharded_snapshot",
+    "default_mesh",
+    "sharded_check_kernel",
+]

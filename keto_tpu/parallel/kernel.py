@@ -1,0 +1,179 @@
+"""SPMD multi-chip check kernel: shard_map over a 1-D device mesh.
+
+Same BFS semantics as the single-chip kernel (engine/kernel.py) — the
+step phases are shared code — with the edge tables sharded by object
+slot and two ICI collectives per step:
+
+  - `psum` OR-merge of per-shard direct-probe hits (a direct edge lives
+    on exactly one shard, the one owning its object slot)
+  - `all_gather` of per-shard candidate children before the dedupe (a
+    task's CSR row lives on one shard; other shards contribute nothing)
+
+The frontier and per-query result masks stay replicated: every device
+runs the identical merged state, so the while_loop trip count agrees
+across the mesh and the host reads back one copy. This mirrors the
+scaling-book recipe — pick a mesh, shard the big arrays, let collectives
+ride ICI — rather than the reference's shared-SQL-database fan-out
+(SURVEY.md §2.11).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.4.35 exposes shard_map at the top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from ..engine.kernel import (
+    Expansion,
+    _State,
+    dedupe_phase,
+    expand_phase,
+    finalize,
+    flag_phase,
+    kernel_static_config,
+    loop_cond,
+    probe_phase,
+    seed_state,
+)
+from .sharding import ShardedSnapshot, _REPLICATED_KEYS, _SHARDED_KEYS
+
+# compiled-executable cache; statics change as the graph grows (probe
+# counts track hash-table clustering), so bound it LRU-style — older
+# snapshots' kernels are never called again
+_kernel_cache: dict = {}
+_KERNEL_CACHE_CAP = 8
+
+
+def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
+    (
+        K, dh_probes, rh_probes, max_steps,
+        wildcard_rel, n_config_rels, frontier_cap,
+    ) = statics
+    F = frontier_cap
+
+    def run(shard_tabs, rep_tabs, q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid):
+        tables = {k: v[0] for k, v in shard_tabs.items()}
+        tables.update(rep_tabs)
+        B = q_obj.shape[0]
+
+        def step_fn(st: _State) -> _State:
+            idx = jnp.arange(F, dtype=jnp.int32)
+            q = st.t_q
+            live = (idx < st.n_tasks) & ~(st.member | st.needs_host)[q]
+            obj, rel, depth = st.t_obj, st.t_rel, st.t_depth
+
+            # flags depend only on replicated tables: identical everywhere
+            flagged = flag_phase(tables, obj, rel, live, n_config_rels=n_config_rels)
+            hit_local = probe_phase(
+                tables, obj, rel, q_skind[q], q_sa[q], q_sb[q], depth, live,
+                dh_probes=dh_probes,
+            )
+            hit = jax.lax.psum(hit_local.astype(jnp.int32), axis) > 0
+            member = st.member.at[q].max(hit)
+            needs_host = st.needs_host.at[q].max(flagged)
+            live = live & ~(member | needs_host)[q]
+
+            children, overflow_q = expand_phase(
+                tables, q, obj, rel, depth, live,
+                K=K, rh_probes=rh_probes, n_config_rels=n_config_rels,
+                wildcard_rel=wildcard_rel, n_queries=B,
+            )
+            needs_host = needs_host | (
+                jax.lax.psum(overflow_q.astype(jnp.int32), axis) > 0
+            )
+
+            # merge candidate frontiers: [ndev, F] -> [ndev * F]
+            gathered = Expansion(
+                *(
+                    jax.lax.all_gather(part, axis).reshape(-1)
+                    for part in children
+                )
+            )
+            nt_q, nt_obj, nt_rel, nt_depth, n_new, overflow2 = dedupe_phase(
+                gathered, F, B
+            )
+            needs_host = needs_host | overflow2
+            return _State(
+                nt_q, nt_obj, nt_rel, nt_depth, n_new,
+                member, needs_host, st.step + 1,
+            )
+
+        init = seed_state(q_obj, q_rel, q_depth, q_valid, F)
+        final = jax.lax.while_loop(loop_cond(max_steps), step_fn, init)
+        return finalize(final, max_steps)
+
+    mapped = _shard_map(
+        run,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P(), P(), P(), P(), P(), P(), P()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def get_sharded_kernel(mesh: Mesh, statics: tuple, axis: str = "x"):
+    key = (mesh, axis, statics)
+    fn = _kernel_cache.pop(key, None)
+    if fn is None:
+        fn = _build_kernel(mesh, axis, statics)
+        while len(_kernel_cache) >= _KERNEL_CACHE_CAP:
+            _kernel_cache.pop(next(iter(_kernel_cache)))
+    _kernel_cache[key] = fn  # re-insert = move to MRU position
+    return fn
+
+
+def sharded_static_config(
+    snap: ShardedSnapshot, max_depth: int, frontier_cap: int
+) -> tuple:
+    """Single-chip static config (one source of truth for the step-budget
+    formula) with the per-shard probe maxima patched in."""
+    cfg = kernel_static_config(snap.base, max_depth, frontier_cap)
+    cfg["dh_probes"] = snap.dh_probes
+    cfg["rh_probes"] = snap.rh_probes
+    return (
+        cfg["K"], cfg["dh_probes"], cfg["rh_probes"], cfg["max_steps"],
+        cfg["wildcard_rel"], cfg["n_config_rels"], cfg["frontier_cap"],
+    )
+
+
+def place_sharded_tables(
+    snap: ShardedSnapshot, mesh: Mesh, axis: str = "x"
+) -> tuple[dict, dict]:
+    """Upload tables once: sharded arrays split along the mesh axis (one
+    shard per device), small tables replicated."""
+    sharded = {
+        k: jax.device_put(
+            v, NamedSharding(mesh, P(axis, *([None] * (v.ndim - 1))))
+        )
+        for k, v in snap.sharded.items()
+    }
+    replicated = {
+        k: jax.device_put(v, NamedSharding(mesh, P()))
+        for k, v in snap.replicated.items()
+    }
+    return sharded, replicated
+
+
+def sharded_check_kernel(
+    mesh: Mesh,
+    sharded_tables: dict,
+    replicated_tables: dict,
+    q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid,
+    *,
+    statics: tuple,
+    axis: str = "x",
+):
+    """Returns (member[B], needs_host[B]); see engine/kernel.check_kernel."""
+    assert set(sharded_tables) == set(_SHARDED_KEYS)
+    assert set(replicated_tables) == set(_REPLICATED_KEYS)
+    fn = get_sharded_kernel(mesh, statics, axis)
+    return fn(
+        sharded_tables, replicated_tables,
+        q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid,
+    )

@@ -1,0 +1,163 @@
+"""Edge-sharded graph snapshots for the multi-chip check kernel.
+
+Partitioning scheme: every (namespace, object) "object slot" is assigned
+to one shard by a murmur hash of its id. A shard owns
+
+  - all direct edges whose object lives on it (the open-addressing probe
+    for `checkDirect` hits exactly one shard; merged with a psum-OR), and
+  - all subject-set CSR rows of its objects (frontier expansion is local;
+    the per-shard candidate children are all-gathered before dedupe).
+
+This is the TPU translation of "namespace/edge sharding across the ICI
+mesh" (SURVEY.md §2.11, §7.7): the vocabulary (string → int32 encoding),
+the rewrite-program table, and the object→namespace map are small and
+replicated; only the O(edges) structures shard.
+
+Open-addressing probe sequences depend on table capacity, so all shards
+are built at the SAME capacity (the max any shard needs) and stacked
+along a leading device axis; probe limits take the per-shard max.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Sequence
+
+import numpy as np
+
+from ..ketoapi import RelationTuple
+from ..namespace.definitions import Namespace
+from ..engine.snapshot import (
+    GraphSnapshot,
+    build_edge_tables,
+    build_snapshot,
+    encode_edge_arrays,
+    hash_table_capacity,
+    mix32,
+)
+
+_SHARDED_KEYS = (
+    "dh_obj", "dh_rel", "dh_skind", "dh_sa", "dh_sb", "dh_val",
+    "rh_obj", "rh_rel", "rh_row", "row_ptr", "e_obj", "e_rel",
+)
+_REPLICATED_KEYS = (
+    "objslot_ns", "ns_has_config",
+    "instr_kind", "instr_rel", "instr_rel2", "prog_flags",
+)
+
+
+def shard_of_objslot(obj_slot: np.ndarray, n_shards: int) -> np.ndarray:
+    """Deterministic object-slot → shard assignment."""
+    return (mix32(np.asarray(obj_slot, dtype=np.uint32)) % np.uint32(n_shards)).astype(
+        np.int64
+    )
+
+
+@dataclass
+class ShardedSnapshot:
+    """A GraphSnapshot whose edge tables are stacked per shard.
+
+    `base` keeps the host-side vocabulary/encoding helpers and the
+    *global* (unsharded) tables — the single-chip fallback path and the
+    encoding front both use it; `sharded[k]` has shape
+    `(n_shards, *table_shape)`, `replicated[k]` matches the base arrays.
+    """
+
+    base: GraphSnapshot
+    n_shards: int
+    sharded: dict[str, np.ndarray]
+    replicated: dict[str, np.ndarray]
+    dh_probes: int
+    rh_probes: int
+
+    @property
+    def K(self) -> int:
+        return self.base.K
+
+    @property
+    def n_config_rels(self) -> int:
+        return self.base.n_config_rels
+
+    @property
+    def wildcard_rel(self) -> int:
+        return self.base.wildcard_rel
+
+
+def build_sharded_snapshot(
+    tuples: Sequence[RelationTuple],
+    namespaces: Sequence[Namespace],
+    n_shards: int,
+    K: int = 8,
+    version: int = 0,
+) -> ShardedSnapshot:
+    base = build_snapshot(
+        tuples, namespaces, K=K, version=version, with_edge_tables=False
+    )
+    t_obj, t_rel, t_skind, t_sa, t_sb = encode_edge_arrays(
+        tuples, base.ns_ids, base.rel_ids, base.obj_slots, base.subj_ids
+    )
+    shard = shard_of_objslot(t_obj, n_shards)
+    masks = [shard == s for s in range(n_shards)]
+
+    # equal capacities across shards: start from the max natural need and
+    # grow until every shard builds without internal growth
+    dh_cap = max(
+        hash_table_capacity(int(m.sum())) for m in masks
+    )
+    rh_cap = max(
+        hash_table_capacity(int((m & (t_skind == 1)).sum())) for m in masks
+    )
+    while True:
+        per_shard = [
+            build_edge_tables(
+                t_obj[m], t_rel[m], t_skind[m], t_sa[m], t_sb[m],
+                dh_min_cap=dh_cap, rh_min_cap=rh_cap,
+            )
+            for m in masks
+        ]
+        got_dh = max(t["dh_obj"].shape[0] for t in per_shard)
+        got_rh = max(t["rh_obj"].shape[0] for t in per_shard)
+        if got_dh == dh_cap and got_rh == rh_cap:
+            break
+        dh_cap, rh_cap = got_dh, got_rh  # pathological clustering: retry
+
+    # pad ragged CSR arrays to the max length and stack everything
+    max_rows = max(t["row_ptr"].shape[0] for t in per_shard)
+    max_edges = max(t["e_obj"].shape[0] for t in per_shard)
+    stacked: dict[str, np.ndarray] = {}
+    for key in _SHARDED_KEYS:
+        parts = []
+        for t in per_shard:
+            a = t[key]
+            if key == "row_ptr" and a.shape[0] < max_rows:
+                # repeat the terminal offset: padded rows are empty spans
+                a = np.concatenate(
+                    [a, np.full(max_rows - a.shape[0], a[-1], dtype=a.dtype)]
+                )
+            elif key in ("e_obj", "e_rel") and a.shape[0] < max_edges:
+                a = np.concatenate(
+                    [a, np.zeros(max_edges - a.shape[0], dtype=a.dtype)]
+                )
+            parts.append(a)
+        stacked[key] = np.stack(parts)
+
+    replicated = {k: base.device_arrays()[k] for k in _REPLICATED_KEYS}
+    return ShardedSnapshot(
+        base=base,
+        n_shards=n_shards,
+        sharded=stacked,
+        replicated=replicated,
+        dh_probes=max(t["dh_probes"] for t in per_shard),
+        rh_probes=max(t["rh_probes"] for t in per_shard),
+    )
+
+
+def default_mesh(n_devices: int = 0, axis: str = "x"):
+    """A 1-D device mesh over the first `n_devices` (all when 0)."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    if n_devices:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
