@@ -1,0 +1,141 @@
+"""Benchmark: batched Check() throughput on the device engine.
+
+Reproduces BASELINE.md config 2 (batched Check over a cat-videos-style
+topology: ~10k tuples, owner/parent/viewer userset rewrite, concurrent
+checks riding one device batch). The reference publishes no numbers
+(SURVEY.md §6) and no Go toolchain exists in this image, so `vs_baseline`
+is reported against the north-star target of 1,000,000 Check()/sec
+(BASELINE.json metric) — vs_baseline = 1.0 means the Zanzibar-paper-class
+goal is met on the current hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+
+import numpy as np
+
+NORTH_STAR_QPS = 1_000_000.0
+
+N_FOLDERS = 64
+FILES_PER_FOLDER = 120
+N_USERS = 512
+BATCH = 4096
+ROUNDS = 20
+
+
+def build_dataset():
+    from keto_tpu.ketoapi import RelationTuple
+    from keto_tpu.namespace import Namespace
+    from keto_tpu.namespace.ast import (
+        ComputedSubjectSet,
+        Relation,
+        SubjectSetRewrite,
+        TupleToSubjectSet,
+    )
+
+    namespaces = [
+        Namespace(
+            name="videos",
+            relations=[
+                Relation(name="owner"),
+                Relation(name="parent"),
+                Relation(
+                    name="view",
+                    subject_set_rewrite=SubjectSetRewrite(
+                        children=[
+                            ComputedSubjectSet(relation="owner"),
+                            TupleToSubjectSet(
+                                relation="parent",
+                                computed_subject_set_relation="view",
+                            ),
+                        ]
+                    ),
+                ),
+            ],
+        )
+    ]
+    rng = random.Random(1234)
+    tuples = []
+    owners: dict[str, str] = {}
+    for d in range(N_FOLDERS):
+        owner = f"user{rng.randrange(N_USERS)}"
+        owners[f"/d{d}"] = owner
+        tuples.append(RelationTuple.from_string(f"videos:/d{d}#owner@{owner}"))
+        for f in range(FILES_PER_FOLDER):
+            obj = f"/d{d}/v{f}.mp4"
+            tuples.append(
+                RelationTuple.from_string(f"videos:{obj}#parent@(videos:/d{d}#...)")
+            )
+            if rng.random() < 0.25:
+                u = f"user{rng.randrange(N_USERS)}"
+                tuples.append(RelationTuple.from_string(f"videos:{obj}#owner@{u}"))
+                owners[obj] = u
+    # query mix: half hits (folder owner sees nested file), half misses
+    queries = []
+    objs = sorted(o for o in owners if o.count("/") == 1)
+    for i in range(BATCH):
+        d = rng.randrange(N_FOLDERS)
+        obj = f"/d{d}/v{rng.randrange(FILES_PER_FOLDER)}.mp4"
+        if i % 2 == 0:
+            sub = owners[f"/d{d}"]
+        else:
+            sub = f"user{rng.randrange(N_USERS)}"
+        queries.append(RelationTuple.from_string(f"videos:{obj}#view@{sub}"))
+    return namespaces, tuples, queries
+
+
+def main():
+    from keto_tpu.config import Config
+    from keto_tpu.engine.tpu_engine import TPUCheckEngine
+    from keto_tpu.storage import MemoryManager
+
+    namespaces, tuples, queries = build_dataset()
+    cfg = Config({"limit": {"max_read_depth": 5}})
+    cfg.set_namespaces(namespaces)
+    manager = MemoryManager()
+    manager.write_relation_tuples(tuples)
+    # frontier cap 2×batch: smallest cap that keeps this workload fully
+    # on-device (overflow would flag host replay); per-step sort cost
+    # scales with the cap, so oversizing it halves throughput
+    engine = TPUCheckEngine(manager, cfg, frontier_cap=2 * BATCH)
+
+    # warm-up: snapshot build + kernel compile
+    engine.check_batch(queries)
+    assert engine.stats["host_checks"] == 0, "bench workload must stay on device"
+
+    latencies = []
+    t0 = time.perf_counter()
+    for _ in range(ROUNDS):
+        s = time.perf_counter()
+        engine.check_batch(queries)
+        latencies.append(time.perf_counter() - s)
+    wall = time.perf_counter() - t0
+
+    qps = ROUNDS * BATCH / wall
+    lat = np.array(latencies) * 1e3
+    import jax
+
+    print(
+        json.dumps(
+            {
+                "metric": "batched_check_qps",
+                "value": round(qps, 1),
+                "unit": "checks/sec",
+                "vs_baseline": round(qps / NORTH_STAR_QPS, 4),
+                "batch": BATCH,
+                "tuples": len(tuples),
+                "p50_batch_ms": round(float(np.percentile(lat, 50)), 2),
+                "p95_batch_ms": round(float(np.percentile(lat, 95)), 2),
+                "device": str(jax.devices()[0]),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -101,7 +101,9 @@ class TPUCheckEngine:
                     )
                     snap = sharded.base
                     self._sharded = sharded
-                    self._tables = place_sharded_tables(sharded, self.mesh)
+                    self._tables = place_sharded_tables(
+                        sharded, self.mesh, axis=self.mesh.axis_names[0]
+                    )
                 else:
                     snap = build_snapshot(
                         tuples, namespaces, K=self.rewrite_instr_cap, version=version
@@ -193,7 +195,7 @@ class TPUCheckEngine:
             member, needs_host = sharded_check_kernel(
                 self.mesh, sharded_tables, replicated_tables,
                 q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid,
-                statics=statics,
+                statics=statics, axis=self.mesh.axis_names[0],
             )
         else:
             cfg = kernel_static_config(snap, global_max, self.frontier_cap)

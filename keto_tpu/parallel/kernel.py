@@ -19,6 +19,8 @@ ride ICI — rather than the reference's shared-SQL-database fan-out
 
 from __future__ import annotations
 
+import threading
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -44,8 +46,10 @@ from .sharding import ShardedSnapshot, _REPLICATED_KEYS, _SHARDED_KEYS
 
 # compiled-executable cache; statics change as the graph grows (probe
 # counts track hash-table clustering), so bound it LRU-style — older
-# snapshots' kernels are never called again
+# snapshots' kernels are never called again. Guarded by a lock: the
+# engine facade serves concurrent check_batch calls.
 _kernel_cache: dict = {}
+_kernel_cache_lock = threading.Lock()
 _KERNEL_CACHE_CAP = 8
 
 
@@ -119,12 +123,13 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
 
 def get_sharded_kernel(mesh: Mesh, statics: tuple, axis: str = "x"):
     key = (mesh, axis, statics)
-    fn = _kernel_cache.pop(key, None)
-    if fn is None:
-        fn = _build_kernel(mesh, axis, statics)
-        while len(_kernel_cache) >= _KERNEL_CACHE_CAP:
-            _kernel_cache.pop(next(iter(_kernel_cache)))
-    _kernel_cache[key] = fn  # re-insert = move to MRU position
+    with _kernel_cache_lock:
+        fn = _kernel_cache.pop(key, None)
+        if fn is None:
+            fn = _build_kernel(mesh, axis, statics)
+            while len(_kernel_cache) >= _KERNEL_CACHE_CAP:
+                _kernel_cache.pop(next(iter(_kernel_cache)))
+        _kernel_cache[key] = fn  # re-insert = move to MRU position
     return fn
 
 

@@ -57,9 +57,11 @@ def shard_of_objslot(obj_slot: np.ndarray, n_shards: int) -> np.ndarray:
 class ShardedSnapshot:
     """A GraphSnapshot whose edge tables are stacked per shard.
 
-    `base` keeps the host-side vocabulary/encoding helpers and the
-    *global* (unsharded) tables — the single-chip fallback path and the
-    encoding front both use it; `sharded[k]` has shape
+    `base` carries ONLY the host-side vocabulary/encoding helpers and the
+    rewrite-program tables (it is built with `with_edge_tables=False`, so
+    its direct-edge table and CSR are empty placeholders and its probe
+    counts are meaningless — `sharded_static_config` patches them from
+    the per-shard maxima); `sharded[k]` has shape
     `(n_shards, *table_shape)`, `replicated[k]` matches the base arrays.
     """
 
