@@ -77,7 +77,6 @@ def build_dataset():
                 owners[obj] = u
     # query mix: half hits (folder owner sees nested file), half misses
     queries = []
-    objs = sorted(o for o in owners if o.count("/") == 1)
     for i in range(BATCH):
         d = rng.randrange(N_FOLDERS)
         obj = f"/d{d}/v{rng.randrange(FILES_PER_FOLDER)}.mp4"

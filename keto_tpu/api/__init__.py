@@ -1,0 +1,11 @@
+"""API surface: wire-compatible v1alpha2 gRPC services, Keto REST routes,
+the Check micro-batcher, and the serving daemon.
+
+ref: internal/{check,expand,relationtuple}/handler.go + internal/driver/
+daemon.go; proto package ory.keto.relation_tuples.v1alpha2.
+"""
+
+from .batcher import CheckBatcher
+from .client import ReadClient, WriteClient, open_channel
+
+__all__ = ["CheckBatcher", "ReadClient", "WriteClient", "open_channel"]

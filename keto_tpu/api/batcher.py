@@ -1,0 +1,112 @@
+"""Micro-batching front for Check().
+
+The reference parallelizes one check across goroutines (checkgroup); the
+TPU engine instead parallelizes across the batch dimension, so concurrent
+RPC handler threads must be coalesced into device batches: each caller
+enqueues (tuple, depth) and blocks on a future; a single collector thread
+drains the queue — waiting at most `window_s` after the first arrival —
+groups by effective depth (the kernel takes one depth per launch), runs
+`engine.check_batch`, and resolves the futures.
+
+Under no concurrency a request pays ~0 extra latency (the collector pops
+it immediately and the window only applies while topping up an in-flight
+batch); under load, batches approach `max_batch` and throughput rides the
+kernel's batch curve instead of thread count.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Pending:
+    tuple: object
+    max_depth: int
+    future: Future = field(default_factory=Future)
+
+
+class CheckBatcher:
+    def __init__(self, engine, max_batch: int = 1024, window_s: float = 0.002):
+        self.engine = engine
+        self.max_batch = max_batch
+        self.window_s = window_s
+        self._queue: queue.Queue[_Pending | None] = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._run, name="keto-check-batcher", daemon=True
+        )
+        self._closed = False
+        self._thread.start()
+
+    # -- caller side ----------------------------------------------------------
+
+    def check(self, tuple, max_depth: int = 0):
+        """Blocking single check; returns a CheckResult."""
+        if self._closed:
+            raise RuntimeError("CheckBatcher is closed")
+        p = _Pending(tuple, max_depth)
+        self._queue.put(p)
+        return p.future.result()
+
+    def close(self) -> None:
+        self._closed = True
+        self._queue.put(None)
+        self._thread.join(timeout=5)
+        # fail any requests that raced past the _closed gate so no caller
+        # blocks forever on a future the dead collector will never resolve
+        while True:
+            try:
+                p = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if p is not None and not p.future.done():
+                p.future.set_exception(RuntimeError("CheckBatcher is closed"))
+
+    # -- collector ------------------------------------------------------------
+
+    def _drain(self, first: _Pending) -> list[_Pending]:
+        batch = [first]
+        end = time.monotonic() + self.window_s
+        while len(batch) < self.max_batch:
+            timeout = end - time.monotonic()
+            if timeout <= 0:
+                # window expired: take whatever is already queued, no waiting
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+            else:
+                try:
+                    item = self._queue.get(timeout=timeout)
+                except queue.Empty:
+                    break
+            if item is None:
+                self._queue.put(None)  # re-signal shutdown for the main loop
+                break
+            batch.append(item)
+        return batch
+
+    def _run(self) -> None:
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            batch = self._drain(item)
+            by_depth: dict[int, list[_Pending]] = {}
+            for p in batch:
+                by_depth.setdefault(p.max_depth, []).append(p)
+            for depth, group in by_depth.items():
+                try:
+                    results = self.engine.check_batch(
+                        [p.tuple for p in group], depth
+                    )
+                except Exception as e:  # engine-level failure fails the batch
+                    for p in group:
+                        p.future.set_exception(e)
+                    continue
+                for p, res in zip(group, results):
+                    p.future.set_result(res)

@@ -1,0 +1,161 @@
+"""gRPC clients for the v1alpha2 services.
+
+The client-side plumbing the CLI commands share (ref: cmd/client/
+grpc_client.go): read/write remotes resolved from flags or
+KETO_READ_REMOTE / KETO_WRITE_REMOTE, plaintext for localhost, TLS
+otherwise (grpc_client.go:75-84). Works against this framework's server
+AND any real Keto deployment (same wire format).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Optional
+
+import grpc
+
+from ..ketoapi import GetResponse, RelationQuery, RelationTuple, Subject, Tree
+from .descriptors import (
+    CHECK_SERVICE,
+    EXPAND_SERVICE,
+    HEALTH_SERVICE,
+    READ_SERVICE,
+    VERSION_SERVICE,
+    WRITE_SERVICE,
+    pb,
+)
+from .messages import (
+    query_to_proto,
+    subject_to_proto,
+    tree_from_proto,
+    tuple_from_proto,
+    tuple_to_proto,
+)
+
+READ_REMOTE_ENV = "KETO_READ_REMOTE"
+WRITE_REMOTE_ENV = "KETO_WRITE_REMOTE"
+DEFAULT_READ_REMOTE = "127.0.0.1:4466"
+DEFAULT_WRITE_REMOTE = "127.0.0.1:4467"
+
+
+def resolve_remote(flag_value: Optional[str], env: str, default: str) -> str:
+    return flag_value or os.environ.get(env) or default
+
+
+def _is_local(remote: str) -> bool:
+    host = remote.rsplit(":", 1)[0]
+    return host in ("localhost", "127.0.0.1", "[::1]", "::1")
+
+
+def open_channel(remote: str, insecure: Optional[bool] = None) -> grpc.Channel:
+    """Plaintext for localhost unless overridden; TLS elsewhere
+    (ref: grpc_client.go:75-84)."""
+    if insecure is None:
+        insecure = _is_local(remote)
+    if insecure:
+        return grpc.insecure_channel(remote)
+    return grpc.secure_channel(remote, grpc.ssl_channel_credentials())
+
+
+class _BaseClient:
+    def __init__(self, channel: grpc.Channel):
+        self.channel = channel
+
+    def _rpc(self, service: str, method: str, req, resp_cls, timeout=None):
+        callable_ = self.channel.unary_unary(
+            f"/{service}/{method}",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=resp_cls.FromString,
+        )
+        return callable_(req, timeout=timeout)
+
+    def get_version(self, timeout=None) -> str:
+        resp = self._rpc(
+            VERSION_SERVICE, "GetVersion", pb.GetVersionRequest(),
+            pb.GetVersionResponse, timeout,
+        )
+        return resp.version
+
+    def health(self, timeout=None) -> str:
+        resp = self._rpc(
+            HEALTH_SERVICE, "Check", pb.HealthCheckRequest(),
+            pb.HealthCheckResponse, timeout,
+        )
+        return pb.HealthCheckResponse.DESCRIPTOR.enum_types_by_name[
+            "ServingStatus"
+        ].values_by_number[resp.status].name
+
+    def close(self) -> None:
+        self.channel.close()
+
+
+class ReadClient(_BaseClient):
+    """CheckService + ExpandService + ReadService client."""
+
+    def check(
+        self, t: RelationTuple, max_depth: int = 0, timeout=None
+    ) -> bool:
+        req = pb.CheckRequest(max_depth=max_depth)
+        req.tuple.CopyFrom(tuple_to_proto(t))
+        resp = self._rpc(CHECK_SERVICE, "Check", req, pb.CheckResponse, timeout)
+        return resp.allowed
+
+    def expand(
+        self, subject: Subject, max_depth: int = 0, timeout=None
+    ) -> Tree:
+        req = pb.ExpandRequest(max_depth=max_depth)
+        req.subject.CopyFrom(subject_to_proto(subject))
+        resp = self._rpc(EXPAND_SERVICE, "Expand", req, pb.ExpandResponse, timeout)
+        return tree_from_proto(resp.tree)
+
+    def list_relation_tuples(
+        self,
+        query: RelationQuery,
+        page_size: int = 0,
+        page_token: str = "",
+        timeout=None,
+    ) -> GetResponse:
+        req = pb.ListRelationTuplesRequest(
+            page_size=page_size, page_token=page_token
+        )
+        req.relation_query.CopyFrom(query_to_proto(query))
+        resp = self._rpc(
+            READ_SERVICE, "ListRelationTuples", req,
+            pb.ListRelationTuplesResponse, timeout,
+        )
+        return GetResponse(
+            relation_tuples=[tuple_from_proto(m) for m in resp.relation_tuples],
+            next_page_token=resp.next_page_token,
+        )
+
+
+class WriteClient(_BaseClient):
+    """WriteService client."""
+
+    def transact(
+        self,
+        insert: Iterable[RelationTuple] = (),
+        delete: Iterable[RelationTuple] = (),
+        timeout=None,
+    ) -> None:
+        req = pb.TransactRelationTuplesRequest()
+        for t in insert:
+            d = req.relation_tuple_deltas.add()
+            d.action = 1
+            d.relation_tuple.CopyFrom(tuple_to_proto(t))
+        for t in delete:
+            d = req.relation_tuple_deltas.add()
+            d.action = 2
+            d.relation_tuple.CopyFrom(tuple_to_proto(t))
+        self._rpc(
+            WRITE_SERVICE, "TransactRelationTuples", req,
+            pb.TransactRelationTuplesResponse, timeout,
+        )
+
+    def delete_all(self, query: RelationQuery, timeout=None) -> None:
+        req = pb.DeleteRelationTuplesRequest()
+        req.relation_query.CopyFrom(query_to_proto(query))
+        self._rpc(
+            WRITE_SERVICE, "DeleteRelationTuples", req,
+            pb.DeleteRelationTuplesResponse, timeout,
+        )

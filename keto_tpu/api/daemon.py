@@ -1,0 +1,241 @@
+"""Serving daemon: read/write/metrics listeners with gRPC+REST port sharing.
+
+Parity with internal/driver/daemon.go: ServeAll starts three listeners —
+read (:4466), write (:4467), metrics (:4468) — and the read/write ports
+serve BOTH gRPC (HTTP/2) and REST (HTTP/1.1) on the same address the way
+the reference multiplexes them with cmux (daemon.go:191-276). The Python
+equivalent is a tiny byte-sniffing mux: every accepted connection is
+peeked for the HTTP/2 client preface ("PRI * HTTP/2.0") and spliced to an
+internal loopback gRPC or REST listener accordingly. Shutdown is graceful
+in the reference's order: stop accepting, drain, stop servers
+(daemon.go:233-273).
+"""
+
+from __future__ import annotations
+
+import logging
+import selectors
+import socket
+import threading
+
+from .batcher import CheckBatcher
+from .grpc_server import build_grpc_server
+from .rest_server import RESTServer
+
+logger = logging.getLogger("keto_tpu")
+
+_H2_PREFACE = b"PRI * HTTP/2.0"
+
+
+class PortMux:
+    """cmux equivalent: route h2 connections to gRPC, h1 to REST."""
+
+    def __init__(self, host: str, port: int, grpc_addr, http_addr):
+        self.grpc_addr = grpc_addr
+        self.http_addr = http_addr
+        self._listener = socket.create_server(
+            (host, port), family=socket.AF_INET, backlog=128, reuse_port=False
+        )
+        self._listener.settimeout(0.5)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._accept_loop, name=f"keto-mux-{port}", daemon=True
+        )
+
+    @property
+    def port(self) -> int:
+        return self._listener.getsockname()[1]
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        self._thread.join(timeout=5)
+
+    # -- internals ------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._handshake, args=(conn,), daemon=True
+            ).start()
+
+    def _handshake(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(10)
+            # Block (PEEK|WAITALL) for the full preface length: an HTTP/1.1
+            # request line is always longer, so a prefix-only peek of a slow
+            # first segment (e.g. just b"P") can never misroute.
+            try:
+                head = conn.recv(
+                    len(_H2_PREFACE), socket.MSG_PEEK | socket.MSG_WAITALL
+                )
+            except socket.timeout:
+                head = b""
+            if not head:
+                conn.close()
+                return
+            backend_addr = (
+                self.grpc_addr if head.startswith(_H2_PREFACE) else self.http_addr
+            )
+            backend = socket.create_connection(backend_addr)
+            conn.settimeout(None)
+            self._splice(conn, backend)
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _splice(a: socket.socket, b: socket.socket) -> None:
+        """Bidirectional byte pump until either side closes."""
+        sel = selectors.DefaultSelector()
+        sel.register(a, selectors.EVENT_READ, b)
+        sel.register(b, selectors.EVENT_READ, a)
+        try:
+            open_sides = 2
+            while open_sides:
+                for key, _ in sel.select(timeout=60):
+                    src, dst = key.fileobj, key.data
+                    try:
+                        data = src.recv(65536)
+                    except OSError:
+                        data = b""
+                    if not data:
+                        sel.unregister(src)
+                        open_sides -= 1
+                        try:
+                            dst.shutdown(socket.SHUT_WR)
+                        except OSError:
+                            pass
+                        continue
+                    try:
+                        dst.sendall(data)
+                    except OSError:
+                        return
+        finally:
+            sel.close()
+            for s in (a, b):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+
+class Daemon:
+    """ServeAll: compose batcher + 2 gRPC servers + 3 REST routers + muxes.
+    ref: daemon.go:87-126 (errgroup of three listeners)."""
+
+    def __init__(self, registry, host: str | None = None):
+        self.registry = registry
+        cfg = registry.config
+        self.read_addr = cfg.read_api_address()
+        self.write_addr = cfg.write_api_address()
+        self.metrics_addr = cfg.metrics_api_address()
+        if host is not None:
+            self.read_addr.host = self.write_addr.host = self.metrics_addr.host = host
+        self.batcher = CheckBatcher(registry.check_engine())
+        self._grpc_read = None
+        self._grpc_write = None
+        self._rest = {}
+        self._muxes = {}
+        self._started = False
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        reg = self.registry
+        # internal loopback backends (ephemeral ports)
+        self._grpc_read = build_grpc_server(reg, write=False, batcher=self.batcher)
+        self._grpc_write = build_grpc_server(reg, write=True)
+        grpc_read_port = self._grpc_read.add_insecure_port("127.0.0.1:0")
+        grpc_write_port = self._grpc_write.add_insecure_port("127.0.0.1:0")
+        self._grpc_read.start()
+        self._grpc_write.start()
+
+        self._rest["read"] = RESTServer(reg, "read", "127.0.0.1", 0, batcher=self.batcher)
+        self._rest["write"] = RESTServer(reg, "write", "127.0.0.1", 0)
+        for s in self._rest.values():
+            s.start()
+
+        self._muxes["read"] = PortMux(
+            self.read_addr.host,
+            self.read_addr.port,
+            ("127.0.0.1", grpc_read_port),
+            ("127.0.0.1", self._rest["read"].port),
+        )
+        self._muxes["write"] = PortMux(
+            self.write_addr.host,
+            self.write_addr.port,
+            ("127.0.0.1", grpc_write_port),
+            ("127.0.0.1", self._rest["write"].port),
+        )
+        # metrics is plain HTTP, no mux needed (daemon.go:152-189)
+        self._rest["metrics"] = RESTServer(
+            reg, "metrics", self.metrics_addr.host, self.metrics_addr.port
+        )
+        self._rest["metrics"].start()
+        for m in self._muxes.values():
+            m.start()
+        reg.ready.set()
+        self._started = True
+        logger.info(
+            "serving read=%s:%d write=%s:%d metrics=%s:%d",
+            self.read_addr.host, self.read_port,
+            self.write_addr.host, self.write_port,
+            self.metrics_addr.host, self.metrics_port,
+        )
+
+    @property
+    def read_port(self) -> int:
+        return self._muxes["read"].port
+
+    @property
+    def write_port(self) -> int:
+        return self._muxes["write"].port
+
+    @property
+    def metrics_port(self) -> int:
+        return self._rest["metrics"].port
+
+    def stop(self, grace: float = 5.0) -> None:
+        """Graceful drain: readiness off, stop accepting, stop servers."""
+        self.registry.ready.clear()
+        for m in self._muxes.values():
+            m.stop()
+        if self._grpc_read is not None:
+            self._grpc_read.stop(grace).wait(grace)
+        if self._grpc_write is not None:
+            self._grpc_write.stop(grace).wait(grace)
+        for s in self._rest.values():
+            s.stop()
+        self.batcher.close()
+
+    def serve_forever(self) -> None:
+        """Blocks until SIGINT/SIGTERM (ref: daemon.go:93-117 graceful)."""
+        import signal
+
+        stop_event = threading.Event()
+
+        def _on_signal(signum, frame):
+            logger.info("received signal %d, shutting down", signum)
+            stop_event.set()
+
+        signal.signal(signal.SIGINT, _on_signal)
+        signal.signal(signal.SIGTERM, _on_signal)
+        if not self._started:
+            self.start()
+        stop_event.wait()
+        self.stop()

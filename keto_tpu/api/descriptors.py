@@ -1,0 +1,75 @@
+"""Runtime proto message classes from the checked-in descriptor set.
+
+The v1alpha2 API surface is declared in `protos/keto.proto` (wire-parity
+with the reference's proto package, see that file) and compiled by protoc
+into `protos/keto_descriptors.binpb`. Loading the descriptor set at import
+time and synthesizing message classes through the descriptor pool keeps the
+repo free of generated *_pb2.py code and independent of the protoc/protobuf
+gencode version treadmill. Regenerate with:
+
+    protoc --include_imports --descriptor_set_out=keto_descriptors.binpb \
+        -I keto_tpu/api/protos keto.proto health.proto
+"""
+
+from __future__ import annotations
+
+import pathlib
+from types import SimpleNamespace
+
+from google.protobuf import descriptor_pb2, descriptor_pool, message_factory
+
+_PKG = "ory.keto.relation_tuples.v1alpha2"
+_DESCRIPTOR_FILE = pathlib.Path(__file__).parent / "protos" / "keto_descriptors.binpb"
+
+# A private pool (not the process-default) so embedding applications that
+# also load real Keto *_pb2 modules don't hit duplicate-symbol errors.
+_pool = descriptor_pool.DescriptorPool()
+_fds = descriptor_pb2.FileDescriptorSet()
+_fds.ParseFromString(_DESCRIPTOR_FILE.read_bytes())
+for _f in _fds.file:
+    _pool.Add(_f)
+
+
+def _msg(full_name: str):
+    return message_factory.GetMessageClass(_pool.FindMessageTypeByName(full_name))
+
+
+def _keto(name: str):
+    return _msg(f"{_PKG}.{name}")
+
+
+pb = SimpleNamespace(
+    RelationTuple=_keto("RelationTuple"),
+    RelationQuery=_keto("RelationQuery"),
+    Subject=_keto("Subject"),
+    SubjectSet=_keto("SubjectSet"),
+    SubjectTree=_keto("SubjectTree"),
+    CheckRequest=_keto("CheckRequest"),
+    CheckResponse=_keto("CheckResponse"),
+    ExpandRequest=_keto("ExpandRequest"),
+    ExpandResponse=_keto("ExpandResponse"),
+    ListRelationTuplesRequest=_keto("ListRelationTuplesRequest"),
+    ListRelationTuplesResponse=_keto("ListRelationTuplesResponse"),
+    TransactRelationTuplesRequest=_keto("TransactRelationTuplesRequest"),
+    TransactRelationTuplesResponse=_keto("TransactRelationTuplesResponse"),
+    RelationTupleDelta=_keto("RelationTupleDelta"),
+    DeleteRelationTuplesRequest=_keto("DeleteRelationTuplesRequest"),
+    DeleteRelationTuplesResponse=_keto("DeleteRelationTuplesResponse"),
+    GetVersionRequest=_keto("GetVersionRequest"),
+    GetVersionResponse=_keto("GetVersionResponse"),
+    HealthCheckRequest=_msg("grpc.health.v1.HealthCheckRequest"),
+    HealthCheckResponse=_msg("grpc.health.v1.HealthCheckResponse"),
+)
+
+NODE_TYPE = _pool.FindEnumTypeByName(f"{_PKG}.NodeType")
+ACTION = pb.RelationTupleDelta.DESCRIPTOR.enum_types_by_name["Action"]
+SERVING_STATUS = pb.HealthCheckResponse.DESCRIPTOR.enum_types_by_name["ServingStatus"]
+
+# Fully-qualified service names: the gRPC route is /<service>/<method>, so
+# these strings ARE the wire compatibility contract for existing clients.
+CHECK_SERVICE = f"{_PKG}.CheckService"
+EXPAND_SERVICE = f"{_PKG}.ExpandService"
+READ_SERVICE = f"{_PKG}.ReadService"
+WRITE_SERVICE = f"{_PKG}.WriteService"
+VERSION_SERVICE = f"{_PKG}.VersionService"
+HEALTH_SERVICE = "grpc.health.v1.Health"

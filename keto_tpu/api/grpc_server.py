@@ -1,0 +1,304 @@
+"""gRPC services: Check, Expand, Read, Write, Version, Health.
+
+Wire-compatible with the reference's v1alpha2 services (the route strings
+and message bytes match; see protos/keto.proto). Handlers are registered
+through `grpc.method_handlers_generic_handler` against the runtime message
+classes from descriptors.py, so no generated service stubs are needed.
+
+Behavioral parity:
+  - Check: `tuple` field preferred over the deprecated flat fields
+    (check/handler.go:248-256); unknown namespace is an ERROR here (only
+    REST swallows it to allowed=false); snaptoken answered with
+    "not yet implemented" (handler.go:273)
+  - Expand: SubjectID short-circuits to a leaf carrying only the
+    deprecated subject field (expand/handler.go:110-118)
+  - List/Delete: `relation_query` preferred, deprecated `query` accepted,
+    neither -> InvalidArgument (read_server.go:65-75, transact_server.go:62-75)
+  - Transact: one snaptoken stub per INSERT delta (transact_server.go:54-58)
+  - errors map through the KetoError HTTP status the way the herodot
+    unwrap interceptor does (daemon.go:351-360)
+
+Check rides the CheckBatcher so concurrent RPCs share device batches.
+"""
+
+from __future__ import annotations
+
+from concurrent import futures as _futures
+
+import grpc
+
+from ..errors import KetoError
+from ..ketoapi import RelationQuery, RelationTuple, SubjectSet
+from .descriptors import (
+    CHECK_SERVICE,
+    EXPAND_SERVICE,
+    HEALTH_SERVICE,
+    READ_SERVICE,
+    VERSION_SERVICE,
+    WRITE_SERVICE,
+    pb,
+)
+from .messages import (
+    query_from_legacy_proto,
+    query_from_proto,
+    subject_from_proto,
+    subject_to_proto,
+    tree_to_proto,
+    tuple_from_proto,
+    tuple_to_proto,
+)
+
+NOT_IMPLEMENTED_SNAPTOKEN = "not yet implemented"
+
+_CODE_BY_STATUS = {
+    400: grpc.StatusCode.INVALID_ARGUMENT,
+    403: grpc.StatusCode.PERMISSION_DENIED,
+    404: grpc.StatusCode.NOT_FOUND,
+    500: grpc.StatusCode.INTERNAL,
+    501: grpc.StatusCode.UNIMPLEMENTED,
+}
+
+
+def _grpc_code(err: Exception) -> grpc.StatusCode:
+    if isinstance(err, KetoError):
+        return _CODE_BY_STATUS.get(err.status, grpc.StatusCode.INTERNAL)
+    return grpc.StatusCode.INTERNAL
+
+
+class _Services:
+    """The shared handler implementations behind both gRPC servers."""
+
+    def __init__(self, registry, batcher=None):
+        self.registry = registry
+        self.batcher = batcher
+        self.metrics = registry.metrics()
+
+    # -- helpers --------------------------------------------------------------
+
+    def _observed(self, method, context, fn, request):
+        with self.metrics.observe_request("grpc", method) as outcome:
+            try:
+                return fn(request, context)
+            except KetoError as e:
+                outcome["code"] = _grpc_code(e).name
+                context.abort(_grpc_code(e), e.message)
+            except Exception as e:  # noqa: BLE001 — RPC boundary
+                outcome["code"] = "INTERNAL"
+                context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    def _check_tuple(self, req) -> RelationTuple:
+        src = req.tuple if req.HasField("tuple") else req
+        sub = subject_from_proto(src.subject)
+        if sub is None:
+            from ..errors import NilSubjectError
+
+            raise NilSubjectError()
+        return RelationTuple.make(src.namespace, src.object, src.relation, sub)
+
+    def _query_from(self, req) -> RelationQuery:
+        if req.HasField("relation_query"):
+            return query_from_proto(req.relation_query)
+        if req.HasField("query"):
+            return query_from_legacy_proto(req.query)
+        from ..errors import MalformedInputError
+
+        raise MalformedInputError("you must provide a query")
+
+    # -- CheckService ---------------------------------------------------------
+
+    def check(self, req, context):
+        t = self._check_tuple(req)
+        self.registry.validate_namespaces(t)
+        if self.batcher is not None:
+            res = self.batcher.check(t, int(req.max_depth))
+        else:
+            res = self.registry.check_engine().check_relation_tuple(
+                t, int(req.max_depth)
+            )
+        if res.error is not None:
+            raise res.error
+        return pb.CheckResponse(
+            allowed=res.allowed, snaptoken=NOT_IMPLEMENTED_SNAPTOKEN
+        )
+
+    # -- ExpandService --------------------------------------------------------
+
+    def expand(self, req, context):
+        sub = subject_from_proto(req.subject)
+        if not isinstance(sub, SubjectSet):
+            resp = pb.ExpandResponse()
+            resp.tree.node_type = 4  # NODE_TYPE_LEAF
+            if sub is not None:
+                resp.tree.subject.CopyFrom(subject_to_proto(sub))
+            return resp
+        self.registry.validate_namespaces(sub)
+        tree = self.registry.expand_engine().expand(sub, int(req.max_depth))
+        if tree is None:
+            return pb.ExpandResponse()
+        resp = pb.ExpandResponse()
+        resp.tree.CopyFrom(tree_to_proto(tree))
+        return resp
+
+    # -- ReadService ----------------------------------------------------------
+
+    def list_relation_tuples(self, req, context):
+        q = self._query_from(req)
+        self.registry.validate_namespaces(q)
+        manager = self.registry.relation_tuple_manager()
+        page_size = int(req.page_size) or self.registry.config.page_size()
+        tuples, next_token = manager.get_relation_tuples(
+            q,
+            page_token=req.page_token,
+            page_size=page_size,
+            nid=self.registry.nid,
+        )
+        resp = pb.ListRelationTuplesResponse(next_page_token=next_token)
+        for t in tuples:
+            resp.relation_tuples.append(tuple_to_proto(t))
+        return resp
+
+    # -- WriteService ---------------------------------------------------------
+
+    def transact_relation_tuples(self, req, context):
+        inserts: list[RelationTuple] = []
+        deletes: list[RelationTuple] = []
+        for d in req.relation_tuple_deltas:
+            if d.action == 1:  # ACTION_INSERT
+                inserts.append(tuple_from_proto(d.relation_tuple))
+            elif d.action == 2:  # ACTION_DELETE
+                deletes.append(tuple_from_proto(d.relation_tuple))
+            # ACTION_UNSPECIFIED deltas are ignored (transact_server.go:20-31)
+        self.registry.validate_namespaces(*inserts, *deletes)
+        self.registry.relation_tuple_manager().transact_relation_tuples(
+            inserts, deletes, nid=self.registry.nid
+        )
+        return pb.TransactRelationTuplesResponse(
+            snaptokens=[NOT_IMPLEMENTED_SNAPTOKEN] * len(inserts)
+        )
+
+    def delete_relation_tuples(self, req, context):
+        if req.HasField("relation_query"):
+            q = query_from_proto(req.relation_query)
+        elif req.HasField("query"):
+            q = query_from_legacy_proto(req.query)
+        else:
+            from ..errors import MalformedInputError
+
+            raise MalformedInputError("invalid request")
+        self.registry.validate_namespaces(q)
+        self.registry.relation_tuple_manager().delete_all_relation_tuples(
+            q, nid=self.registry.nid
+        )
+        return pb.DeleteRelationTuplesResponse()
+
+    # -- VersionService / Health ----------------------------------------------
+
+    def get_version(self, req, context):
+        return pb.GetVersionResponse(version=self.registry.version)
+
+    def health_check(self, req, context):
+        status = 1 if self.registry.ready.is_set() else 2  # SERVING / NOT_SERVING
+        return pb.HealthCheckResponse(status=status)
+
+    def health_watch(self, req, context):
+        """Streams the current status, then pushes changes until the client
+        disconnects (grpc.health.v1 Watch contract)."""
+        import time as _time
+
+        last = None
+        while context.is_active():
+            current = 1 if self.registry.ready.is_set() else 2
+            if current != last:
+                last = current
+                yield pb.HealthCheckResponse(status=current)
+            _time.sleep(0.5)
+
+
+def _unary(services: _Services, name: str, fn, req_cls):
+    def handler(request, context):
+        return services._observed(name, context, fn, request)
+
+    return grpc.unary_unary_rpc_method_handler(
+        handler,
+        request_deserializer=req_cls.FromString,
+        response_serializer=lambda m: m.SerializeToString(),
+    )
+
+
+def _service_handlers(services: _Services, write: bool):
+    """Generic handlers for one server. Version + Health live on both
+    (daemon.go:387-419)."""
+    s = services
+    handlers = [
+        grpc.method_handlers_generic_handler(
+            VERSION_SERVICE,
+            {"GetVersion": _unary(s, "GetVersion", s.get_version, pb.GetVersionRequest)},
+        ),
+        grpc.method_handlers_generic_handler(
+            HEALTH_SERVICE,
+            {
+                "Check": _unary(s, "HealthCheck", s.health_check, pb.HealthCheckRequest),
+                "Watch": grpc.unary_stream_rpc_method_handler(
+                    lambda req, ctx: s.health_watch(req, ctx),
+                    request_deserializer=pb.HealthCheckRequest.FromString,
+                    response_serializer=lambda m: m.SerializeToString(),
+                ),
+            },
+        ),
+    ]
+    if write:
+        handlers.append(
+            grpc.method_handlers_generic_handler(
+                WRITE_SERVICE,
+                {
+                    "TransactRelationTuples": _unary(
+                        s, "TransactRelationTuples", s.transact_relation_tuples,
+                        pb.TransactRelationTuplesRequest,
+                    ),
+                    "DeleteRelationTuples": _unary(
+                        s, "DeleteRelationTuples", s.delete_relation_tuples,
+                        pb.DeleteRelationTuplesRequest,
+                    ),
+                },
+            )
+        )
+    else:
+        handlers.extend(
+            [
+                grpc.method_handlers_generic_handler(
+                    CHECK_SERVICE,
+                    {"Check": _unary(s, "Check", s.check, pb.CheckRequest)},
+                ),
+                grpc.method_handlers_generic_handler(
+                    EXPAND_SERVICE,
+                    {"Expand": _unary(s, "Expand", s.expand, pb.ExpandRequest)},
+                ),
+                grpc.method_handlers_generic_handler(
+                    READ_SERVICE,
+                    {
+                        "ListRelationTuples": _unary(
+                            s, "ListRelationTuples", s.list_relation_tuples,
+                            pb.ListRelationTuplesRequest,
+                        )
+                    },
+                ),
+            ]
+        )
+    return handlers
+
+
+def build_grpc_server(
+    registry, *, write: bool, batcher=None, max_workers: int = 32
+) -> grpc.Server:
+    """One gRPC server for the read (:4466) or write (:4467) API.
+    The caller binds ports and manages lifecycle (see daemon.py)."""
+    services = _Services(registry, batcher=batcher)
+    server = grpc.server(
+        _futures.ThreadPoolExecutor(
+            max_workers=max_workers,
+            thread_name_prefix="keto-grpc-write" if write else "keto-grpc-read",
+        )
+    )
+    for h in _service_handlers(services, write=write):
+        server.add_generic_rpc_handlers((h,))
+    return server

@@ -1,0 +1,141 @@
+"""Proto <-> ketoapi conversions (the enc_proto layer).
+
+Parity with ketoapi/enc_proto.go: subject oneof handling (:26-43), tuple
+round-trips (:45-77), query round-trips (:80-115), tree encoding incl. the
+deprecated `subject` mirror field (:117-133), and the lossy node-type
+mapping (:160-186) where every node type outside {leaf, union, exclusion,
+intersection} serializes as NODE_TYPE_UNSPECIFIED.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..errors import NilSubjectError
+from ..ketoapi import (
+    RelationQuery,
+    RelationTuple,
+    Subject,
+    SubjectSet,
+    Tree,
+    TreeNodeType,
+)
+from .descriptors import pb
+
+_TO_PROTO_NODE_TYPE = {
+    TreeNodeType.LEAF: 4,
+    TreeNodeType.UNION: 1,
+    TreeNodeType.EXCLUSION: 2,
+    TreeNodeType.INTERSECTION: 3,
+}
+_FROM_PROTO_NODE_TYPE = {
+    4: TreeNodeType.LEAF,
+    1: TreeNodeType.UNION,
+    2: TreeNodeType.EXCLUSION,
+    3: TreeNodeType.INTERSECTION,
+}
+
+
+def subject_to_proto(sub: Subject):
+    m = pb.Subject()
+    if isinstance(sub, SubjectSet):
+        m.set.namespace = sub.namespace
+        m.set.object = sub.object
+        m.set.relation = sub.relation
+    else:
+        m.id = sub
+    return m
+
+
+def subject_from_proto(m) -> Optional[Subject]:
+    which = m.WhichOneof("ref")
+    if which == "id":
+        return m.id
+    if which == "set":
+        return SubjectSet(
+            namespace=m.set.namespace, object=m.set.object, relation=m.set.relation
+        )
+    return None
+
+
+def tuple_to_proto(t: RelationTuple):
+    m = pb.RelationTuple(namespace=t.namespace, object=t.object, relation=t.relation)
+    m.subject.CopyFrom(subject_to_proto(t.subject))
+    return m
+
+
+def tuple_from_proto(m) -> RelationTuple:
+    sub = subject_from_proto(m.subject)
+    if sub is None:
+        raise NilSubjectError()
+    return RelationTuple.make(m.namespace, m.object, m.relation, sub)
+
+
+def query_to_proto(q: RelationQuery):
+    m = pb.RelationQuery()
+    if q.namespace is not None:
+        m.namespace = q.namespace
+    if q.object is not None:
+        m.object = q.object
+    if q.relation is not None:
+        m.relation = q.relation
+    if q.subject is not None:
+        m.subject.CopyFrom(subject_to_proto(q.subject))
+    return m
+
+
+def query_from_proto(m) -> RelationQuery:
+    q = RelationQuery(
+        namespace=m.namespace if m.HasField("namespace") else None,
+        object=m.object if m.HasField("object") else None,
+        relation=m.relation if m.HasField("relation") else None,
+    )
+    if m.HasField("subject"):
+        sub = subject_from_proto(m.subject)
+        if isinstance(sub, SubjectSet):
+            q.subject_set = sub
+        elif sub is not None:
+            q.subject_id = sub
+    return q
+
+
+def query_from_legacy_proto(m) -> RelationQuery:
+    """The deprecated nested Query messages (all-string, empty = unset) used
+    by ListRelationTuplesRequest.query / DeleteRelationTuplesRequest.query.
+    ref: read_server.go:65-102 legacy branch."""
+    q = RelationQuery(namespace=m.namespace or None)
+    if m.object:
+        q.object = m.object
+    if m.relation:
+        q.relation = m.relation
+    if m.HasField("subject"):
+        sub = subject_from_proto(m.subject)
+        if isinstance(sub, SubjectSet):
+            q.subject_set = sub
+        elif sub is not None:
+            q.subject_id = sub
+    return q
+
+
+def tree_to_proto(t: Tree):
+    m = pb.SubjectTree()
+    m.node_type = _TO_PROTO_NODE_TYPE.get(t.type, 0)
+    if t.tuple is not None:
+        m.tuple.CopyFrom(tuple_to_proto(t.tuple))
+        m.subject.CopyFrom(m.tuple.subject)  # deprecated mirror field
+    for c in t.children:
+        m.children.append(tree_to_proto(c))
+    return m
+
+
+def tree_from_proto(m) -> Tree:
+    t = Tree(type=_FROM_PROTO_NODE_TYPE.get(m.node_type, TreeNodeType.UNSPECIFIED))
+    if m.HasField("tuple"):
+        t.tuple = tuple_from_proto(m.tuple)
+    elif m.HasField("subject"):
+        # legacy trees carry only the deprecated subject field
+        sub = subject_from_proto(m.subject)
+        if sub is not None:
+            t.tuple = RelationTuple.make("", "", "", sub)
+    t.children = [tree_from_proto(c) for c in m.children]
+    return t

@@ -1,0 +1,346 @@
+"""REST API: Keto-compatible HTTP routes on stdlib ThreadingHTTPServer.
+
+Route/behavior parity (ref files in internal/):
+  read router (:4466)  — GET /relation-tuples (relationtuple/read_server.go:122-175),
+    GET+POST /relation-tuples/check and .../check/openapi — the bare routes
+    mirror the check status as 403-on-deny, the /openapi variants always
+    200 (check/handler.go:49-55, :129-142, :183-226); GET
+    /relation-tuples/expand (expand/handler.go:43-107)
+  write router (:4467) — PUT /admin/relation-tuples -> 201 + Location +
+    echoed tuple (transact_server.go:105-133), DELETE by URL query -> 204
+    (:152-181), PATCH with [{action, relation_tuple}] deltas -> 204
+    (:211-252)
+  both                 — /health/alive, /health/ready, /version (healthx)
+  metrics (:4468)      — GET /metrics/prometheus (prometheusx path)
+
+Error bodies use the herodot JSON shape {"error": {code, status, message}}
+via KetoError.to_dict. Unknown namespaces on the REST check path answer
+{"allowed": false} instead of erroring (check/handler.go:156-161) — unlike
+gRPC, which propagates NOT_FOUND.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..errors import KetoError, MalformedInputError, NamespaceNotFoundError
+from ..ketoapi import (
+    GetResponse,
+    PatchDelta,
+    RelationQuery,
+    RelationTuple,
+    SubjectSet,
+)
+
+READ_ROUTE_BASE = "/relation-tuples"
+CHECK_ROUTE_BASE = "/relation-tuples/check"
+CHECK_OPENAPI_ROUTE = "/relation-tuples/check/openapi"
+EXPAND_ROUTE = "/relation-tuples/expand"
+WRITE_ROUTE_BASE = "/admin/relation-tuples"
+ALIVE_PATH = "/health/alive"
+READY_PATH = "/health/ready"
+VERSION_PATH = "/version"
+METRICS_PATH = "/metrics/prometheus"
+
+
+def _get_max_depth(params: dict[str, str]) -> int:
+    """ref: internal/x/max_depth.go (param name "max-depth", 0 if absent)."""
+    raw = params.get("max-depth", "")
+    if not raw:
+        return 0
+    try:
+        return int(raw, 0)
+    except ValueError:
+        raise MalformedInputError(debug=f"invalid max-depth {raw!r}")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "keto_tpu"
+
+    # members injected by make_handler_class
+    registry = None
+    batcher = None
+    kind = "read"  # read | write | metrics
+
+    # -- plumbing -------------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # route through our logger, not stderr
+        from ..observability import logger
+
+        logger.debug("http %s", fmt % args)
+
+    def _write(self, code: int, body: bytes, content_type="application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, code: int, obj, location: str | None = None) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        if location is not None:
+            self.send_header("Location", location)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, err: Exception) -> None:
+        if isinstance(err, KetoError):
+            self._json(err.status, err.to_dict())
+        else:
+            e = KetoError(str(err))
+            self._json(500, e.to_dict())
+
+    def _params(self) -> dict[str, str]:
+        qs = urllib.parse.urlparse(self.path).query
+        return {k: v[0] for k, v in urllib.parse.parse_qs(qs).items()}
+
+    def _body_json(self):
+        length = int(self.headers.get("Content-Length") or 0)
+        raw = self.rfile.read(length) if length else b""
+        try:
+            return json.loads(raw or b"null")
+        except json.JSONDecodeError as e:
+            raise MalformedInputError(f"could not unmarshal json: {e}")
+
+    def _route(self, method: str) -> None:
+        path = urllib.parse.urlparse(self.path).path.rstrip("/") or "/"
+        metrics = self.registry.metrics()
+        name = f"{method} {path}"
+        with metrics.observe_request("http", name) as outcome:
+            try:
+                handled = self._dispatch(method, path)
+            except KetoError as e:
+                outcome["code"] = str(e.status)
+                self._error(e)
+                return
+            except (BrokenPipeError, ConnectionResetError):
+                raise
+            except Exception as e:  # noqa: BLE001 — HTTP boundary
+                outcome["code"] = "500"
+                self._error(e)
+                return
+            if not handled:
+                outcome["code"] = "404"
+                from ..errors import NotFoundError
+
+                self._json(404, NotFoundError("route not found").to_dict())
+
+    # -- routing --------------------------------------------------------------
+
+    def _dispatch(self, method: str, path: str) -> bool:
+        # shared routes
+        if method == "GET":
+            if path == ALIVE_PATH:
+                self._json(200, {"status": "ok"})
+                return True
+            if path == READY_PATH:
+                ok = self.registry.ready.is_set()
+                self._json(200 if ok else 503, {"status": "ok" if ok else "unavailable"})
+                return True
+            if path == VERSION_PATH:
+                self._json(200, {"version": self.registry.version})
+                return True
+
+        if self.kind == "metrics":
+            if method == "GET" and path == METRICS_PATH:
+                self._write(
+                    200,
+                    self.registry.metrics().export(),
+                    content_type="text/plain; version=0.0.4; charset=utf-8",
+                )
+                return True
+            return False
+
+        if self.kind == "read":
+            if method == "GET" and path == READ_ROUTE_BASE:
+                self._get_relations()
+                return True
+            if path == CHECK_ROUTE_BASE and method in ("GET", "POST"):
+                self._check(method, mirror_status=True)
+                return True
+            if path == CHECK_OPENAPI_ROUTE and method in ("GET", "POST"):
+                self._check(method, mirror_status=False)
+                return True
+            if method == "GET" and path == EXPAND_ROUTE:
+                self._expand()
+                return True
+            return False
+
+        # write router
+        if path == WRITE_ROUTE_BASE:
+            if method == "PUT":
+                self._create_relation()
+                return True
+            if method == "DELETE":
+                self._delete_relations()
+                return True
+            if method == "PATCH":
+                self._patch_relations()
+                return True
+        return False
+
+    # -- read handlers --------------------------------------------------------
+
+    def _get_relations(self) -> None:
+        """ref: read_server.go:122-175."""
+        params = self._params()
+        query = RelationQuery.from_url_query(params)
+        self.registry.validate_namespaces(query)
+        page_size = int(params.get("page_size") or 0) or self.registry.config.page_size()
+        tuples, next_token = self.registry.relation_tuple_manager().get_relation_tuples(
+            query,
+            page_token=params.get("page_token", ""),
+            page_size=page_size,
+            nid=self.registry.nid,
+        )
+        self._json(200, GetResponse(tuples, next_token).to_dict())
+
+    def _check_tuple_from_request(self, method: str) -> RelationTuple:
+        if method == "GET":
+            return RelationTuple.from_url_query(self._params())
+        body = self._body_json()
+        if not isinstance(body, dict):
+            raise MalformedInputError("could not unmarshal json: expected object")
+        return RelationTuple.from_dict(body)
+
+    def _check(self, method: str, mirror_status: bool) -> None:
+        """ref: check/handler.go getCheck/postCheck + 403 mirroring."""
+        max_depth = _get_max_depth(self._params())
+        t = self._check_tuple_from_request(method)
+        try:
+            self.registry.validate_namespaces(t)
+        except NamespaceNotFoundError:
+            # unknown namespace => allowed=false, not 404 (handler.go:156-161)
+            code = 403 if mirror_status else 200
+            self._json(code, {"allowed": False})
+            return
+        if self.batcher is not None:
+            res = self.batcher.check(t, max_depth)
+        else:
+            res = self.registry.check_engine().check_relation_tuple(t, max_depth)
+        if res.error is not None:
+            raise res.error
+        code = 403 if (mirror_status and not res.allowed) else 200
+        self._json(code, {"allowed": res.allowed})
+
+    def _expand(self) -> None:
+        """ref: expand/handler.go:43-107 (GET, subject-set params)."""
+        params = self._params()
+        max_depth = _get_max_depth(params)
+        try:
+            subject_set = SubjectSet(
+                namespace=params["namespace"],
+                object=params["object"],
+                relation=params["relation"],
+            )
+        except KeyError:
+            raise MalformedInputError(
+                debug="expand requires namespace, object, and relation"
+            )
+        self.registry.validate_namespaces(subject_set)
+        tree = self.registry.expand_engine().expand(subject_set, max_depth)
+        if tree is None:
+            from ..errors import NotFoundError
+
+            self._json(404, NotFoundError("no relation tuples found").to_dict())
+            return
+        self._json(200, tree.to_dict())
+
+    # -- write handlers -------------------------------------------------------
+
+    def _create_relation(self) -> None:
+        """ref: transact_server.go:105-133 (201 + Location + echo)."""
+        body = self._body_json()
+        if not isinstance(body, dict):
+            raise MalformedInputError("could not unmarshal json: expected object")
+        t = RelationTuple.from_dict(body)
+        self.registry.validate_namespaces(t)
+        self.registry.relation_tuple_manager().write_relation_tuples(
+            [t], nid=self.registry.nid
+        )
+        location = READ_ROUTE_BASE + "?" + urllib.parse.urlencode(t.to_url_query())
+        self._json(201, t.to_dict(), location=location)
+
+    def _delete_relations(self) -> None:
+        """ref: transact_server.go:152-181 (by URL query, 204)."""
+        query = RelationQuery.from_url_query(self._params())
+        self.registry.validate_namespaces(query)
+        self.registry.relation_tuple_manager().delete_all_relation_tuples(
+            query, nid=self.registry.nid
+        )
+        self._write(204, b"", content_type="application/json")
+
+    def _patch_relations(self) -> None:
+        """ref: transact_server.go:211-252 (deltas, 204)."""
+        body = self._body_json()
+        if not isinstance(body, list):
+            raise MalformedInputError("could not unmarshal json: expected array")
+        deltas = [PatchDelta.from_dict(d) for d in body]
+        inserts = [d.relation_tuple for d in deltas if d.action.value == "insert"]
+        deletes = [d.relation_tuple for d in deltas if d.action.value == "delete"]
+        self.registry.validate_namespaces(*inserts, *deletes)
+        self.registry.relation_tuple_manager().transact_relation_tuples(
+            inserts, deletes, nid=self.registry.nid
+        )
+        self._write(204, b"", content_type="application/json")
+
+    # -- HTTP verbs -----------------------------------------------------------
+
+    def do_GET(self):
+        self._route("GET")
+
+    def do_POST(self):
+        self._route("POST")
+
+    def do_PUT(self):
+        self._route("PUT")
+
+    def do_DELETE(self):
+        self._route("DELETE")
+
+    def do_PATCH(self):
+        self._route("PATCH")
+
+
+def make_handler_class(registry, kind: str, batcher=None):
+    return type(
+        f"KetoHTTP{kind.capitalize()}Handler",
+        (_Handler,),
+        {"registry": registry, "kind": kind, "batcher": batcher},
+    )
+
+
+class RESTServer:
+    """One HTTP listener (read, write, or metrics router)."""
+
+    def __init__(self, registry, kind: str, host: str, port: int, batcher=None):
+        handler = make_handler_class(registry, kind, batcher)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self.kind = kind
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            name=f"keto-http-{self.kind}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -51,6 +52,7 @@ class TPUCheckEngine:
         frontier_cap: int = 1 << 14,
         rewrite_instr_cap: int = 8,
         mesh=None,
+        metrics=None,
     ):
         self.manager = manager
         self.config = config
@@ -67,8 +69,10 @@ class TPUCheckEngine:
         self._snapshot: Optional[GraphSnapshot] = None
         self._sharded = None
         self._tables = None
-        # device-path observability (served vs host-fallback checks)
+        # device-path observability (served vs host-fallback checks);
+        # `metrics` is an optional observability.Metrics mirror of the same
         self.stats = {"device_checks": 0, "host_checks": 0, "snapshot_builds": 0}
+        self.metrics = metrics
 
     # -- snapshot lifecycle ---------------------------------------------------
 
@@ -87,6 +91,7 @@ class TPUCheckEngine:
         with self._lock:
             snap = self._snapshot
             if snap is None or snap.version != version:
+                build_start = time.perf_counter()
                 tuples = self.manager.all_relation_tuples(nid=self.nid)
                 if self.mesh is not None:
                     from ..parallel import build_sharded_snapshot
@@ -111,6 +116,12 @@ class TPUCheckEngine:
                     self._tables = snapshot_tables(snap)
                 self._snapshot = snap
                 self.stats["snapshot_builds"] += 1
+                if self.metrics is not None:
+                    self.metrics.snapshot_builds_total.inc()
+                    self.metrics.snapshot_tuples.set(snap.n_tuples)
+                    self.metrics.snapshot_build_duration.observe(
+                        time.perf_counter() - build_start
+                    )
             return snap, self._sharded, self._tables
 
     def invalidate(self) -> None:
@@ -223,4 +234,9 @@ class TPUCheckEngine:
                 )
         self.stats["device_checks"] += n - n_host
         self.stats["host_checks"] += n_host
+        if self.metrics is not None:
+            self.metrics.check_batch_size.observe(n)
+            self.metrics.checks_total.labels("device").inc(n - n_host)
+            if n_host:
+                self.metrics.checks_total.labels("host").inc(n_host)
         return results

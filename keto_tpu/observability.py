@@ -1,0 +1,138 @@
+"""Observability: Prometheus metrics, OpenTelemetry tracing, request logs.
+
+Parity with the reference's aux subsystems (SURVEY.md §5.1/§5.5):
+prometheusx metrics served on the metrics port (registry_default.go:
+131-143, daemon.go:421-436), otelx tracer with spans in every persister/
+handler method, logrusx structured request logging (daemon.go:294).
+
+Everything here degrades gracefully: metrics use a dedicated
+CollectorRegistry (so embedders/tests never hit duplicate-collector
+errors), and tracing is a no-op unless `tracing.enabled` is set.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+
+import prometheus_client as prom
+
+logger = logging.getLogger("keto_tpu")
+
+
+class Metrics:
+    """Prometheus metrics for the serving path + the TPU engine."""
+
+    def __init__(self):
+        self.registry = prom.CollectorRegistry()
+        self.requests_total = prom.Counter(
+            "keto_tpu_requests_total",
+            "RPC/REST requests served",
+            ["transport", "method", "code"],
+            registry=self.registry,
+        )
+        self.request_duration = prom.Histogram(
+            "keto_tpu_request_duration_seconds",
+            "Request latency",
+            ["transport", "method"],
+            registry=self.registry,
+            buckets=(
+                0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+            ),
+        )
+        self.checks_total = prom.Counter(
+            "keto_tpu_checks_total",
+            "Check() queries evaluated, by engine path",
+            ["path"],  # device | host
+            registry=self.registry,
+        )
+        self.check_batch_size = prom.Histogram(
+            "keto_tpu_check_batch_size",
+            "Queries per device batch",
+            registry=self.registry,
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096),
+        )
+        self.snapshot_builds_total = prom.Counter(
+            "keto_tpu_snapshot_builds_total",
+            "Device graph-mirror rebuilds",
+            registry=self.registry,
+        )
+        self.snapshot_tuples = prom.Gauge(
+            "keto_tpu_snapshot_tuples",
+            "Relation tuples in the current device snapshot",
+            registry=self.registry,
+        )
+        self.snapshot_build_duration = prom.Histogram(
+            "keto_tpu_snapshot_build_duration_seconds",
+            "Device graph-mirror rebuild latency",
+            registry=self.registry,
+        )
+
+    def export(self) -> bytes:
+        return prom.generate_latest(self.registry)
+
+    @contextlib.contextmanager
+    def observe_request(self, transport: str, method: str):
+        """Times a request and counts its outcome code."""
+        start = time.perf_counter()
+        outcome = {"code": "OK"}
+        try:
+            yield outcome
+        finally:
+            self.request_duration.labels(transport, method).observe(
+                time.perf_counter() - start
+            )
+            self.requests_total.labels(transport, method, outcome["code"]).inc()
+
+
+class _NoopSpan:
+    def set_attribute(self, *a, **k):
+        pass
+
+    def record_exception(self, *a, **k):
+        pass
+
+
+class _NoopTracer:
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        yield _NoopSpan()
+
+
+class _OtelTracer:
+    def __init__(self, service_name: str):
+        from opentelemetry import trace
+
+        self._tracer = trace.get_tracer(service_name)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        with self._tracer.start_as_current_span(name) as s:
+            for k, v in attrs.items():
+                s.set_attribute(k, v)
+            yield s
+
+
+def build_tracer(config):
+    """ref: otelx tracer built once from config (registry_default.go:118-129)."""
+    if config.get("tracing.enabled", False):
+        try:
+            return _OtelTracer(config.get("tracing.service_name", "keto_tpu"))
+        except Exception as e:  # otel mis-setup must never block serving
+            logger.warning("tracing disabled: %s", e)
+    return _NoopTracer()
+
+
+def request_log(transport: str, method: str, code: str, duration_s: float) -> None:
+    """Structured per-request log line (ref: reqlog middleware daemon.go:294)."""
+    logger.info(
+        "request handled",
+        extra={
+            "transport": transport,
+            "method": method,
+            "code": code,
+            "duration_ms": round(duration_s * 1e3, 3),
+        },
+    )

@@ -1,0 +1,177 @@
+"""Registry: the dependency-injection composition root.
+
+Parity with driver.Registry (internal/driver/registry.go:23-52) and
+RegistryDefault's lazy singletons (internal/driver/registry_default.go:
+98-192): config + logger, tuple manager (chosen by DSN), check/expand
+engines (TPU or host, chosen by `check.engine`), mapper, health state,
+metrics, and the server handlers hang off one object that everything
+receives. This is the plugin boundary named in the north star: swapping
+`check.engine=tpu` for `host` here changes nothing above it.
+
+DSN forms (ref: internal/driver/config/provider.go:187-193 aliases
+"memory"; pop DSNs otherwise):
+  - "memory"            -> in-process dict-of-arrays store (fast path)
+  - "sqlite://<path>"   -> durable SQLite persister (runs migrations)
+  - "sqlite://:memory:" -> in-memory SQLite (the reference's "memory")
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from . import __version__
+from .config import Config
+from .engine.reference import ReferenceEngine
+from .errors import NamespaceNotFoundError
+from .ketoapi import RelationQuery, RelationTuple
+from .storage.definitions import DEFAULT_NETWORK
+from .storage.memory import MemoryManager
+from .storage.sqlite import SQLitePersister
+
+logger = logging.getLogger("keto_tpu")
+
+
+class Registry:
+    """Composition root. Lazily builds every service exactly once."""
+
+    def __init__(
+        self,
+        config: Optional[Config] = None,
+        nid: str = DEFAULT_NETWORK,
+        mesh=None,
+    ):
+        self.config = config or Config()
+        self.nid = nid
+        self.mesh = mesh
+        self.version = __version__
+        self._lock = threading.RLock()
+        self._manager = None
+        self._engine = None
+        self._metrics = None
+        self._tracer = None
+        # health: flipped by the daemon around serving
+        # (ref: registry_default.go:98-112 healthx readiness checkers)
+        self.ready = threading.Event()
+
+    # -- storage --------------------------------------------------------------
+
+    def relation_tuple_manager(self):
+        with self._lock:
+            if self._manager is None:
+                dsn = self.config.dsn
+                if dsn == "memory":
+                    self._manager = MemoryManager()
+                elif dsn.startswith("sqlite://"):
+                    self._manager = SQLitePersister(dsn.removeprefix("sqlite://"))
+                else:
+                    raise ValueError(f"unsupported DSN: {dsn!r}")
+            return self._manager
+
+    # -- engines --------------------------------------------------------------
+
+    def check_engine(self):
+        """The configured check engine; `check.engine` selects `tpu`
+        (batched device kernel + exact host fallback) or `host` (pure
+        reference semantics)."""
+        with self._lock:
+            if self._engine is None:
+                kind = self.config.get("check.engine", "tpu")
+                manager = self.relation_tuple_manager()
+                if kind == "tpu":
+                    from .engine.tpu_engine import TPUCheckEngine
+
+                    self._engine = TPUCheckEngine(
+                        manager, self.config, nid=self.nid, mesh=self.mesh,
+                        metrics=self.metrics(),
+                    )
+                elif kind == "host":
+                    self._engine = _HostEngineFacade(
+                        ReferenceEngine(manager, self.config), self.nid,
+                        metrics=self.metrics(),
+                    )
+                else:
+                    raise ValueError(f"unknown check.engine: {kind!r}")
+            return self._engine
+
+    def expand_engine(self):
+        return self.check_engine()
+
+    def namespace_manager(self):
+        return self.config.namespace_manager()
+
+    # -- namespace validation (the Mapper's role) -----------------------------
+
+    def validate_namespaces(self, *objs) -> None:
+        """Every namespace mentioned by a tuple/query must be configured —
+        the reference enforces this inside Mapper.FromTuple/FromQuery via
+        NamespaceManager.GetNamespaceByName (internal/relationtuple/
+        uuid_mapping.go:70-81); raises NamespaceNotFoundError."""
+        nm = self.namespace_manager()
+        for o in objs:
+            if o is None:
+                continue
+            names = []
+            if isinstance(o, (RelationTuple, RelationQuery)):
+                if o.namespace is not None:
+                    names.append(o.namespace)
+                if o.subject_set is not None:
+                    names.append(o.subject_set.namespace)
+            else:  # SubjectSet
+                names.append(o.namespace)
+            for name in names:
+                nm.get_namespace_by_name(name)  # raises if unknown
+
+    # -- observability --------------------------------------------------------
+
+    def metrics(self):
+        with self._lock:
+            if self._metrics is None:
+                from .observability import Metrics
+
+                self._metrics = Metrics()
+            return self._metrics
+
+    def tracer(self):
+        with self._lock:
+            if self._tracer is None:
+                from .observability import build_tracer
+
+                self._tracer = build_tracer(self.config)
+            return self._tracer
+
+
+class _HostEngineFacade:
+    """Adapts ReferenceEngine to the engine surface the RPC layer uses
+    (check_batch / check_is_member / check_relation_tuple / expand)."""
+
+    def __init__(self, reference: ReferenceEngine, nid: str, metrics=None):
+        self.reference = reference
+        self.nid = nid
+        self.stats = {"device_checks": 0, "host_checks": 0, "snapshot_builds": 0}
+        self.metrics = metrics
+
+    def check_is_member(self, r, max_depth: int = 0) -> bool:
+        res = self.check_relation_tuple(r, max_depth)
+        if res.error is not None:
+            raise res.error
+        from .engine.definitions import Membership
+
+        return res.membership == Membership.IS_MEMBER
+
+    def check_relation_tuple(self, r, max_depth: int = 0):
+        return self.reference.check_relation_tuple(r, max_depth, self.nid)
+
+    def check_batch(self, tuples, max_depth: int = 0):
+        self.stats["host_checks"] += len(tuples)
+        if self.metrics is not None and tuples:
+            self.metrics.check_batch_size.observe(len(tuples))
+            self.metrics.checks_total.labels("host").inc(len(tuples))
+        return [self.check_relation_tuple(t, max_depth) for t in tuples]
+
+    def expand(self, subject, max_depth: int = 0):
+        return self.reference.expand(subject, max_depth, self.nid)
+
+    def invalidate(self) -> None:
+        pass

@@ -1,0 +1,536 @@
+"""API layer tests: proto conversions, gRPC services, REST routes, the
+check micro-batcher, and single-port gRPC/REST multiplexing.
+
+Modeled on the reference's e2e strategy (SURVEY.md §4): a real in-process
+server on free ports, exercised through real clients. The full shared
+case-suite matrix lives in test_e2e.py; here each transport's behavior
+contract is pinned down (status codes, error mapping, wire parity).
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import grpc
+import pytest
+
+from keto_tpu.api import CheckBatcher, ReadClient, WriteClient, open_channel
+from keto_tpu.api.daemon import Daemon
+from keto_tpu.api.descriptors import pb
+from keto_tpu.api.messages import (
+    query_from_proto,
+    query_to_proto,
+    tree_from_proto,
+    tree_to_proto,
+    tuple_from_proto,
+    tuple_to_proto,
+)
+from keto_tpu.config import Config
+from keto_tpu.ketoapi import (
+    RelationQuery,
+    RelationTuple,
+    SubjectSet,
+    Tree,
+    TreeNodeType,
+)
+from keto_tpu.registry import Registry
+
+NAMESPACES = [
+    {
+        "name": "videos",
+        "relations": [
+            {"name": "owner"},
+            {
+                "name": "view",
+                "rewrite": {
+                    "operation": "or",
+                    "children": [{"type": "computed_subject_set", "relation": "owner"}],
+                },
+            },
+        ],
+    },
+    {"name": "groups", "relations": [{"name": "member"}]},
+]
+
+
+def make_registry(engine: str = "host") -> Registry:
+    cfg = Config(
+        {
+            "dsn": "memory",
+            "check": {"engine": engine},
+            "serve": {
+                "read": {"host": "127.0.0.1", "port": 0},
+                "write": {"host": "127.0.0.1", "port": 0},
+                "metrics": {"host": "127.0.0.1", "port": 0},
+            },
+            "namespaces": NAMESPACES,
+        }
+    )
+    return Registry(cfg)
+
+
+@pytest.fixture(scope="module")
+def daemon():
+    d = Daemon(make_registry())
+    d.start()
+    yield d
+    d.stop()
+
+
+@pytest.fixture(scope="module")
+def clients(daemon):
+    rc = ReadClient(open_channel(f"127.0.0.1:{daemon.read_port}"))
+    wc = WriteClient(open_channel(f"127.0.0.1:{daemon.write_port}"))
+    yield rc, wc
+    rc.close()
+    wc.close()
+
+
+@pytest.fixture(autouse=True)
+def clean_store(daemon):
+    yield
+    daemon.registry.relation_tuple_manager().delete_all_relation_tuples(
+        RelationQuery(), nid=daemon.registry.nid
+    )
+
+
+def http(method, port, path, body=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req) as r:
+            raw = r.read()
+            return r.status, json.loads(raw) if raw else None, dict(r.headers)
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, json.loads(raw) if raw else None, dict(e.headers)
+
+
+# -- proto conversion unit tests ---------------------------------------------
+
+
+class TestProtoConversions:
+    def test_tuple_roundtrip_subject_id(self):
+        t = RelationTuple.from_string("videos:v1#owner@alice")
+        m = tuple_to_proto(t)
+        assert m.subject.WhichOneof("ref") == "id"
+        # byte-level stability: serialized form parses back identically
+        assert tuple_from_proto(pb.RelationTuple.FromString(m.SerializeToString())) == t
+
+    def test_tuple_roundtrip_subject_set(self):
+        t = RelationTuple.from_string("videos:v1#view@(groups:g#member)")
+        m = tuple_to_proto(t)
+        assert m.subject.WhichOneof("ref") == "set"
+        assert tuple_from_proto(m) == t
+
+    def test_query_roundtrip_partial(self):
+        q = RelationQuery(namespace="videos", relation="owner")
+        m = query_to_proto(q)
+        assert m.HasField("namespace") and not m.HasField("object")
+        q2 = query_from_proto(m)
+        assert q2 == q
+
+    def test_query_roundtrip_empty(self):
+        m = query_to_proto(RelationQuery())
+        assert query_from_proto(m) == RelationQuery()
+
+    def test_tree_roundtrip(self):
+        t = Tree(
+            type=TreeNodeType.UNION,
+            tuple=RelationTuple.from_string("videos:v1#view@(videos:v1#owner)"),
+            children=[
+                Tree(
+                    type=TreeNodeType.LEAF,
+                    tuple=RelationTuple.from_string("videos:v1#owner@alice"),
+                )
+            ],
+        )
+        m = tree_to_proto(t)
+        assert m.node_type == 1 and m.children[0].node_type == 4
+        # deprecated subject mirror is filled (enc_proto.go:117-125)
+        assert m.subject.set.namespace == "videos"
+        t2 = tree_from_proto(m)
+        assert t2.type == TreeNodeType.UNION
+        assert t2.children[0].tuple == t.children[0].tuple
+
+    def test_tree_internal_node_types_serialize_unspecified(self):
+        t = Tree(
+            type=TreeNodeType.COMPUTED_SUBJECT_SET,
+            tuple=RelationTuple.from_string("videos:v1#owner@alice"),
+        )
+        assert tree_to_proto(t).node_type == 0
+        assert tree_from_proto(tree_to_proto(t)).type == TreeNodeType.UNSPECIFIED
+
+
+# -- gRPC service tests ------------------------------------------------------
+
+
+class TestGRPC:
+    def test_version_and_health(self, clients):
+        rc, wc = clients
+        assert rc.get_version() == wc.get_version() != ""
+        assert rc.health() == "SERVING"
+
+    def test_transact_check_expand_list(self, clients):
+        rc, wc = clients
+        wc.transact(
+            insert=[
+                RelationTuple.from_string("videos:v1#owner@alice"),
+                RelationTuple.from_string("videos:v1#view@(groups:g#member)"),
+                RelationTuple.from_string("groups:g#member@bob"),
+            ]
+        )
+        assert rc.check(RelationTuple.from_string("videos:v1#view@alice"))
+        assert rc.check(RelationTuple.from_string("videos:v1#view@bob"))
+        assert not rc.check(RelationTuple.from_string("videos:v1#view@eve"))
+
+        tree = rc.expand(SubjectSet("videos", "v1", "view"), max_depth=5)
+        assert tree.type == TreeNodeType.UNION
+
+        got = rc.list_relation_tuples(RelationQuery(namespace="videos"))
+        assert len(got.relation_tuples) == 2 and got.next_page_token == ""
+
+    def test_list_pagination(self, clients):
+        rc, wc = clients
+        wc.transact(
+            insert=[
+                RelationTuple.from_string(f"videos:v{i}#owner@alice")
+                for i in range(7)
+            ]
+        )
+        seen = []
+        token = ""
+        while True:
+            page = rc.list_relation_tuples(
+                RelationQuery(namespace="videos"), page_size=3, page_token=token
+            )
+            seen.extend(str(t) for t in page.relation_tuples)
+            token = page.next_page_token
+            if not token:
+                break
+        assert sorted(seen) == sorted(f"videos:v{i}#owner@alice" for i in range(7))
+
+    def test_delete_by_query(self, clients):
+        rc, wc = clients
+        wc.transact(
+            insert=[
+                RelationTuple.from_string("videos:v1#owner@alice"),
+                RelationTuple.from_string("videos:v2#owner@alice"),
+            ]
+        )
+        wc.delete_all(RelationQuery(namespace="videos", object="v1"))
+        left = rc.list_relation_tuples(RelationQuery(namespace="videos"))
+        assert [str(t) for t in left.relation_tuples] == ["videos:v2#owner@alice"]
+
+    def test_transact_delete_action(self, clients):
+        rc, wc = clients
+        t = RelationTuple.from_string("videos:v1#owner@alice")
+        wc.transact(insert=[t])
+        wc.transact(delete=[t])
+        assert not rc.check(t)
+
+    def test_unknown_namespace_is_grpc_error(self, clients):
+        rc, _ = clients
+        with pytest.raises(grpc.RpcError) as exc:
+            rc.check(RelationTuple.from_string("nope:x#y@z"))
+        assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+
+    def test_nil_subject_invalid_argument(self, daemon, clients):
+        rc, _ = clients
+        # hand-built request without subject
+        chan = open_channel(f"127.0.0.1:{daemon.read_port}")
+        call = chan.unary_unary(
+            "/ory.keto.relation_tuples.v1alpha2.CheckService/Check",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.CheckResponse.FromString,
+        )
+        req = pb.CheckRequest()
+        req.tuple.namespace = "videos"
+        req.tuple.object = "v1"
+        req.tuple.relation = "owner"
+        with pytest.raises(grpc.RpcError) as exc:
+            call(req)
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        chan.close()
+
+    def test_check_deprecated_flat_fields(self, daemon, clients):
+        _, wc = clients
+        wc.transact(insert=[RelationTuple.from_string("videos:v1#owner@alice")])
+        chan = open_channel(f"127.0.0.1:{daemon.read_port}")
+        call = chan.unary_unary(
+            "/ory.keto.relation_tuples.v1alpha2.CheckService/Check",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.CheckResponse.FromString,
+        )
+        req = pb.CheckRequest(namespace="videos", object="v1", relation="owner")
+        req.subject.id = "alice"
+        resp = call(req)
+        assert resp.allowed and resp.snaptoken == "not yet implemented"
+        chan.close()
+
+    def test_expand_subject_id_leaf(self, daemon):
+        chan = open_channel(f"127.0.0.1:{daemon.read_port}")
+        call = chan.unary_unary(
+            "/ory.keto.relation_tuples.v1alpha2.ExpandService/Expand",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.ExpandResponse.FromString,
+        )
+        req = pb.ExpandRequest()
+        req.subject.id = "alice"
+        resp = call(req)
+        # leaf with only the deprecated subject field (expand/handler.go:110-118)
+        assert resp.tree.node_type == 4
+        assert resp.tree.subject.id == "alice"
+        assert not resp.tree.HasField("tuple")
+        chan.close()
+
+    def test_list_requires_query(self, daemon):
+        chan = open_channel(f"127.0.0.1:{daemon.read_port}")
+        call = chan.unary_unary(
+            "/ory.keto.relation_tuples.v1alpha2.ReadService/ListRelationTuples",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.ListRelationTuplesResponse.FromString,
+        )
+        with pytest.raises(grpc.RpcError) as exc:
+            call(pb.ListRelationTuplesRequest())
+        assert exc.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        chan.close()
+
+    def test_list_legacy_query_message(self, daemon, clients):
+        _, wc = clients
+        wc.transact(insert=[RelationTuple.from_string("videos:v1#owner@alice")])
+        chan = open_channel(f"127.0.0.1:{daemon.read_port}")
+        call = chan.unary_unary(
+            "/ory.keto.relation_tuples.v1alpha2.ReadService/ListRelationTuples",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.ListRelationTuplesResponse.FromString,
+        )
+        req = pb.ListRelationTuplesRequest()
+        req.query.namespace = "videos"
+        resp = call(req)
+        assert len(resp.relation_tuples) == 1
+        chan.close()
+
+
+# -- REST tests ---------------------------------------------------------------
+
+
+class TestREST:
+    def test_create_status_and_location(self, daemon):
+        code, body, headers = http(
+            "PUT",
+            daemon.write_port,
+            "/admin/relation-tuples",
+            {"namespace": "videos", "object": "v9", "relation": "owner", "subject_id": "zoe"},
+        )
+        assert code == 201
+        assert body["subject_id"] == "zoe"
+        assert headers["Location"].startswith("/relation-tuples?")
+
+    def test_check_mirror_status(self, daemon, clients):
+        _, wc = clients
+        wc.transact(insert=[RelationTuple.from_string("videos:v1#owner@alice")])
+        ok = {"namespace": "videos", "object": "v1", "relation": "owner", "subject_id": "alice"}
+        deny = dict(ok, subject_id="eve")
+        assert http("POST", daemon.read_port, "/relation-tuples/check", ok)[0] == 200
+        assert http("POST", daemon.read_port, "/relation-tuples/check", deny)[0] == 403
+        # openapi variant always answers 200 (check/handler.go:183-226)
+        code, body, _ = http(
+            "POST", daemon.read_port, "/relation-tuples/check/openapi", deny
+        )
+        assert (code, body) == (200, {"allowed": False})
+
+    def test_check_get_url_query(self, daemon, clients):
+        _, wc = clients
+        wc.transact(insert=[RelationTuple.from_string("videos:v1#owner@alice")])
+        code, body, _ = http(
+            "GET",
+            daemon.read_port,
+            "/relation-tuples/check?namespace=videos&object=v1&relation=owner&subject_id=alice",
+        )
+        assert (code, body) == (200, {"allowed": True})
+
+    def test_check_unknown_namespace_allowed_false(self, daemon):
+        # REST swallows unknown namespaces (check/handler.go:156-161)
+        code, body, _ = http(
+            "POST",
+            daemon.read_port,
+            "/relation-tuples/check",
+            {"namespace": "nope", "object": "x", "relation": "y", "subject_id": "z"},
+        )
+        assert (code, body) == (403, {"allowed": False})
+
+    def test_check_dropped_subject_key(self, daemon):
+        code, body, _ = http(
+            "POST",
+            daemon.read_port,
+            "/relation-tuples/check",
+            {"namespace": "videos", "object": "x", "relation": "y", "subject": "z"},
+        )
+        assert code == 400
+        assert "error" in body
+
+    def test_expand_and_404(self, daemon, clients):
+        _, wc = clients
+        wc.transact(
+            insert=[RelationTuple.from_string("videos:v1#owner@alice")]
+        )
+        code, body, _ = http(
+            "GET",
+            daemon.read_port,
+            "/relation-tuples/expand?namespace=videos&object=v1&relation=owner",
+        )
+        assert code == 200 and body["type"] == "union"
+        code, _, _ = http(
+            "GET",
+            daemon.read_port,
+            "/relation-tuples/expand?namespace=videos&object=missing&relation=owner",
+        )
+        assert code == 404
+
+    def test_list_and_pagination(self, daemon, clients):
+        _, wc = clients
+        wc.transact(
+            insert=[
+                RelationTuple.from_string(f"videos:p{i}#owner@alice") for i in range(5)
+            ]
+        )
+        code, body, _ = http(
+            "GET", daemon.read_port, "/relation-tuples?namespace=videos&page_size=2"
+        )
+        assert code == 200
+        assert len(body["relation_tuples"]) == 2 and body["next_page_token"]
+
+    def test_delete_by_query_204(self, daemon, clients):
+        _, wc = clients
+        wc.transact(insert=[RelationTuple.from_string("videos:v1#owner@alice")])
+        code, _, _ = http(
+            "DELETE", daemon.write_port, "/admin/relation-tuples?namespace=videos"
+        )
+        assert code == 204
+        _, body, _ = http("GET", daemon.read_port, "/relation-tuples?namespace=videos")
+        assert body["relation_tuples"] == []
+
+    def test_patch_deltas(self, daemon, clients):
+        rc, wc = clients
+        wc.transact(insert=[RelationTuple.from_string("videos:v1#owner@old")])
+        code, _, _ = http(
+            "PATCH",
+            daemon.write_port,
+            "/admin/relation-tuples",
+            [
+                {"action": "insert", "relation_tuple": {"namespace": "videos", "object": "v1", "relation": "owner", "subject_id": "new"}},
+                {"action": "delete", "relation_tuple": {"namespace": "videos", "object": "v1", "relation": "owner", "subject_id": "old"}},
+            ],
+        )
+        assert code == 204
+        assert rc.check(RelationTuple.from_string("videos:v1#owner@new"))
+        assert not rc.check(RelationTuple.from_string("videos:v1#owner@old"))
+
+    def test_patch_unknown_action_400(self, daemon):
+        code, _, _ = http(
+            "PATCH",
+            daemon.write_port,
+            "/admin/relation-tuples",
+            [{"action": "upsert", "relation_tuple": {"namespace": "videos", "object": "v", "relation": "owner", "subject_id": "x"}}],
+        )
+        assert code == 400
+
+    def test_write_routes_not_on_read_port(self, daemon):
+        code, _, _ = http(
+            "PUT",
+            daemon.read_port,
+            "/admin/relation-tuples",
+            {"namespace": "videos", "object": "v", "relation": "owner", "subject_id": "x"},
+        )
+        assert code == 404
+
+    def test_metrics_endpoint(self, daemon):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{daemon.metrics_port}/metrics/prometheus"
+        )
+        with urllib.request.urlopen(req) as r:
+            text = r.read().decode()
+        assert "keto_tpu_requests_total" in text
+
+
+# -- micro-batcher ------------------------------------------------------------
+
+
+class TestBatcher:
+    def test_concurrent_checks_batch(self):
+        reg = make_registry()
+        wc_tuples = [
+            RelationTuple.from_string(f"videos:b{i}#owner@user{i}") for i in range(32)
+        ]
+        reg.relation_tuple_manager().write_relation_tuples(wc_tuples, nid=reg.nid)
+
+        calls = []
+        engine = reg.check_engine()
+        orig = engine.check_batch
+
+        def spy(tuples, depth):
+            calls.append(len(tuples))
+            return orig(tuples, depth)
+
+        engine.check_batch = spy
+        b = CheckBatcher(engine, max_batch=64, window_s=0.05)
+
+        results = {}
+
+        def worker(i):
+            results[i] = b.check(wc_tuples[i], 0).allowed
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        b.close()
+        assert all(results[i] for i in range(32))
+        # the 32 concurrent checks ran in far fewer engine launches
+        assert sum(calls) == 32 and len(calls) < 32
+
+    def test_batcher_propagates_engine_error(self):
+        class Boom:
+            def check_batch(self, tuples, depth):
+                raise RuntimeError("kernel exploded")
+
+        b = CheckBatcher(Boom(), window_s=0.001)
+        with pytest.raises(RuntimeError, match="kernel exploded"):
+            b.check(RelationTuple.from_string("a:b#c@d"), 0)
+        b.close()
+
+
+# -- TPU engine through the API ----------------------------------------------
+
+
+class TestTPUEngineAPI:
+    def test_grpc_check_on_tpu_engine(self):
+        d = Daemon(make_registry(engine="tpu"))
+        d.start()
+        try:
+            rc = ReadClient(open_channel(f"127.0.0.1:{d.read_port}"))
+            wc = WriteClient(open_channel(f"127.0.0.1:{d.write_port}"))
+            wc.transact(
+                insert=[
+                    RelationTuple.from_string("videos:v1#owner@alice"),
+                    RelationTuple.from_string("videos:v1#view@(groups:g#member)"),
+                    RelationTuple.from_string("groups:g#member@bob"),
+                ]
+            )
+            assert rc.check(RelationTuple.from_string("videos:v1#view@alice"))
+            assert rc.check(RelationTuple.from_string("videos:v1#view@bob"))
+            assert not rc.check(RelationTuple.from_string("videos:v1#view@eve"))
+            # read-your-writes through snapshot invalidation
+            wc.transact(delete=[RelationTuple.from_string("groups:g#member@bob")])
+            assert not rc.check(RelationTuple.from_string("videos:v1#view@bob"))
+            rc.close()
+            wc.close()
+        finally:
+            d.stop()
