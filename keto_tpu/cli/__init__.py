@@ -1,0 +1,476 @@
+"""Command-line interface.
+
+Parity with the reference's cobra tree (cmd/root.go:47-66):
+
+    keto_tpu {serve, migrate {up,down,status}, namespace validate,
+              relation-tuple {parse, create, delete, delete-all, get},
+              check, expand, status, version}
+
+Client commands speak gRPC to --read-remote / --write-remote (env:
+KETO_READ_REMOTE / KETO_WRITE_REMOTE, cmd/client/grpc_client.go:26-27);
+`serve` and `migrate` run in-process. Output format flags mirror cmdx:
+--format {default, json, json-pretty}.
+
+Heavy imports (jax via the registry) happen inside the subcommands that
+need them, so client commands stay fast.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+from .. import __version__
+from ..ketoapi import (
+    RelationQuery,
+    RelationTuple,
+    SubjectSet,
+    Tree,
+)
+
+FORMAT_DEFAULT = "default"
+FORMAT_JSON = "json"
+FORMAT_JSON_PRETTY = "json-pretty"
+
+
+class CLIError(Exception):
+    """Printed to stderr; exits 1."""
+
+
+def _print_formatted(args, obj, default_text: str) -> None:
+    if args.format == FORMAT_JSON:
+        print(json.dumps(obj))
+    elif args.format == FORMAT_JSON_PRETTY:
+        print(json.dumps(obj, indent=2))
+    else:
+        print(default_text)
+
+
+def _read_client(args):
+    from ..api.client import (
+        DEFAULT_READ_REMOTE,
+        READ_REMOTE_ENV,
+        ReadClient,
+        open_channel,
+        resolve_remote,
+    )
+
+    remote = resolve_remote(args.read_remote, READ_REMOTE_ENV, DEFAULT_READ_REMOTE)
+    return ReadClient(open_channel(remote, insecure=args.insecure or None))
+
+
+def _write_client(args):
+    from ..api.client import (
+        DEFAULT_WRITE_REMOTE,
+        WRITE_REMOTE_ENV,
+        WriteClient,
+        open_channel,
+        resolve_remote,
+    )
+
+    remote = resolve_remote(args.write_remote, WRITE_REMOTE_ENV, DEFAULT_WRITE_REMOTE)
+    return WriteClient(open_channel(remote, insecure=args.insecure or None))
+
+
+# -- tuple input helpers (ref: cmd/relationtuple/create.go readTuplesFromArg) --
+
+
+def _tuples_from_json_text(text: str) -> list[RelationTuple]:
+    data = json.loads(text)
+    if isinstance(data, list):
+        return [RelationTuple.from_dict(d) for d in data]
+    return [RelationTuple.from_dict(data)]
+
+
+def _read_tuples_from_arg(arg: str) -> list[RelationTuple]:
+    """Files, directories (recursive), or '-' for stdin; JSON object/array."""
+    if arg == "-":
+        return _tuples_from_json_text(sys.stdin.read())
+    if os.path.isdir(arg):
+        out: list[RelationTuple] = []
+        for name in sorted(os.listdir(arg)):
+            out.extend(_read_tuples_from_arg(os.path.join(arg, name)))
+        return out
+    try:
+        with open(arg) as f:
+            return _tuples_from_json_text(f.read())
+    except OSError as e:
+        raise CLIError(f"Error processing arg {arg}: {e}")
+    except json.JSONDecodeError as e:
+        raise CLIError(f"Could not decode {arg}: {e}")
+
+
+def _tuple_table(tuples: list[RelationTuple]) -> str:
+    """ref: ketoapi/cmd_output.go Header/Columns."""
+    header = ["NAMESPACE", "OBJECT ID", "RELATION NAME", "SUBJECT"]
+    rows = [
+        [
+            t.namespace,
+            t.object,
+            t.relation,
+            str(t.subject_set) if t.subject_set is not None else (t.subject_id or ""),
+        ]
+        for t in tuples
+    ]
+    widths = [
+        max(len(header[i]), *(len(r[i]) for r in rows)) if rows else len(header[i])
+        for i in range(4)
+    ]
+    lines = ["\t".join(h.ljust(widths[i]) for i, h in enumerate(header))]
+    for r in rows:
+        lines.append("\t".join(c.ljust(widths[i]) for i, c in enumerate(r)))
+    return "\n".join(lines)
+
+
+# -- subcommands ---------------------------------------------------------------
+
+
+def cmd_serve(args) -> int:
+    from ..config import Config
+    from ..registry import Registry
+    from ..api.daemon import Daemon
+
+    config = Config.from_file(args.config) if args.config else Config()
+    Daemon(Registry(config)).serve_forever()
+    return 0
+
+
+def cmd_migrate(args) -> int:
+    from ..config import Config
+    from ..storage.sqlite import SQLitePersister
+
+    config = Config.from_file(args.config) if args.config else Config()
+    dsn = config.dsn
+    if not dsn.startswith("sqlite://"):
+        print(f"dsn {dsn!r} needs no migrations")
+        return 0
+    p = SQLitePersister(dsn.removeprefix("sqlite://"), auto_migrate=False)
+    if args.action == "status":
+        for name, status in p.migration_status():
+            print(f"{status:10s} {name}")
+        return 0
+    if args.action == "up":
+        if not args.yes:
+            print("Applying migrations. Use --yes to skip this prompt.")
+            if input("Apply migrations? [y/N] ").strip().lower() != "y":
+                return 1
+        p.migrate_up()
+        print("Successfully applied all migrations.")
+        return 0
+    # down
+    if not args.yes:
+        print("Use --yes to confirm destructive down-migration.")
+        return 1
+    p.migrate_down(args.steps)
+    print(f"Rolled back {args.steps} migration(s).")
+    return 0
+
+
+def cmd_namespace_validate(args) -> int:
+    from ..config import NamespaceFileManager
+
+    ok = True
+    for path in args.files:
+        try:
+            namespaces = NamespaceFileManager.parse_file(path)
+        except Exception as e:  # noqa: BLE001 — validation surface
+            print(f"{path}: INVALID: {e}", file=sys.stderr)
+            ok = False
+            continue
+        names = ", ".join(ns.name for ns in namespaces) or "<none>"
+        print(f"{path}: OK ({names})")
+    return 0 if ok else 1
+
+
+def cmd_relation_tuple_parse(args) -> int:
+    """ref: cmd/relationtuple/parse.go — human tuple text -> JSON;
+    ignores comments (//) and blank lines; '-' reads stdin."""
+    tuples: list[RelationTuple] = []
+    for fn in args.files:
+        if fn == "-":
+            text = sys.stdin.read()
+        elif os.path.exists(fn):
+            with open(fn) as f:
+                text = f.read()
+        else:
+            text = fn  # convenience: parse a literal tuple argument
+        for lineno, line in enumerate(text.splitlines(), 1):
+            line = line.split("//")[0].strip()
+            if not line:
+                continue
+            try:
+                tuples.append(RelationTuple.from_string(line))
+            except Exception as e:  # noqa: BLE001
+                raise CLIError(f"{fn}:{lineno}: {e}")
+    if args.format in (FORMAT_JSON, FORMAT_JSON_PRETTY):
+        obj = (
+            tuples[0].to_dict()
+            if len(tuples) == 1
+            else [t.to_dict() for t in tuples]
+        )
+        _print_formatted(args, obj, "")
+    else:
+        print(_tuple_table(tuples))
+    return 0
+
+
+def cmd_relation_tuple_create(args) -> int:
+    tuples: list[RelationTuple] = []
+    for arg in args.files:
+        tuples.extend(_read_tuples_from_arg(arg))
+    client = _write_client(args)
+    try:
+        client.transact(insert=tuples)
+    finally:
+        client.close()
+    _print_formatted(
+        args,
+        [t.to_dict() for t in tuples],
+        f"Created {len(tuples)} relation tuple(s).",
+    )
+    return 0
+
+
+def cmd_relation_tuple_delete(args) -> int:
+    tuples: list[RelationTuple] = []
+    for arg in args.files:
+        tuples.extend(_read_tuples_from_arg(arg))
+    client = _write_client(args)
+    try:
+        client.transact(delete=tuples)
+    finally:
+        client.close()
+    print(f"Deleted {len(tuples)} relation tuple(s).")
+    return 0
+
+
+def _query_from_flags(args) -> RelationQuery:
+    q = RelationQuery(
+        namespace=args.namespace,
+        object=args.object,
+        relation=args.relation,
+        subject_id=args.subject_id,
+    )
+    if args.subject_set:
+        q.subject_set = SubjectSet.from_string(args.subject_set)
+    return q
+
+
+def cmd_relation_tuple_delete_all(args) -> int:
+    if not args.force:
+        print("Use --force to proceed with irreversible deletion.", file=sys.stderr)
+        return 1
+    client = _write_client(args)
+    try:
+        client.delete_all(_query_from_flags(args))
+    finally:
+        client.close()
+    print("Done.")
+    return 0
+
+
+def cmd_relation_tuple_get(args) -> int:
+    client = _read_client(args)
+    try:
+        resp = client.list_relation_tuples(
+            _query_from_flags(args),
+            page_size=args.page_size,
+            page_token=args.page_token,
+        )
+    finally:
+        client.close()
+    _print_formatted(
+        args,
+        resp.to_dict(),
+        _tuple_table(resp.relation_tuples)
+        + (f"\nNEXT PAGE TOKEN\t{resp.next_page_token}" if resp.next_page_token else ""),
+    )
+    return 0
+
+
+def cmd_check(args) -> int:
+    """ref: cmd/check/root.go — subject is a plain subject id."""
+    t = RelationTuple(
+        namespace=args.namespace,
+        object=args.object,
+        relation=args.relation,
+        subject_id=args.subject,
+    )
+    client = _read_client(args)
+    try:
+        allowed = client.check(t, max_depth=args.max_depth)
+    finally:
+        client.close()
+    _print_formatted(args, {"allowed": allowed}, "Allowed" if allowed else "Denied")
+    return 0
+
+
+def cmd_expand(args) -> int:
+    """ref: cmd/expand/root.go — args are <relation> <namespace> <object>."""
+    client = _read_client(args)
+    try:
+        tree = client.expand(
+            SubjectSet(args.namespace, args.object, args.relation),
+            max_depth=args.max_depth,
+        )
+    finally:
+        client.close()
+    if tree is None or tree.type.value == "unspecified" and tree.tuple is None:
+        print(
+            "Got an empty tree. This probably means that the requested "
+            "relation tuple is not present in Keto."
+        )
+        return 0
+    _print_formatted(args, tree.to_dict(), str(tree))
+    return 0
+
+
+def cmd_status(args) -> int:
+    """ref: cmd/status/root.go — health polling, --block retries."""
+    make = _write_client if args.endpoint == "write" else _read_client
+    while True:
+        try:
+            client = make(args)
+            try:
+                status = client.health(timeout=2)
+            finally:
+                client.close()
+            print(status)
+            if status == "SERVING" or not args.block:
+                return 0 if status == "SERVING" else 1
+        except Exception as e:  # noqa: BLE001 — retry loop
+            if not args.block:
+                print("NOT_SERVING")
+                return 1
+        time.sleep(1)
+
+
+def cmd_version(args) -> int:
+    print(__version__)
+    return 0
+
+
+# -- parser wiring -------------------------------------------------------------
+
+
+def _add_remote_flags(p: argparse.ArgumentParser, **_ignored):
+    # both remotes are registered on every client command, like the
+    # reference's RegisterRemoteURLFlags (cmd/client/grpc_client.go)
+    p.add_argument("--read-remote", default=None, help="read API gRPC remote (env KETO_READ_REMOTE)")
+    p.add_argument("--write-remote", default=None, help="write API gRPC remote (env KETO_WRITE_REMOTE)")
+    p.add_argument("--insecure", action="store_true", help="force plaintext gRPC")
+
+
+def _add_format_flag(p: argparse.ArgumentParser):
+    p.add_argument(
+        "--format",
+        choices=[FORMAT_DEFAULT, FORMAT_JSON, FORMAT_JSON_PRETTY],
+        default=FORMAT_DEFAULT,
+    )
+
+
+def build_parser() -> argparse.ArgumentParser:
+    root = argparse.ArgumentParser(
+        prog="keto_tpu", description="TPU-native Zanzibar-style permission server"
+    )
+    sub = root.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("serve", help="serve the read/write/metrics APIs")
+    p.add_argument("--config", "-c", default=None)
+    p.set_defaults(fn=cmd_serve)
+
+    p = sub.add_parser("migrate", help="run SQL migrations")
+    p.add_argument("action", choices=["up", "down", "status"])
+    p.add_argument("--config", "-c", default=None)
+    p.add_argument("--yes", action="store_true")
+    p.add_argument("--steps", type=int, default=1)
+    p.set_defaults(fn=cmd_migrate)
+
+    p = sub.add_parser("namespace", help="namespace utilities")
+    nsub = p.add_subparsers(dest="ns_command", required=True)
+    np = nsub.add_parser("validate", help="validate namespace definition files")
+    np.add_argument("files", nargs="+")
+    np.set_defaults(fn=cmd_namespace_validate)
+
+    p = sub.add_parser("relation-tuple", help="relation tuple commands")
+    rsub = p.add_subparsers(dest="rt_command", required=True)
+
+    rp = rsub.add_parser("parse", help="parse human readable relation tuples")
+    rp.add_argument("files", nargs="+")
+    _add_format_flag(rp)
+    rp.set_defaults(fn=cmd_relation_tuple_parse)
+
+    rp = rsub.add_parser("create", help="create relation tuples from JSON files")
+    rp.add_argument("files", nargs="+")
+    _add_remote_flags(rp, write=True)
+    _add_format_flag(rp)
+    rp.set_defaults(fn=cmd_relation_tuple_create)
+
+    rp = rsub.add_parser("delete", help="delete relation tuples from JSON files")
+    rp.add_argument("files", nargs="+")
+    _add_remote_flags(rp, write=True)
+    _add_format_flag(rp)
+    rp.set_defaults(fn=cmd_relation_tuple_delete)
+
+    for name, fn, needs_read, needs_write in (
+        ("delete-all", cmd_relation_tuple_delete_all, False, True),
+        ("get", cmd_relation_tuple_get, True, False),
+    ):
+        rp = rsub.add_parser(name)
+        rp.add_argument("--namespace", default=None)
+        rp.add_argument("--object", default=None)
+        rp.add_argument("--relation", default=None)
+        rp.add_argument("--subject-id", default=None)
+        rp.add_argument("--subject-set", default=None, help='"namespace:object#relation"')
+        _add_remote_flags(rp, write=needs_write, read=needs_read)
+        _add_format_flag(rp)
+        if name == "delete-all":
+            rp.add_argument("--force", action="store_true")
+        else:
+            rp.add_argument("--page-size", type=int, default=100)
+            rp.add_argument("--page-token", default="")
+        rp.set_defaults(fn=fn)
+
+    p = sub.add_parser("check", help="check whether a subject has a relation on an object")
+    p.add_argument("subject")
+    p.add_argument("relation")
+    p.add_argument("namespace")
+    p.add_argument("object")
+    p.add_argument("--max-depth", "-d", type=int, default=0)
+    _add_remote_flags(p, read=True)
+    _add_format_flag(p)
+    p.set_defaults(fn=cmd_check)
+
+    p = sub.add_parser("expand", help="expand a subject set into its members")
+    p.add_argument("relation")
+    p.add_argument("namespace")
+    p.add_argument("object")
+    p.add_argument("--max-depth", "-d", type=int, default=0)
+    _add_remote_flags(p, read=True)
+    _add_format_flag(p)
+    p.set_defaults(fn=cmd_expand)
+
+    p = sub.add_parser("status", help="poll server health")
+    p.add_argument("--block", action="store_true")
+    p.add_argument("--endpoint", choices=["read", "write"], default="read")
+    _add_remote_flags(p, read=True, write=True)
+    p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("version", help="print version")
+    p.set_defaults(fn=cmd_version)
+
+    return root
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except CLIError as e:
+        print(str(e), file=sys.stderr)
+        return 1
+    except KeyboardInterrupt:
+        return 130

@@ -1,0 +1,7 @@
+"""`python -m keto_tpu.cli` entry point (ref: main.go:23-26)."""
+
+import sys
+
+from . import main
+
+sys.exit(main())

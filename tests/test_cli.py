@@ -1,0 +1,194 @@
+"""CLI tests: parse/validate run pure; client commands run against an
+in-process daemon (the reference exercises its CLI through the cobra
+executor against a live server the same way, cmd/**/*_test.go)."""
+
+import json
+
+import pytest
+
+from keto_tpu.api.daemon import Daemon
+from keto_tpu.cli import main
+from keto_tpu.config import Config
+from keto_tpu.ketoapi import RelationQuery
+from keto_tpu.registry import Registry
+
+
+@pytest.fixture(scope="module")
+def daemon():
+    cfg = Config(
+        {
+            "dsn": "memory",
+            "check": {"engine": "host"},
+            "serve": {
+                "read": {"host": "127.0.0.1", "port": 0},
+                "write": {"host": "127.0.0.1", "port": 0},
+                "metrics": {"host": "127.0.0.1", "port": 0},
+            },
+            "namespaces": [
+                {"name": "videos", "relations": [{"name": "owner"}, {"name": "view"}]}
+            ],
+        }
+    )
+    d = Daemon(Registry(cfg))
+    d.start()
+    yield d
+    d.stop()
+
+
+@pytest.fixture
+def remotes(daemon):
+    return [
+        "--read-remote", f"127.0.0.1:{daemon.read_port}",
+        "--write-remote", f"127.0.0.1:{daemon.write_port}",
+    ]
+
+
+@pytest.fixture(autouse=True)
+def clean_store(daemon):
+    yield
+    daemon.registry.relation_tuple_manager().delete_all_relation_tuples(
+        RelationQuery(), nid=daemon.registry.nid
+    )
+
+
+def run(capsys, argv):
+    code = main(argv)
+    out = capsys.readouterr()
+    return code, out.out, out.err
+
+
+def test_version(capsys):
+    code, out, _ = run(capsys, ["version"])
+    assert code == 0 and out.strip()
+
+
+def test_parse_single_json(capsys):
+    code, out, _ = run(
+        capsys,
+        ["relation-tuple", "parse", "videos:v1#owner@alice", "--format", "json"],
+    )
+    assert code == 0
+    assert json.loads(out) == {
+        "namespace": "videos",
+        "object": "v1",
+        "relation": "owner",
+        "subject_id": "alice",
+    }
+
+
+def test_parse_table_and_comments(capsys, tmp_path):
+    f = tmp_path / "tuples.txt"
+    f.write_text("// comment\nvideos:v1#owner@alice\n\nvideos:v2#view@(videos:v2#owner)\n")
+    code, out, _ = run(capsys, ["relation-tuple", "parse", str(f)])
+    assert code == 0
+    assert "NAMESPACE" in out and "videos:v2#owner" in out
+
+
+def test_parse_invalid_exits_1(capsys):
+    code, _, err = run(capsys, ["relation-tuple", "parse", "not-a-tuple"])
+    assert code == 1 and err
+
+
+def test_namespace_validate(capsys, tmp_path):
+    good = tmp_path / "ns.json"
+    good.write_text(json.dumps({"name": "files", "relations": [{"name": "owner"}]}))
+    bad = tmp_path / "bad.json"
+    bad.write_text("{nope")
+    code, out, err = run(capsys, ["namespace", "validate", str(good)])
+    assert code == 0 and "OK" in out
+    code, out, err = run(capsys, ["namespace", "validate", str(good), str(bad)])
+    assert code == 1 and "INVALID" in err
+
+
+def test_namespace_validate_opl(capsys, tmp_path):
+    f = tmp_path / "ns.ts"
+    f.write_text(
+        "class User implements Namespace {}\n"
+        "class Doc implements Namespace {\n"
+        "  related: { owners: User[] }\n"
+        "  permits = { view: (ctx) => this.related.owners.includes(ctx.subject) }\n"
+        "}\n"
+    )
+    code, out, _ = run(capsys, ["namespace", "validate", str(f)])
+    assert code == 0 and "Doc" in out
+
+
+def test_create_check_get_expand_delete_all(capsys, tmp_path, remotes):
+    t = tmp_path / "t.json"
+    t.write_text(
+        json.dumps(
+            [
+                {"namespace": "videos", "object": "v1", "relation": "owner", "subject_id": "alice"},
+                {"namespace": "videos", "object": "v1", "relation": "view",
+                 "subject_set": {"namespace": "videos", "object": "v1", "relation": "owner"}},
+            ]
+        )
+    )
+    code, out, _ = run(capsys, ["relation-tuple", "create", str(t), *remotes])
+    assert code == 0 and "Created 2" in out
+
+    code, out, _ = run(capsys, ["check", "alice", "view", "videos", "v1", *remotes])
+    assert code == 0 and out.strip() == "Allowed"
+    code, out, _ = run(capsys, ["check", "eve", "view", "videos", "v1", *remotes])
+    assert code == 0 and out.strip() == "Denied"
+    code, out, _ = run(
+        capsys, ["check", "alice", "view", "videos", "v1", "--format", "json", *remotes]
+    )
+    assert json.loads(out) == {"allowed": True}
+
+    code, out, _ = run(
+        capsys, ["relation-tuple", "get", "--namespace", "videos", "--format", "json", *remotes]
+    )
+    assert code == 0 and len(json.loads(out)["relation_tuples"]) == 2
+
+    code, out, _ = run(capsys, ["expand", "view", "videos", "v1", *remotes])
+    assert code == 0 and "alice" in out
+
+    code, out, err = run(
+        capsys, ["relation-tuple", "delete-all", "--namespace", "videos", *remotes]
+    )
+    assert code == 1 and "--force" in err  # refuses without --force
+    code, out, _ = run(
+        capsys,
+        ["relation-tuple", "delete-all", "--namespace", "videos", "--force", *remotes],
+    )
+    assert code == 0
+    code, out, _ = run(
+        capsys, ["relation-tuple", "get", "--namespace", "videos", "--format", "json", *remotes]
+    )
+    assert json.loads(out)["relation_tuples"] == []
+
+
+def test_delete_tuples_from_file(capsys, tmp_path, remotes):
+    t = tmp_path / "t.json"
+    t.write_text(
+        json.dumps({"namespace": "videos", "object": "v3", "relation": "owner", "subject_id": "bo"})
+    )
+    run(capsys, ["relation-tuple", "create", str(t), *remotes])
+    code, out, _ = run(capsys, ["relation-tuple", "delete", str(t), *remotes])
+    assert code == 0 and "Deleted 1" in out
+    code, out, _ = run(capsys, ["check", "bo", "owner", "videos", "v3", *remotes])
+    assert out.strip() == "Denied"
+
+
+def test_status(capsys, remotes):
+    code, out, _ = run(capsys, ["status", *remotes])
+    assert code == 0 and out.strip() == "SERVING"
+
+
+def test_migrate_status_and_up(capsys, tmp_path):
+    cfg = tmp_path / "keto.yml"
+    cfg.write_text(f"dsn: sqlite://{tmp_path}/keto.db\n")
+    code, out, _ = run(capsys, ["migrate", "status", "-c", str(cfg)])
+    assert code == 0 and "pending" in out.lower()
+    code, out, _ = run(capsys, ["migrate", "up", "--yes", "-c", str(cfg)])
+    assert code == 0
+    code, out, _ = run(capsys, ["migrate", "status", "-c", str(cfg)])
+    assert "pending" not in out.lower()
+
+
+def test_migrate_memory_noop(capsys, tmp_path):
+    cfg = tmp_path / "keto.yml"
+    cfg.write_text("dsn: memory\n")
+    code, out, _ = run(capsys, ["migrate", "up", "--yes", "-c", str(cfg)])
+    assert code == 0 and "no migrations" in out
