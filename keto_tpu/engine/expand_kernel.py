@@ -1,0 +1,371 @@
+"""Batched TPU expand: device BFS subgraph gather + exact host assembly.
+
+The reference's Expand is a sequential DFS issuing one paginated SQL query
+per tree node (internal/expand/engine.go:35-104). Here the device walks
+all B expand queries breadth-first in lockstep over a full-edge CSR
+(subject-id leaves AND subject-set children, unlike the check kernel's
+subject-set-only CSR) and emits every discovered edge into a bounded
+per-query buffer; the host then runs the reference's exact DFS —
+visited-set cycle cut (graph_utils.go), depth bookkeeping (restDepth<=1 ⇒
+leaf, engine.go:74-77), nil-vs-leaf rules — over the device-gathered
+adjacency, touching no store.
+
+Expand applies NO userset rewrites (the reference's BuildTree only follows
+stored tuples), so the kernel needs no rewrite programs.
+
+Per step every live task (query, obj, rel, depth):
+  1. looks up its full-CSR row and, when depth >= 2, appends the row's
+     edges to the query's edge buffer (per-query bump allocation via a
+     segmented scan over tasks sorted by query)
+  2. enqueues subject-set children at depth-1 (>= 2) into the next
+     frontier, deduped on (query, obj, rel) keeping the deepest instance —
+     deepest-wins guarantees the host DFS always finds children for any
+     node it first visits at an expandable depth
+Buffer overflow or frontier overflow flags the query needs_host and the
+engine facade re-runs it on the host ReferenceEngine.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ketoapi import RelationTuple, SubjectSet, Tree, TreeNodeType
+from .snapshot import EMPTY, GraphSnapshot, _build_hash_table, encode_edge_arrays
+
+
+# -- full-edge CSR (host build) ------------------------------------------------
+
+
+def build_full_csr(
+    tuples: Sequence[RelationTuple], snapshot: GraphSnapshot
+) -> dict[str, np.ndarray]:
+    """Group ALL edges by (obj_slot, rel): subject-id leaves and
+    subject-set children, in tuple order within a row."""
+    t_obj, t_rel, t_skind, t_sa, t_sb = encode_edge_arrays(
+        list(tuples),
+        snapshot.ns_ids,
+        snapshot.rel_ids,
+        snapshot.obj_slots,
+        snapshot.subj_ids,
+    )
+    n = len(t_obj)
+    if n:
+        order = np.lexsort((np.arange(n), t_rel, t_obj))  # stable within row
+        t_obj, t_rel = t_obj[order], t_rel[order]
+        t_skind, t_sa, t_sb = t_skind[order], t_sa[order], t_sb[order]
+        row_change = np.empty(n, dtype=bool)
+        row_change[0] = True
+        row_change[1:] = (t_obj[1:] != t_obj[:-1]) | (t_rel[1:] != t_rel[:-1])
+        row_starts = np.flatnonzero(row_change)
+        row_ptr = np.append(row_starts, n).astype(np.int32)
+        fh_obj, fh_rel, fh_row, fh_probes = _build_hash_table(
+            (t_obj[row_starts], t_rel[row_starts]),
+            np.arange(len(row_starts), dtype=np.int32),
+        )
+    else:
+        row_ptr = np.zeros(1, dtype=np.int32)
+        fh_obj = np.full(64, EMPTY, np.int32)
+        fh_rel = np.full(64, EMPTY, np.int32)
+        fh_row = np.full(64, EMPTY, np.int32)
+        fh_probes = 1
+    return {
+        "fh_obj": fh_obj, "fh_rel": fh_rel, "fh_row": fh_row,
+        "fh_probes": fh_probes,
+        "f_row_ptr": row_ptr,
+        "f_skind": t_skind.astype(np.int32),
+        "f_sa": t_sa.astype(np.int32),
+        "f_sb": t_sb.astype(np.int32),
+    }
+
+
+# -- device kernel -------------------------------------------------------------
+
+
+def _row_lookup(tables, obj, rel, probes: int):
+    from .kernel import _hash_combine, _mix32
+
+    cap_mask = jnp.uint32(tables["fh_obj"].shape[0] - 1)
+    h1 = _hash_combine(obj, rel)
+    h2 = _mix32(h1 ^ jnp.uint32(0x9E3779B9)) | jnp.uint32(1)
+    row = jnp.full(obj.shape, EMPTY, dtype=jnp.int32)
+    for j in range(probes):
+        slot = ((h1 + jnp.uint32(j) * h2) & cap_mask).astype(jnp.int32)
+        match = (tables["fh_obj"][slot] == obj) & (tables["fh_rel"][slot] == rel)
+        row = jnp.where(match & (row == EMPTY), tables["fh_row"][slot], row)
+    return row
+
+
+class _ExpandState(NamedTuple):
+    t_q: jnp.ndarray  # [F]
+    t_obj: jnp.ndarray  # [F]
+    t_rel: jnp.ndarray  # [F]
+    t_depth: jnp.ndarray  # [F]
+    n_tasks: jnp.ndarray
+    # edge buffer, flattened [B * E]
+    eb_pobj: jnp.ndarray
+    eb_prel: jnp.ndarray
+    eb_skind: jnp.ndarray
+    eb_sa: jnp.ndarray
+    eb_sb: jnp.ndarray
+    eb_count: jnp.ndarray  # [B]
+    needs_host: jnp.ndarray  # [B]
+    step: jnp.ndarray
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("fh_probes", "max_steps", "frontier_cap", "edge_cap"),
+)
+def expand_kernel(
+    tables: dict,
+    q_obj: jnp.ndarray,  # [B]
+    q_rel: jnp.ndarray,  # [B]
+    q_depth: jnp.ndarray,  # [B] clamped depths
+    q_valid: jnp.ndarray,  # [B]
+    *,
+    fh_probes: int,
+    max_steps: int,
+    frontier_cap: int,
+    edge_cap: int,
+):
+    """Returns (eb_pobj, eb_prel, eb_skind, eb_sa, eb_sb  [B*E],
+    eb_count [B], root_has_children [B], needs_host [B])."""
+    B = q_obj.shape[0]
+    F = frontier_cap
+    E = edge_cap
+    n_edges = tables["f_skind"].shape[0]
+    n_rows = tables["f_row_ptr"].shape[0] - 1
+
+    def row_span(row):
+        start = jnp.where(row == EMPTY, 0, tables["f_row_ptr"][jnp.maximum(row, 0)])
+        end = jnp.where(
+            row == EMPTY, 0, tables["f_row_ptr"][jnp.minimum(row + 1, n_rows)]
+        )
+        return start, end - start
+
+    root_row = _row_lookup(tables, q_obj, q_rel, fh_probes)
+    _, root_len = row_span(root_row)
+    root_has_children = (root_len > 0) & q_valid
+
+    def step_fn(st: _ExpandState) -> _ExpandState:
+        idx = jnp.arange(F, dtype=jnp.int32)
+        live = (idx < st.n_tasks) & ~st.needs_host[st.t_q]
+        q, obj, rel, depth = st.t_q, st.t_obj, st.t_rel, st.t_depth
+
+        row = _row_lookup(tables, obj, rel, fh_probes)
+        start, length = row_span(row)
+        # only depth >= 2 nodes expand (restDepth<=1 ⇒ leaf, engine.go:74-77)
+        emit = live & (depth >= 2)
+        counts = jnp.where(emit, length, 0)
+
+        # per-query bump allocation: sort tasks by query, segmented
+        # exclusive scan of counts within each query
+        order = jnp.argsort(q + jnp.where(live, 0, B))  # dead tasks last
+        sq = q[order]
+        scounts = counts[order]
+        cum = jnp.cumsum(scounts) - scounts
+        seg_first = jnp.concatenate(
+            [jnp.ones(1, dtype=bool), sq[1:] != sq[:-1]]
+        )
+        seg_base = jnp.where(seg_first, cum, 0)
+        seg_base = jax.lax.associative_scan(jnp.maximum, seg_base)
+        within_q = cum - seg_base  # exclusive scan within query segment
+        alloc = st.eb_count[sq] + within_q  # first edge slot for this task
+
+        # unsort back to task order
+        inv = jnp.zeros(F, dtype=jnp.int32).at[order].set(
+            jnp.arange(F, dtype=jnp.int32)
+        )
+        alloc_t = alloc[inv]
+
+        # overflow: any task whose row doesn't fit flags its query
+        overflow = emit & ((alloc_t + counts) > E)
+        needs_host = st.needs_host.at[q].max(overflow)
+        emit = emit & ~overflow
+
+        # scatter edges: one pass over the max row length via a bounded
+        # segmented gather (total emitted this step <= F rows * row len,
+        # flattened through a [F] work list like the check kernel)
+        flat_counts = jnp.where(emit, counts, 0)
+        offsets = jnp.cumsum(flat_counts) - flat_counts
+        total = offsets[-1] + flat_counts[-1]
+        j = jnp.arange(F * 4, dtype=jnp.int32)  # emission slots this step
+        seg = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32) - 1
+        seg = jnp.clip(seg, 0, F - 1)
+        within = j - offsets[seg]
+        in_range = j < jnp.minimum(total, F * 4)
+        e = jnp.clip(start[seg] + within, 0, max(n_edges - 1, 0))
+        if n_edges:
+            c_skind = tables["f_skind"][e]
+            c_sa = tables["f_sa"][e]
+            c_sb = tables["f_sb"][e]
+        else:
+            c_skind = jnp.zeros(F * 4, jnp.int32)
+            c_sa = jnp.zeros(F * 4, jnp.int32)
+            c_sb = jnp.zeros(F * 4, jnp.int32)
+
+        dest_q = q[seg]
+        dest = jnp.where(
+            in_range, dest_q * E + alloc_t[seg] + within, B * E
+        )  # out-of-bounds drops
+        eb_pobj = st.eb_pobj.at[dest].set(obj[seg], mode="drop")
+        eb_prel = st.eb_prel.at[dest].set(rel[seg], mode="drop")
+        eb_skind = st.eb_skind.at[dest].set(c_skind, mode="drop")
+        eb_sa = st.eb_sa.at[dest].set(c_sa, mode="drop")
+        eb_sb = st.eb_sb.at[dest].set(c_sb, mode="drop")
+        eb_count = st.eb_count.at[dest_q].add(
+            jnp.where(in_range & emit[seg], 1, 0), mode="drop"
+        )
+        # rows longer than the F*4 emission budget truncate: flag them
+        trunc = (offsets + flat_counts) > F * 4
+        needs_host = needs_host.at[q].max(emit & trunc)
+
+        # next frontier: subject-set children at depth-1 >= 2
+        child_depth = depth[seg] - 1
+        cand_valid = in_range & (c_skind == 1) & (child_depth >= 2) & emit[seg]
+        from .kernel import Expansion, dedupe_phase
+
+        children = Expansion(
+            q=dest_q, obj=c_sa, rel=c_sb, depth=child_depth, valid=cand_valid
+        )
+        nt_q, nt_obj, nt_rel, nt_depth, n_new, overflow_q = dedupe_phase(
+            children, F, B
+        )
+        needs_host = needs_host | overflow_q
+        return _ExpandState(
+            nt_q, nt_obj, nt_rel, nt_depth, n_new,
+            eb_pobj, eb_prel, eb_skind, eb_sa, eb_sb,
+            eb_count, needs_host, st.step + 1,
+        )
+
+    pad = F - B
+    init = _ExpandState(
+        t_q=jnp.pad(jnp.arange(B, dtype=jnp.int32), (0, pad)),
+        t_obj=jnp.pad(q_obj.astype(jnp.int32), (0, pad)),
+        t_rel=jnp.pad(q_rel.astype(jnp.int32), (0, pad)),
+        t_depth=jnp.where(
+            jnp.pad(q_valid, (0, pad), constant_values=False),
+            jnp.pad(q_depth.astype(jnp.int32), (0, pad)),
+            -1,
+        ),
+        n_tasks=jnp.int32(B),
+        eb_pobj=jnp.full(B * edge_cap, EMPTY, jnp.int32),
+        eb_prel=jnp.full(B * edge_cap, EMPTY, jnp.int32),
+        eb_skind=jnp.zeros(B * edge_cap, jnp.int32),
+        eb_sa=jnp.zeros(B * edge_cap, jnp.int32),
+        eb_sb=jnp.zeros(B * edge_cap, jnp.int32),
+        eb_count=jnp.zeros(B, jnp.int32),
+        needs_host=jnp.zeros(B, dtype=bool),
+        step=jnp.int32(0),
+    )
+
+    def cond_fn(st: _ExpandState):
+        return (st.step < max_steps) & (st.n_tasks > 0)
+
+    final = jax.lax.while_loop(cond_fn, step_fn, init)
+    return (
+        final.eb_pobj, final.eb_prel, final.eb_skind, final.eb_sa, final.eb_sb,
+        final.eb_count, root_has_children, final.needs_host,
+    )
+
+
+# -- host assembly -------------------------------------------------------------
+
+
+class ExpandDecoder:
+    """Reverse vocabularies for decoding device ids back to strings."""
+
+    def __init__(self, snapshot: GraphSnapshot):
+        self.ns_names = {v: k for k, v in snapshot.ns_ids.items()}
+        self.rel_names = {v: k for k, v in snapshot.rel_ids.items()}
+        self.slot_to_obj = {v: k for k, v in snapshot.obj_slots.items()}
+        self.subj_names = {v: k for k, v in snapshot.subj_ids.items()}
+
+    def subject_set(self, obj_slot: int, rel: int) -> SubjectSet:
+        ns_id, obj = self.slot_to_obj[obj_slot]
+        return SubjectSet(
+            namespace=self.ns_names[ns_id],
+            object=obj,
+            relation=self.rel_names[rel],
+        )
+
+
+def assemble_tree(
+    root: SubjectSet,
+    root_slot: int,
+    root_rel: int,
+    depth: int,
+    adjacency: dict[tuple[int, int], list[tuple[int, int, int]]],
+    root_has_children: bool,
+    decoder: ExpandDecoder,
+) -> Optional[Tree]:
+    """Exact reference DFS over the device-gathered adjacency:
+    visited-set cycle cut, restDepth accounting, nil-vs-leaf rules
+    (internal/expand/engine.go:35-104)."""
+    visited: set[tuple[int, int]] = set()
+
+    def subject_tuple(skind: int, sa: int, sb: int) -> RelationTuple:
+        t = RelationTuple(namespace="", object="", relation="")
+        if skind == 1:
+            t.subject_set = decoder.subject_set(sa, sb)
+        else:
+            t.subject_id = decoder.subj_names[sa]
+        return t
+
+    def build(obj_slot: int, rel: int, rest: int) -> Optional[Tree]:
+        key = (obj_slot, rel)
+        if key in visited:
+            return None  # cycle cut ⇒ nil ⇒ parent renders a leaf
+        visited.add(key)
+        children = adjacency.get(key)
+        if not children:
+            return None  # no matching tuples ⇒ nil
+        node_tuple = RelationTuple(namespace="", object="", relation="")
+        node_tuple.subject_set = decoder.subject_set(obj_slot, rel)
+        node = Tree(type=TreeNodeType.UNION, tuple=node_tuple)
+        if rest <= 1:
+            node.type = TreeNodeType.LEAF
+            return node
+        for skind, sa, sb in children:
+            child = build(sa, sb, rest - 1) if skind == 1 else None
+            if child is None:
+                child = Tree(
+                    type=TreeNodeType.LEAF, tuple=subject_tuple(skind, sa, sb)
+                )
+            node.children.append(child)
+        return node
+
+    if depth <= 1:
+        # the root expands nothing at restDepth<=1: leaf if its row is
+        # non-empty, nil otherwise (engine.go:57-77)
+        if not root_has_children:
+            return None
+        node_tuple = RelationTuple(namespace="", object="", relation="")
+        node_tuple.subject_set = root
+        return Tree(type=TreeNodeType.LEAF, tuple=node_tuple)
+    return build(root_slot, root_rel, depth)
+
+
+def decode_edge_buffer(
+    eb_pobj, eb_prel, eb_skind, eb_sa, eb_sb, count: int, base: int
+) -> dict[tuple[int, int], list[tuple[int, int, int]]]:
+    """Edge records [base : base+count] → adjacency keyed by parent node,
+    deduped preserving first-emission order (a node expanded at two BFS
+    steps emits its row twice)."""
+    adjacency: dict[tuple[int, int], list[tuple[int, int, int]]] = {}
+    seen: set[tuple[int, int, int, int, int]] = set()
+    for i in range(base, base + count):
+        rec = (
+            int(eb_pobj[i]), int(eb_prel[i]),
+            int(eb_skind[i]), int(eb_sa[i]), int(eb_sb[i]),
+        )
+        if rec in seen:
+            continue
+        seen.add(rec)
+        adjacency.setdefault((rec[0], rec[1]), []).append(rec[2:])
+    return adjacency

@@ -69,6 +69,9 @@ class TPUCheckEngine:
         self._snapshot: Optional[GraphSnapshot] = None
         self._sharded = None
         self._tables = None
+        # lazy full-edge CSR for the expand kernel (version-keyed)
+        self._expand_tables = None
+        self._expand_decoder = None
         # device-path observability (served vs host-fallback checks);
         # `metrics` is an optional observability.Metrics mirror of the same
         self.stats = {"device_checks": 0, "host_checks": 0, "snapshot_builds": 0}
@@ -129,6 +132,29 @@ class TPUCheckEngine:
             self._snapshot = None
             self._sharded = None
             self._tables = None
+            self._expand_tables = None
+            self._expand_decoder = None
+
+    def _ensure_expand_tables(self):
+        """Full-edge CSR + reverse vocabularies for the expand kernel,
+        rebuilt whenever the check snapshot moves."""
+        snap, _, _ = self._ensure_snapshot()
+        with self._lock:
+            if self._expand_tables is None or self._expand_tables[0] != snap.version:
+                from .expand_kernel import ExpandDecoder, build_full_csr
+
+                tuples = self.manager.all_relation_tuples(nid=self.nid)
+                csr = build_full_csr(list(tuples), snap)
+                import jax.numpy as jnp
+
+                device_csr = {
+                    k: jnp.asarray(v)
+                    for k, v in csr.items()
+                    if k not in ("fh_probes",)
+                }
+                self._expand_tables = (snap.version, device_csr, csr["fh_probes"])
+                self._expand_decoder = ExpandDecoder(snap)
+            return snap, self._expand_tables[1], self._expand_tables[2], self._expand_decoder
 
     # -- check API ------------------------------------------------------------
 
@@ -149,7 +175,88 @@ class TPUCheckEngine:
         return self.reference.check_relation_tuple(r, max_depth, self.nid)
 
     def expand(self, subject: Subject, max_depth: int = 0) -> Optional[Tree]:
-        return self.reference.expand(subject, max_depth, self.nid)
+        res = self.expand_batch([subject], max_depth)
+        return res[0]
+
+    def expand_batch(
+        self,
+        subjects: Sequence[Subject],
+        max_depth: int = 0,
+        frontier_cap: int = 1024,
+        edge_cap: int = 4096,
+    ) -> list:
+        """Batched expand: device BFS subgraph gather + exact host DFS
+        assembly (engine/expand_kernel.py); SubjectIDs and overflowing /
+        unknown-vocabulary queries fall back to the host engine."""
+        from ..ketoapi import SubjectSet as _SubjectSet
+        from .expand_kernel import assemble_tree, decode_edge_buffer, expand_kernel
+
+        n = len(subjects)
+        if n == 0:
+            return []
+        snap, tables, fh_probes, decoder = self._ensure_expand_tables()
+        global_max = self.config.max_read_depth()
+        depth = max_depth if 0 < max_depth <= global_max else global_max
+
+        B = next((b for b in _BUCKETS if b >= n), None)
+        if B is None:
+            out = []
+            step = _BUCKETS[-1]
+            for i in range(0, n, step):
+                out.extend(
+                    self.expand_batch(subjects[i : i + step], max_depth,
+                                      frontier_cap, edge_cap)
+                )
+            return out
+
+        q_obj = np.zeros(B, dtype=np.int32)
+        q_rel = np.zeros(B, dtype=np.int32)
+        q_valid = np.zeros(B, dtype=bool)
+        host_idx: set[int] = set()
+        for i, sub in enumerate(subjects):
+            if not isinstance(sub, _SubjectSet):
+                host_idx.add(i)
+                continue
+            node = snap.encode_node(sub.namespace, sub.object, sub.relation)
+            if node is None:
+                # unknown to graph+config: no tuples can match => nil tree,
+                # but keep exact host semantics for the verdict
+                host_idx.add(i)
+                continue
+            q_obj[i], q_rel[i] = node
+            q_valid[i] = True
+
+        eb = expand_kernel(
+            tables,
+            q_obj, q_rel,
+            np.full(B, depth, dtype=np.int32),
+            q_valid,
+            fh_probes=fh_probes,
+            max_steps=depth + 2,
+            frontier_cap=max(frontier_cap, B),
+            edge_cap=edge_cap,
+        )
+        eb_pobj, eb_prel, eb_skind, eb_sa, eb_sb = (np.asarray(x) for x in eb[:5])
+        eb_count = np.asarray(eb[5])
+        root_has_children = np.asarray(eb[6])
+        needs_host = np.asarray(eb[7])
+
+        results = []
+        for i, sub in enumerate(subjects):
+            if i in host_idx or not q_valid[i] or needs_host[i]:
+                results.append(self.reference.expand(sub, max_depth, self.nid))
+                continue
+            adjacency = decode_edge_buffer(
+                eb_pobj, eb_prel, eb_skind, eb_sa, eb_sb,
+                int(eb_count[i]), i * edge_cap,
+            )
+            results.append(
+                assemble_tree(
+                    sub, int(q_obj[i]), int(q_rel[i]), depth,
+                    adjacency, bool(root_has_children[i]), decoder,
+                )
+            )
+        return results
 
     def check_batch(
         self, tuples: Sequence[RelationTuple], max_depth: int = 0

@@ -1,0 +1,166 @@
+"""Differential tests: device expand (BFS gather + host assembly) vs the
+exact host ReferenceEngine, mirroring how the TPU check kernel is tested.
+
+Tree comparison normalizes child order: the device path emits children in
+CSR row order while the host engine follows store pagination order; the
+reference makes no ordering promise (children come back in DB index
+order), so order-insensitive equality is the correct contract.
+"""
+
+import random
+
+import pytest
+
+from keto_tpu.config import Config
+from keto_tpu.engine.reference import ReferenceEngine
+from keto_tpu.engine.tpu_engine import TPUCheckEngine
+from keto_tpu.ketoapi import RelationTuple, SubjectSet
+from keto_tpu.namespace import Namespace
+from keto_tpu.storage.memory import MemoryManager
+
+
+def normalize(tree):
+    if tree is None:
+        return None
+    kids = sorted((normalize(c) for c in tree.children), key=repr)
+    return (tree.type.value, str(tree.tuple) if tree.tuple else None, tuple(kids))
+
+
+def make_engine(tuples, namespaces=None):
+    manager = MemoryManager()
+    manager.write_relation_tuples([RelationTuple.from_string(s) for s in tuples])
+    config = Config({"namespaces": []})
+    if namespaces is not None:
+        config.set_namespaces(namespaces)
+    else:
+        config.set_namespaces([Namespace(name=n) for n in {"files", "groups", "v"}])
+    engine = TPUCheckEngine(manager, config)
+    return engine, ReferenceEngine(manager, config)
+
+
+def assert_expand_matches(engine, reference, subject, max_depth=0):
+    device = engine.expand(subject, max_depth)
+    host = reference.expand(subject, max_depth)
+    assert normalize(device) == normalize(host)
+    return device
+
+
+class TestExpandKernel:
+    def test_single_level(self):
+        e, r = make_engine(
+            ["files:doc#owner@alice", "files:doc#owner@bob"]
+        )
+        tree = assert_expand_matches(e, r, SubjectSet("files", "doc", "owner"))
+        assert tree.type.value == "union" and len(tree.children) == 2
+
+    def test_nested_subject_sets(self):
+        e, r = make_engine(
+            [
+                "files:doc#view@(groups:eng#member)",
+                "groups:eng#member@alice",
+                "groups:eng#member@(groups:leads#member)",
+                "groups:leads#member@carol",
+            ]
+        )
+        assert_expand_matches(e, r, SubjectSet("files", "doc", "view"))
+
+    def test_empty_is_none(self):
+        e, r = make_engine(["files:doc#owner@alice"])
+        assert (
+            assert_expand_matches(e, r, SubjectSet("files", "doc", "missing")) is None
+        )
+
+    def test_depth_one_is_leaf(self):
+        e, r = make_engine(
+            ["files:doc#view@(groups:eng#member)", "groups:eng#member@alice"]
+        )
+        tree = assert_expand_matches(e, r, SubjectSet("files", "doc", "view"), 1)
+        assert tree.type.value == "leaf" and not tree.children
+
+    def test_depth_two_children_are_leaves(self):
+        e, r = make_engine(
+            ["files:doc#view@(groups:eng#member)", "groups:eng#member@alice"]
+        )
+        tree = assert_expand_matches(e, r, SubjectSet("files", "doc", "view"), 2)
+        assert tree.children[0].type.value == "leaf"
+
+    def test_cycle_cut(self):
+        e, r = make_engine(
+            [
+                "groups:a#member@(groups:b#member)",
+                "groups:b#member@(groups:a#member)",
+                "groups:b#member@bob",
+            ]
+        )
+        assert_expand_matches(e, r, SubjectSet("groups", "a", "member"), 10)
+
+    def test_self_cycle(self):
+        e, r = make_engine(
+            ["groups:g#member@(groups:g#member)", "groups:g#member@zoe"]
+        )
+        assert_expand_matches(e, r, SubjectSet("groups", "g", "member"), 8)
+
+    def test_subject_id_falls_back_to_host(self):
+        e, r = make_engine(["files:doc#owner@alice"])
+        assert normalize(e.expand("alice", 3)) == normalize(r.expand("alice", 3))
+
+    def test_unknown_namespace_nil(self):
+        e, r = make_engine(["files:doc#owner@alice"])
+        assert e.expand(SubjectSet("nope", "doc", "owner"), 3) is None
+
+    def test_batch(self):
+        e, r = make_engine(
+            [
+                "files:a#owner@alice",
+                "files:b#owner@bob",
+                "files:c#view@(files:a#owner)",
+            ]
+        )
+        subjects = [
+            SubjectSet("files", "a", "owner"),
+            SubjectSet("files", "b", "owner"),
+            SubjectSet("files", "c", "view"),
+            SubjectSet("files", "zzz", "owner"),
+        ]
+        got = e.expand_batch(subjects, 4)
+        want = [r.expand(s, 4) for s in subjects]
+        assert [normalize(g) for g in got] == [normalize(w) for w in want]
+
+    def test_tiny_edge_cap_falls_back(self):
+        e, r = make_engine(
+            [f"files:doc#owner@user{i}" for i in range(40)]
+        )
+        got = e.expand_batch([SubjectSet("files", "doc", "owner")], 3, edge_cap=8)
+        assert normalize(got[0]) == normalize(
+            r.expand(SubjectSet("files", "doc", "owner"), 3)
+        )
+
+    def test_wide_fanout(self):
+        tuples = [f"groups:g#member@u{i}" for i in range(200)]
+        tuples += [f"groups:g#member@(groups:sub{j}#member)" for j in range(10)]
+        tuples += [f"groups:sub{j}#member@m{j}" for j in range(10)]
+        e, r = make_engine(tuples)
+        assert_expand_matches(e, r, SubjectSet("groups", "g", "member"), 5)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_graphs_differential(self, seed):
+        rng = random.Random(seed)
+        objects = [f"o{i}" for i in range(12)]
+        relations = ["r1", "r2"]
+        subjects = [f"u{i}" for i in range(8)]
+        tuples = set()
+        for _ in range(60):
+            ns = "v"
+            obj = rng.choice(objects)
+            rel = rng.choice(relations)
+            if rng.random() < 0.45:
+                tuples.add(
+                    f"{ns}:{obj}#{rel}@({ns}:{rng.choice(objects)}#{rng.choice(relations)})"
+                )
+            else:
+                tuples.add(f"{ns}:{obj}#{rel}@{rng.choice(subjects)}")
+        e, r = make_engine(sorted(tuples))
+        for obj in objects[:6]:
+            for rel in relations:
+                for depth in (1, 2, 4, 0):
+                    assert_expand_matches(e, r, SubjectSet("v", obj, rel), depth)
