@@ -35,51 +35,56 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ketoapi import RelationTuple, SubjectSet, Tree, TreeNodeType
-from .snapshot import EMPTY, GraphSnapshot, _build_hash_table, encode_edge_arrays
+from .snapshot import EMPTY, GraphSnapshot
 
 
 # -- full-edge CSR (host build) ------------------------------------------------
 
 
 def build_full_csr(
-    tuples: Sequence[RelationTuple], snapshot: GraphSnapshot
+    tuples: Sequence[RelationTuple], snapshot: GraphSnapshot, view=None
 ) -> dict[str, np.ndarray]:
     """Group ALL edges by (obj_slot, rel): subject-id leaves and
-    subject-set children, in tuple order within a row."""
-    t_obj, t_rel, t_skind, t_sa, t_sb = encode_edge_arrays(
-        list(tuples),
-        snapshot.ns_ids,
-        snapshot.rel_ids,
-        snapshot.obj_slots,
-        snapshot.subj_ids,
-    )
-    n = len(t_obj)
-    if n:
-        order = np.lexsort((np.arange(n), t_rel, t_obj))  # stable within row
-        t_obj, t_rel = t_obj[order], t_rel[order]
-        t_skind, t_sa, t_sb = t_skind[order], t_sa[order], t_sb[order]
-        row_change = np.empty(n, dtype=bool)
-        row_change[0] = True
-        row_change[1:] = (t_obj[1:] != t_obj[:-1]) | (t_rel[1:] != t_rel[:-1])
-        row_starts = np.flatnonzero(row_change)
-        row_ptr = np.append(row_starts, n).astype(np.int32)
-        fh_obj, fh_rel, fh_row, fh_probes = _build_hash_table(
-            (t_obj[row_starts], t_rel[row_starts]),
-            np.arange(len(row_starts), dtype=np.int32),
+    subject-set children, in tuple order within a row. Encoding goes
+    through `view` (base vocab + delta overlay) when given; tuples whose
+    names the view doesn't know yet (written after the covered version)
+    are skipped — their rows are either dirty-flagged or beyond this
+    state's staleness horizon anyway."""
+    from .delta import SnapshotView
+
+    view = view or SnapshotView(snapshot)
+    n_t = len(tuples)
+    t_obj = np.zeros(n_t, dtype=np.int32)
+    t_rel = np.zeros(n_t, dtype=np.int32)
+    t_skind = np.zeros(n_t, dtype=np.int32)
+    t_sa = np.zeros(n_t, dtype=np.int32)
+    t_sb = np.zeros(n_t, dtype=np.int32)
+    keep = np.zeros(n_t, dtype=bool)
+    for i, t in enumerate(tuples):
+        node = view.encode_node(t.namespace, t.object, t.relation)
+        subject = view.encode_subject(t)
+        if node is None or subject is None:
+            continue
+        t_obj[i], t_rel[i] = node
+        t_skind[i], t_sa[i], t_sb[i] = subject
+        keep[i] = True
+
+    from .snapshot import group_rows_csr
+
+    fh_obj, fh_rel, fh_row, fh_probes, row_ptr, (f_skind, f_sa, f_sb) = (
+        group_rows_csr(
+            t_obj[keep],
+            t_rel[keep],
+            (t_skind[keep], t_sa[keep], t_sb[keep]),
         )
-    else:
-        row_ptr = np.zeros(1, dtype=np.int32)
-        fh_obj = np.full(64, EMPTY, np.int32)
-        fh_rel = np.full(64, EMPTY, np.int32)
-        fh_row = np.full(64, EMPTY, np.int32)
-        fh_probes = 1
+    )
     return {
         "fh_obj": fh_obj, "fh_rel": fh_rel, "fh_row": fh_row,
         "fh_probes": fh_probes,
         "f_row_ptr": row_ptr,
-        "f_skind": t_skind.astype(np.int32),
-        "f_sa": t_sa.astype(np.int32),
-        "f_sb": t_sb.astype(np.int32),
+        "f_skind": f_skind,
+        "f_sa": f_sa,
+        "f_sb": f_sb,
     }
 
 
@@ -152,6 +157,15 @@ def expand_kernel(
     _, root_len = row_span(root_row)
     root_has_children = (root_len > 0) & q_valid
 
+    # delta-overlay dirty roots: the CSR no longer reflects this row
+    # (even root_has_children may be stale) -> exact host replay
+    from .delta import DIRTY_FOR_EXPAND
+    from .kernel import dirty_lookup
+
+    init_needs_host = q_valid & (
+        (dirty_lookup(tables, q_obj, q_rel) & DIRTY_FOR_EXPAND) != 0
+    )
+
     def step_fn(st: _ExpandState) -> _ExpandState:
         idx = jnp.arange(F, dtype=jnp.int32)
         live = (idx < st.n_tasks) & ~st.needs_host[st.t_q]
@@ -161,6 +175,12 @@ def expand_kernel(
         start, length = row_span(row)
         # only depth >= 2 nodes expand (restDepth<=1 ⇒ leaf, engine.go:74-77)
         emit = live & (depth >= 2)
+        # overlay-dirty rows: stale CSR contents -> host replay
+        task_dirty = emit & (
+            (dirty_lookup(tables, obj, rel) & DIRTY_FOR_EXPAND) != 0
+        )
+        needs_host_d = st.needs_host.at[q].max(task_dirty)
+        emit = emit & ~task_dirty
         counts = jnp.where(emit, length, 0)
 
         # per-query bump allocation: sort tasks by query, segmented
@@ -185,7 +205,7 @@ def expand_kernel(
 
         # overflow: any task whose row doesn't fit flags its query
         overflow = emit & ((alloc_t + counts) > E)
-        needs_host = st.needs_host.at[q].max(overflow)
+        needs_host = needs_host_d.at[q].max(overflow)
         emit = emit & ~overflow
 
         # scatter edges: one pass over the max row length via a bounded
@@ -260,7 +280,7 @@ def expand_kernel(
         eb_sa=jnp.zeros(B * edge_cap, jnp.int32),
         eb_sb=jnp.zeros(B * edge_cap, jnp.int32),
         eb_count=jnp.zeros(B, jnp.int32),
-        needs_host=jnp.zeros(B, dtype=bool),
+        needs_host=init_needs_host,
         step=jnp.int32(0),
     )
 
@@ -277,14 +297,48 @@ def expand_kernel(
 # -- host assembly -------------------------------------------------------------
 
 
+class _ChainLookup:
+    """Two-level id -> name lookup: small overlay first, then base. Lets a
+    delta refresh extend a decoder without copying the base dicts."""
+
+    __slots__ = ("base", "extra")
+
+    def __init__(self, base, extra):
+        self.base = base
+        self.extra = extra
+
+    def __getitem__(self, key):
+        v = self.extra.get(key)
+        if v is None:
+            return self.base[key]
+        return v
+
+
 class ExpandDecoder:
     """Reverse vocabularies for decoding device ids back to strings."""
 
-    def __init__(self, snapshot: GraphSnapshot):
-        self.ns_names = {v: k for k, v in snapshot.ns_ids.items()}
-        self.rel_names = {v: k for k, v in snapshot.rel_ids.items()}
-        self.slot_to_obj = {v: k for k, v in snapshot.obj_slots.items()}
-        self.subj_names = {v: k for k, v in snapshot.subj_ids.items()}
+    def __init__(self, snapshot: Optional[GraphSnapshot]):
+        if snapshot is not None:
+            self.ns_names = {v: k for k, v in snapshot.ns_ids.items()}
+            self.rel_names = {v: k for k, v in snapshot.rel_ids.items()}
+            self.slot_to_obj = {v: k for k, v in snapshot.obj_slots.items()}
+            self.subj_names = {v: k for k, v in snapshot.subj_ids.items()}
+
+    def extended(self, overlay) -> "ExpandDecoder":
+        """Decoder view including a VocabOverlay's additions; O(overlay),
+        the base reverse dicts are shared, not copied."""
+        if overlay is None:
+            return self
+        d = ExpandDecoder(None)
+        d.ns_names = _ChainLookup(self.ns_names, {v: k for k, v in overlay.ns_ids.items()})
+        d.rel_names = _ChainLookup(self.rel_names, {v: k for k, v in overlay.rel_ids.items()})
+        d.slot_to_obj = _ChainLookup(
+            self.slot_to_obj, {v: k for k, v in overlay.obj_slots.items()}
+        )
+        d.subj_names = _ChainLookup(
+            self.subj_names, {v: k for k, v in overlay.subj_ids.items()}
+        )
+        return d
 
     def subject_set(self, obj_slot: int, rel: int) -> SubjectSet:
         ns_id, obj = self.slot_to_obj[obj_slot]

@@ -44,6 +44,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from .delta import DELTA_PROBES, DIRTY_FOR_CHECK, empty_delta_tables
 from .snapshot import (
     EMPTY,
     FLAG_CONFIG_MISSING,
@@ -90,6 +91,47 @@ def _direct_lookup(tables, obj, rel, skind, sa, sb, probes: int):
         )
         found = found | match
     return found
+
+
+def _delta_lookup(tables, obj, rel, skind, sa, sb):
+    """Probe the delta overlay's direct-edge table: returns (in_delta,
+    is_insert) — a delta entry overrides the main table (tombstones mask
+    deleted edges, inserts add unseen ones). Fixed capacity + probe count,
+    so delta refreshes never recompile (engine/delta.py)."""
+    cap_mask = jnp.uint32(tables["dd_obj"].shape[0] - 1)
+    h1 = _hash_combine(obj, rel, skind, sa, sb)
+    h2 = _mix32(h1 ^ _GOLDEN) | jnp.uint32(1)
+    found = jnp.zeros(obj.shape, dtype=bool)
+    val = jnp.zeros(obj.shape, dtype=jnp.int32)
+    for j in range(DELTA_PROBES):
+        slot = ((h1 + jnp.uint32(j) * h2) & cap_mask).astype(jnp.int32)
+        match = (
+            (tables["dd_obj"][slot] == obj)
+            & (tables["dd_rel"][slot] == rel)
+            & (tables["dd_skind"][slot] == skind)
+            & (tables["dd_sa"][slot] == sa)
+            & (tables["dd_sb"][slot] == sb)
+        )
+        val = jnp.where(match & ~found, tables["dd_val"][slot], val)
+        found = found | match
+    return found, val == 1
+
+
+def dirty_lookup(tables, obj, rel):
+    """Dirty-row bitmask for (obj, rel), 0 when the row is clean."""
+    cap_mask = jnp.uint32(tables["dirty_obj"].shape[0] - 1)
+    h1 = _hash_combine(obj, rel)
+    h2 = _mix32(h1 ^ _GOLDEN) | jnp.uint32(1)
+    found = jnp.zeros(obj.shape, dtype=bool)
+    val = jnp.zeros(obj.shape, dtype=jnp.int32)
+    for j in range(DELTA_PROBES):
+        slot = ((h1 + jnp.uint32(j) * h2) & cap_mask).astype(jnp.int32)
+        match = (tables["dirty_obj"][slot] == obj) & (
+            tables["dirty_rel"][slot] == rel
+        )
+        val = jnp.where(match & ~found, tables["dirty_val"][slot], val)
+        found = found | match
+    return val
 
 
 def _row_lookup(tables, obj, rel, probes: int):
@@ -145,12 +187,13 @@ def flag_phase(tables, obj, rel, live, *, n_config_rels: int):
 
 
 def probe_phase(tables, obj, rel, skind, sa, sb, depth, live, *, dh_probes: int):
-    """Direct-edge probe; needs depth >= 1 (checkDirect gets restDepth-1)."""
-    return (
-        _direct_lookup(tables, obj, rel, skind, sa, sb, dh_probes)
-        & live
-        & (depth >= 1)
-    )
+    """Direct-edge probe; needs depth >= 1 (checkDirect gets restDepth-1).
+    A delta-overlay entry for the exact key overrides the compacted table
+    (insert adds the edge, tombstone masks a deleted one)."""
+    main_hit = _direct_lookup(tables, obj, rel, skind, sa, sb, dh_probes)
+    in_delta, is_insert = _delta_lookup(tables, obj, rel, skind, sa, sb)
+    hit = jnp.where(in_delta, is_insert, main_hit)
+    return hit & live & (depth >= 1)
 
 
 def expand_phase(
@@ -194,12 +237,16 @@ def expand_phase(
     kinds = jnp.zeros((F, S), dtype=jnp.int32)
     crel = jnp.zeros((F, S), dtype=jnp.int32)
 
-    # slot 0: subject-set expansion at depth-1
+    # slot 0: subject-set expansion at depth-1; a delta-dirty row means the
+    # compacted CSR no longer reflects this row's edge list -> host replay
     row0 = _row_lookup(tables, obj, rel, rh_probes)
     s0, c0 = row_span(row0)
     can_expand = live & (depth >= 1)
     counts = counts.at[:, 0].set(jnp.where(can_expand, c0, 0))
     starts = starts.at[:, 0].set(s0)
+    dirty = can_expand & (
+        (dirty_lookup(tables, obj, rel) & DIRTY_FOR_CHECK) != 0
+    )
 
     # slots 1..K: rewrite instructions
     for k in range(K):
@@ -217,12 +264,16 @@ def expand_phase(
         kinds = kinds.at[:, k + 1].set(ik)
         # for computed: child relation = ir; for ttu: child rel = ir2
         crel = crel.at[:, k + 1].set(jnp.where(ik == INSTR_COMPUTED, ir, ir2))
+        dirty = dirty | (
+            is_ttu & ((dirty_lookup(tables, obj, ir) & DIRTY_FOR_CHECK) != 0)
+        )
 
     flat_counts = counts.reshape(-1)
     offsets = jnp.cumsum(flat_counts) - flat_counts  # exclusive scan
     total = offsets[-1] + flat_counts[-1]
 
-    # queries whose expansions overflow the frontier need host replay
+    # queries whose expansions overflow the frontier need host replay;
+    # delta-dirty rows do too (their CSR contents are stale)
     truncated_seg = (offsets + flat_counts) > F
     seg_q = jnp.repeat(q, S, total_repeat_length=F * S)
     overflow_q = (
@@ -230,6 +281,7 @@ def expand_phase(
         .at[seg_q]
         .max(truncated_seg & (flat_counts > 0))
     )
+    overflow_q = overflow_q.at[q].max(dirty)
 
     # build candidate children by segmented gather
     j = jnp.arange(F, dtype=jnp.int32)
@@ -410,9 +462,25 @@ def check_kernel(
     return finalize(final, max_steps)
 
 
-def snapshot_tables(snapshot: GraphSnapshot) -> dict:
-    """Device-resident table dict for check_kernel (uploads once)."""
-    return {k: jnp.asarray(v) for k, v in snapshot.device_arrays().items()}
+def snapshot_tables(snapshot: GraphSnapshot, delta: dict | None = None) -> dict:
+    """Device-resident table dict for check_kernel (uploads once); the
+    delta-overlay tables default to empty (fixed shapes either way)."""
+    tables = {k: jnp.asarray(v) for k, v in snapshot.device_arrays().items()}
+    tables.update(
+        {k: jnp.asarray(v) for k, v in (delta or empty_delta_tables()).items()}
+    )
+    return tables
+
+
+def refresh_delta_tables(tables: dict, snapshot: GraphSnapshot, delta: dict) -> dict:
+    """New table dict with only the overlay (and the vocab-dependent
+    objslot_ns / ns_has_config arrays, which grow with delta vocab) re-
+    uploaded; the big compacted tables are reused as-is."""
+    out = dict(tables)
+    out["objslot_ns"] = jnp.asarray(snapshot.objslot_ns)
+    out["ns_has_config"] = jnp.asarray(snapshot.ns_has_config)
+    out.update({k: jnp.asarray(v) for k, v in delta.items()})
+    return out
 
 
 def kernel_static_config(

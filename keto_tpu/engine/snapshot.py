@@ -153,6 +153,45 @@ def encode_edge_arrays(
     return t_obj, t_rel, t_skind, t_sa, t_sb
 
 
+def group_rows_csr(
+    key_obj: np.ndarray,
+    key_rel: np.ndarray,
+    payloads: tuple[np.ndarray, ...],
+    min_capacity: int = 64,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, int, np.ndarray, tuple]:
+    """Group edges by (obj, rel) into a CSR addressed through a row hash
+    table. Stable within a row (original order preserved). Returns
+    (rh_obj, rh_rel, rh_row, rh_probes, row_ptr, sorted_payloads).
+    Shared by the check kernel's subject-set CSR and the expand kernel's
+    full-edge CSR so the probe-sensitive row-index construction has one
+    implementation."""
+    n = len(key_obj)
+    if n:
+        order = np.lexsort((np.arange(n), key_rel, key_obj))
+        key_obj, key_rel = key_obj[order], key_rel[order]
+        payloads = tuple(p[order] for p in payloads)
+        row_change = np.empty(n, dtype=bool)
+        row_change[0] = True
+        row_change[1:] = (key_obj[1:] != key_obj[:-1]) | (
+            key_rel[1:] != key_rel[:-1]
+        )
+        row_starts = np.flatnonzero(row_change)
+        row_ptr = np.append(row_starts, n).astype(np.int32)
+        rh_obj, rh_rel, rh_row, rh_probes = _build_hash_table(
+            (key_obj[row_starts], key_rel[row_starts]),
+            np.arange(len(row_starts), dtype=np.int32),
+            min_capacity=min_capacity,
+        )
+    else:
+        cap = max(min_capacity, 64)
+        row_ptr = np.zeros(1, dtype=np.int32)
+        rh_obj = np.full(cap, EMPTY, np.int32)
+        rh_rel = np.full(cap, EMPTY, np.int32)
+        rh_row = np.full(cap, EMPTY, np.int32)
+        rh_probes = 1
+    return rh_obj, rh_rel, rh_row, rh_probes, row_ptr, payloads
+
+
 def build_edge_tables(
     t_obj: np.ndarray,
     t_rel: np.ndarray,
@@ -182,38 +221,12 @@ def build_edge_tables(
     # are kept (TTU traverses them; the kernel filters them for the
     # expand-subject slot)
     is_set = t_skind == 1
-    ss_obj = t_obj[is_set]
-    ss_rel = t_rel[is_set]
-    ss_sa = t_sa[is_set]
-    ss_sb = t_sb[is_set]
-    if len(ss_obj):
-        order = np.lexsort((ss_sb, ss_sa, ss_rel, ss_obj))
-        ss_obj, ss_rel = ss_obj[order], ss_rel[order]
-        ss_sa, ss_sb = ss_sa[order], ss_sb[order]
-        row_change = np.empty(len(ss_obj), dtype=bool)
-        row_change[0] = True
-        row_change[1:] = (ss_obj[1:] != ss_obj[:-1]) | (ss_rel[1:] != ss_rel[:-1])
-        row_starts = np.flatnonzero(row_change)
-        n_rows = len(row_starts)
-        row_ptr = np.append(row_starts, len(ss_obj)).astype(np.int32)
-        row_keys_obj = ss_obj[row_starts]
-        row_keys_rel = ss_rel[row_starts]
-        rh = _build_hash_table(
-            (row_keys_obj, row_keys_rel),
-            np.arange(n_rows, dtype=np.int32),
-            min_capacity=rh_min_cap,
-        )
-        rh_obj, rh_rel, rh_row, rh_probes = rh
-        e_obj, e_rel = ss_sa.astype(np.int32), ss_sb.astype(np.int32)
-    else:
-        row_ptr = np.zeros(1, dtype=np.int32)
-        cap = max(rh_min_cap, 64)
-        rh_obj = np.full(cap, EMPTY, np.int32)
-        rh_rel = np.full(cap, EMPTY, np.int32)
-        rh_row = np.full(cap, EMPTY, np.int32)
-        rh_probes = 1
-        e_obj = np.zeros(0, dtype=np.int32)
-        e_rel = np.zeros(0, dtype=np.int32)
+    rh_obj, rh_rel, rh_row, rh_probes, row_ptr, (e_obj, e_rel) = group_rows_csr(
+        t_obj[is_set],
+        t_rel[is_set],
+        (t_sa[is_set].astype(np.int32), t_sb[is_set].astype(np.int32)),
+        min_capacity=rh_min_cap,
+    )
 
     return {
         "dh_obj": dh_obj, "dh_rel": dh_rel, "dh_skind": dh_skind,

@@ -2,24 +2,25 @@
 
 Owns the device mirror lifecycle and the batched check path:
 
-  - snapshot management: rebuilds the GraphSnapshot (engine/snapshot.py)
-    when the store's write version moves — the device analog of the
-    reference's "stateless replicas over one authoritative DB"; writes
-    stay host-authoritative, checks read the mirror (read-your-writes is
-    preserved because every write bumps the version and the next check
-    batch refreshes)
+  - snapshot management: one immutable `_EngineState` per store/config
+    version — base GraphSnapshot + vocabulary overlay view + device
+    tables + delta overlay. Writes refresh the fixed-shape delta overlay
+    (engine/delta.py) in a NEW state object; a full rebuild (compaction)
+    happens only on config changes, truncated change logs, or oversized
+    deltas. Concurrent batches capture one state atomically and stay
+    internally consistent.
   - batching front: single checks ride in padded buckets so the jitted
     kernel compiles once per (bucket, static-config) pair — the
     goroutine-per-branch concurrency of the reference becomes batch-
     dimension parallelism
   - exact-semantics fallback: queries flagged needs_host (AND/NOT rewrite
-    islands, config-missing-relation errors, frontier overflow) and
-    queries whose namespace/object/relation never occur in the graph are
-    re-evaluated by the host ReferenceEngine; proof trees and expand
-    always come from the host engine
+    islands, config-missing-relation errors, frontier overflow, delta-
+    dirty rows) and queries whose namespace/object/relation never occur
+    in the graph are re-evaluated by the host ReferenceEngine; proof
+    trees always come from the host engine
 
 The public surface mirrors check.Engine (CheckIsMember/CheckRelationTuple,
-internal/check/engine.go:54-80) plus a batch entry point the RPC layer's
+internal/check/engine.go:54-80) plus batch entry points the RPC layer's
 micro-batcher feeds.
 """
 
@@ -28,6 +29,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 import numpy as np
@@ -36,11 +38,33 @@ from ..config import Config
 from ..ketoapi import RelationTuple, Subject, Tree
 from ..storage.definitions import DEFAULT_NETWORK, Manager
 from .definitions import CheckResult, Membership
+from .delta import SnapshotView, empty_delta_tables
 from .kernel import check_kernel, kernel_static_config, snapshot_tables
 from .reference import ReferenceEngine
 from .snapshot import GraphSnapshot, build_snapshot
 
 _BUCKETS = (16, 256, 1024, 4096)
+
+
+@dataclass
+class _EngineState:
+    """One consistent device-mirror generation. Immutable except for the
+    lazily-built expand fields, which are only written under the engine
+    lock and only transition None -> value."""
+
+    snapshot: GraphSnapshot
+    view: SnapshotView
+    sharded: object  # ShardedSnapshot | None
+    tables: object  # dict | (sharded_tables, replicated_tables)
+    delta_np: dict
+    base_version: int
+    covered_version: int
+    config_fp: int
+    # expand-kernel extras (lazy)
+    expand_tables: Optional[dict] = None  # device full CSR + dirty tables
+    fh_probes: Optional[int] = None
+    base_decoder: object = None  # reverse vocab of the base snapshot only
+    decoder: object = None  # base_decoder extended with the overlay
 
 
 class TPUCheckEngine:
@@ -66,12 +90,7 @@ class TPUCheckEngine:
         self.mesh = mesh
         self.reference = ReferenceEngine(manager, config)
         self._lock = threading.Lock()
-        self._snapshot: Optional[GraphSnapshot] = None
-        self._sharded = None
-        self._tables = None
-        # lazy full-edge CSR for the expand kernel (version-keyed)
-        self._expand_tables = None
-        self._expand_decoder = None
+        self._state: Optional[_EngineState] = None
         # device-path observability (served vs host-fallback checks);
         # `metrics` is an optional observability.Metrics mirror of the same
         self.stats = {"device_checks": 0, "host_checks": 0, "snapshot_builds": 0}
@@ -79,82 +98,182 @@ class TPUCheckEngine:
 
     # -- snapshot lifecycle ---------------------------------------------------
 
-    def _ensure_snapshot(self):
-        """Returns (snapshot, sharded_snapshot_or_None, tables) as one
-        consistent triple (concurrent rebuild/invalidate safe)."""
-        # staleness key covers BOTH the store write version and the
-        # namespace-config content: a rewrite change with no tuple writes
-        # must also rebuild the compiled rewrite programs
+    def _ensure_state(self) -> _EngineState:
+        """Returns one consistent engine state.
+
+        A namespace-config change (rewrite programs compile into the
+        tables), truncated/oversized change log, or missing change-log
+        support compacts — full rebuild; otherwise writes since the base
+        snapshot refresh only the fixed-shape delta overlay, so the write
+        path never re-uploads the O(edges) tables nor recompiles XLA."""
         store_version = self.manager.version(nid=self.nid)
         namespaces = self.config.namespace_manager().namespaces()
         config_fp = hash(
             json.dumps([ns.to_dict() for ns in namespaces], sort_keys=True)
         )
-        version = hash((store_version, config_fp))
         with self._lock:
-            snap = self._snapshot
-            if snap is None or snap.version != version:
-                build_start = time.perf_counter()
-                tuples = self.manager.all_relation_tuples(nid=self.nid)
-                if self.mesh is not None:
-                    from ..parallel import build_sharded_snapshot
-                    from ..parallel.kernel import place_sharded_tables
+            state = self._state
+            rebuild = state is None or state.config_fp != config_fp
+            if not rebuild and state.covered_version != store_version:
+                state = self._delta_refresh(state, store_version)
+                rebuild = state is None
+            if rebuild:
+                state = self._rebuild(store_version, config_fp, namespaces)
+            self._state = state
+            return state
 
-                    sharded = build_sharded_snapshot(
-                        tuples,
-                        namespaces,
-                        n_shards=self.mesh.devices.size,
-                        K=self.rewrite_instr_cap,
-                        version=version,
-                    )
-                    snap = sharded.base
-                    self._sharded = sharded
-                    self._tables = place_sharded_tables(
-                        sharded, self.mesh, axis=self.mesh.axis_names[0]
-                    )
-                else:
-                    snap = build_snapshot(
-                        tuples, namespaces, K=self.rewrite_instr_cap, version=version
-                    )
-                    self._tables = snapshot_tables(snap)
-                self._snapshot = snap
-                self.stats["snapshot_builds"] += 1
-                if self.metrics is not None:
-                    self.metrics.snapshot_builds_total.inc()
-                    self.metrics.snapshot_tuples.set(snap.n_tuples)
-                    self.metrics.snapshot_build_duration.observe(
-                        time.perf_counter() - build_start
-                    )
-            return snap, self._sharded, self._tables
+    def _delta_refresh(
+        self, state: _EngineState, store_version: int
+    ) -> Optional[_EngineState]:
+        """Incremental overlay refresh into a NEW state; None => compact."""
+        from .delta import (
+            DeltaOverflow,
+            build_delta_tables,
+            build_vocab_overlay,
+        )
+
+        changes_since = getattr(self.manager, "changes_since", None)
+        if changes_since is None:
+            return None
+        ops = changes_since(state.base_version, nid=self.nid)
+        if ops is None:
+            return None
+        try:
+            overlay = build_vocab_overlay(state.snapshot, ops)
+            view = SnapshotView(state.snapshot, overlay)
+            delta = build_delta_tables(view, ops)
+        except DeltaOverflow:
+            return None
+
+        vocab_arrays = {
+            "objslot_ns": overlay.objslot_ns,
+            "ns_has_config": overlay.ns_has_config,
+        }
+        if self.mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sharded_tables, replicated = state.tables
+            replicated = dict(replicated)
+            for k, v in {**delta, **vocab_arrays}.items():
+                replicated[k] = jax.device_put(v, NamedSharding(self.mesh, P()))
+            tables = (sharded_tables, replicated)
+        else:
+            import jax.numpy as jnp
+
+            tables = dict(state.tables)
+            for k, v in {**delta, **vocab_arrays}.items():
+                tables[k] = jnp.asarray(v)
+
+        new_state = _EngineState(
+            snapshot=state.snapshot,
+            view=view,
+            sharded=state.sharded,
+            tables=tables,
+            delta_np=delta,
+            base_version=state.base_version,
+            covered_version=store_version,
+            config_fp=state.config_fp,
+        )
+        # carry the base full-CSR + base decoder forward; the dirty tables
+        # and overlay extension re-derive from the fresh delta (O(delta))
+        if state.expand_tables is not None:
+            base_csr = {
+                k: v
+                for k, v in state.expand_tables.items()
+                if not k.startswith("dirty_")
+            }
+            new_state.expand_tables = self._merge_expand_dirty(base_csr, delta)
+            new_state.fh_probes = state.fh_probes
+            new_state.base_decoder = state.base_decoder
+            new_state.decoder = state.base_decoder.extended(overlay)
+        return new_state
+
+    @staticmethod
+    def _merge_expand_dirty(base_csr: dict, delta_np: dict) -> dict:
+        import jax.numpy as jnp
+
+        merged = dict(base_csr)
+        for k in ("dirty_obj", "dirty_rel", "dirty_val"):
+            merged[k] = jnp.asarray(delta_np[k])
+        return merged
+
+    def _rebuild(self, store_version: int, config_fp, namespaces) -> _EngineState:
+        version = hash((store_version, config_fp))
+        build_start = time.perf_counter()
+        tuples = self.manager.all_relation_tuples(nid=self.nid)
+        sharded = None
+        if self.mesh is not None:
+            from ..parallel import build_sharded_snapshot
+            from ..parallel.kernel import place_sharded_tables
+
+            sharded = build_sharded_snapshot(
+                tuples,
+                namespaces,
+                n_shards=self.mesh.devices.size,
+                K=self.rewrite_instr_cap,
+                version=version,
+            )
+            snap = sharded.base
+            tables = place_sharded_tables(
+                sharded, self.mesh, axis=self.mesh.axis_names[0]
+            )
+        else:
+            snap = build_snapshot(
+                tuples, namespaces, K=self.rewrite_instr_cap, version=version
+            )
+            tables = snapshot_tables(snap)
+        state = _EngineState(
+            snapshot=snap,
+            view=SnapshotView(snap),
+            sharded=sharded,
+            tables=tables,
+            delta_np=empty_delta_tables(),
+            base_version=store_version,
+            covered_version=store_version,
+            config_fp=config_fp,
+        )
+        self.stats["snapshot_builds"] += 1
+        if self.metrics is not None:
+            self.metrics.snapshot_builds_total.inc()
+            self.metrics.snapshot_tuples.set(snap.n_tuples)
+            self.metrics.snapshot_build_duration.observe(
+                time.perf_counter() - build_start
+            )
+        return state
 
     def invalidate(self) -> None:
         with self._lock:
-            self._snapshot = None
-            self._sharded = None
-            self._tables = None
-            self._expand_tables = None
-            self._expand_decoder = None
+            self._state = None
 
-    def _ensure_expand_tables(self):
-        """Full-edge CSR + reverse vocabularies for the expand kernel,
-        rebuilt whenever the check snapshot moves."""
-        snap, _, _ = self._ensure_snapshot()
+    def _ensure_expand_state(self) -> _EngineState:
+        """State with the expand-kernel extras (full-edge CSR + dirty
+        tables + decoder) populated. The CSR follows the BASE snapshot;
+        writes since then ride the overlay's dirty tables — the expand
+        kernel sends queries touching dirty rows to the host, so the CSR
+        needs no rebuild on the write path."""
+        state = self._ensure_state()
+        if state.expand_tables is not None:
+            return state
+        import jax.numpy as jnp
+
+        from .expand_kernel import ExpandDecoder, build_full_csr
+
         with self._lock:
-            if self._expand_tables is None or self._expand_tables[0] != snap.version:
-                from .expand_kernel import ExpandDecoder, build_full_csr
-
-                tuples = self.manager.all_relation_tuples(nid=self.nid)
-                csr = build_full_csr(list(tuples), snap)
-                import jax.numpy as jnp
-
-                device_csr = {
-                    k: jnp.asarray(v)
-                    for k, v in csr.items()
-                    if k not in ("fh_probes",)
-                }
-                self._expand_tables = (snap.version, device_csr, csr["fh_probes"])
-                self._expand_decoder = ExpandDecoder(snap)
-            return snap, self._expand_tables[1], self._expand_tables[2], self._expand_decoder
+            if state.expand_tables is not None:  # raced with another filler
+                return state
+            tuples = self.manager.all_relation_tuples(nid=self.nid)
+            csr = build_full_csr(list(tuples), state.snapshot, view=state.view)
+            fh_probes = csr.pop("fh_probes")
+            device_csr = {k: jnp.asarray(v) for k, v in csr.items()}
+            state.fh_probes = fh_probes
+            state.base_decoder = ExpandDecoder(state.snapshot)
+            state.decoder = state.base_decoder.extended(state.view.overlay)
+            # expand_tables is the readiness signal: set it last
+            state.expand_tables = self._merge_expand_dirty(
+                device_csr, state.delta_np
+            )
+            return state
 
     # -- check API ------------------------------------------------------------
 
@@ -187,14 +306,14 @@ class TPUCheckEngine:
     ) -> list:
         """Batched expand: device BFS subgraph gather + exact host DFS
         assembly (engine/expand_kernel.py); SubjectIDs and overflowing /
-        unknown-vocabulary queries fall back to the host engine."""
+        unknown-vocabulary / delta-dirty queries fall back to the host."""
         from ..ketoapi import SubjectSet as _SubjectSet
         from .expand_kernel import assemble_tree, decode_edge_buffer, expand_kernel
 
         n = len(subjects)
         if n == 0:
             return []
-        snap, tables, fh_probes, decoder = self._ensure_expand_tables()
+        state = self._ensure_expand_state()
         global_max = self.config.max_read_depth()
         depth = max_depth if 0 < max_depth <= global_max else global_max
 
@@ -217,7 +336,7 @@ class TPUCheckEngine:
             if not isinstance(sub, _SubjectSet):
                 host_idx.add(i)
                 continue
-            node = snap.encode_node(sub.namespace, sub.object, sub.relation)
+            node = state.view.encode_node(sub.namespace, sub.object, sub.relation)
             if node is None:
                 # unknown to graph+config: no tuples can match => nil tree,
                 # but keep exact host semantics for the verdict
@@ -227,12 +346,15 @@ class TPUCheckEngine:
             q_valid[i] = True
 
         eb = expand_kernel(
-            tables,
+            state.expand_tables,
             q_obj, q_rel,
             np.full(B, depth, dtype=np.int32),
             q_valid,
-            fh_probes=fh_probes,
-            max_steps=depth + 2,
+            fh_probes=state.fh_probes,
+            # static step budget keyed to the GLOBAL depth cap, not the
+            # per-call depth (avoids one recompile per requested depth);
+            # the loop exits early once the frontier drains
+            max_steps=global_max + 2,
             frontier_cap=max(frontier_cap, B),
             edge_cap=edge_cap,
         )
@@ -253,7 +375,7 @@ class TPUCheckEngine:
             results.append(
                 assemble_tree(
                     sub, int(q_obj[i]), int(q_rel[i]), depth,
-                    adjacency, bool(root_has_children[i]), decoder,
+                    adjacency, bool(root_has_children[i]), state.decoder,
                 )
             )
         return results
@@ -265,7 +387,7 @@ class TPUCheckEngine:
         n = len(tuples)
         if n == 0:
             return []
-        snap, sharded_snap, tables = self._ensure_snapshot()
+        state = self._ensure_state()
         global_max = self.config.max_read_depth()
         depth = max_depth if 0 < max_depth <= global_max else global_max
 
@@ -288,7 +410,7 @@ class TPUCheckEngine:
         host_idx: list[int] = []
 
         for i, t in enumerate(tuples):
-            node = snap.encode_node(t.namespace, t.object, t.relation)
+            node = state.view.encode_node(t.namespace, t.object, t.relation)
             if node is None:
                 # namespace/object/relation absent from graph+config: no
                 # edge can match, but error semantics (missing relation in
@@ -296,7 +418,7 @@ class TPUCheckEngine:
                 host_idx.append(i)
                 continue
             q_obj[i], q_rel[i] = node
-            subject = snap.encode_subject(t)
+            subject = state.view.encode_subject(t)
             if subject is not None:
                 q_skind[i], q_sa[i], q_sb[i] = subject
             # unknown subject keeps the sentinel: traversal still runs so
@@ -307,18 +429,18 @@ class TPUCheckEngine:
             from ..parallel.kernel import sharded_check_kernel, sharded_static_config
 
             statics = sharded_static_config(
-                sharded_snap, global_max, self.frontier_cap
+                state.sharded, global_max, self.frontier_cap
             )
-            sharded_tables, replicated_tables = tables
+            sharded_tables, replicated_tables = state.tables
             member, needs_host = sharded_check_kernel(
                 self.mesh, sharded_tables, replicated_tables,
                 q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid,
                 statics=statics, axis=self.mesh.axis_names[0],
             )
         else:
-            cfg = kernel_static_config(snap, global_max, self.frontier_cap)
+            cfg = kernel_static_config(state.snapshot, global_max, self.frontier_cap)
             member, needs_host = check_kernel(
-                tables,
+                state.tables,
                 q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid,
                 **cfg,
             )

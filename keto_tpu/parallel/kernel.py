@@ -42,7 +42,7 @@ from ..engine.kernel import (
     probe_phase,
     seed_state,
 )
-from .sharding import ShardedSnapshot, _REPLICATED_KEYS, _SHARDED_KEYS
+from .sharding import ShardedSnapshot, _DELTA_KEYS, _REPLICATED_KEYS, _SHARDED_KEYS
 
 # compiled-executable cache; statics change as the graph grows (probe
 # counts track hash-table clustering), so bound it LRU-style — older
@@ -176,7 +176,7 @@ def sharded_check_kernel(
 ):
     """Returns (member[B], needs_host[B]); see engine/kernel.check_kernel."""
     assert set(sharded_tables) == set(_SHARDED_KEYS)
-    assert set(replicated_tables) == set(_REPLICATED_KEYS)
+    assert set(replicated_tables) == set(_REPLICATED_KEYS) | set(_DELTA_KEYS)
     fn = get_sharded_kernel(mesh, statics, axis)
     return fn(
         sharded_tables, replicated_tables,

@@ -44,6 +44,11 @@ _REPLICATED_KEYS = (
     "objslot_ns", "ns_has_config",
     "instr_kind", "instr_rel", "instr_rel2", "prog_flags",
 )
+# delta-overlay tables (engine/delta.py): small + fixed-shape, replicated
+_DELTA_KEYS = (
+    "dd_obj", "dd_rel", "dd_skind", "dd_sa", "dd_sb", "dd_val",
+    "dirty_obj", "dirty_rel", "dirty_val",
+)
 
 
 def shard_of_objslot(obj_slot: np.ndarray, n_shards: int) -> np.ndarray:
@@ -144,6 +149,9 @@ def build_sharded_snapshot(
         stacked[key] = np.stack(parts)
 
     replicated = {k: base.device_arrays()[k] for k in _REPLICATED_KEYS}
+    from ..engine.delta import empty_delta_tables
+
+    replicated.update(empty_delta_tables())
     return ShardedSnapshot(
         base=base,
         n_shards=n_shards,

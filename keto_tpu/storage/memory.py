@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import bisect
 import threading
-from collections import defaultdict
+from collections import defaultdict, deque
 from typing import Optional, Sequence
 
 from ..ketoapi import RelationQuery, RelationTuple
@@ -34,10 +34,13 @@ from .definitions import (
 )
 
 
+CHANGE_LOG_CAP = 1 << 16
+
+
 class _NetworkStore:
     """All tuples of one network id."""
 
-    __slots__ = ("by_shard", "order", "forward", "by_subject", "version")
+    __slots__ = ("by_shard", "order", "forward", "by_subject", "version", "log")
 
     def __init__(self):
         # shard id -> tuple
@@ -51,6 +54,12 @@ class _NetworkStore:
         self.by_subject: dict[str, set[str]] = defaultdict(set)
         # monotonically increasing write version (device mirror staleness)
         self.version: int = 0
+        # bounded change log for incremental device-mirror refresh:
+        # (version, "insert"|"delete", tuple) — the TPU engine's delta
+        # overlay consumes this instead of re-scanning all tuples
+        self.log: deque[tuple[int, str, RelationTuple]] = deque(
+            maxlen=CHANGE_LOG_CAP
+        )
 
 
 def _subject_key(t: RelationTuple) -> str:
@@ -153,6 +162,27 @@ class MemoryManager:
         with self._lock:
             return self._net_ro(nid).version
 
+    def changes_since(
+        self, version: int, nid: str = DEFAULT_NETWORK
+    ) -> Optional[list[tuple[str, RelationTuple]]]:
+        """Ordered (op, tuple) ops committed after `version`, or None when
+        the bounded log no longer reaches back that far (callers must then
+        rebuild their mirror from all_relation_tuples)."""
+        with self._lock:
+            net = self._net_ro(nid)
+            if version >= net.version:
+                return []
+            log = net.log
+            # evicted entries all have v <= log[0][0]; the slice since
+            # `version` is complete iff nothing was ever evicted (log not
+            # full) or every evicted op predates `version`
+            complete = len(log) < (log.maxlen or 0) or (
+                bool(log) and version >= log[0][0]
+            )
+            if not complete:
+                return None
+            return [(op, t) for v, op, t in log if v > version]
+
     # -- writes --------------------------------------------------------------
 
     def write_relation_tuples(
@@ -219,6 +249,8 @@ class MemoryManager:
         bisect.insort(net.order, sid)
         net.forward[(t.namespace, t.object, t.relation)].add(sid)
         net.by_subject[_subject_key(t)].add(sid)
+        # tagged with the version the enclosing batch is about to commit
+        net.log.append((net.version + 1, "insert", t))
         return True
 
     def _delete(self, net: _NetworkStore, nid: str, t: RelationTuple) -> bool:
@@ -239,4 +271,5 @@ class MemoryManager:
             sub.discard(sid)
             if not sub:
                 del net.by_subject[_subject_key(t)]
+        net.log.append((net.version + 1, "delete", t))
         return True

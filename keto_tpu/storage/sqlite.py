@@ -22,6 +22,7 @@ traffic shape as the reference's Mapper-wrapped SQL store.
 
 from __future__ import annotations
 
+import json
 import sqlite3
 import threading
 import uuid
@@ -68,6 +69,28 @@ MIGRATIONS: list[tuple[str, list[str], list[str]]] = [
             """
         ],
         ["DROP TABLE keto_store_version"],
+    ),
+    (
+        "20220513200303_create_change_log",
+        [
+            # bounded per-nid write log consumed by the TPU engine's delta
+            # overlay (incremental device-mirror refresh); no reference
+            # equivalent — Keto replicas re-read SQL on every query
+            """
+            CREATE TABLE keto_change_log (
+                seq INTEGER PRIMARY KEY AUTOINCREMENT,
+                nid TEXT NOT NULL,
+                version INTEGER NOT NULL,
+                op TEXT NOT NULL,
+                tuple TEXT NOT NULL
+            )
+            """,
+            """
+            CREATE INDEX keto_change_log_nid_version_idx
+                ON keto_change_log (nid, version)
+            """,
+        ],
+        ["DROP TABLE keto_change_log"],
     ),
     (
         "20220513200301_create_relation_tuples_uuid",
@@ -351,11 +374,18 @@ class SQLitePersister:
         # the WHERE clause (incl. its nid guard) applies directly to the
         # DELETE; "t" aliases the deleted table itself
         with self._lock, self._conn:
+            doomed = [
+                self._row_to_tuple(r)
+                for r in self._conn.execute(
+                    f"{_SELECT} WHERE {where}", params
+                ).fetchall()
+            ]
             cur = self._conn.execute(
                 f"DELETE FROM keto_relation_tuples_uuid AS t WHERE {where}", params
             )
             if cur.rowcount:
                 self._bump_version(nid)
+                self._log_changes(nid, [("delete", t) for t in doomed])
 
     def transact_relation_tuples(
         self,
@@ -368,6 +398,22 @@ class SQLitePersister:
             for t in insert:
                 strings.extend(self._tuple_strings(t))
             m = self._ensure_mappings(nid, strings)
+            # identify real inserts/deletes (idempotent ops don't log),
+            # simulating SQL order: all inserts, then all deletes
+            present = self._existing_shard_ids(
+                nid, [shard_id(nid, t) for t in [*insert, *delete]]
+            )
+            ops = []
+            for t in insert:
+                sid = shard_id(nid, t)
+                if sid not in present:
+                    ops.append(("insert", t))
+                    present.add(sid)
+            for t in delete:
+                sid = shard_id(nid, t)
+                if sid in present:
+                    ops.append(("delete", t))
+                    present.discard(sid)
             before = self._conn.total_changes
             self._conn.executemany(
                 "INSERT OR IGNORE INTO keto_relation_tuples_uuid "
@@ -382,6 +428,68 @@ class SQLitePersister:
             )
             if self._conn.total_changes != before:
                 self._bump_version(nid)
+                self._log_changes(nid, ops)
+
+    # -- change log (delta-overlay feed) --------------------------------------
+
+    CHANGE_LOG_CAP = 1 << 16
+
+    def _existing_shard_ids(self, nid: str, sids: Sequence[str]) -> set[str]:
+        out: set[str] = set()
+        for i in range(0, len(sids), 500):
+            chunk = sids[i : i + 500]
+            placeholders = ",".join("?" * len(chunk))
+            rows = self._conn.execute(
+                "SELECT shard_id FROM keto_relation_tuples_uuid"
+                f" WHERE nid = ? AND shard_id IN ({placeholders})",
+                [nid, *chunk],
+            ).fetchall()
+            out.update(r[0] for r in rows)
+        return out
+
+    def _log_changes(self, nid: str, ops: Sequence[tuple[str, RelationTuple]]) -> None:
+        """Called inside the write transaction, after _bump_version."""
+        if not ops:
+            return
+        version = self._conn.execute(
+            "SELECT version FROM keto_store_version WHERE nid = ?", (nid,)
+        ).fetchone()[0]
+        self._conn.executemany(
+            "INSERT INTO keto_change_log (nid, version, op, tuple) VALUES (?, ?, ?, ?)",
+            [(nid, version, op, json.dumps(t.to_dict())) for op, t in ops],
+        )
+        # bounded: prune the oldest rows beyond the cap
+        self._conn.execute(
+            "DELETE FROM keto_change_log WHERE nid = ? AND seq <= ("
+            "  SELECT seq FROM keto_change_log WHERE nid = ?"
+            "  ORDER BY seq DESC LIMIT 1 OFFSET ?)",
+            (nid, nid, self.CHANGE_LOG_CAP),
+        )
+
+    def changes_since(self, version: int, nid: str = DEFAULT_NETWORK):
+        """Ordered (op, tuple) ops after `version`, or None when the
+        bounded log can't prove completeness back that far (see
+        memory.MemoryManager.changes_since)."""
+        with self._lock:
+            if version >= self.version(nid):
+                return []
+            n_total, min_version = self._conn.execute(
+                "SELECT COUNT(*), MIN(version) FROM keto_change_log WHERE nid = ?",
+                (nid,),
+            ).fetchone()
+            complete = n_total < self.CHANGE_LOG_CAP or (
+                min_version is not None and version >= min_version
+            )
+            if not complete:
+                return None
+            rows = self._conn.execute(
+                "SELECT op, tuple FROM keto_change_log"
+                " WHERE nid = ? AND version > ? ORDER BY seq",
+                (nid, version),
+            ).fetchall()
+        return [
+            (op, RelationTuple.from_dict(json.loads(raw))) for op, raw in rows
+        ]
 
     # -- mapping manager protocol (durable) -----------------------------------
 

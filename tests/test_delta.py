@@ -1,0 +1,269 @@
+"""Delta-overlay tests: incremental device-mirror refresh.
+
+The contract under test: after a base snapshot build, writes must be
+visible to device checks/expands (read-your-writes, like the reference's
+query-the-DB-every-time) WITHOUT another full snapshot build — plain-edge
+writes ride the overlay hash table entirely on device; subject-set row
+changes route only the affected queries to the exact host engine.
+"""
+
+import numpy as np
+import pytest
+
+from keto_tpu.config import Config
+from keto_tpu.engine.delta import (
+    DELTA_COMPACT_THRESHOLD,
+    DeltaOverflow,
+    build_delta_tables,
+)
+from keto_tpu.engine.reference import ReferenceEngine
+from keto_tpu.engine.tpu_engine import TPUCheckEngine
+from keto_tpu.ketoapi import RelationQuery, RelationTuple, SubjectSet
+from keto_tpu.namespace.definitions import Namespace
+from keto_tpu.storage.memory import MemoryManager
+from keto_tpu.storage.sqlite import SQLitePersister
+
+
+def ts(*strs):
+    return [RelationTuple.from_string(s) for s in strs]
+
+
+def make_engine(manager=None):
+    manager = manager or MemoryManager()
+    config = Config({"namespaces": []})
+    config.set_namespaces([Namespace(name=n) for n in ("files", "groups")])
+    return TPUCheckEngine(manager, config), manager
+
+
+class TestChangeLog:
+    @pytest.mark.parametrize("factory", [MemoryManager, SQLitePersister])
+    def test_ordered_ops_since_version(self, factory):
+        m = factory()
+        m.write_relation_tuples(ts("files:a#owner@alice"))
+        v1 = m.version()
+        m.write_relation_tuples(ts("files:b#owner@bob"))
+        m.delete_relation_tuples(ts("files:a#owner@alice"))
+        ops = m.changes_since(v1)
+        assert [(op, str(t)) for op, t in ops] == [
+            ("insert", "files:b#owner@bob"),
+            ("delete", "files:a#owner@alice"),
+        ]
+        assert m.changes_since(m.version()) == []
+
+    @pytest.mark.parametrize("factory", [MemoryManager, SQLitePersister])
+    def test_idempotent_ops_not_logged(self, factory):
+        m = factory()
+        m.write_relation_tuples(ts("files:a#owner@alice"))
+        v = m.version()
+        m.write_relation_tuples(ts("files:a#owner@alice"))  # no-op
+        m.delete_relation_tuples(ts("files:zzz#owner@none"))  # no-op
+        assert m.changes_since(v) == []
+        assert m.version() == v
+
+    @pytest.mark.parametrize("factory", [MemoryManager, SQLitePersister])
+    def test_delete_all_logged(self, factory):
+        m = factory()
+        m.write_relation_tuples(ts("files:a#owner@alice", "files:b#owner@bob"))
+        v = m.version()
+        m.delete_all_relation_tuples(RelationQuery(namespace="files", object="a"))
+        ops = m.changes_since(v)
+        assert [(op, str(t)) for op, t in ops] == [
+            ("delete", "files:a#owner@alice")
+        ]
+
+    def test_truncated_log_returns_none(self):
+        m = MemoryManager()
+        m.write_relation_tuples(ts("files:seed#owner@x"))
+        v0 = m.version()
+        net = m._networks["default"]
+        # shrink the log so eviction occurs quickly
+        import collections
+
+        net.log = collections.deque(net.log, maxlen=4)
+        for i in range(6):
+            m.write_relation_tuples(ts(f"files:o{i}#owner@u{i}"))
+        assert m.changes_since(v0) is None
+        # recent slice still answerable
+        assert m.changes_since(m.version() - 1) is not None
+
+
+class TestDeltaCheck:
+    def test_insert_visible_without_rebuild(self):
+        e, m = make_engine()
+        m.write_relation_tuples(ts("files:a#owner@alice"))
+        assert e.check_is_member(ts("files:a#owner@alice")[0])
+        assert e.stats["snapshot_builds"] == 1
+        m.write_relation_tuples(ts("files:b#owner@bob"))
+        t = ts("files:b#owner@bob")[0]
+        assert e.check_is_member(t)
+        assert e.stats["snapshot_builds"] == 1  # overlay, not rebuild
+        assert e.stats["host_checks"] == 0  # pure device path
+
+    def test_delete_tombstone_without_rebuild(self):
+        e, m = make_engine()
+        m.write_relation_tuples(ts("files:a#owner@alice", "files:b#owner@bob"))
+        assert e.check_is_member(ts("files:a#owner@alice")[0])
+        m.delete_relation_tuples(ts("files:a#owner@alice"))
+        assert not e.check_is_member(ts("files:a#owner@alice")[0])
+        assert e.check_is_member(ts("files:b#owner@bob")[0])
+        assert e.stats["snapshot_builds"] == 1
+        assert e.stats["host_checks"] == 0
+
+    def test_new_vocabulary_entries(self):
+        e, m = make_engine()
+        m.write_relation_tuples(ts("files:a#owner@alice"))
+        assert e.check_is_member(ts("files:a#owner@alice")[0])
+        # brand-new object, subject, and relation names
+        m.write_relation_tuples(ts("files:brand_new#touch@stranger"))
+        assert e.check_is_member(ts("files:brand_new#touch@stranger")[0])
+        assert not e.check_is_member(ts("files:brand_new#touch@alice")[0])
+        assert e.stats["snapshot_builds"] == 1
+
+    def test_subject_set_write_falls_back_for_affected_row_only(self):
+        e, m = make_engine()
+        m.write_relation_tuples(
+            ts("files:doc#view@(groups:eng#member)", "groups:eng#member@alice",
+               "files:other#owner@bob")
+        )
+        assert e.check_is_member(ts("files:doc#view@alice")[0])
+        base_host = e.stats["host_checks"]
+        # add a subject-set edge: the (files:doc, view) row is now dirty
+        m.write_relation_tuples(ts("files:doc#view@(groups:ops#member)",
+                                   "groups:ops#member@carol"))
+        assert e.check_is_member(ts("files:doc#view@carol")[0])
+        assert e.stats["snapshot_builds"] == 1
+        assert e.stats["host_checks"] > base_host  # dirty row -> host
+        # an unrelated query stays on device
+        host_now = e.stats["host_checks"]
+        assert e.check_is_member(ts("files:other#owner@bob")[0])
+        assert e.stats["host_checks"] == host_now
+
+    def test_matches_reference_after_mixed_writes(self):
+        e, m = make_engine()
+        ref = ReferenceEngine(m, e.config)
+        m.write_relation_tuples(
+            ts(*[f"files:f{i}#owner@u{i % 5}" for i in range(30)])
+        )
+        e.check_batch(ts("files:f0#owner@u0"))  # base build
+        m.write_relation_tuples(ts("files:f99#owner@u1", "files:f5#view@u2"))
+        m.delete_relation_tuples(ts("files:f3#owner@u3"))
+        queries = ts(
+            "files:f99#owner@u1", "files:f5#view@u2", "files:f3#owner@u3",
+            "files:f0#owner@u0", "files:f1#owner@u1", "files:f99#owner@u2",
+        )
+        got = [r.membership for r in e.check_batch(queries)]
+        want = [ref.check_relation_tuple(q).membership for q in queries]
+        assert got == want
+        assert e.stats["snapshot_builds"] == 1
+
+    def test_compaction_on_oversized_delta(self):
+        e, m = make_engine()
+        m.write_relation_tuples(ts("files:a#owner@alice"))
+        e.check_is_member(ts("files:a#owner@alice")[0])
+        m.write_relation_tuples(
+            ts(*[f"files:bulk{i}#owner@u{i}" for i in range(DELTA_COMPACT_THRESHOLD + 10)])
+        )
+        assert e.check_is_member(ts("files:bulk7#owner@u7")[0])
+        assert e.stats["snapshot_builds"] == 2  # compacted
+
+    def test_sqlite_backed_delta(self):
+        e, m = make_engine(SQLitePersister("memory"))
+        m.write_relation_tuples(ts("files:a#owner@alice"))
+        assert e.check_is_member(ts("files:a#owner@alice")[0])
+        m.write_relation_tuples(ts("files:b#owner@bob"))
+        assert e.check_is_member(ts("files:b#owner@bob")[0])
+        assert not e.check_is_member(ts("files:b#owner@alice")[0])
+        assert e.stats["snapshot_builds"] == 1
+
+
+class TestDeltaExpand:
+    def test_clean_rows_stay_on_device(self):
+        e, m = make_engine()
+        m.write_relation_tuples(
+            ts("files:doc#owner@alice", "files:other#owner@bob")
+        )
+        tree = e.expand(SubjectSet("files", "doc", "owner"), 3)
+        assert {str(c.tuple.subject_id) for c in tree.children} == {"alice"}
+        # dirty a different row: doc expansion still served from device
+        m.write_relation_tuples(ts("files:other#owner@carol"))
+        ref = ReferenceEngine(m, e.config)
+        tree2 = e.expand(SubjectSet("files", "other", "owner"), 3)
+        want = ref.expand(SubjectSet("files", "other", "owner"), 3)
+        assert {str(c.tuple) for c in tree2.children} == {
+            str(c.tuple) for c in want.children
+        }
+        assert e.stats["snapshot_builds"] == 1
+
+    def test_dirty_row_expand_correct(self):
+        e, m = make_engine()
+        m.write_relation_tuples(ts("files:doc#owner@alice"))
+        e.expand(SubjectSet("files", "doc", "owner"), 3)
+        m.write_relation_tuples(ts("files:doc#owner@bob"))
+        tree = e.expand(SubjectSet("files", "doc", "owner"), 3)
+        assert {c.tuple.subject_id for c in tree.children} == {"alice", "bob"}
+        assert e.stats["snapshot_builds"] == 1
+
+    def test_expand_new_root_after_delta(self):
+        e, m = make_engine()
+        m.write_relation_tuples(ts("files:doc#owner@alice"))
+        e.expand(SubjectSet("files", "doc", "owner"), 3)
+        m.write_relation_tuples(ts("files:fresh#owner@zoe"))
+        tree = e.expand(SubjectSet("files", "fresh", "owner"), 3)
+        assert tree is not None
+        assert {c.tuple.subject_id for c in tree.children} == {"zoe"}
+
+
+class TestStateIsolation:
+    def test_captured_state_blind_to_later_writes(self):
+        """A batch that captured an engine state before a write must stay
+        internally consistent: the base snapshot is immutable and the old
+        view cannot encode delta-added names (it would otherwise probe
+        tables that lack them)."""
+        e, m = make_engine()
+        m.write_relation_tuples(ts("files:a#owner@alice"))
+        e.check_is_member(ts("files:a#owner@alice")[0])
+        state1 = e._ensure_state()
+        n_slots_before = len(state1.snapshot.obj_slots)
+        m.write_relation_tuples(ts("files:brand_new#owner@zed"))
+        state2 = e._ensure_state()
+        assert state2 is not state1
+        # old view: unknown name -> None -> host fallback (correct)
+        assert state1.view.encode_node("files", "brand_new", "owner") is None
+        assert state2.view.encode_node("files", "brand_new", "owner") is not None
+        # base snapshot untouched by the refresh
+        assert len(state1.snapshot.obj_slots) == n_slots_before
+        assert state2.snapshot is state1.snapshot
+
+    def test_expand_state_carried_across_refresh(self):
+        e, m = make_engine()
+        m.write_relation_tuples(ts("files:doc#owner@alice"))
+        e.expand(SubjectSet("files", "doc", "owner"), 3)
+        state1 = e._ensure_state()
+        assert state1.expand_tables is not None
+        m.write_relation_tuples(ts("files:doc2#owner@newbie"))
+        tree = e.expand(SubjectSet("files", "doc2", "owner"), 3)
+        assert {c.tuple.subject_id for c in tree.children} == {"newbie"}
+        state2 = e._ensure_state()
+        # base CSR device arrays reused, not rebuilt
+        assert state2.expand_tables["f_sa"] is state1.expand_tables["f_sa"]
+
+
+class TestShardedDelta:
+    def test_mesh_delta_refresh(self):
+        import jax
+        from jax.sharding import Mesh
+
+        devices = np.array(jax.devices("cpu")[:4])
+        mesh = Mesh(devices, ("x",))
+        manager = MemoryManager()
+        config = Config({"namespaces": []})
+        config.set_namespaces([Namespace(name="files")])
+        e = TPUCheckEngine(manager, config, mesh=mesh)
+        manager.write_relation_tuples(ts("files:a#owner@alice"))
+        assert e.check_is_member(ts("files:a#owner@alice")[0])
+        manager.write_relation_tuples(ts("files:b#owner@bob"))
+        manager.delete_relation_tuples(ts("files:a#owner@alice"))
+        assert e.check_is_member(ts("files:b#owner@bob")[0])
+        assert not e.check_is_member(ts("files:a#owner@alice")[0])
+        assert e.stats["snapshot_builds"] == 1
+        assert e.stats["host_checks"] == 0

@@ -279,7 +279,8 @@ class TestKernelDifferential:
         assert e.check_batch([q])[0].membership == Membership.IS_MEMBER
         m.delete_relation_tuples([q])
         assert e.check_batch([q])[0].membership == Membership.NOT_MEMBER
-        assert e.stats["snapshot_builds"] == 3
+        # the delta overlay serves read-your-writes without rebuilds
+        assert e.stats["snapshot_builds"] == 1
 
     def test_large_batch_spans_buckets(self):
         tuples = [f"n:o{i}#r@u{i}" for i in range(50)]

@@ -202,9 +202,10 @@ class TestMigrations:
         assert all(s == "Pending" for _, s in p.migration_status())
         p.migrate_up()
         assert all(s == "Applied" for _, s in p.migration_status())
-        p.migrate_down(2)
+        p.migrate_down(3)
         status = dict(p.migration_status())
         assert status["20220513200302_create_store_version"] == "Pending"
+        assert status["20220513200303_create_change_log"] == "Pending"
         assert status["20220513200301_create_relation_tuples_uuid"] == "Pending"
         assert status["20220513200300_create_uuid_mappings"] == "Applied"
         p.migrate_up()
