@@ -474,3 +474,12 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     except KeyboardInterrupt:
         return 130
+    except Exception as e:  # noqa: BLE001 — RPC/user errors exit 1, like
+        # the reference's "Could not make request: %s" handling
+        import grpc
+
+        if isinstance(e, grpc.RpcError):
+            print(f"Could not make request: {e.details()}", file=sys.stderr)
+        else:
+            print(str(e), file=sys.stderr)
+        return 1

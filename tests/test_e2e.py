@@ -1,0 +1,410 @@
+"""End-to-end matrix: one shared case suite through three client types.
+
+Port of the reference's e2e strategy (internal/e2e/full_suit_test.go +
+cases_test.go): a real in-process server (mux'd gRPC+REST ports, TPU
+check engine) exercised through raw gRPC, raw REST, and the CLI — every
+case runs once per client type, like the reference's
+grpc/rest/cli/sdk × DSN matrix. Our ReadClient/WriteClient doubles as
+the SDK (there is no generated client to diverge from).
+"""
+
+import itertools
+import json
+import urllib.error
+import urllib.parse
+import urllib.request
+
+import grpc
+import pytest
+
+from keto_tpu.api import ReadClient, WriteClient, open_channel
+from keto_tpu.api.daemon import Daemon
+from keto_tpu.cli import main as cli_main
+from keto_tpu.config import Config
+from keto_tpu.ketoapi import (
+    GetResponse,
+    RelationQuery,
+    RelationTuple,
+    SubjectSet,
+    Tree,
+    TreeNodeType,
+)
+from keto_tpu.registry import Registry
+
+N_NAMESPACES = 64
+_ns_counter = itertools.count()
+
+
+def fresh_namespace() -> str:
+    return f"ns{next(_ns_counter)}"
+
+
+@pytest.fixture(scope="module")
+def daemon():
+    cfg = Config(
+        {
+            "dsn": "memory",
+            "check": {"engine": "tpu"},
+            "serve": {
+                "read": {"host": "127.0.0.1", "port": 0},
+                "write": {"host": "127.0.0.1", "port": 0},
+                "metrics": {"host": "127.0.0.1", "port": 0},
+            },
+            "namespaces": [
+                {"name": f"ns{i}", "relations": []} for i in range(N_NAMESPACES)
+            ],
+        }
+    )
+    d = Daemon(Registry(cfg))
+    d.start()
+    yield d
+    d.stop()
+
+
+# -- client adapters ----------------------------------------------------------
+
+
+class GRPCClientAdapter:
+    """Raw gRPC (the reference's grpc client + sdk in one)."""
+
+    def __init__(self, daemon):
+        self.rc = ReadClient(open_channel(f"127.0.0.1:{daemon.read_port}"))
+        self.wc = WriteClient(open_channel(f"127.0.0.1:{daemon.write_port}"))
+
+    def create(self, t: RelationTuple):
+        self.wc.transact(insert=[t])
+
+    def delete(self, t: RelationTuple):
+        self.wc.transact(delete=[t])
+
+    def delete_all(self, q: RelationQuery):
+        self.wc.delete_all(q)
+
+    def query(self, q: RelationQuery, page_size=0, page_token="") -> GetResponse:
+        return self.rc.list_relation_tuples(q, page_size, page_token)
+
+    def check(self, t: RelationTuple, max_depth=0) -> bool:
+        return self.rc.check(t, max_depth)
+
+    def expand(self, s: SubjectSet, max_depth=0) -> Tree:
+        return self.rc.expand(s, max_depth)
+
+    def query_unknown_namespace_error(self, q: RelationQuery):
+        with pytest.raises(grpc.RpcError) as exc:
+            self.rc.list_relation_tuples(q)
+        assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+
+    def close(self):
+        self.rc.close()
+        self.wc.close()
+
+
+class RESTClientAdapter:
+    def __init__(self, daemon):
+        self.read = f"http://127.0.0.1:{daemon.read_port}"
+        self.write = f"http://127.0.0.1:{daemon.write_port}"
+
+    @staticmethod
+    def _do(method, url, body=None):
+        req = urllib.request.Request(
+            url,
+            method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req) as r:
+                raw = r.read()
+                return r.status, json.loads(raw) if raw else None
+        except urllib.error.HTTPError as e:
+            raw = e.read()
+            return e.code, json.loads(raw) if raw else None
+
+    def create(self, t: RelationTuple):
+        code, _ = self._do("PUT", f"{self.write}/admin/relation-tuples", t.to_dict())
+        assert code == 201
+
+    def delete(self, t: RelationTuple):
+        code, _ = self._do(
+            "PATCH",
+            f"{self.write}/admin/relation-tuples",
+            [{"action": "delete", "relation_tuple": t.to_dict()}],
+        )
+        assert code == 204
+
+    def delete_all(self, q: RelationQuery):
+        qs = urllib.parse.urlencode(q.to_url_query())
+        code, _ = self._do("DELETE", f"{self.write}/admin/relation-tuples?{qs}")
+        assert code == 204
+
+    def query(self, q: RelationQuery, page_size=0, page_token="") -> GetResponse:
+        params = q.to_url_query()
+        if page_size:
+            params["page_size"] = str(page_size)
+        if page_token:
+            params["page_token"] = page_token
+        qs = urllib.parse.urlencode(params)
+        code, body = self._do("GET", f"{self.read}/relation-tuples?{qs}")
+        assert code == 200
+        return GetResponse(
+            relation_tuples=[
+                RelationTuple.from_dict(d) for d in body["relation_tuples"]
+            ],
+            next_page_token=body["next_page_token"],
+        )
+
+    def check(self, t: RelationTuple, max_depth=0) -> bool:
+        path = "/relation-tuples/check/openapi"
+        if max_depth:
+            path += f"?max-depth={max_depth}"
+        code, body = self._do("POST", f"{self.read}{path}", t.to_dict())
+        assert code == 200
+        return body["allowed"]
+
+    def expand(self, s: SubjectSet, max_depth=0) -> Tree:
+        params = {"namespace": s.namespace, "object": s.object, "relation": s.relation}
+        if max_depth:
+            params["max-depth"] = str(max_depth)
+        qs = urllib.parse.urlencode(params)
+        code, body = self._do("GET", f"{self.read}/relation-tuples/expand?{qs}")
+        assert code == 200
+        return Tree.from_dict(body)
+
+    def query_unknown_namespace_error(self, q: RelationQuery):
+        qs = urllib.parse.urlencode(q.to_url_query())
+        code, body = self._do("GET", f"{self.read}/relation-tuples?{qs}")
+        assert code == 404
+        assert "error" in body
+
+    def close(self):
+        pass
+
+
+class CLIClientAdapter:
+    def __init__(self, daemon, capsys, tmp_path):
+        self.remotes = [
+            "--read-remote", f"127.0.0.1:{daemon.read_port}",
+            "--write-remote", f"127.0.0.1:{daemon.write_port}",
+        ]
+        self.capsys = capsys
+        self.tmp_path = tmp_path
+        self._file_counter = itertools.count()
+
+    def _run(self, argv) -> str:
+        code = cli_main(argv)
+        out = self.capsys.readouterr().out
+        assert code == 0, out
+        return out
+
+    def _tuple_file(self, t: RelationTuple) -> str:
+        p = self.tmp_path / f"tuple{next(self._file_counter)}.json"
+        p.write_text(json.dumps(t.to_dict()))
+        return str(p)
+
+    def create(self, t: RelationTuple):
+        self._run(["relation-tuple", "create", self._tuple_file(t), *self.remotes])
+
+    def delete(self, t: RelationTuple):
+        self._run(["relation-tuple", "delete", self._tuple_file(t), *self.remotes])
+
+    def delete_all(self, q: RelationQuery):
+        argv = ["relation-tuple", "delete-all", "--force"]
+        if q.namespace is not None:
+            argv += ["--namespace", q.namespace]
+        if q.object is not None:
+            argv += ["--object", q.object]
+        if q.relation is not None:
+            argv += ["--relation", q.relation]
+        if q.subject_id is not None:
+            argv += ["--subject-id", q.subject_id]
+        if q.subject_set is not None:
+            argv += ["--subject-set", str(q.subject_set)]
+        self._run(argv + self.remotes)
+
+    def query(self, q: RelationQuery, page_size=0, page_token="") -> GetResponse:
+        argv = ["relation-tuple", "get", "--format", "json"]
+        if q.namespace is not None:
+            argv += ["--namespace", q.namespace]
+        if q.object is not None:
+            argv += ["--object", q.object]
+        if q.relation is not None:
+            argv += ["--relation", q.relation]
+        if page_size:
+            argv += ["--page-size", str(page_size)]
+        if page_token:
+            argv += ["--page-token", page_token]
+        body = json.loads(self._run(argv + self.remotes))
+        return GetResponse(
+            relation_tuples=[
+                RelationTuple.from_dict(d) for d in body["relation_tuples"]
+            ],
+            next_page_token=body["next_page_token"],
+        )
+
+    def check(self, t: RelationTuple, max_depth=0) -> bool:
+        assert t.subject_id is not None  # CLI check takes a subject id
+        argv = [
+            "check", t.subject_id, t.relation, t.namespace, t.object,
+            "--format", "json",
+        ]
+        if max_depth:
+            argv += ["--max-depth", str(max_depth)]
+        return json.loads(self._run(argv + self.remotes))["allowed"]
+
+    def expand(self, s: SubjectSet, max_depth=0) -> Tree:
+        argv = ["expand", s.relation, s.namespace, s.object, "--format", "json"]
+        if max_depth:
+            argv += ["--max-depth", str(max_depth)]
+        return Tree.from_dict(json.loads(self._run(argv + self.remotes)))
+
+    def query_unknown_namespace_error(self, q: RelationQuery):
+        code = cli_main(
+            ["relation-tuple", "get", "--namespace", q.namespace, *self.remotes]
+        )
+        self.capsys.readouterr()
+        assert code != 0
+
+    def close(self):
+        pass
+
+
+ADAPTERS = ["grpc", "rest", "cli"]
+
+
+@pytest.fixture(params=ADAPTERS)
+def client(request, daemon, capsys, tmp_path):
+    if request.param == "grpc":
+        c = GRPCClientAdapter(daemon)
+    elif request.param == "rest":
+        c = RESTClientAdapter(daemon)
+    else:
+        c = CLIClientAdapter(daemon, capsys, tmp_path)
+    yield c
+    c.close()
+
+
+# -- the shared case suite (cases_test.go ports) ------------------------------
+
+
+class TestE2ECases:
+    def test_gets_empty_namespace(self, client):
+        ns = fresh_namespace()
+        assert client.query(RelationQuery(namespace=ns)).relation_tuples == []
+
+    def test_creates_tuple_and_uses_it(self, client):
+        ns = fresh_namespace()
+        t = RelationTuple(
+            namespace=ns,
+            object=f"object for client {type(client).__name__}",
+            relation="access",
+            subject_id="client",
+        )
+        client.create(t)
+        resp = client.query(RelationQuery(namespace=ns))
+        assert resp.relation_tuples == [t]
+        assert client.check(t)
+        assert not client.check(
+            RelationTuple(ns, t.object, t.relation, subject_id="other")
+        )
+
+    def test_expand_api(self, client):
+        ns = fresh_namespace()
+        obj = f"tree for client {type(client).__name__}"
+        subjects = ["s1", "s2"]
+        for s in subjects:
+            client.create(
+                RelationTuple(namespace=ns, object=obj, relation="expand", subject_id=s)
+            )
+        tree = client.expand(SubjectSet(ns, obj, "expand"), 100)
+        assert tree.type == TreeNodeType.UNION
+        assert tree.tuple.subject_set == SubjectSet(ns, obj, "expand")
+        assert sorted(c.tuple.subject_id for c in tree.children) == subjects
+        assert all(c.type == TreeNodeType.LEAF for c in tree.children)
+
+    def test_gets_result_paginated(self, client):
+        ns = fresh_namespace()
+        n_tuples = 10
+        rel = f"rel {type(client).__name__}"
+        for i in range(n_tuples):
+            client.create(
+                RelationTuple(namespace=ns, object=f"o{i}", relation=rel,
+                              subject_id=f"s{i}")
+            )
+        token = ""
+        pages = 0
+        seen = []
+        while True:
+            resp = client.query(
+                RelationQuery(namespace=ns, relation=rel),
+                page_size=1, page_token=token,
+            )
+            assert len(resp.relation_tuples) == 1
+            seen.extend(resp.relation_tuples)
+            pages += 1
+            token = resp.next_page_token
+            if not token:
+                break
+        assert pages == n_tuples
+        assert len({str(t) for t in seen}) == n_tuples
+
+    def test_deletes_tuple(self, client):
+        ns = fresh_namespace()
+        for subject in ("s", SubjectSet(ns, "so", "sr")):
+            t = RelationTuple.make(ns, "o", "r", subject)
+            client.create(t)
+            assert len(client.query(RelationQuery(namespace=ns)).relation_tuples) == 1
+            client.delete(t)
+            assert client.query(RelationQuery(namespace=ns)).relation_tuples == []
+
+    def test_deletes_tuples_by_relation_query(self, client):
+        ns = fresh_namespace()
+        for i in range(4):
+            client.create(
+                RelationTuple(namespace=ns, object="o", relation=f"r{i % 2}",
+                              subject_id=f"s{i}")
+            )
+        client.delete_all(RelationQuery(namespace=ns, relation="r0"))
+        left = client.query(RelationQuery(namespace=ns)).relation_tuples
+        assert sorted(t.relation for t in left) == ["r1", "r1"]
+
+    def test_unknown_namespace_error(self, client):
+        client.query_unknown_namespace_error(
+            RelationQuery(namespace="definitely unknown")
+        )
+
+    def test_subject_set_chain_via_check(self, client):
+        ns = fresh_namespace()
+        client.create(
+            RelationTuple.make(ns, "doc", "view", SubjectSet(ns, "group", "member"))
+        )
+        client.create(
+            RelationTuple(namespace=ns, object="group", relation="member",
+                          subject_id="alice")
+        )
+        assert client.check(
+            RelationTuple(namespace=ns, object="doc", relation="view",
+                          subject_id="alice")
+        )
+        assert not client.check(
+            RelationTuple(namespace=ns, object="doc", relation="view",
+                          subject_id="eve")
+        )
+
+
+class TestE2ETransactions:
+    """Port of transaction_cases_test.go: batched insert+delete atomicity."""
+
+    def test_transact_insert_and_delete(self, daemon):
+        ns = fresh_namespace()
+        rc = ReadClient(open_channel(f"127.0.0.1:{daemon.read_port}"))
+        wc = WriteClient(open_channel(f"127.0.0.1:{daemon.write_port}"))
+        try:
+            a = RelationTuple(namespace=ns, object="o", relation="r", subject_id="a")
+            b = RelationTuple(namespace=ns, object="o", relation="r", subject_id="b")
+            wc.transact(insert=[a])
+            wc.transact(insert=[b], delete=[a])
+            left = rc.list_relation_tuples(RelationQuery(namespace=ns))
+            assert left.relation_tuples == [b]
+        finally:
+            rc.close()
+            wc.close()
