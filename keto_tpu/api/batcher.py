@@ -31,13 +31,29 @@ class _Pending:
 
 
 class CheckBatcher:
-    def __init__(self, engine, max_batch: int = 1024, window_s: float = 0.002):
+    def __init__(
+        self,
+        engine,
+        max_batch: int = 1024,
+        window_s: float = 0.002,
+        pipeline_depth: int = 2,
+    ):
         self.engine = engine
         self.max_batch = max_batch
         self.window_s = window_s
         self._queue: queue.Queue[_Pending | None] = queue.Queue()
         self._thread = threading.Thread(
             target=self._run, name="keto-check-batcher", daemon=True
+        )
+        # dispatch pool: while one batch synchronizes on device results,
+        # the collector keeps building and dispatching the next — device
+        # execution of consecutive batches overlaps (jax dispatch is
+        # async; the sync point is reading results back)
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(pipeline_depth, 1),
+            thread_name_prefix="keto-check-dispatch",
         )
         self._closed = False
         self._thread.start()
@@ -90,23 +106,25 @@ class CheckBatcher:
             batch.append(item)
         return batch
 
+    def _evaluate(self, group: list[_Pending], depth: int) -> None:
+        try:
+            results = self.engine.check_batch([p.tuple for p in group], depth)
+        except Exception as e:  # engine-level failure fails the batch
+            for p in group:
+                p.future.set_exception(e)
+            return
+        for p, res in zip(group, results):
+            p.future.set_result(res)
+
     def _run(self) -> None:
         while True:
             item = self._queue.get()
             if item is None:
+                self._pool.shutdown(wait=True)
                 return
             batch = self._drain(item)
             by_depth: dict[int, list[_Pending]] = {}
             for p in batch:
                 by_depth.setdefault(p.max_depth, []).append(p)
             for depth, group in by_depth.items():
-                try:
-                    results = self.engine.check_batch(
-                        [p.tuple for p in group], depth
-                    )
-                except Exception as e:  # engine-level failure fails the batch
-                    for p in group:
-                        p.future.set_exception(e)
-                    continue
-                for p, res in zip(group, results):
-                    p.future.set_result(res)
+                self._pool.submit(self._evaluate, group, depth)

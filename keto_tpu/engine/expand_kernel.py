@@ -92,17 +92,9 @@ def build_full_csr(
 
 
 def _row_lookup(tables, obj, rel, probes: int):
-    from .kernel import _hash_combine, _mix32
+    from .kernel import _pair_key_probe
 
-    cap_mask = jnp.uint32(tables["fh_obj"].shape[0] - 1)
-    h1 = _hash_combine(obj, rel)
-    h2 = _mix32(h1 ^ jnp.uint32(0x9E3779B9)) | jnp.uint32(1)
-    row = jnp.full(obj.shape, EMPTY, dtype=jnp.int32)
-    for j in range(probes):
-        slot = ((h1 + jnp.uint32(j) * h2) & cap_mask).astype(jnp.int32)
-        match = (tables["fh_obj"][slot] == obj) & (tables["fh_rel"][slot] == rel)
-        row = jnp.where(match & (row == EMPTY), tables["fh_row"][slot], row)
-    return row
+    return _pair_key_probe(tables, "fh", "fh_row", obj, rel, probes)
 
 
 class _ExpandState(NamedTuple):
@@ -147,11 +139,12 @@ def expand_kernel(
     n_rows = tables["f_row_ptr"].shape[0] - 1
 
     def row_span(row):
-        start = jnp.where(row == EMPTY, 0, tables["f_row_ptr"][jnp.maximum(row, 0)])
-        end = jnp.where(
-            row == EMPTY, 0, tables["f_row_ptr"][jnp.minimum(row + 1, n_rows)]
-        )
-        return start, end - start
+        row_c = jnp.clip(row, 0, n_rows)
+        start = tables["f_row_ptr"][row_c]
+        end = tables["f_row_ptr"][jnp.minimum(row_c + 1, n_rows)]
+        start = jnp.where(row == EMPTY, 0, start)
+        length = jnp.where(row == EMPTY, 0, end - start)
+        return start, length
 
     root_row = _row_lookup(tables, q_obj, q_rel, fh_probes)
     _, root_len = row_span(root_row)

@@ -20,6 +20,16 @@ in-flight checks advance together as one frontier of tasks
     4. dedupe the next frontier on (query, object, relation) keeping the
        deepest remaining-depth instance (safe: more depth explores more)
 
+TPU-specific gather discipline (learned from profiling on v5e): each
+gather op carries a fixed dispatch cost, and a gather whose OUTPUT last
+dimension is tiny gets lane-padded up to 128 — a [F, S, P, 4] packed-row
+gather materializes 32x its logical size (hundreds of MB of temps per
+step). So every logical lookup here (a) keeps tables as 1-D columns, and
+(b) batches ALL its probe rounds/slots into one wide trailing index dim
+per column: slots [F, S*P] -> one gather per key column with a
+[F, ~128]-shaped output. This puts the step budget at ~20 gather ops of
+lane-friendly shape instead of hundreds of scalar-shaped ones.
+
 The phases are factored as standalone functions so the sharded multi-chip
 kernel (keto_tpu/parallel/kernel.py) can interleave them with mesh
 collectives: probe hits are psum-OR-merged across edge shards and local
@@ -30,8 +40,9 @@ depth ≥ 1 (restDepth-1 ≥ 0), expand-subject and TTU children are enqueued
 at depth-1 (only when ≥ 0), computed children keep their depth.
 
 Tasks touching host-only programs (AND/NOT islands), config-missing
-relations, or overflowing the frontier raise the per-query needs_host
-flag; the engine facade re-runs those queries on the exact host engine.
+relations, delta-dirty rows, or overflowing the frontier raise the
+per-query needs_host flag; the engine facade re-runs those queries on the
+exact host engine.
 
 All arrays int32/uint32/bool — no 64-bit emulation on TPU.
 """
@@ -68,83 +79,75 @@ def _mix32(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _hash_combine(*parts: jnp.ndarray) -> jnp.ndarray:
-    h = jnp.full_like(parts[0].astype(jnp.uint32), _GOLDEN)
+    shape = jnp.broadcast_shapes(*(jnp.shape(p) for p in parts))
+    h = jnp.full(shape, _GOLDEN, dtype=jnp.uint32)
     for p in parts:
         h = _mix32(h ^ p.astype(jnp.uint32))
     return h
 
 
-def _direct_lookup(tables, obj, rel, skind, sa, sb, probes: int):
-    """Vectorized open-addressing probe of the direct-edge table."""
-    cap_mask = jnp.uint32(tables["dh_obj"].shape[0] - 1)
+def _edge_key_probe(tables, prefix, obj, rel, skind, sa, sb, probes: int):
+    """Probe a 5-key edge hash table (columns `{prefix}_obj`...): returns
+    (found[F], value[F]) with value = the matched slot's val column.
+    One [F, P]-shaped gather per column (2-D, lane-friendly)."""
     h1 = _hash_combine(obj, rel, skind, sa, sb)
     h2 = _mix32(h1 ^ _GOLDEN) | jnp.uint32(1)
-    found = jnp.zeros(obj.shape, dtype=bool)
-    for j in range(probes):  # static unroll; probes is the build-time max
-        slot = ((h1 + jnp.uint32(j) * h2) & cap_mask).astype(jnp.int32)
-        match = (
-            (tables["dh_obj"][slot] == obj)
-            & (tables["dh_rel"][slot] == rel)
-            & (tables["dh_skind"][slot] == skind)
-            & (tables["dh_sa"][slot] == sa)
-            & (tables["dh_sb"][slot] == sb)
-        )
-        found = found | match
-    return found
+    cap_mask = jnp.uint32(tables[f"{prefix}_obj"].shape[0] - 1)
+    j = jnp.arange(probes, dtype=jnp.uint32)
+    slots = ((h1[:, None] + j * h2[:, None]) & cap_mask).astype(jnp.int32)
+    match = (
+        (tables[f"{prefix}_obj"][slots] == obj[:, None])
+        & (tables[f"{prefix}_rel"][slots] == rel[:, None])
+        & (tables[f"{prefix}_skind"][slots] == skind[:, None])
+        & (tables[f"{prefix}_sa"][slots] == sa[:, None])
+        & (tables[f"{prefix}_sb"][slots] == sb[:, None])
+    )
+    found = jnp.any(match, axis=-1)
+    val = jnp.max(
+        jnp.where(match, tables[f"{prefix}_val"][slots], EMPTY), axis=-1
+    )
+    return found, val
 
 
-def _delta_lookup(tables, obj, rel, skind, sa, sb):
-    """Probe the delta overlay's direct-edge table: returns (in_delta,
-    is_insert) — a delta entry overrides the main table (tombstones mask
-    deleted edges, inserts add unseen ones). Fixed capacity + probe count,
-    so delta refreshes never recompile (engine/delta.py)."""
-    cap_mask = jnp.uint32(tables["dd_obj"].shape[0] - 1)
-    h1 = _hash_combine(obj, rel, skind, sa, sb)
+def _multi_pair_key_probe(tables, prefix, valcol, obj, rels_cols, probes: int):
+    """Probe a (obj, rel)-keyed table for MANY relations per task at once:
+    `rels_cols` is a list of S [F]-arrays. All S*P probe slots ride one
+    [F, S*P]-shaped gather per column; every intermediate stays 2-D with a
+    wide trailing dim (a [F, S, P] layout would lane-pad P up to 128 and
+    blow hundreds of MB of temps). Returns [F]-value arrays, one per rel.
+    """
+    F = obj.shape[0]
+    P = probes
+    # flat repeated key columns [F, S*P], built by 2-D broadcasts only
+    rel_flat = jnp.concatenate(
+        [jnp.broadcast_to(r[:, None], (F, P)) for r in rels_cols], axis=1
+    )
+    obj_flat = obj[:, None]
+    h1 = _hash_combine(obj_flat, rel_flat)  # [F, S*P]
     h2 = _mix32(h1 ^ _GOLDEN) | jnp.uint32(1)
-    found = jnp.zeros(obj.shape, dtype=bool)
-    val = jnp.zeros(obj.shape, dtype=jnp.int32)
-    for j in range(DELTA_PROBES):
-        slot = ((h1 + jnp.uint32(j) * h2) & cap_mask).astype(jnp.int32)
-        match = (
-            (tables["dd_obj"][slot] == obj)
-            & (tables["dd_rel"][slot] == rel)
-            & (tables["dd_skind"][slot] == skind)
-            & (tables["dd_sa"][slot] == sa)
-            & (tables["dd_sb"][slot] == sb)
-        )
-        val = jnp.where(match & ~found, tables["dd_val"][slot], val)
-        found = found | match
-    return found, val == 1
+    p_flat = jnp.tile(jnp.arange(P, dtype=jnp.uint32), len(rels_cols))
+    cap_mask = jnp.uint32(tables[f"{prefix}_obj"].shape[0] - 1)
+    slots = ((h1 + p_flat * h2) & cap_mask).astype(jnp.int32)
+    match = (tables[f"{prefix}_obj"][slots] == obj_flat) & (
+        tables[f"{prefix}_rel"][slots] == rel_flat
+    )
+    cand = jnp.where(match, tables[valcol][slots], EMPTY)
+    # per-slot max over its P probes: 2-D slices, no 3-D relayout
+    return [
+        jnp.max(cand[:, s * P : (s + 1) * P], axis=1)
+        for s in range(len(rels_cols))
+    ]
+
+
+def _pair_key_probe(tables, prefix, valcol, obj, rel, probes: int):
+    """Single-relation probe of a (obj, rel)-keyed table -> value or EMPTY."""
+    return _multi_pair_key_probe(tables, prefix, valcol, obj, [rel], probes)[0]
 
 
 def dirty_lookup(tables, obj, rel):
     """Dirty-row bitmask for (obj, rel), 0 when the row is clean."""
-    cap_mask = jnp.uint32(tables["dirty_obj"].shape[0] - 1)
-    h1 = _hash_combine(obj, rel)
-    h2 = _mix32(h1 ^ _GOLDEN) | jnp.uint32(1)
-    found = jnp.zeros(obj.shape, dtype=bool)
-    val = jnp.zeros(obj.shape, dtype=jnp.int32)
-    for j in range(DELTA_PROBES):
-        slot = ((h1 + jnp.uint32(j) * h2) & cap_mask).astype(jnp.int32)
-        match = (tables["dirty_obj"][slot] == obj) & (
-            tables["dirty_rel"][slot] == rel
-        )
-        val = jnp.where(match & ~found, tables["dirty_val"][slot], val)
-        found = found | match
-    return val
-
-
-def _row_lookup(tables, obj, rel, probes: int):
-    """(obj, rel) -> CSR row index, or -1."""
-    cap_mask = jnp.uint32(tables["rh_obj"].shape[0] - 1)
-    h1 = _hash_combine(obj, rel)
-    h2 = _mix32(h1 ^ _GOLDEN) | jnp.uint32(1)
-    row = jnp.full(obj.shape, EMPTY, dtype=jnp.int32)
-    for j in range(probes):
-        slot = ((h1 + jnp.uint32(j) * h2) & cap_mask).astype(jnp.int32)
-        match = (tables["rh_obj"][slot] == obj) & (tables["rh_rel"][slot] == rel)
-        row = jnp.where(match & (row == EMPTY), tables["rh_row"][slot], row)
-    return row
+    val = _pair_key_probe(tables, "dirty", "dirty_val", obj, rel, DELTA_PROBES)
+    return jnp.maximum(val, 0)
 
 
 class _State(NamedTuple):
@@ -190,9 +193,13 @@ def probe_phase(tables, obj, rel, skind, sa, sb, depth, live, *, dh_probes: int)
     """Direct-edge probe; needs depth >= 1 (checkDirect gets restDepth-1).
     A delta-overlay entry for the exact key overrides the compacted table
     (insert adds the edge, tombstone masks a deleted one)."""
-    main_hit = _direct_lookup(tables, obj, rel, skind, sa, sb, dh_probes)
-    in_delta, is_insert = _delta_lookup(tables, obj, rel, skind, sa, sb)
-    hit = jnp.where(in_delta, is_insert, main_hit)
+    main_hit, _ = _edge_key_probe(
+        tables, "dh", obj, rel, skind, sa, sb, dh_probes
+    )
+    in_delta, dval = _edge_key_probe(
+        tables, "dd", obj, rel, skind, sa, sb, DELTA_PROBES
+    )
+    hit = jnp.where(in_delta, dval == 1, main_hit)
     return hit & live & (depth >= 1)
 
 
@@ -212,68 +219,74 @@ def expand_phase(
 ) -> tuple[Expansion, jnp.ndarray]:
     """Expand every live task through its CSR row + rewrite instructions.
 
-    Returns (candidate children [F], per-query overflow flag [B]): children
+    Returns (candidate children [F], per-query host flag [B]): children
     beyond the frontier capacity are truncated and their owning queries
-    flagged for host replay.
+    flagged for host replay; delta-dirty rows flag their queries too.
     """
     F = q.shape[0]
     S = K + 1  # expansion slots per task: CSR row + K instructions
-    row_len_total = tables["row_ptr"].shape[0] - 1
     n_edges = tables["e_obj"].shape[0]
-
-    def row_span(row):
-        start = jnp.where(row == EMPTY, 0, tables["row_ptr"][jnp.maximum(row, 0)])
-        end = jnp.where(
-            row == EMPTY, 0, tables["row_ptr"][jnp.minimum(row + 1, row_len_total)]
-        )
-        return start, end - start
+    n_rows = tables["row_ptr"].shape[0] - 1
 
     ns = tables["objslot_ns"][jnp.clip(obj, 0, None)]
     has_prog = (rel < n_config_rels) & live
     pid = jnp.where(has_prog, ns * n_config_rels + rel, 0)
 
-    counts = jnp.zeros((F, S), dtype=jnp.int32)
-    starts = jnp.zeros((F, S), dtype=jnp.int32)
-    kinds = jnp.zeros((F, S), dtype=jnp.int32)
-    crel = jnp.zeros((F, S), dtype=jnp.int32)
+    # instruction load: 3 gathers with [F, K] outputs
+    mask_prog = has_prog[:, None]
+    ik = jnp.where(mask_prog, tables["instr_kind"][pid], INSTR_NONE)  # [F, K]
+    ir = jnp.where(mask_prog, tables["instr_rel"][pid], 0)
+    ir2 = jnp.where(mask_prog, tables["instr_rel2"][pid], 0)
 
-    # slot 0: subject-set expansion at depth-1; a delta-dirty row means the
-    # compacted CSR no longer reflects this row's edge list -> host replay
-    row0 = _row_lookup(tables, obj, rel, rh_probes)
-    s0, c0 = row_span(row0)
+    # relation per expansion slot: slot 0 = the task's own relation
+    # (subject-set row), slots 1..K = the instruction relation
+    rels_cols = [rel] + [ir[:, k] for k in range(K)]
+
+    # row lookup for every (obj, slot-relation): 3 gathers, slots batched
+    rows_cols = _multi_pair_key_probe(
+        tables, "rh", "rh_row", obj, rels_cols, rh_probes
+    )
+    rows = jnp.stack(rows_cols, axis=1)  # [F, S]
+    rows_c = jnp.clip(rows, 0, n_rows)
+    starts = tables["row_ptr"][rows_c]  # [F, S]
+    ends = tables["row_ptr"][jnp.minimum(rows_c + 1, n_rows)]
+    row_len = jnp.where(rows == EMPTY, 0, ends - starts)
+
     can_expand = live & (depth >= 1)
-    counts = counts.at[:, 0].set(jnp.where(can_expand, c0, 0))
-    starts = starts.at[:, 0].set(s0)
-    dirty = can_expand & (
-        (dirty_lookup(tables, obj, rel) & DIRTY_FOR_CHECK) != 0
+    is_comp = (ik == INSTR_COMPUTED) & live[:, None]
+    is_ttu = (ik == INSTR_TTU) & (live & (depth >= 1))[:, None]
+
+    counts = jnp.concatenate(
+        [
+            jnp.where(can_expand, row_len[:, 0], 0)[:, None],
+            jnp.where(is_comp, 1, jnp.where(is_ttu, row_len[:, 1:], 0)),
+        ],
+        axis=1,
+    )  # [F, S]
+
+    # delta-dirty rows (stale CSR contents): slot-0 expansion or TTU rows
+    dirty_cols = _multi_pair_key_probe(
+        tables, "dirty", "dirty_val", obj, rels_cols, DELTA_PROBES
+    )
+    row_dirty = jnp.stack(
+        [(jnp.maximum(d, 0) & DIRTY_FOR_CHECK) != 0 for d in dirty_cols], axis=1
+    )  # [F, S]
+    dirty = (can_expand & row_dirty[:, 0]) | jnp.any(
+        is_ttu & row_dirty[:, 1:], axis=1
     )
 
-    # slots 1..K: rewrite instructions
-    for k in range(K):
-        ik = jnp.where(has_prog, tables["instr_kind"][pid, k], INSTR_NONE)
-        ir = tables["instr_rel"][pid, k]
-        ir2 = tables["instr_rel2"][pid, k]
-        is_comp = live & (ik == INSTR_COMPUTED)
-        is_ttu = live & (ik == INSTR_TTU) & (depth >= 1)
-        rowk = _row_lookup(tables, obj, ir, rh_probes)
-        sk, ck = row_span(rowk)
-        counts = counts.at[:, k + 1].set(
-            jnp.where(is_comp, 1, jnp.where(is_ttu, ck, 0))
-        )
-        starts = starts.at[:, k + 1].set(sk)
-        kinds = kinds.at[:, k + 1].set(ik)
-        # for computed: child relation = ir; for ttu: child rel = ir2
-        crel = crel.at[:, k + 1].set(jnp.where(ik == INSTR_COMPUTED, ir, ir2))
-        dirty = dirty | (
-            is_ttu & ((dirty_lookup(tables, obj, ir) & DIRTY_FOR_CHECK) != 0)
-        )
+    # child relation: slot 0 = edge relation (from e_rel), computed = ir,
+    # ttu = ir2; child depth: computed keeps depth, others depth-1
+    crel = jnp.concatenate(
+        [jnp.zeros((F, 1), jnp.int32), jnp.where(ik == INSTR_COMPUTED, ir, ir2)],
+        axis=1,
+    )
 
     flat_counts = counts.reshape(-1)
     offsets = jnp.cumsum(flat_counts) - flat_counts  # exclusive scan
     total = offsets[-1] + flat_counts[-1]
 
-    # queries whose expansions overflow the frontier need host replay;
-    # delta-dirty rows do too (their CSR contents are stale)
+    # queries whose expansions overflow the frontier need host replay
     truncated_seg = (offsets + flat_counts) > F
     seg_q = jnp.repeat(q, S, total_repeat_length=F * S)
     overflow_q = (
@@ -283,68 +296,112 @@ def expand_phase(
     )
     overflow_q = overflow_q.at[q].max(dirty)
 
-    # build candidate children by segmented gather
+    # build candidate children by segmented gather; all per-(task, slot)
+    # source columns flatten to [F*S] 1-D arrays (no small-lane layouts)
     j = jnp.arange(F, dtype=jnp.int32)
     seg = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32) - 1
     seg = jnp.clip(seg, 0, F * S - 1)
     within = j - offsets[seg]
     in_range = j < jnp.minimum(total, F)
-    ti = seg // S  # source task
+
+    ti = seg // S  # source task (1-D)
     sk = seg % S  # slot
 
-    src_kind = kinds[ti, sk]  # INSTR_NONE for slot 0
-    is_slot0 = sk == 0
-    is_comp = (~is_slot0) & (src_kind == INSTR_COMPUTED)
+    src_q = q[ti]
+    src_obj = obj[ti]
+    src_depth = depth[ti]
+    src_start = starts.reshape(-1)[seg]
+    src_slot0 = sk == 0
+    src_comp = jnp.concatenate(
+        [jnp.zeros((F, 1), bool), is_comp], axis=1
+    ).reshape(-1)[seg]
+    src_crel = crel.reshape(-1)[seg]
 
-    e = jnp.clip(starts[ti, sk] + within, 0, max(n_edges - 1, 0))
-    edge_obj = tables["e_obj"][e] if n_edges else jnp.zeros(F, jnp.int32)
-    edge_rel = tables["e_rel"][e] if n_edges else jnp.zeros(F, jnp.int32)
+    e = jnp.clip(src_start + within, 0, max(n_edges - 1, 0))
+    if n_edges:
+        edge_obj = tables["e_obj"][e]
+        edge_rel = tables["e_rel"][e]
+    else:
+        edge_obj = jnp.zeros(F, jnp.int32)
+        edge_rel = jnp.zeros(F, jnp.int32)
 
-    child_q = q[ti]
-    child_obj = jnp.where(is_comp, obj[ti], edge_obj)
-    child_rel = jnp.where(is_slot0, edge_rel, crel[ti, sk])
-    child_depth = jnp.where(is_comp, depth[ti], depth[ti] - 1)
-    child_valid = in_range & ~(is_slot0 & (edge_rel == wildcard_rel))
-    return Expansion(child_q, child_obj, child_rel, child_depth, child_valid), overflow_q
+    child_obj = jnp.where(src_comp, src_obj, edge_obj)
+    child_rel = jnp.where(src_slot0, edge_rel, src_crel)
+    child_depth = jnp.where(src_comp, src_depth, src_depth - 1)
+    child_valid = in_range & ~(src_slot0 & (edge_rel == wildcard_rel))
+    return (
+        Expansion(src_q, child_obj, child_rel, child_depth, child_valid),
+        overflow_q,
+    )
 
 
 def dedupe_phase(
     children: Expansion, F: int, n_queries: int
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Dedupe candidates on (q, obj, rel) keeping the deepest instance and
-    pack the first F survivors into the next frontier. Candidates may be
-    longer than F (multi-shard gather); survivors beyond F flag their
-    queries for host replay.
+    pack the survivors into the next frontier. Candidates may be longer
+    than F (multi-shard gather); survivors beyond F flag their queries
+    for host replay.
+
+    Sort-free: candidates race for a hash bucket (scatter-max of a
+    priority encoding depth then candidate index); each candidate then
+    reads its bucket's winner back. Losing against the SAME key is a
+    duplicate (dropped — the winner carries >= depth); losing against a
+    DIFFERENT key (bucket collision) keeps the candidate — dedupe is an
+    optimization and duplicates are safe, so collisions only cost slots.
+    A sort-based dedupe costs a multi-MB unrolled bitonic network on TPU;
+    this is two scatters + a few gathers.
 
     Returns (t_q, t_obj, t_rel, t_depth, n_new, overflow_q[B]).
     """
     G = children.q.shape[0]
-    invalid = ~children.valid
-    order = jnp.lexsort(
-        (-children.depth, children.rel, children.obj, children.q, invalid)
+    cap = 1
+    while cap < 2 * G:
+        cap *= 2
+    h = _hash_combine(children.q, children.obj, children.rel)
+    bucket = (h & jnp.uint32(cap - 1)).astype(jnp.int32)
+    bucket = jnp.where(children.valid, bucket, cap)  # invalid -> dropped
+
+    # priority: deeper wins (uint32: depth in the top 14 bits, candidate
+    # index below — G <= 2^18 holds up to a 16-shard gather at F = 16384;
+    # depths beyond 16383 tie, acceptable: the step budget caps effective
+    # exploration long before such depths anyway)
+    idx = jnp.arange(G, dtype=jnp.int32)
+    prio = (
+        jnp.clip(children.depth, 0, (1 << 14) - 1).astype(jnp.uint32)
+        << jnp.uint32(18)
+    ) | idx.astype(jnp.uint32)
+    winner_prio = (
+        jnp.zeros(cap, jnp.uint32).at[bucket].max(prio, mode="drop")
     )
-    sq = children.q[order]
-    so = children.obj[order]
-    sr = children.rel[order]
-    sd = children.depth[order]
-    sv = children.valid[order]
-    first = jnp.ones(G, dtype=bool)
-    same = (sq[1:] == sq[:-1]) & (so[1:] == so[:-1]) & (sr[1:] == sr[:-1])
-    first = first.at[1:].set(~same)
-    keep = sv & first
+    winner_idx = (
+        winner_prio[jnp.clip(bucket, 0, cap - 1)] & jnp.uint32((1 << 18) - 1)
+    ).astype(jnp.int32)
+
+    won = children.valid & (winner_idx == idx)
+    # same-key losers are duplicates; different-key losers survive
+    same_key = (
+        (children.q[winner_idx] == children.q)
+        & (children.obj[winner_idx] == children.obj)
+        & (children.rel[winner_idx] == children.rel)
+    )
+    keep = children.valid & (won | ~same_key)
+
     pos = jnp.cumsum(keep) - 1
     n_keep = keep.sum().astype(jnp.int32)
     kept_in_cap = keep & (pos < F)
     # survivors that don't fit in the frontier: their queries go to host
     overflow_q = (
-        jnp.zeros(n_queries, dtype=bool).at[sq].max(keep & (pos >= F))
+        jnp.zeros(n_queries, dtype=bool)
+        .at[children.q]
+        .max(keep & (pos >= F), mode="drop")
     )
     # non-kept entries park at index F: out-of-bounds scatter drops them
     dest = jnp.where(kept_in_cap, pos, F)
-    nt_q = jnp.zeros(F, jnp.int32).at[dest].set(sq, mode="drop")
-    nt_obj = jnp.zeros(F, jnp.int32).at[dest].set(so, mode="drop")
-    nt_rel = jnp.zeros(F, jnp.int32).at[dest].set(sr, mode="drop")
-    nt_depth = jnp.zeros(F, jnp.int32).at[dest].set(sd, mode="drop")
+    nt_q = jnp.zeros(F, jnp.int32).at[dest].set(children.q, mode="drop")
+    nt_obj = jnp.zeros(F, jnp.int32).at[dest].set(children.obj, mode="drop")
+    nt_rel = jnp.zeros(F, jnp.int32).at[dest].set(children.rel, mode="drop")
+    nt_depth = jnp.zeros(F, jnp.int32).at[dest].set(children.depth, mode="drop")
     n_new = jnp.minimum(n_keep, F)
     return nt_q, nt_obj, nt_rel, nt_depth, n_new, overflow_q
 
@@ -465,20 +522,18 @@ def check_kernel(
 def snapshot_tables(snapshot: GraphSnapshot, delta: dict | None = None) -> dict:
     """Device-resident table dict for check_kernel (uploads once); the
     delta-overlay tables default to empty (fixed shapes either way)."""
-    tables = {k: jnp.asarray(v) for k, v in snapshot.device_arrays().items()}
-    tables.update(
-        {k: jnp.asarray(v) for k, v in (delta or empty_delta_tables()).items()}
-    )
-    return tables
+    raw = dict(snapshot.device_arrays())
+    raw.update(delta or empty_delta_tables())
+    return {k: jnp.asarray(v) for k, v in raw.items()}
 
 
-def refresh_delta_tables(tables: dict, snapshot: GraphSnapshot, delta: dict) -> dict:
+def refresh_delta_tables(tables: dict, delta: dict, vocab_arrays: dict) -> dict:
     """New table dict with only the overlay (and the vocab-dependent
     objslot_ns / ns_has_config arrays, which grow with delta vocab) re-
     uploaded; the big compacted tables are reused as-is."""
     out = dict(tables)
-    out["objslot_ns"] = jnp.asarray(snapshot.objslot_ns)
-    out["ns_has_config"] = jnp.asarray(snapshot.ns_has_config)
+    for k, v in vocab_arrays.items():
+        out[k] = jnp.asarray(v)
     out.update({k: jnp.asarray(v) for k, v in delta.items()})
     return out
 

@@ -145,6 +145,8 @@ class TPUCheckEngine:
         except DeltaOverflow:
             return None
 
+        from .kernel import refresh_delta_tables
+
         vocab_arrays = {
             "objslot_ns": overlay.objslot_ns,
             "ns_has_config": overlay.ns_has_config,
@@ -159,11 +161,7 @@ class TPUCheckEngine:
                 replicated[k] = jax.device_put(v, NamedSharding(self.mesh, P()))
             tables = (sharded_tables, replicated)
         else:
-            import jax.numpy as jnp
-
-            tables = dict(state.tables)
-            for k, v in {**delta, **vocab_arrays}.items():
-                tables[k] = jnp.asarray(v)
+            tables = refresh_delta_tables(state.tables, delta, vocab_arrays)
 
         new_state = _EngineState(
             snapshot=state.snapshot,
