@@ -1,0 +1,121 @@
+"""Device-mirror checkpointing: GraphSnapshot save/restore.
+
+The TPU analog of "checkpoint/resume" (SURVEY.md §5.4): the reference has
+none in-engine (durability = the SQL store; snaptokens are stubbed), and
+here too the authoritative state is the tuple store — what's worth
+persisting is the COMPILED mirror. At 1e8 edges the hash-table/CSR build
+is minutes of host work; a warm restart should `mmap` it back instead.
+
+Format: one `.npz` (all int32 arrays, vocabularies as fixed-width
+unicode arrays sorted by id) + metadata. A checkpoint is valid for
+exactly one (store_version, config fingerprint) pair — the engine
+compares `version` before trusting it, so a stale file is just ignored
+(the delta overlay then covers any writes since the snapshot's base the
+usual way).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Optional
+from zipfile import BadZipFile
+
+import numpy as np
+
+from .snapshot import GraphSnapshot
+
+FORMAT_VERSION = 1
+
+_ARRAY_FIELDS = (
+    "objslot_ns", "ns_has_config",
+    "dh_obj", "dh_rel", "dh_skind", "dh_sa", "dh_sb", "dh_val",
+    "rh_obj", "rh_rel", "rh_row",
+    "row_ptr", "e_obj", "e_rel",
+    "instr_kind", "instr_rel", "instr_rel2", "prog_flags",
+)
+_INT_FIELDS = (
+    "n_config_rels", "wildcard_rel", "dh_probes", "rh_probes",
+    "K", "version", "n_tuples",
+)
+
+
+def stable_fingerprint(obj) -> int:
+    """Process-stable 63-bit fingerprint of a JSON-able value (unlike
+    Python's hash(), which is salted per process for strings)."""
+    payload = json.dumps(obj, sort_keys=True, default=str).encode()
+    return int.from_bytes(hashlib.sha256(payload).digest()[:8], "big") >> 1
+
+
+def _names_by_id(d: dict, n: int) -> np.ndarray:
+    out = [""] * n
+    for name, i in d.items():
+        out[i] = name
+    return np.array(out, dtype="U")
+
+
+def save_snapshot(snapshot: GraphSnapshot, path: str) -> None:
+    """Atomic write of the snapshot to `path` (an .npz file)."""
+    n_obj = len(snapshot.obj_slots)
+    obj_ns = np.zeros(n_obj, dtype=np.int32)
+    obj_names = [""] * n_obj
+    for (ns, obj), slot in snapshot.obj_slots.items():
+        obj_ns[slot] = ns
+        obj_names[slot] = obj
+    payload = {k: getattr(snapshot, k) for k in _ARRAY_FIELDS}
+    payload.update(
+        {
+            "meta": np.array(
+                [FORMAT_VERSION] + [int(getattr(snapshot, k)) for k in _INT_FIELDS],
+                dtype=np.int64,
+            ),
+            "ns_names": _names_by_id(snapshot.ns_ids, len(snapshot.ns_ids)),
+            "rel_names": _names_by_id(snapshot.rel_ids, len(snapshot.rel_ids)),
+            "obj_ns": obj_ns,
+            "obj_names": np.array(obj_names, dtype="U"),
+            "subj_names": _names_by_id(snapshot.subj_ids, len(snapshot.subj_ids)),
+        }
+    )
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, **payload)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_snapshot(path: str) -> Optional[GraphSnapshot]:
+    """Load a snapshot; None when missing/corrupt/incompatible."""
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            meta = z["meta"]
+            if int(meta[0]) != FORMAT_VERSION:
+                return None
+            ints = {k: int(meta[i + 1]) for i, k in enumerate(_INT_FIELDS)}
+            arrays = {k: z[k] for k in _ARRAY_FIELDS}
+            ns_names = z["ns_names"]
+            rel_names = z["rel_names"]
+            obj_ns = z["obj_ns"]
+            obj_names = z["obj_names"]
+            subj_names = z["subj_names"]
+    except (OSError, KeyError, ValueError, BadZipFile):
+        return None
+    return GraphSnapshot(
+        ns_ids={str(n): i for i, n in enumerate(ns_names)},
+        rel_ids={str(n): i for i, n in enumerate(rel_names)},
+        obj_slots={
+            (int(obj_ns[i]), str(obj_names[i])): i for i in range(len(obj_names))
+        },
+        subj_ids={str(n): i for i, n in enumerate(subj_names)},
+        **arrays,
+        **ints,
+    )

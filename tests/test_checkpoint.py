@@ -1,0 +1,134 @@
+"""Mirror checkpoint tests: snapshot save/restore + engine warm restart."""
+
+import numpy as np
+
+from keto_tpu.config import Config
+from keto_tpu.engine.checkpoint import (
+    load_snapshot,
+    save_snapshot,
+    stable_fingerprint,
+)
+from keto_tpu.engine.snapshot import build_snapshot
+from keto_tpu.engine.tpu_engine import TPUCheckEngine
+from keto_tpu.ketoapi import RelationTuple
+from keto_tpu.namespace.ast import ComputedSubjectSet, Relation, SubjectSetRewrite
+from keto_tpu.namespace.definitions import Namespace
+from keto_tpu.storage.memory import MemoryManager
+
+
+def ts(*strs):
+    return [RelationTuple.from_string(s) for s in strs]
+
+
+NAMESPACES = [
+    Namespace(
+        name="files",
+        relations=[
+            Relation(name="owner"),
+            Relation(
+                name="view",
+                subject_set_rewrite=SubjectSetRewrite(
+                    children=[ComputedSubjectSet(relation="owner")]
+                ),
+            ),
+        ],
+    )
+]
+
+TUPLES = ts(
+    "files:a#owner@alice",
+    "files:a#view@(files:b#owner)",
+    "files:b#owner@bob",
+    "files:weird name#owner@user with spaces",
+)
+
+
+class TestStableFingerprint:
+    def test_deterministic(self):
+        a = stable_fingerprint([{"x": 1}, "y"])
+        assert a == stable_fingerprint([{"x": 1}, "y"])
+        assert a != stable_fingerprint([{"x": 2}, "y"])
+
+
+class TestSnapshotRoundtrip:
+    def test_roundtrip_equality(self, tmp_path):
+        snap = build_snapshot(TUPLES, NAMESPACES, K=8, version=12345)
+        path = str(tmp_path / "mirror.npz")
+        save_snapshot(snap, path)
+        loaded = load_snapshot(path)
+        assert loaded is not None
+        assert loaded.version == 12345
+        assert loaded.ns_ids == snap.ns_ids
+        assert loaded.rel_ids == snap.rel_ids
+        assert loaded.obj_slots == snap.obj_slots
+        assert loaded.subj_ids == snap.subj_ids
+        assert loaded.n_config_rels == snap.n_config_rels
+        assert loaded.dh_probes == snap.dh_probes
+        for k in ("dh_obj", "dh_sa", "rh_row", "row_ptr", "e_obj",
+                  "instr_kind", "prog_flags", "objslot_ns"):
+            np.testing.assert_array_equal(getattr(loaded, k), getattr(snap, k))
+
+    def test_missing_and_corrupt_files(self, tmp_path):
+        assert load_snapshot(str(tmp_path / "absent.npz")) is None
+        bad = tmp_path / "bad.npz"
+        bad.write_bytes(b"not a zip archive")
+        assert load_snapshot(str(bad)) is None
+
+
+class TestEngineWarmRestart:
+    def _config(self, tmp_path):
+        cfg = Config({"check": {"mirror_cache": str(tmp_path)}})
+        cfg.set_namespaces(NAMESPACES)
+        return cfg
+
+    def test_second_engine_loads_from_cache(self, tmp_path):
+        m = MemoryManager()
+        m.write_relation_tuples(TUPLES)
+        e1 = TPUCheckEngine(m, self._config(tmp_path))
+        assert e1.check_is_member(ts("files:a#view@bob")[0])
+        assert e1.stats["snapshot_builds"] == 1
+
+        # "restart": fresh engine over the same store + cache dir
+        e2 = TPUCheckEngine(m, self._config(tmp_path))
+        assert e2.check_is_member(ts("files:a#view@bob")[0])
+        assert not e2.check_is_member(ts("files:a#view@eve")[0])
+        assert e2.stats["snapshot_builds"] == 0
+        assert e2.stats.get("snapshot_loads") == 1
+
+    def test_stale_cache_ignored(self, tmp_path):
+        m = MemoryManager()
+        m.write_relation_tuples(TUPLES)
+        e1 = TPUCheckEngine(m, self._config(tmp_path))
+        e1.check_is_member(ts("files:a#view@bob")[0])
+
+        # the store moves beyond the checkpointed version; a fresh engine
+        # cannot prove delta coverage from version 0, so it rebuilds
+        m.write_relation_tuples(ts("files:new#owner@zoe"))
+        e2 = TPUCheckEngine(m, self._config(tmp_path))
+        assert e2.check_is_member(ts("files:new#owner@zoe")[0])
+        assert e2.stats["snapshot_builds"] == 1
+
+    def test_config_change_invalidates_cache(self, tmp_path):
+        m = MemoryManager()
+        m.write_relation_tuples(TUPLES)
+        e1 = TPUCheckEngine(m, self._config(tmp_path))
+        e1.check_is_member(ts("files:a#view@bob")[0])
+
+        cfg2 = Config({"check": {"mirror_cache": str(tmp_path)}})
+        cfg2.set_namespaces([Namespace(name="files", relations=[Relation(name="owner")])])
+        e2 = TPUCheckEngine(m, cfg2)
+        e2.check_batch(ts("files:a#owner@alice"))
+        assert e2.stats["snapshot_builds"] == 1
+        assert e2.stats.get("snapshot_loads") is None
+
+    def test_cache_refreshes_after_rebuild(self, tmp_path):
+        m = MemoryManager()
+        m.write_relation_tuples(TUPLES)
+        e1 = TPUCheckEngine(m, self._config(tmp_path))
+        e1.check_is_member(ts("files:a#view@bob")[0])
+        m.write_relation_tuples(ts("files:new#owner@zoe"))
+        e2 = TPUCheckEngine(m, self._config(tmp_path))
+        e2.check_is_member(ts("files:new#owner@zoe")[0])  # rebuild + save
+        e3 = TPUCheckEngine(m, self._config(tmp_path))
+        assert e3.check_is_member(ts("files:new#owner@zoe")[0])
+        assert e3.stats.get("snapshot_loads") == 1
