@@ -76,12 +76,19 @@ class TPUCheckEngine:
         rewrite_instr_cap: int = 8,
         mesh=None,
         metrics=None,
+        auto_frontier: bool = True,
     ):
         self.manager = manager
         self.config = config
         self.nid = nid
         # the frontier must hold at least one task per batched query
         self.frontier_cap = max(frontier_cap, _BUCKETS[0])
+        # scale the per-launch frontier down for small buckets (step cost
+        # is O(frontier), so a 16-query launch must not pay a 16k-task
+        # frontier). False pins every launch at `frontier_cap` — for
+        # operators who sized it explicitly to keep wide-fanout queries
+        # on-device (overflow falls back to exact-but-slow host replay).
+        self.auto_frontier = auto_frontier
         self._allowed_buckets = [b for b in _BUCKETS if b <= self.frontier_cap]
         self.rewrite_instr_cap = rewrite_instr_cap
         # multi-chip: a 1-D jax.sharding.Mesh shards the edge tables and
@@ -460,11 +467,22 @@ class TPUCheckEngine:
             # error flags surface, but no direct probe can hit
             q_valid[i] = True
 
+        # per-launch frontier sizing: every BFS step's cost scales with the
+        # frontier length, not the query count, so a small bucket must not
+        # pay the full-size frontier (a 16-query launch at F=16384 costs
+        # the same ~130 ms as a 4096-query one). Small buckets get a
+        # proportional frontier; queries whose exploration outgrows it are
+        # flagged needs_host and replayed exactly — a safe (slower) path.
+        if self.auto_frontier:
+            launch_cap = min(self.frontier_cap, max(4 * B, 1024))
+        else:
+            launch_cap = self.frontier_cap
+
         if self.mesh is not None:
             from ..parallel.kernel import sharded_check_kernel, sharded_static_config
 
             statics = sharded_static_config(
-                state.sharded, global_max, self.frontier_cap
+                state.sharded, global_max, launch_cap
             )
             sharded_tables, replicated_tables = state.tables
             member, needs_host = sharded_check_kernel(
@@ -473,7 +491,7 @@ class TPUCheckEngine:
                 statics=statics, axis=self.mesh.axis_names[0],
             )
         else:
-            cfg = kernel_static_config(state.snapshot, global_max, self.frontier_cap)
+            cfg = kernel_static_config(state.snapshot, global_max, launch_cap)
             member, needs_host = check_kernel(
                 state.tables,
                 q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid,

@@ -85,6 +85,12 @@ class Registry:
                     self._engine = TPUCheckEngine(
                         manager, self.config, nid=self.nid, mesh=self.mesh,
                         metrics=self.metrics(),
+                        frontier_cap=int(
+                            self.config.get("check.frontier_cap", 1 << 14)
+                        ),
+                        auto_frontier=bool(
+                            self.config.get("check.auto_frontier", True)
+                        ),
                     )
                 elif kind == "host":
                     self._engine = _HostEngineFacade(
