@@ -72,6 +72,11 @@ class _Services:
         self.registry = registry
         self.batcher = batcher
         self.metrics = registry.metrics()
+        # health Watch streams pin one sync-server worker thread each for
+        # their lifetime; cap them so watchers can't starve the pool
+        import threading as _threading
+
+        self._watch_slots = _threading.BoundedSemaphore(16)
 
     # -- helpers --------------------------------------------------------------
 
@@ -202,16 +207,25 @@ class _Services:
 
     def health_watch(self, req, context):
         """Streams the current status, then pushes changes until the client
-        disconnects (grpc.health.v1 Watch contract)."""
-        import time as _time
-
-        last = None
-        while context.is_active():
-            current = 1 if self.registry.ready.is_set() else 2
-            if current != last:
-                last = current
-                yield pb.HealthCheckResponse(status=current)
-            _time.sleep(0.5)
+        disconnects (grpc.health.v1 Watch contract). Event-driven: the
+        stream parks on the registry ReadyState condition and wakes on
+        transitions; the 5s timeout only re-checks client liveness."""
+        if not self._watch_slots.acquire(blocking=False):
+            context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                "too many concurrent health watchers",
+            )
+        try:
+            flag, gen = self.registry.ready.state()
+            last = None
+            while context.is_active():
+                current = 1 if flag else 2
+                if current != last:
+                    last = current
+                    yield pb.HealthCheckResponse(status=current)
+                flag, gen = self.registry.ready.wait_change(gen, timeout=5.0)
+        finally:
+            self._watch_slots.release()
 
 
 def _unary(services: _Services, name: str, fn, req_cls):

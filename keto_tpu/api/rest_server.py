@@ -112,79 +112,85 @@ class _Handler(BaseHTTPRequestHandler):
     def _route(self, method: str) -> None:
         path = urllib.parse.urlparse(self.path).path.rstrip("/") or "/"
         metrics = self.registry.metrics()
-        name = f"{method} {path}"
-        with metrics.observe_request("http", name) as outcome:
+        # metrics are labeled by the MATCHED route constant — never the raw
+        # request path (arbitrary scanner URLs would create unbounded
+        # Prometheus label cardinality); unmatched requests share one label
+        resolved = self._resolve(method, path)
+        label = f"{method} {resolved[0]}" if resolved else "unmatched"
+        with metrics.observe_request("http", label) as outcome:
+            if resolved is None:
+                outcome["code"] = "404"
+                from ..errors import NotFoundError
+
+                self._json(404, NotFoundError("route not found").to_dict())
+                return
             try:
-                handled = self._dispatch(method, path)
+                resolved[1]()
             except KetoError as e:
                 outcome["code"] = str(e.status)
                 self._error(e)
-                return
             except (BrokenPipeError, ConnectionResetError):
                 raise
             except Exception as e:  # noqa: BLE001 — HTTP boundary
                 outcome["code"] = "500"
                 self._error(e)
-                return
-            if not handled:
-                outcome["code"] = "404"
-                from ..errors import NotFoundError
-
-                self._json(404, NotFoundError("route not found").to_dict())
 
     # -- routing --------------------------------------------------------------
 
-    def _dispatch(self, method: str, path: str) -> bool:
+    def _resolve(self, method: str, path: str):
+        """(route constant, handler thunk) for a matched route, else None."""
         # shared routes
         if method == "GET":
             if path == ALIVE_PATH:
-                self._json(200, {"status": "ok"})
-                return True
+                return ALIVE_PATH, lambda: self._json(200, {"status": "ok"})
             if path == READY_PATH:
-                ok = self.registry.ready.is_set()
-                self._json(200 if ok else 503, {"status": "ok" if ok else "unavailable"})
-                return True
+
+                def ready():
+                    ok = self.registry.ready.is_set()
+                    self._json(
+                        200 if ok else 503,
+                        {"status": "ok" if ok else "unavailable"},
+                    )
+
+                return READY_PATH, ready
             if path == VERSION_PATH:
-                self._json(200, {"version": self.registry.version})
-                return True
+                return VERSION_PATH, lambda: self._json(
+                    200, {"version": self.registry.version}
+                )
 
         if self.kind == "metrics":
             if method == "GET" and path == METRICS_PATH:
-                self._write(
+                return METRICS_PATH, lambda: self._write(
                     200,
                     self.registry.metrics().export(),
                     content_type="text/plain; version=0.0.4; charset=utf-8",
                 )
-                return True
-            return False
+            return None
 
         if self.kind == "read":
             if method == "GET" and path == READ_ROUTE_BASE:
-                self._get_relations()
-                return True
+                return READ_ROUTE_BASE, self._get_relations
             if path == CHECK_ROUTE_BASE and method in ("GET", "POST"):
-                self._check(method, mirror_status=True)
-                return True
+                return CHECK_ROUTE_BASE, lambda: self._check(
+                    method, mirror_status=True
+                )
             if path == CHECK_OPENAPI_ROUTE and method in ("GET", "POST"):
-                self._check(method, mirror_status=False)
-                return True
+                return CHECK_OPENAPI_ROUTE, lambda: self._check(
+                    method, mirror_status=False
+                )
             if method == "GET" and path == EXPAND_ROUTE:
-                self._expand()
-                return True
-            return False
+                return EXPAND_ROUTE, self._expand
+            return None
 
         # write router
         if path == WRITE_ROUTE_BASE:
             if method == "PUT":
-                self._create_relation()
-                return True
+                return WRITE_ROUTE_BASE, self._create_relation
             if method == "DELETE":
-                self._delete_relations()
-                return True
+                return WRITE_ROUTE_BASE, self._delete_relations
             if method == "PATCH":
-                self._patch_relations()
-                return True
-        return False
+                return WRITE_ROUTE_BASE, self._patch_relations
+        return None
 
     # -- read handlers --------------------------------------------------------
 

@@ -18,8 +18,11 @@ alongside compacted CSR":
         (expand kernel); a task touching a dirty row flags its query for
         exact host replay
   - capacities are compile-time constants (DELTA_CAPACITY / DIRTY_CAPACITY
-    at <=1/4 load) so delta refreshes NEVER change array shapes or probe
-    statics — no XLA recompilation on the write path
+    at <=1/4 load) and the vocab-dependent objslot_ns / ns_has_config
+    arrays carry headroom padding (snapshot.pad_headroom), so delta
+    refreshes keep every array shape and probe static — no XLA
+    recompilation on the write path until vocab growth crosses a padding
+    quantum (then exactly one recompile at the new shape)
   - the base GraphSnapshot stays IMMUTABLE: vocabulary entries first seen
     in a delta live in a VocabOverlay (new entries only) combined with the
     base through SnapshotView — concurrent readers holding the previous
@@ -184,17 +187,24 @@ def build_vocab_overlay(
                 t.subject_id or "", len(base.subj_ids) + len(subj_new)
             )
 
+    from .snapshot import pad_headroom
+
     objslot_ns = snapshot.objslot_ns
     ns_has_config = snapshot.ns_has_config
     if slot_new:
-        objslot_ns = np.zeros(len(base.obj_slots) + len(slot_new), dtype=np.int32)
+        # keep the base (headroom-padded) shape while the new slots fit,
+        # so the refreshed tables don't trigger an XLA recompile
+        total = len(base.obj_slots) + len(slot_new)
+        size = max(len(snapshot.objslot_ns), pad_headroom(total))
+        objslot_ns = np.zeros(size, dtype=np.int32)
         objslot_ns[: len(snapshot.objslot_ns)] = snapshot.objslot_ns
         for (ns, _obj), slot in slot_new.items():
             objslot_ns[slot] = ns
     if ns_new:
         # namespaces first seen in tuples have no config by definition
         n_ns = len(base.ns_ids) + len(ns_new)
-        ns_has_config = np.zeros(n_ns, dtype=np.int32)
+        size = max(len(snapshot.ns_has_config), pad_headroom(n_ns, 64))
+        ns_has_config = np.zeros(size, dtype=np.int32)
         ns_has_config[: len(snapshot.ns_has_config)] = snapshot.ns_has_config
     return VocabOverlay(
         ns_ids=ns_new,

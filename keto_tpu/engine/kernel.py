@@ -362,20 +362,30 @@ def dedupe_phase(
     bucket = (h & jnp.uint32(cap - 1)).astype(jnp.int32)
     bucket = jnp.where(children.valid, bucket, cap)  # invalid -> dropped
 
-    # priority: deeper wins (uint32: depth in the top 14 bits, candidate
-    # index below — G <= 2^18 holds up to a 16-shard gather at F = 16384;
-    # depths beyond 16383 tie, acceptable: the step budget caps effective
-    # exploration long before such depths anyway)
+    # priority: deeper wins (uint32: depth in the top bits, candidate
+    # index below). The bit split is derived from the STATIC candidate
+    # count G (= n_shards * F after a multi-shard gather) so winner_idx
+    # can never silently truncate — oversized meshes shrink the depth
+    # field instead (deep depths tie, acceptable: the step budget caps
+    # effective exploration long before such depths anyway).
+    idx_bits = max(1, (G - 1).bit_length())
+    if idx_bits > 28:
+        raise ValueError(
+            f"dedupe candidate count {G} needs {idx_bits} index bits; "
+            "max 28 (shrink frontier_cap or the shard count)"
+        )
+    depth_max = (1 << (32 - idx_bits)) - 1
     idx = jnp.arange(G, dtype=jnp.int32)
     prio = (
-        jnp.clip(children.depth, 0, (1 << 14) - 1).astype(jnp.uint32)
-        << jnp.uint32(18)
+        jnp.clip(children.depth, 0, depth_max).astype(jnp.uint32)
+        << jnp.uint32(idx_bits)
     ) | idx.astype(jnp.uint32)
     winner_prio = (
         jnp.zeros(cap, jnp.uint32).at[bucket].max(prio, mode="drop")
     )
     winner_idx = (
-        winner_prio[jnp.clip(bucket, 0, cap - 1)] & jnp.uint32((1 << 18) - 1)
+        winner_prio[jnp.clip(bucket, 0, cap - 1)]
+        & jnp.uint32((1 << idx_bits) - 1)
     ).astype(jnp.int32)
 
     won = children.valid & (winner_idx == idx)

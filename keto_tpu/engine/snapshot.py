@@ -71,6 +71,15 @@ def hash_combine(*parts: np.ndarray) -> np.ndarray:
     return h
 
 
+def pad_headroom(n: int, quantum: int = 1024) -> int:
+    """Array length for n entries plus delta headroom. Vocab-dependent
+    device arrays (objslot_ns, ns_has_config) are sized to a quantum
+    boundary so a delta that introduces new object slots or namespaces
+    keeps the array shape (no XLA recompile) until growth crosses the
+    next quantum."""
+    return ((n // quantum) + 2) * quantum
+
+
 def hash_table_capacity(n: int, min_capacity: int = 64) -> int:
     """Power-of-two capacity at load factor ≤ 0.5 for n entries."""
     cap = max(min_capacity, 1)
@@ -430,10 +439,10 @@ def build_snapshot(
     n_ns = max(len(ns_ids), 1)
     n_objslots = max(len(obj_slots), 1)
 
-    objslot_ns = np.zeros(n_objslots, dtype=np.int32)
+    objslot_ns = np.zeros(pad_headroom(n_objslots), dtype=np.int32)
     for (ns, _obj), slot in obj_slots.items():
         objslot_ns[slot] = ns
-    ns_has_config = np.zeros(n_ns, dtype=np.int32)
+    ns_has_config = np.zeros(pad_headroom(n_ns, 64), dtype=np.int32)
     for ns in namespaces:
         if ns.relations:
             ns_has_config[ns_ids[ns.name]] = 1

@@ -97,6 +97,18 @@ class TPUCheckEngine:
         self.reference = ReferenceEngine(manager, config)
         self._lock = threading.Lock()
         self._state: Optional[_EngineState] = None
+        # mirror-checkpoint persistence runs OUTSIDE self._lock (an
+        # O(edges) compressed write must not block check traffic) and is
+        # throttled so frequent compaction cycles don't re-write it;
+        # throttled snapshots are DEFERRED (timer), never dropped, so the
+        # last compaction before an idle period still reaches disk
+        self._persist_mu = threading.Lock()
+        self._pending_persist: Optional[GraphSnapshot] = None
+        self._persist_scheduled = False
+        self._last_persist = 0.0
+        self.persist_min_interval = float(
+            config.get("check.mirror_persist_interval", 60.0)
+        )
         # device-path observability (served vs host-fallback checks);
         # `metrics` is an optional observability.Metrics mirror of the same
         self.stats = {"device_checks": 0, "host_checks": 0, "snapshot_builds": 0}
@@ -118,6 +130,7 @@ class TPUCheckEngine:
         namespaces = self.config.namespace_manager().namespaces()
         # process-stable so persisted mirror checkpoints stay comparable
         config_fp = stable_fingerprint([ns.to_dict() for ns in namespaces])
+        persist_snap = None
         with self._lock:
             state = self._state
             rebuild = state is None or state.config_fp != config_fp
@@ -125,9 +138,64 @@ class TPUCheckEngine:
                 state = self._delta_refresh(state, store_version)
                 rebuild = state is None
             if rebuild:
-                state = self._rebuild(store_version, config_fp, namespaces)
+                state, persist_snap = self._rebuild(
+                    store_version, config_fp, namespaces
+                )
             self._state = state
-            return state
+        if persist_snap is not None:
+            self._maybe_persist(persist_snap)
+        return state
+
+    def _maybe_persist(self, snap: GraphSnapshot) -> None:
+        """Checkpoint the freshly-built mirror without holding the engine
+        lock. Writes are throttled to one per persist_min_interval, but a
+        throttled snapshot is kept pending and flushed by a timer when
+        the window opens — dropping it would leave the cache stale until
+        the NEXT rebuild, which may never come before a restart."""
+        cache_path = self._mirror_cache_path()
+        if cache_path is None:
+            return
+        with self._persist_mu:
+            self._pending_persist = snap
+            delay = 0.0
+            if self._last_persist:
+                delay = (
+                    self._last_persist
+                    + self.persist_min_interval
+                    - time.monotonic()
+                )
+            if delay <= 0:
+                self._flush_pending_locked(cache_path)
+            elif not self._persist_scheduled:
+                self._persist_scheduled = True
+                timer = threading.Timer(delay, self._flush_deferred)
+                timer.daemon = True
+                timer.start()
+
+    def _flush_deferred(self) -> None:
+        cache_path = self._mirror_cache_path()
+        with self._persist_mu:
+            self._persist_scheduled = False
+            if cache_path is not None:
+                self._flush_pending_locked(cache_path)
+
+    def _flush_pending_locked(self, cache_path: str) -> None:
+        """Write the pending snapshot (caller holds _persist_mu)."""
+        from .checkpoint import save_snapshot
+
+        snap = self._pending_persist
+        self._pending_persist = None
+        if snap is None:
+            return
+        try:
+            save_snapshot(snap, cache_path)
+            self._last_persist = time.monotonic()
+        except OSError as err:  # cache write failure must not block serving
+            import logging
+
+            logging.getLogger("keto_tpu").warning(
+                "mirror checkpoint write failed: %s", err
+            )
 
     def _delta_refresh(
         self, state: _EngineState, store_version: int
@@ -211,8 +279,14 @@ class TPUCheckEngine:
 
         return os.path.join(d, f"mirror-{self.nid}.npz")
 
-    def _rebuild(self, store_version: int, config_fp, namespaces) -> _EngineState:
-        from .checkpoint import load_snapshot, save_snapshot, stable_fingerprint
+    def _rebuild(
+        self, store_version: int, config_fp, namespaces
+    ) -> tuple[_EngineState, Optional[GraphSnapshot]]:
+        """Returns (state, snapshot-to-persist). The snapshot is non-None
+        only for a fresh build; the caller checkpoints it AFTER releasing
+        the engine lock (an O(edges) compressed write must not stall
+        check traffic)."""
+        from .checkpoint import load_snapshot, stable_fingerprint
 
         version = stable_fingerprint([store_version, config_fp])
         # warm-restart path: a persisted mirror for exactly this
@@ -232,7 +306,7 @@ class TPUCheckEngine:
                     config_fp=config_fp,
                 )
                 self.stats["snapshot_loads"] = self.stats.get("snapshot_loads", 0) + 1
-                return state
+                return state, None
         build_start = time.perf_counter()
         tuples = self.manager.all_relation_tuples(nid=self.nid)
         sharded = None
@@ -266,15 +340,6 @@ class TPUCheckEngine:
             covered_version=store_version,
             config_fp=config_fp,
         )
-        if cache_path is not None and self.mesh is None:
-            try:
-                save_snapshot(snap, cache_path)
-            except OSError as err:  # cache write failure must not block serving
-                import logging
-
-                logging.getLogger("keto_tpu").warning(
-                    "mirror checkpoint write failed: %s", err
-                )
         self.stats["snapshot_builds"] += 1
         if self.metrics is not None:
             self.metrics.snapshot_builds_total.inc()
@@ -282,7 +347,9 @@ class TPUCheckEngine:
             self.metrics.snapshot_build_duration.observe(
                 time.perf_counter() - build_start
             )
-        return state
+        # mirror checkpoints cover the single-device path only (the
+        # sharded build re-derives per-shard tables anyway)
+        return state, (snap if self.mesh is None else None)
 
     def invalidate(self) -> None:
         with self._lock:

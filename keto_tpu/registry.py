@@ -33,6 +33,50 @@ from .storage.sqlite import SQLitePersister
 logger = logging.getLogger("keto_tpu")
 
 
+class ReadyState:
+    """Event-compatible readiness flag with change notification.
+
+    Health Watch streams park on `wait_change` (a Condition) instead of
+    busy-polling, so idle watchers cost no CPU and wake immediately on a
+    readiness transition (ref pushes on change; ADVICE round-1 flagged
+    the 0.5s poll loop)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._flag = False
+        self._gen = 0
+
+    def is_set(self) -> bool:
+        return self._flag
+
+    def set(self) -> None:
+        with self._cond:
+            if not self._flag:
+                self._flag = True
+                self._gen += 1
+                self._cond.notify_all()
+
+    def clear(self) -> None:
+        with self._cond:
+            if self._flag:
+                self._flag = False
+                self._gen += 1
+                self._cond.notify_all()
+
+    def state(self) -> tuple[bool, int]:
+        with self._cond:
+            return self._flag, self._gen
+
+    def wait_change(self, gen: int, timeout: float) -> tuple[bool, int]:
+        """Block until the generation moves past `gen` (or timeout, so
+        stream handlers can re-check client liveness); returns the
+        current (flag, generation)."""
+        with self._cond:
+            if self._gen == gen:
+                self._cond.wait(timeout)
+            return self._flag, self._gen
+
+
 class Registry:
     """Composition root. Lazily builds every service exactly once."""
 
@@ -53,7 +97,7 @@ class Registry:
         self._tracer = None
         # health: flipped by the daemon around serving
         # (ref: registry_default.go:98-112 healthx readiness checkers)
-        self.ready = threading.Event()
+        self.ready = ReadyState()
 
     # -- storage --------------------------------------------------------------
 

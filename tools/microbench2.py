@@ -1,0 +1,192 @@
+"""Microbench round 2: fusion-isolation hypothesis + scatter variants.
+
+    python tools/microbench2.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timed(fn, *args, n=30):
+    out = fn(*args)
+    _block(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    _block(out)
+    return (time.perf_counter() - t0) / n * 1e3
+
+
+def _block(out):
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    F, P, CAP = 16384, 8, 32768
+    rng = np.random.default_rng(0)
+    cols = {
+        c: jnp.asarray(rng.integers(0, 1 << 20, CAP, dtype=np.int32))
+        for c in "abcdef"
+    }
+    idx_fp = jnp.asarray(rng.integers(0, CAP, (F, P), dtype=np.int32))
+
+    def rec(op, ms, note=""):
+        print(json.dumps({"op": op, "ms": round(ms, 3), "note": note}), flush=True)
+
+    # fused 6-col probe (baseline, 8ms) vs optimization_barrier-isolated
+    def probe_fused(idx):
+        return sum(cols[c][idx] for c in "abcdef")
+
+    def probe_isolated(idx):
+        outs = []
+        for c in "abcdef":
+            g = cols[c][idx]
+            (g,) = jax.lax.optimization_barrier((g,))
+            outs.append(g)
+        return sum(outs)
+
+    rec("probe6_fused", timed(jax.jit(probe_fused), idx_fp))
+    rec("probe6_isolated", timed(jax.jit(probe_isolated), idx_fp))
+
+    # barrier both sides?
+    def probe_isolated2(idx):
+        (idx,) = jax.lax.optimization_barrier((idx,))
+        outs = []
+        for c in "abcdef":
+            g = cols[c][idx]
+            (g,) = jax.lax.optimization_barrier((g,))
+            outs.append(g)
+        return sum(outs)
+
+    rec("probe6_isolated2", timed(jax.jit(probe_isolated2), idx_fp))
+
+    # 2-D table: one row gather then unpack (6 cols padded to 8)
+    tab_rows = jnp.asarray(
+        rng.integers(0, 1 << 20, (CAP, 8), dtype=np.int32)
+    )
+
+    def probe_rows(idx):
+        r = tab_rows[idx]  # [F, P, 8]
+        (r,) = jax.lax.optimization_barrier((r,))
+        return r.sum(axis=(1, 2))
+
+    rec("probe_rowgather_FxPx8", timed(jax.jit(probe_rows), idx_fp))
+
+    # scatter variants, 16384 updates
+    prio = jnp.asarray(rng.integers(0, 1 << 30, F, dtype=np.uint32))
+    buck = jnp.asarray(rng.integers(0, 2 * F, F, dtype=np.int32))
+    buck_sorted = jnp.sort(buck)
+
+    rec(
+        "scatter_max_u32",
+        timed(jax.jit(lambda b, p: jnp.zeros(2 * F, jnp.uint32).at[b].max(p)), buck, prio),
+    )
+    rec(
+        "scatter_max_f32",
+        timed(
+            jax.jit(lambda b, p: jnp.zeros(2 * F, jnp.float32).at[b].max(p)),
+            buck,
+            prio.astype(jnp.float32),
+        ),
+    )
+    rec(
+        "scatter_add_f32",
+        timed(
+            jax.jit(lambda b, p: jnp.zeros(2 * F, jnp.float32).at[b].add(p)),
+            buck,
+            prio.astype(jnp.float32),
+        ),
+    )
+    rec(
+        "scatter_max_sorted",
+        timed(
+            jax.jit(
+                lambda b, p: jnp.zeros(2 * F, jnp.uint32)
+                .at[b]
+                .max(p, indices_are_sorted=True)
+            ),
+            buck_sorted,
+            prio,
+        ),
+    )
+    rec(
+        "scatter_set_unique",
+        timed(
+            jax.jit(
+                lambda p: jnp.zeros(F, jnp.uint32)
+                .at[jnp.arange(F)]
+                .set(p, unique_indices=True, indices_are_sorted=True)
+            ),
+            prio,
+        ),
+        "identity perm scatter",
+    )
+    # isolated scatter (barrier before+after)
+    rec(
+        "scatter_max_isolated",
+        timed(
+            jax.jit(
+                lambda b, p: jax.lax.optimization_barrier(
+                    (jnp.zeros(2 * F, jnp.uint32).at[b].max(p),)
+                )[0]
+            ),
+            buck,
+            prio,
+        ),
+    )
+
+    # segment-OR via matmul: member[B] |= any(hit where q==b)
+    B = 4096
+    q = jnp.asarray(rng.integers(0, B, F, dtype=np.int32))
+    hit = jnp.asarray((rng.integers(0, 2, F) > 0))
+
+    def member_matmul(qv, hv):
+        oh = (qv[None, :] == jnp.arange(B)[:, None]).astype(jnp.bfloat16)
+        s = oh @ hv.astype(jnp.bfloat16)
+        return s > 0
+
+    rec("member_or_matmul", timed(jax.jit(member_matmul), q, hit), "[4096,16384] onehot")
+    rec(
+        "member_or_scatter",
+        timed(jax.jit(lambda qv, hv: jnp.zeros(B, bool).at[qv].max(hv)), q, hit),
+    )
+
+    # cumsum widths
+    for n in (4096, 16384, 49152, 147456):
+        c = jnp.asarray(rng.integers(0, 3, n, dtype=np.int32))
+        rec(f"cumsum_{n}", timed(jax.jit(jnp.cumsum), c))
+
+    # cumsum via matmul-scan (blocked): reshape [n/128, 128], row-local scan
+    def cumsum_blocked(x):
+        m = x.reshape(-1, 128).astype(jnp.float32)
+        tri = jnp.tril(jnp.ones((128, 128), jnp.float32))
+        local = m @ tri.T  # within-row inclusive scan
+        rows = local[:, -1]
+        row_off = jnp.concatenate([jnp.zeros(1), jnp.cumsum(rows)[:-1]])
+        return (local + row_off[:, None]).reshape(-1)
+
+    c = jnp.asarray(rng.integers(0, 3, 147456, dtype=np.int32))
+    rec("cumsum_matmul_147456", timed(jax.jit(cumsum_blocked), c))
+    c = jnp.asarray(rng.integers(0, 3, 16384, dtype=np.int32))
+    rec("cumsum_matmul_16384", timed(jax.jit(cumsum_blocked), c))
+
+    rec("device", 0.0, str(jax.devices()[0]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,221 @@
+"""Prototype: can Pallas scalar loops beat XLA's gather/scatter on TPU?
+
+Measures VMEM/SMEM scalar-loop implementations of the check kernel's
+irregular primitives against their XLA counterparts:
+
+  probe:   out[i] = tab[idx[i]]                  (XLA gather ~15ns/row)
+  scatmax: win[b[i]] = max(win[b[i]], p[i])      (XLA scatter ~200ns/upd)
+  pack:    out[cnt++] = val[i] if keep[i]        (XLA cumsum+scatter ~5ms)
+
+    python tools/microbench_pallas.py
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timed(fn, *args, n=50):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n * 1e3
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    F, CAP = 16384, 32768
+    rng = np.random.default_rng(0)
+    tab = jnp.asarray(rng.integers(0, 1 << 20, (CAP, 1), dtype=np.int32))
+    idx = jnp.asarray(rng.integers(0, CAP, (F, 1), dtype=np.int32))
+
+    def rec(op, ms, note=""):
+        print(json.dumps({"op": op, "ms": round(ms, 3), "note": note}), flush=True)
+
+    # ---- probe: scalar loop over VMEM ------------------------------------
+    def probe_kernel(tab_ref, idx_ref, out_ref):
+        def body(i, _):
+            j = idx_ref[i, 0]
+            out_ref[i, 0] = tab_ref[j, 0]
+            return 0
+
+        jax.lax.fori_loop(0, F, body, 0)
+
+    probe_vmem = pl.pallas_call(
+        probe_kernel,
+        out_shape=jax.ShapeDtypeStruct((F, 1), jnp.int32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )
+    try:
+        rec("pallas_probe_vmem", timed(jax.jit(probe_vmem), tab, idx))
+    except Exception as e:
+        rec("pallas_probe_vmem", -1, str(e)[:200])
+
+    # SMEM variant
+    probe_smem = pl.pallas_call(
+        probe_kernel,
+        out_shape=jax.ShapeDtypeStruct((F, 1), jnp.int32),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+    )
+    try:
+        rec("pallas_probe_smem", timed(jax.jit(probe_smem), tab, idx))
+    except Exception as e:
+        rec("pallas_probe_smem", -1, str(e)[:200])
+
+    rec("xla_gather_F", timed(jax.jit(lambda t, i: t[i[:, 0], 0]), tab, idx))
+
+    # ---- scatter-max: serial loop ---------------------------------------
+    buck = jnp.asarray(rng.integers(0, 2 * F, (F, 1), dtype=np.int32))
+    prio = jnp.asarray(rng.integers(0, 1 << 30, (F, 1), dtype=np.int32))
+
+    def scatmax_kernel(b_ref, p_ref, out_ref):
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+        def body(i, _):
+            b = b_ref[i, 0]
+            cur = out_ref[b, 0]
+            p = p_ref[i, 0]
+            out_ref[b, 0] = jnp.maximum(cur, p)
+            return 0
+
+        jax.lax.fori_loop(0, F, body, 0)
+
+    for space, name in ((pltpu.SMEM, "smem"), (pltpu.VMEM, "vmem")):
+        scat = pl.pallas_call(
+            scatmax_kernel,
+            out_shape=jax.ShapeDtypeStruct((2 * F, 1), jnp.int32),
+            in_specs=[
+                pl.BlockSpec(memory_space=space),
+                pl.BlockSpec(memory_space=space),
+            ],
+            out_specs=pl.BlockSpec(memory_space=space),
+        )
+        try:
+            rec(f"pallas_scatmax_{name}", timed(jax.jit(scat), buck, prio))
+        except Exception as e:
+            rec(f"pallas_scatmax_{name}", -1, str(e)[:200])
+    rec(
+        "xla_scatter_max",
+        timed(
+            jax.jit(
+                lambda b, p: jnp.zeros((2 * F, 1), jnp.int32)
+                .at[b[:, 0]]
+                .max(p)
+            ),
+            buck,
+            prio,
+        ),
+    )
+
+    # ---- pack (stream compaction) ---------------------------------------
+    keep = jnp.asarray(rng.integers(0, 2, (F, 1), dtype=np.int32))
+    vals = jnp.asarray(rng.integers(0, 1 << 20, (F, 1), dtype=np.int32))
+
+    def pack_kernel(keep_ref, val_ref, out_ref, n_ref):
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+        def body(i, cnt):
+            k = keep_ref[i, 0]
+
+            @pl.when(k != 0)
+            def _():
+                out_ref[cnt, 0] = val_ref[i, 0]
+
+            return cnt + k
+
+        n = jax.lax.fori_loop(0, F, body, 0)
+        n_ref[0, 0] = n
+
+    for space, name in ((pltpu.SMEM, "smem"), (pltpu.VMEM, "vmem")):
+        packk = pl.pallas_call(
+            pack_kernel,
+            out_shape=(
+                jax.ShapeDtypeStruct((F, 1), jnp.int32),
+                jax.ShapeDtypeStruct((1, 1), jnp.int32),
+            ),
+            in_specs=[
+                pl.BlockSpec(memory_space=space),
+                pl.BlockSpec(memory_space=space),
+            ],
+            out_specs=(
+                pl.BlockSpec(memory_space=space),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ),
+        )
+        try:
+            rec(f"pallas_pack_{name}", timed(jax.jit(packk), keep, vals))
+        except Exception as e:
+            rec(f"pallas_pack_{name}", -1, str(e)[:200])
+
+    def xla_pack(k, v):
+        pos = jnp.cumsum(k[:, 0]) - 1
+        dest = jnp.where(k[:, 0] > 0, pos, F)
+        return jnp.zeros((F,), jnp.int32).at[dest].set(v[:, 0], mode="drop")
+
+    rec("xla_pack", timed(jax.jit(xla_pack), keep, vals))
+
+    # ---- fused probe(2x)+compare loop (realistic hash probe) -------------
+    def hashprobe_kernel(ko_ref, kv_ref, q_ref, out_ref):
+        def body(i, _):
+            k = q_ref[i, 0]
+            h1 = (k * 2654435761) & (CAP - 1)
+            s0 = ko_ref[h1, 0]
+            h2 = ((k * 40503) | 1) & (CAP - 1)
+            hit0 = s0 == k
+            s1 = jax.lax.select(
+                hit0, s0, ko_ref[(h1 + h2) & (CAP - 1), 0]
+            )
+            v = jax.lax.select(
+                s1 == k,
+                kv_ref[jax.lax.select(hit0, h1, (h1 + h2) & (CAP - 1)), 0],
+                -1,
+            )
+            out_ref[i, 0] = v
+            return 0
+
+        jax.lax.fori_loop(0, F, body, 0)
+
+    keys = jnp.asarray(rng.integers(0, 1 << 26, (CAP, 1), dtype=np.int32))
+    kvals = jnp.asarray(rng.integers(0, 1 << 20, (CAP, 1), dtype=np.int32))
+    qk = jnp.asarray(rng.integers(0, 1 << 26, (F, 1), dtype=np.int32))
+    hp = pl.pallas_call(
+        hashprobe_kernel,
+        out_shape=jax.ShapeDtypeStruct((F, 1), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM)] * 3,
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+    )
+    try:
+        rec("pallas_hashprobe2_smem", timed(jax.jit(hp), keys, kvals, qk))
+    except Exception as e:
+        rec("pallas_hashprobe2_smem", -1, str(e)[:200])
+
+    rec("device", 0.0, str(jax.devices()[0]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,159 @@
+"""TPU primitive cost model: measures the access patterns the check
+kernel is built from, to pick layouts with evidence instead of folklore.
+
+    python tools/microbench_tpu.py [--platform cpu]
+
+Each line: {"op", "ms", "note"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def timed(fn, *args, n=30):
+    out = fn(*args)
+    _block(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    _block(out)
+    return (time.perf_counter() - t0) / n * 1e3
+
+
+def _block(out):
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", choices=("auto", "cpu"), default="auto")
+    args = ap.parse_args()
+    if args.platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    F, P, CAP = 16384, 8, 32768
+    rng = np.random.default_rng(0)
+    tab1d = jnp.asarray(rng.integers(0, 1 << 20, CAP, dtype=np.int32))
+    tab2d_8 = jnp.asarray(rng.integers(0, 1 << 20, (CAP, 8), dtype=np.int32))
+    tab2d_128 = jnp.asarray(
+        rng.integers(0, 1 << 20, (CAP // 16, 128), dtype=np.int32)
+    )
+    idx_fp = jnp.asarray(rng.integers(0, CAP, (F, P), dtype=np.int32))
+    idx_f = jnp.asarray(rng.integers(0, CAP, F, dtype=np.int32))
+    idx_rows = jnp.asarray(rng.integers(0, CAP // 16, F, dtype=np.int32))
+    out = []
+
+    def rec(op, ms, note=""):
+        line = {"op": op, "ms": round(ms, 3), "note": note}
+        print(json.dumps(line), flush=True)
+
+    f = jax.jit(lambda t, i: t[i])
+    rec("gather_1d_FxP", timed(f, tab1d, idx_fp), "scalar-gather 131072 elems")
+    rec("gather_1d_F", timed(f, tab1d, idx_f), "scalar-gather 16384 elems")
+    rec(
+        "gather_rows_128",
+        timed(f, tab2d_128, idx_rows),
+        "16384 row-gathers of [128] int32 (8MB)",
+    )
+    rec(
+        "gather_rows_8",
+        timed(f, tab2d_8, idx_f),
+        "16384 row-gathers of [8] int32",
+    )
+
+    # 6-column probe (current dh layout) vs one bucket-row gather
+    cols = {c: jnp.asarray(rng.integers(0, 1 << 20, CAP, dtype=np.int32))
+            for c in "abcdef"}
+
+    def probe6(idx):
+        return sum(cols[c][idx] for c in "abcdef")
+
+    rec("probe_6col_FxP", timed(jax.jit(probe6), idx_fp), "current probe shape")
+
+    # scatter patterns
+    prio = jnp.asarray(rng.integers(0, 1 << 30, F, dtype=np.uint32))
+    buck = jnp.asarray(rng.integers(0, 2 * F, F, dtype=np.int32))
+    f_scat = jax.jit(
+        lambda b, p: jnp.zeros(2 * F, jnp.uint32).at[b].max(p, mode="drop")
+    )
+    rec("scatter_max_F", timed(f_scat, buck, prio), "dedupe winner scatter")
+    qidx = jnp.asarray(rng.integers(0, 4096, F, dtype=np.int32))
+    hit = jnp.asarray(rng.integers(0, 2, F, dtype=np.int32).astype(bool))
+    f_scat2 = jax.jit(
+        lambda q, h: jnp.zeros(4096, bool).at[q].max(h)
+    )
+    rec("scatter_or_member", timed(f_scat2, qidx, hit), "member-mask update")
+    f_scat3 = jax.jit(
+        lambda d, v: jnp.zeros(F, jnp.int32).at[d].set(v, mode="drop")
+    )
+    rec("scatter_set_F", timed(f_scat3, buck, prio.astype(jnp.int32)),
+        "frontier pack scatter")
+
+    # sort-based alternative
+    f_sort = jax.jit(lambda k: jnp.sort(k))
+    rec("sort_F_u32", timed(f_sort, prio), "16384-elem radix/bitonic sort")
+    f_sortv = jax.jit(
+        lambda k, a, b: jax.lax.sort((k, a, b), num_keys=1)
+    )
+    rec(
+        "sort_F_3operand",
+        timed(f_sortv, prio, idx_f, idx_f),
+        "variadic sort, 1 key + 2 payloads",
+    )
+
+    # segmented machinery from expand_phase
+    S = 9
+    counts = jnp.asarray(rng.integers(0, 3, F * S, dtype=np.int32))
+    f_cum = jax.jit(lambda c: jnp.cumsum(c))
+    rec("cumsum_FxS", timed(f_cum, counts), "147456-elem exclusive scan")
+    offs = jnp.cumsum(counts) - counts
+    j = jnp.arange(F, dtype=jnp.int32)
+    f_ss = jax.jit(
+        lambda o, jj: jnp.searchsorted(o, jj, side="right").astype(jnp.int32)
+    )
+    rec("searchsorted", timed(f_ss, offs, j), "16384 queries over 147456")
+    f_rep = jax.jit(
+        lambda q: jnp.repeat(q, S, total_repeat_length=F * S)
+    )
+    rec("repeat_FxS", timed(f_rep, idx_f), "")
+
+    # one-hot matmul lookup (exact int32 via two 16-bit halves, f32 acc)
+    def onehot_lookup(table, idx):
+        oh = (idx[:, None] == jnp.arange(table.shape[0])[None, :]).astype(
+            jnp.bfloat16
+        )
+        lo = (table & 0xFFFF).astype(jnp.float32)
+        hi = (table >> 16).astype(jnp.float32)
+        vlo = oh @ lo.astype(jnp.bfloat16)
+        vhi = oh @ hi.astype(jnp.bfloat16)
+        return vlo, vhi
+
+    f_oh = jax.jit(lambda t, i: onehot_lookup(t, i))
+    rec(
+        "onehot_matmul_F",
+        timed(f_oh, tab1d, idx_f, n=10),
+        "16384 lookups over 32768 table via MXU",
+    )
+    rec("device", 0.0, str(jax.devices()[0]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
