@@ -222,6 +222,12 @@ class Daemon:
         for s in self._rest.values():
             s.stop()
         self.batcher.close()
+        # persist any pending device-mirror checkpoint before exiting so
+        # the next start warm-restarts from the latest compaction
+        engine = self.registry.check_engine()
+        flush = getattr(engine, "flush_checkpoints", None)
+        if flush is not None:
+            flush()
 
     def serve_forever(self) -> None:
         """Blocks until SIGINT/SIGTERM (ref: daemon.go:93-117 graceful)."""

@@ -27,7 +27,7 @@ import numpy as np
 
 from .snapshot import GraphSnapshot
 
-FORMAT_VERSION = 1
+FORMAT_VERSION = 2  # v2: island circuits (AND/NOT device programs)
 
 _ARRAY_FIELDS = (
     "objslot_ns", "ns_has_config",
@@ -76,6 +76,15 @@ def save_snapshot(snapshot: GraphSnapshot, path: str) -> None:
             "obj_ns": obj_ns,
             "obj_names": np.array(obj_names, dtype="U"),
             "subj_names": _names_by_id(snapshot.subj_ids, len(snapshot.subj_ids)),
+            # island circuits are tiny host-side tuples: JSON round-trip
+            "island_circuits": np.array(
+                [
+                    json.dumps(
+                        {str(k): list(v) for k, v in snapshot.island_circuits.items()}
+                    )
+                ],
+                dtype="U",
+            ),
         }
     )
     d = os.path.dirname(os.path.abspath(path))
@@ -107,9 +116,14 @@ def load_snapshot(path: str) -> Optional[GraphSnapshot]:
             obj_ns = z["obj_ns"]
             obj_names = z["obj_names"]
             subj_names = z["subj_names"]
+            circuits = {
+                int(k): tuple(tuple(op) for op in v)
+                for k, v in json.loads(str(z["island_circuits"][0])).items()
+            }
     except (OSError, KeyError, ValueError, BadZipFile):
         return None
     return GraphSnapshot(
+        island_circuits=circuits,
         ns_ids={str(n): i for i, n in enumerate(ns_names)},
         rel_ids={str(n): i for i, n in enumerate(rel_names)},
         obj_slots={

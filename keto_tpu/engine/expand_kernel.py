@@ -243,10 +243,12 @@ def expand_kernel(
         cand_valid = in_range & (c_skind == 1) & (child_depth >= 2) & emit[seg]
         from .kernel import Expansion, dedupe_phase
 
+        # expand has no islands: every task rides its query's root ctx
         children = Expansion(
-            q=dest_q, obj=c_sa, rel=c_sb, depth=child_depth, valid=cand_valid
+            q=dest_q, ctx=dest_q, obj=c_sa, rel=c_sb,
+            depth=child_depth, valid=cand_valid,
         )
-        nt_q, nt_obj, nt_rel, nt_depth, n_new, overflow_q = dedupe_phase(
+        nt_q, _nt_ctx, nt_obj, nt_rel, nt_depth, n_new, overflow_q = dedupe_phase(
             children, F, B
         )
         needs_host = needs_host | overflow_q

@@ -1,0 +1,74 @@
+"""Island combine: host-side evaluation of AND/NOT rewrite circuits.
+
+The kernel evaluates the MONOTONE mass of every query on device: each
+island leaf (a computed/TTU sub-check under an AND/NOT rewrite) is a
+full BFS exploration accumulating hits in its own ctx slot. What remains
+after the BFS converges is pure boolean algebra over those leaf bits —
+a few thousand ops at most — combined here in numpy.
+
+Two-valued logic is EXACT for check verdicts (not an approximation):
+every or/and in the reference collapses MembershipUnknown to NotMember
+(internal/check/binop.go:15-36 `or` falls through to NotMember,
+binop.go:52-57 `and` returns NotMember for any non-IsMember child, and
+the checkgroup consumer finalizes to NotMember likewise,
+checkgroup/concurrent_checkgroup.go:100-121). Unknown therefore only
+survives along a chain of the nodes' own `restDepth < 0` guards
+(rewrites.go:36-39,:96-105,:172-175,:211-214) — and island tasks always
+run at depth >= 0, so those guards never fire on device. Depth-bounded
+branches below a leaf evaluate to NotMember exactly as the reference
+reports them (e.g. not(exhausted-branch) => IsMember, reference
+semantics replicated deliberately).
+
+Ordering: islands are allocated in BFS step order, so a nested island
+(spawned by a leaf task of an earlier island) always has a HIGHER index
+than its parent. Walking indices in reverse is therefore a topological
+inner-first sweep: by the time an island's circuit reads its leaf bits,
+every nested island feeding those leaves has already resolved.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .snapshot import CIRC_AND, CIRC_FALSE, CIRC_LEAF, CIRC_NOT, CIRC_OR
+
+
+def eval_circuit(ops: tuple, leaves: np.ndarray) -> bool:
+    """Evaluate one postfix boolean circuit over the island's leaf bits."""
+    stack: list[bool] = []
+    for op in ops:
+        code = op[0]
+        if code == CIRC_LEAF:
+            stack.append(bool(leaves[op[1]]))
+        elif code == CIRC_FALSE:
+            stack.append(False)
+        elif code == CIRC_NOT:
+            stack[-1] = not stack[-1]
+        elif code == CIRC_AND:
+            b = stack.pop()
+            stack[-1] = stack[-1] and b
+        elif code == CIRC_OR:
+            b = stack.pop()
+            stack[-1] = stack[-1] or b
+        else:  # pragma: no cover — compiler emits only the codes above
+            raise ValueError(f"unknown circuit op {code!r}")
+    return stack[-1]
+
+
+def combine_islands(
+    ctx_hit: np.ndarray,
+    isl_parent: np.ndarray,
+    isl_pid: np.ndarray,
+    n_isl: int,
+    circuits: dict,
+    n_queries: int,
+    K: int,
+) -> np.ndarray:
+    """Resolve all island instances bottom-up; returns the per-query
+    verdict ctx_hit[:B] (mutates the ctx_hit copy passed in)."""
+    for i in range(n_isl - 1, -1, -1):
+        base = n_queries + i * K
+        ops = circuits[int(isl_pid[i])]
+        if eval_circuit(ops, ctx_hit[base : base + K]):
+            ctx_hit[int(isl_parent[i])] = True
+    return ctx_hit[:n_queries]

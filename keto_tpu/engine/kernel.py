@@ -60,6 +60,7 @@ from .snapshot import (
     EMPTY,
     FLAG_CONFIG_MISSING,
     FLAG_HOST_ONLY,
+    FLAG_ISLAND,
     INSTR_COMPUTED,
     INSTR_NONE,
     INSTR_TTU,
@@ -152,12 +153,19 @@ def dirty_lookup(tables, obj, rel):
 
 class _State(NamedTuple):
     t_q: jnp.ndarray  # [F] owning query index
+    t_ctx: jnp.ndarray  # [F] result accumulator id (0..B-1 = query roots)
     t_obj: jnp.ndarray  # [F] object slot
     t_rel: jnp.ndarray  # [F] relation id
     t_depth: jnp.ndarray  # [F] remaining depth
     n_tasks: jnp.ndarray  # scalar int32
-    member: jnp.ndarray  # [B] bool
+    # ctx_hit[:B] is the per-query root verdict (the old `member`);
+    # ctx_hit[B + i*K + k] accumulates island i's leaf-k sub-check
+    ctx_hit: jnp.ndarray  # [B + NI*K] bool
     needs_host: jnp.ndarray  # [B] bool
+    # island instance table (populated only when NI > 0)
+    isl_parent: jnp.ndarray  # [max(NI,1)] ctx the island's result ORs into
+    isl_pid: jnp.ndarray  # [max(NI,1)] program id (selects the circuit)
+    n_isl: jnp.ndarray  # scalar int32
     step: jnp.ndarray  # scalar int32
 
 
@@ -165,21 +173,30 @@ class Expansion(NamedTuple):
     """Candidate children of one expansion phase (pre-dedupe)."""
 
     q: jnp.ndarray
+    ctx: jnp.ndarray
     obj: jnp.ndarray
     rel: jnp.ndarray
     depth: jnp.ndarray
     valid: jnp.ndarray
 
 
-def flag_phase(tables, obj, rel, live, *, n_config_rels: int):
-    """Per-task host-island flags; pure function of replicated tables, so
+def flag_phase(
+    tables, obj, rel, live, *, n_config_rels: int, island_is_host: bool = False,
+):
+    """Per-task host-replay flags; pure function of replicated tables, so
     every shard computes the identical result (no collective needed).
-    ref: engine.go:219-228 (relation-not-found), snapshot FLAG_* bits."""
+    ref: engine.go:219-228 (relation-not-found), snapshot FLAG_* bits.
+    `island_is_host=True` (a kernel compiled with n_island_cap=0) routes
+    AND/NOT programs to exact host replay — evaluating them with the
+    pure-union fast path would silently corrupt verdicts."""
     ns = tables["objslot_ns"][jnp.clip(obj, 0, None)]
     has_prog = (rel < n_config_rels) & live
     pid = jnp.where(has_prog, ns * n_config_rels + rel, 0)
     flags = jnp.where(has_prog, tables["prog_flags"][pid], 0)
-    flagged = (flags & (FLAG_HOST_ONLY | FLAG_CONFIG_MISSING)) != 0
+    host_mask = FLAG_HOST_ONLY | FLAG_CONFIG_MISSING
+    if island_is_host:
+        host_mask |= FLAG_ISLAND
+    flagged = (flags & host_mask) != 0
     # a data-only relation (id >= n_config_rels) visited inside a
     # namespace that HAS a relation config is the reference's
     # "relation not found" error (engine.go:219-228): host replay
@@ -189,42 +206,68 @@ def flag_phase(tables, obj, rel, live, *, n_config_rels: int):
     return flagged & live
 
 
-def probe_phase(tables, obj, rel, skind, sa, sb, depth, live, *, dh_probes: int):
+def probe_phase(
+    tables, obj, rel, skind, sa, sb, depth, live, *,
+    dh_probes: int, has_delta: bool = True,
+):
     """Direct-edge probe; needs depth >= 1 (checkDirect gets restDepth-1).
     A delta-overlay entry for the exact key overrides the compacted table
-    (insert adds the edge, tombstone masks a deleted one)."""
+    (insert adds the edge, tombstone masks a deleted one). `has_delta` is
+    static: a clean mirror (the common serving state between writes)
+    skips the overlay probe entirely — half the probe gathers."""
     main_hit, _ = _edge_key_probe(
         tables, "dh", obj, rel, skind, sa, sb, dh_probes
     )
-    in_delta, dval = _edge_key_probe(
-        tables, "dd", obj, rel, skind, sa, sb, DELTA_PROBES
-    )
-    hit = jnp.where(in_delta, dval == 1, main_hit)
-    return hit & live & (depth >= 1)
+    if has_delta:
+        in_delta, dval = _edge_key_probe(
+            tables, "dd", obj, rel, skind, sa, sb, DELTA_PROBES
+        )
+        main_hit = jnp.where(in_delta, dval == 1, main_hit)
+    return main_hit & live & (depth >= 1)
 
 
 def expand_phase(
     tables,
     q,
+    ctx,
     obj,
     rel,
     depth,
     live,
+    isl_state,
     *,
     K: int,
     rh_probes: int,
     n_config_rels: int,
     wildcard_rel: int,
     n_queries: int,
-) -> tuple[Expansion, jnp.ndarray]:
+    n_island_cap: int,
+    has_delta: bool = True,
+) -> tuple[Expansion, jnp.ndarray, tuple]:
     """Expand every live task through its CSR row + rewrite instructions.
 
-    Returns (candidate children [F], per-query host flag [B]): children
-    beyond the frontier capacity are truncated and their owning queries
-    flagged for host replay; delta-dirty rows flag their queries too.
+    Monotone programs: instruction children inherit the task's ctx (any
+    hit anywhere resolves the accumulator — pure-union semantics).
+
+    Island programs (FLAG_ISLAND — the rewrite contains AND/NOT): the
+    task allocates an island instance; each instruction becomes a LEAF
+    sub-check whose children carry a fresh leaf ctx. The island's boolean
+    circuit is combined on host after the BFS (engine/islands.py) and the
+    result ORs into the task's own ctx — the data-parallel form of the
+    reference's synchronous binop.and/checkInverted islands
+    (internal/check/binop.go:38-70, rewrites.go:95-159). The task's CSR
+    slot (checkExpandSubject) still inherits the task ctx: subject-set
+    expansion is an or-branch BESIDE the rewrite, not inside it
+    (engine.go:183-207).
+
+    Returns (candidates, per-query host flags, island updates):
+    candidates beyond the frontier capacity are truncated and their
+    owning queries flagged for host replay; delta-dirty rows and island-
+    table overflow flag their queries too.
     """
     F = q.shape[0]
     S = K + 1  # expansion slots per task: CSR row + K instructions
+    NI = n_island_cap
     n_edges = tables["e_obj"].shape[0]
     n_rows = tables["row_ptr"].shape[0] - 1
 
@@ -264,16 +307,67 @@ def expand_phase(
         axis=1,
     )  # [F, S]
 
+    overflow_q = jnp.zeros(n_queries, dtype=bool)
+
     # delta-dirty rows (stale CSR contents): slot-0 expansion or TTU rows
-    dirty_cols = _multi_pair_key_probe(
-        tables, "dirty", "dirty_val", obj, rels_cols, DELTA_PROBES
-    )
-    row_dirty = jnp.stack(
-        [(jnp.maximum(d, 0) & DIRTY_FOR_CHECK) != 0 for d in dirty_cols], axis=1
-    )  # [F, S]
-    dirty = (can_expand & row_dirty[:, 0]) | jnp.any(
-        is_ttu & row_dirty[:, 1:], axis=1
-    )
+    if has_delta:
+        dirty_cols = _multi_pair_key_probe(
+            tables, "dirty", "dirty_val", obj, rels_cols, DELTA_PROBES
+        )
+        row_dirty = jnp.stack(
+            [(jnp.maximum(d, 0) & DIRTY_FOR_CHECK) != 0 for d in dirty_cols],
+            axis=1,
+        )  # [F, S]
+        dirty = (can_expand & row_dirty[:, 0]) | jnp.any(
+            is_ttu & row_dirty[:, 1:], axis=1
+        )
+        overflow_q = overflow_q.at[q].max(dirty)
+
+    # island allocation: one instance per live task whose program has
+    # AND/NOT; its instruction slots seed leaf ctxs B + idx*K + (k-1)
+    isl_parent, isl_pid, n_isl = isl_state
+    if NI > 0:
+        flags = jnp.where(has_prog, tables["prog_flags"][pid], 0)
+        is_island = ((flags & FLAG_ISLAND) != 0) & live
+        inc = is_island.astype(jnp.int32)
+        rank = jnp.cumsum(inc) - inc  # exclusive rank among island tasks
+        idx = n_isl + rank
+        isl_ok = is_island & (idx < NI)
+        # island-table overflow: exact host replay for those queries
+        overflow_q = overflow_q.at[q].max(is_island & (idx >= NI))
+        dest = jnp.where(isl_ok, idx, NI)
+        isl_parent = isl_parent.at[dest].set(ctx, mode="drop")
+        isl_pid = isl_pid.at[dest].set(pid, mode="drop")
+        n_isl = jnp.minimum(n_isl + inc.sum(), NI)
+        # per-(task, slot) child ctx: islands route instruction slots to
+        # leaf ctxs; everything else inherits the task ctx
+        B = n_queries
+        leaf_base = B + idx * K
+        slot_ctx = jnp.concatenate(
+            [
+                ctx[:, None],
+                jnp.where(
+                    isl_ok[:, None],
+                    leaf_base[:, None] + jnp.arange(K, dtype=jnp.int32)[None, :],
+                    ctx[:, None],
+                ),
+            ],
+            axis=1,
+        )  # [F, S]
+        # an overflowed island must not seed leaves under the PARENT ctx
+        # (that would mix island semantics into the plain accumulator);
+        # its instruction slots are suppressed instead — the query is
+        # host-flagged anyway
+        suppress = (is_island & ~isl_ok)[:, None]
+        counts = jnp.concatenate(
+            [
+                counts[:, :1],
+                jnp.where(suppress, 0, counts[:, 1:]),
+            ],
+            axis=1,
+        )
+    else:
+        slot_ctx = jnp.broadcast_to(ctx[:, None], (F, S))
 
     # child relation: slot 0 = edge relation (from e_rel), computed = ir,
     # ttu = ir2; child depth: computed keeps depth, others depth-1
@@ -289,12 +383,7 @@ def expand_phase(
     # queries whose expansions overflow the frontier need host replay
     truncated_seg = (offsets + flat_counts) > F
     seg_q = jnp.repeat(q, S, total_repeat_length=F * S)
-    overflow_q = (
-        jnp.zeros(n_queries, dtype=bool)
-        .at[seg_q]
-        .max(truncated_seg & (flat_counts > 0))
-    )
-    overflow_q = overflow_q.at[q].max(dirty)
+    overflow_q = overflow_q.at[seg_q].max(truncated_seg & (flat_counts > 0))
 
     # build candidate children by segmented gather; all per-(task, slot)
     # source columns flatten to [F*S] 1-D arrays (no small-lane layouts)
@@ -308,6 +397,7 @@ def expand_phase(
     sk = seg % S  # slot
 
     src_q = q[ti]
+    src_ctx = slot_ctx.reshape(-1)[seg]
     src_obj = obj[ti]
     src_depth = depth[ti]
     src_start = starts.reshape(-1)[seg]
@@ -330,18 +420,23 @@ def expand_phase(
     child_depth = jnp.where(src_comp, src_depth, src_depth - 1)
     child_valid = in_range & ~(src_slot0 & (edge_rel == wildcard_rel))
     return (
-        Expansion(src_q, child_obj, child_rel, child_depth, child_valid),
+        Expansion(src_q, src_ctx, child_obj, child_rel, child_depth, child_valid),
         overflow_q,
+        (isl_parent, isl_pid, n_isl),
     )
 
 
 def dedupe_phase(
     children: Expansion, F: int, n_queries: int
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Dedupe candidates on (q, obj, rel) keeping the deepest instance and
-    pack the survivors into the next frontier. Candidates may be longer
-    than F (multi-shard gather); survivors beyond F flag their queries
-    for host replay.
+) -> tuple[
+    jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray,
+    jnp.ndarray, jnp.ndarray,
+]:
+    """Dedupe candidates on (ctx, obj, rel) keeping the deepest instance
+    and pack the survivors into the next frontier (ctx implies the query:
+    root ctxs ARE query ids, leaf ctxs belong to one island instance).
+    Candidates may be longer than F (multi-shard gather); survivors
+    beyond F flag their queries for host replay.
 
     Sort-free: candidates race for a hash bucket (scatter-max of a
     priority encoding depth then candidate index); each candidate then
@@ -358,7 +453,7 @@ def dedupe_phase(
     cap = 1
     while cap < 2 * G:
         cap *= 2
-    h = _hash_combine(children.q, children.obj, children.rel)
+    h = _hash_combine(children.ctx, children.obj, children.rel)
     bucket = (h & jnp.uint32(cap - 1)).astype(jnp.int32)
     bucket = jnp.where(children.valid, bucket, cap)  # invalid -> dropped
 
@@ -391,7 +486,7 @@ def dedupe_phase(
     won = children.valid & (winner_idx == idx)
     # same-key losers are duplicates; different-key losers survive
     same_key = (
-        (children.q[winner_idx] == children.q)
+        (children.ctx[winner_idx] == children.ctx)
         & (children.obj[winner_idx] == children.obj)
         & (children.rel[winner_idx] == children.rel)
     )
@@ -409,17 +504,23 @@ def dedupe_phase(
     # non-kept entries park at index F: out-of-bounds scatter drops them
     dest = jnp.where(kept_in_cap, pos, F)
     nt_q = jnp.zeros(F, jnp.int32).at[dest].set(children.q, mode="drop")
+    nt_ctx = jnp.zeros(F, jnp.int32).at[dest].set(children.ctx, mode="drop")
     nt_obj = jnp.zeros(F, jnp.int32).at[dest].set(children.obj, mode="drop")
     nt_rel = jnp.zeros(F, jnp.int32).at[dest].set(children.rel, mode="drop")
     nt_depth = jnp.zeros(F, jnp.int32).at[dest].set(children.depth, mode="drop")
     n_new = jnp.minimum(n_keep, F)
-    return nt_q, nt_obj, nt_rel, nt_depth, n_new, overflow_q
+    return nt_q, nt_ctx, nt_obj, nt_rel, nt_depth, n_new, overflow_q
 
 
-def seed_state(q_obj, q_rel, q_depth, q_valid, frontier_cap: int) -> _State:
-    """Initial frontier: one task per valid query (frontier_cap >= B)."""
+def seed_state(
+    q_obj, q_rel, q_depth, q_valid, frontier_cap: int, n_island_cap: int = 0,
+    K: int = 1,
+) -> _State:
+    """Initial frontier: one task per valid query (frontier_cap >= B);
+    task i starts in root ctx i. NC = B + NI*K ctx accumulators."""
     B = q_obj.shape[0]
     pad = frontier_cap - B
+    NC = B + n_island_cap * K
     depth0 = jnp.pad(q_depth.astype(jnp.int32), (0, pad))
     # invalid queries contribute inert tasks (depth -1 ⇒ no probes/expansion)
     depth0 = jnp.where(
@@ -429,36 +530,46 @@ def seed_state(q_obj, q_rel, q_depth, q_valid, frontier_cap: int) -> _State:
     )
     return _State(
         t_q=jnp.pad(jnp.arange(B, dtype=jnp.int32), (0, pad)),
+        t_ctx=jnp.pad(jnp.arange(B, dtype=jnp.int32), (0, pad)),
         t_obj=jnp.pad(q_obj.astype(jnp.int32), (0, pad)),
         t_rel=jnp.pad(q_rel.astype(jnp.int32), (0, pad)),
         t_depth=depth0,
         n_tasks=jnp.int32(B),
-        member=jnp.zeros(B, dtype=bool),
+        ctx_hit=jnp.zeros(NC, dtype=bool),
         needs_host=jnp.zeros(B, dtype=bool),
+        isl_parent=jnp.zeros(max(n_island_cap, 1), jnp.int32),
+        isl_pid=jnp.zeros(max(n_island_cap, 1), jnp.int32),
+        n_isl=jnp.int32(0),
         step=jnp.int32(0),
     )
 
 
-def loop_cond(max_steps: int):
+def loop_cond(max_steps: int, n_queries: int):
     def cond_fn(st: _State) -> jnp.ndarray:
         return (
             (st.step < max_steps)
             & (st.n_tasks > 0)
-            & ~jnp.all(st.member | st.needs_host)
+            & ~jnp.all(st.ctx_hit[:n_queries] | st.needs_host)
         )
 
     return cond_fn
 
 
-def finalize(final: _State, max_steps: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+def finalize(
+    final: _State, max_steps: int, n_queries: int
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Step-budget exhaustion with live tasks means the device did NOT
     finish exploring: those queries must go to the host, not be reported
-    NotMember (silent false denials otherwise)."""
+    NotMember (silent false denials otherwise).
+
+    Returns (ctx_hit, needs_host, isl_parent, isl_pid, n_isl) — the
+    engine combines island circuits on host and reads the per-query
+    verdict from ctx_hit[:B] (engine/islands.py)."""
     F = final.t_q.shape[0]
     exhausted = (final.step >= max_steps) & (final.n_tasks > 0)
     live = jnp.arange(F, dtype=jnp.int32) < final.n_tasks
     needs_host = final.needs_host.at[final.t_q].max(exhausted & live)
-    return final.member, needs_host
+    return final.ctx_hit, needs_host, final.isl_parent, final.isl_pid, final.n_isl
 
 
 @functools.partial(
@@ -466,6 +577,7 @@ def finalize(final: _State, max_steps: int) -> tuple[jnp.ndarray, jnp.ndarray]:
     static_argnames=(
         "K", "dh_probes", "rh_probes", "max_steps",
         "wildcard_rel", "n_config_rels", "frontier_cap",
+        "n_island_cap", "has_delta",
     ),
 )
 def check_kernel(
@@ -485,48 +597,61 @@ def check_kernel(
     wildcard_rel: int,
     n_config_rels: int,
     frontier_cap: int,
-) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Returns (member[B], needs_host[B])."""
+    n_island_cap: int = 0,
+    has_delta: bool = True,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Returns (ctx_hit[B + NI*K], needs_host[B], isl_parent, isl_pid,
+    n_isl); the per-query verdict is ctx_hit[:B] after the host island
+    combine (a no-op for monotone-only configs, where n_island_cap=0)."""
     B = q_obj.shape[0]
     F = frontier_cap
 
     def step_fn(st: _State) -> _State:
         idx = jnp.arange(F, dtype=jnp.int32)
         q = st.t_q
-        alive_q = ~(st.member | st.needs_host)
-        live = (idx < st.n_tasks) & alive_q[q]
+        ctx = st.t_ctx
+        root_done = st.ctx_hit[:B] | st.needs_host
+        # a task dies when its query is resolved (top-level or short-
+        # circuit) or its own accumulator already hit (per-ctx
+        # short-circuit: an island leaf is an OR accumulation too)
+        live = (idx < st.n_tasks) & ~root_done[q] & ~st.ctx_hit[ctx]
         obj, rel, depth = st.t_obj, st.t_rel, st.t_depth
 
-        flagged = flag_phase(tables, obj, rel, live, n_config_rels=n_config_rels)
+        flagged = flag_phase(
+            tables, obj, rel, live,
+            n_config_rels=n_config_rels, island_is_host=(n_island_cap == 0),
+        )
         hit = probe_phase(
             tables, obj, rel, q_skind[q], q_sa[q], q_sb[q], depth, live,
-            dh_probes=dh_probes,
+            dh_probes=dh_probes, has_delta=has_delta,
         )
-        member = st.member.at[q].max(hit)
+        ctx_hit = st.ctx_hit.at[ctx].max(hit)
         needs_host = st.needs_host.at[q].max(flagged)
 
-        # refresh liveness after membership updates (short-circuit)
-        live = live & ~(member | needs_host)[q]
+        # refresh liveness after accumulator updates (short-circuit)
+        live = live & ~(ctx_hit[:B] | needs_host)[q] & ~ctx_hit[ctx]
 
-        children, overflow_q = expand_phase(
-            tables, q, obj, rel, depth, live,
+        children, overflow_q, isl_state = expand_phase(
+            tables, q, ctx, obj, rel, depth, live,
+            (st.isl_parent, st.isl_pid, st.n_isl),
             K=K, rh_probes=rh_probes, n_config_rels=n_config_rels,
             wildcard_rel=wildcard_rel, n_queries=B,
+            n_island_cap=n_island_cap, has_delta=has_delta,
         )
         needs_host = needs_host | overflow_q
 
-        nt_q, nt_obj, nt_rel, nt_depth, n_new, overflow2 = dedupe_phase(
+        nt_q, nt_ctx, nt_obj, nt_rel, nt_depth, n_new, overflow2 = dedupe_phase(
             children, F, B
         )
         needs_host = needs_host | overflow2
         return _State(
-            nt_q, nt_obj, nt_rel, nt_depth, n_new,
-            member, needs_host, st.step + 1,
+            nt_q, nt_ctx, nt_obj, nt_rel, nt_depth, n_new,
+            ctx_hit, needs_host, *isl_state, st.step + 1,
         )
 
-    init = seed_state(q_obj, q_rel, q_depth, q_valid, F)
-    final = jax.lax.while_loop(loop_cond(max_steps), step_fn, init)
-    return finalize(final, max_steps)
+    init = seed_state(q_obj, q_rel, q_depth, q_valid, F, n_island_cap, K)
+    final = jax.lax.while_loop(loop_cond(max_steps, B), step_fn, init)
+    return finalize(final, max_steps, B)
 
 
 def snapshot_tables(snapshot: GraphSnapshot, delta: dict | None = None) -> dict:
@@ -549,9 +674,15 @@ def refresh_delta_tables(tables: dict, delta: dict, vocab_arrays: dict) -> dict:
 
 
 def kernel_static_config(
-    snapshot: GraphSnapshot, max_depth: int, frontier_cap: int
+    snapshot: GraphSnapshot,
+    max_depth: int,
+    frontier_cap: int,
+    n_island_cap: int = 0,
+    has_delta: bool = True,
 ) -> dict:
-    """The static kwargs for check_kernel, derived from a snapshot."""
+    """The static kwargs for check_kernel, derived from a snapshot.
+    Monotone-only configs force n_island_cap=0 (zero island overhead);
+    has_delta=False compiles out the overlay probes for a clean mirror."""
     return dict(
         K=snapshot.K,
         dh_probes=snapshot.dh_probes,
@@ -562,4 +693,6 @@ def kernel_static_config(
         wildcard_rel=snapshot.wildcard_rel,
         n_config_rels=max(snapshot.n_config_rels, 1),
         frontier_cap=frontier_cap,
+        n_island_cap=n_island_cap if snapshot.island_circuits else 0,
+        has_delta=has_delta,
     )

@@ -47,8 +47,19 @@ INSTR_COMPUTED = 1
 INSTR_TTU = 2
 
 # per-(ns,rel) flags
-FLAG_HOST_ONLY = 1  # rewrite has AND/NOT or exceeds K instructions
+FLAG_HOST_ONLY = 1  # rewrite exceeds the instruction/circuit caps
 FLAG_CONFIG_MISSING = 2  # namespace declares relations but not this one
+FLAG_ISLAND = 4  # rewrite has AND/NOT: full-evaluation island on device
+
+# island circuit op codes (host-side combine; see engine/islands.py)
+CIRC_FALSE = "false"
+CIRC_LEAF = "leaf"
+CIRC_NOT = "not"
+CIRC_AND = "and"
+CIRC_OR = "or"
+
+# circuit length cap: a rewrite tree compiling past this goes host_only
+CIRCUIT_CAP = 48
 
 _GOLDEN = np.uint32(0x9E3779B9)
 
@@ -285,12 +296,19 @@ class GraphSnapshot:
     e_obj: np.ndarray  # [n_edges] subject-set object slot
     e_rel: np.ndarray  # [n_edges] subject-set relation id
 
-    # rewrite programs, dense [n_ns * n_config_rels, K]
+    # rewrite programs, dense [n_ns * n_config_rels, K]; K is the
+    # EFFECTIVE max instruction/leaf count over all programs (not the
+    # build-time cap) — the kernel's expansion-slot count S = K + 1
+    # scales every per-step gather, so it must stay tight
     instr_kind: np.ndarray
     instr_rel: np.ndarray
     instr_rel2: np.ndarray
     prog_flags: np.ndarray  # [n_ns * n_config_rels]
     K: int
+
+    # island programs: pid -> postfix circuit over leaf values (host-side
+    # combine, engine/islands.py); empty for monotone-only configs
+    island_circuits: dict = field(default_factory=dict)
 
     version: int = 0
     n_tuples: int = 0
@@ -345,40 +363,117 @@ class GraphSnapshot:
         }
 
 
+def _is_monotone(rw: ast.SubjectSetRewrite) -> bool:
+    if rw.operation != ast.Operator.OR:
+        return False
+    for child in rw.children:
+        if isinstance(child, ast.SubjectSetRewrite):
+            if not _is_monotone(child):
+                return False
+        elif isinstance(child, ast.InvertResult):
+            return False
+        elif not isinstance(
+            child, (ast.ComputedSubjectSet, ast.TupleToSubjectSet)
+        ):
+            return False
+    return True
+
+
 def _compile_rewrite(
     rewrite: Optional[ast.SubjectSetRewrite], rel_ids: dict[str, int], K: int
-) -> tuple[list[tuple[int, int, int]], bool]:
-    """Flatten a pure-union rewrite into instructions; host_only if the
-    tree contains AND/NOT/unknown nodes or exceeds K instructions."""
+) -> tuple[list[tuple[int, int, int]], Optional[tuple], int]:
+    """Compile a rewrite AST for device execution.
+
+    Returns (instructions, circuit, flags):
+      - pure-union (monotone) trees flatten to <= K inline instructions
+        executed in the BFS itself (children inherit the task's ctx):
+        circuit None, flags 0
+      - trees containing AND/NOT compile to a full-evaluation ISLAND
+        (the data-parallel form of the reference's synchronous and/or/
+        checkInverted, internal/check/binop.go:38-70, rewrites.go:95-159):
+        the instructions become the island's LEAF sub-checks (each leaf
+        accumulates hits in its own ctx) and `circuit` is a postfix
+        boolean program over the leaf values, combined on host after the
+        BFS converges (engine/islands.py). Two-valued logic is exact
+        here: every or/and in the reference collapses Unknown to
+        NotMember (binop.go or/and, checkgroup consumer), so Unknown
+        never changes a check verdict — depth-exhaustion inside a branch
+        is NotMember for that branch, exactly as the reference reports
+      - trees exceeding the instruction/circuit caps: flags
+        FLAG_HOST_ONLY (exact host replay)
+    """
     if rewrite is None:
-        return [], False
-    instrs: list[tuple[int, int, int]] = []
+        return [], None, 0
 
-    def walk(rw: ast.SubjectSetRewrite) -> bool:
-        if rw.operation != ast.Operator.OR:
-            return False
-        for child in rw.children:
-            if isinstance(child, ast.ComputedSubjectSet):
-                instrs.append((INSTR_COMPUTED, rel_ids[child.relation], 0))
-            elif isinstance(child, ast.TupleToSubjectSet):
-                instrs.append(
-                    (
-                        INSTR_TTU,
-                        rel_ids[child.relation],
-                        rel_ids[child.computed_subject_set_relation],
+    if _is_monotone(rewrite):
+        instrs: list[tuple[int, int, int]] = []
+
+        def walk(rw: ast.SubjectSetRewrite) -> None:
+            for child in rw.children:
+                if isinstance(child, ast.ComputedSubjectSet):
+                    instrs.append((INSTR_COMPUTED, rel_ids[child.relation], 0))
+                elif isinstance(child, ast.TupleToSubjectSet):
+                    instrs.append(
+                        (
+                            INSTR_TTU,
+                            rel_ids[child.relation],
+                            rel_ids[child.computed_subject_set_relation],
+                        )
                     )
-                )
-            elif isinstance(child, ast.SubjectSetRewrite):
-                if not walk(child):
-                    return False
-            else:
-                return False  # InvertResult / unknown: host island
-        return True
+                else:
+                    walk(child)
 
-    monotone = walk(rewrite)
-    if not monotone or len(instrs) > K:
-        return [], True
-    return instrs, False
+        walk(rewrite)
+        if len(instrs) > K:
+            return [], None, FLAG_HOST_ONLY
+        return instrs, None, 0
+
+    # non-monotone: island leaves + postfix circuit
+    leaves: list[tuple[int, int, int]] = []
+    leaf_index: dict[tuple[int, int, int], int] = {}
+    ops: list[tuple] = []
+    ok = True
+
+    def leaf(key: tuple[int, int, int]) -> None:
+        k = leaf_index.get(key)
+        if k is None:
+            k = len(leaves)
+            leaf_index[key] = k
+            leaves.append(key)
+        ops.append((CIRC_LEAF, k))
+
+    def emit(node) -> None:
+        nonlocal ok
+        if isinstance(node, ast.ComputedSubjectSet):
+            leaf((INSTR_COMPUTED, rel_ids[node.relation], 0))
+        elif isinstance(node, ast.TupleToSubjectSet):
+            leaf(
+                (
+                    INSTR_TTU,
+                    rel_ids[node.relation],
+                    rel_ids[node.computed_subject_set_relation],
+                )
+            )
+        elif isinstance(node, ast.InvertResult):
+            emit(node.child)
+            ops.append((CIRC_NOT,))
+        elif isinstance(node, ast.SubjectSetRewrite):
+            if not node.children:
+                # or([]) = and([]) = NotMember (binop.go:16-18,:39-41)
+                ops.append((CIRC_FALSE,))
+                return
+            combine = CIRC_AND if node.operation == ast.Operator.AND else CIRC_OR
+            for i, child in enumerate(node.children):
+                emit(child)
+                if i:
+                    ops.append((combine,))
+        else:
+            ok = False
+
+    emit(rewrite)
+    if not ok or len(leaves) > K or len(ops) > CIRCUIT_CAP:
+        return [], None, FLAG_HOST_ONLY
+    return leaves, tuple(ops), FLAG_ISLAND
 
 
 def build_snapshot(
@@ -468,12 +563,11 @@ def build_snapshot(
     e_obj, e_rel = tables["e_obj"], tables["e_rel"]
 
     # ---- rewrite programs ---------------------------------------------------
+    # two passes: compile everything first so the stored K is the
+    # EFFECTIVE max program length (per-step kernel cost scales with K)
     NR = n_ns * max(n_config_rels, 1)
-    instr_kind = np.zeros((NR, K), dtype=np.int32)
-    instr_rel = np.zeros((NR, K), dtype=np.int32)
-    instr_rel2 = np.zeros((NR, K), dtype=np.int32)
-    prog_flags = np.zeros(NR, dtype=np.int32)
-
+    compiled: dict[int, tuple] = {}
+    missing_flags: list[int] = []
     for ns in namespaces:
         nsid = ns_ids[ns.name]
         if not ns.relations:
@@ -485,17 +579,28 @@ def build_snapshot(
             if rid >= n_config_rels:
                 continue
             if rel_name not in declared:
-                prog_flags[nsid * n_config_rels + rid] |= FLAG_CONFIG_MISSING
+                missing_flags.append(nsid * n_config_rels + rid)
         for rel in ns.relations:
             rid = rel_ids[rel.name]
             pidx = nsid * n_config_rels + rid
-            instrs, host_only = _compile_rewrite(rel.subject_set_rewrite, rel_ids, K)
-            if host_only:
-                prog_flags[pidx] |= FLAG_HOST_ONLY
-            for k, (kind, a, b) in enumerate(instrs):
-                instr_kind[pidx, k] = kind
-                instr_rel[pidx, k] = a
-                instr_rel2[pidx, k] = b
+            compiled[pidx] = _compile_rewrite(rel.subject_set_rewrite, rel_ids, K)
+
+    K_eff = max([len(instrs) for instrs, _, _ in compiled.values()] + [1])
+    instr_kind = np.zeros((NR, K_eff), dtype=np.int32)
+    instr_rel = np.zeros((NR, K_eff), dtype=np.int32)
+    instr_rel2 = np.zeros((NR, K_eff), dtype=np.int32)
+    prog_flags = np.zeros(NR, dtype=np.int32)
+    island_circuits: dict[int, tuple] = {}
+    for pidx in missing_flags:
+        prog_flags[pidx] |= FLAG_CONFIG_MISSING
+    for pidx, (instrs, circuit, cflags) in compiled.items():
+        prog_flags[pidx] |= cflags
+        if circuit is not None:
+            island_circuits[pidx] = circuit
+        for k, (kind, a, b) in enumerate(instrs):
+            instr_kind[pidx, k] = kind
+            instr_rel[pidx, k] = a
+            instr_rel2[pidx, k] = b
 
     return GraphSnapshot(
         ns_ids=ns_ids,
@@ -511,7 +616,8 @@ def build_snapshot(
         rh_obj=rh_obj, rh_rel=rh_rel, rh_row=rh_row, rh_probes=rh_probes,
         row_ptr=row_ptr, e_obj=e_obj, e_rel=e_rel,
         instr_kind=instr_kind, instr_rel=instr_rel, instr_rel2=instr_rel2,
-        prog_flags=prog_flags, K=K,
+        prog_flags=prog_flags, K=K_eff,
+        island_circuits=island_circuits,
         version=version, n_tuples=n_t,
     )
 

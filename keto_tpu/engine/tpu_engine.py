@@ -59,6 +59,9 @@ class _EngineState:
     base_version: int
     covered_version: int
     config_fp: int
+    # False for a clean mirror: the kernel compiles out the delta-overlay
+    # probes entirely (they're half the probe gathers per step)
+    has_delta: bool = False
     # expand-kernel extras (lazy)
     expand_tables: Optional[dict] = None  # device full CSR + dirty tables
     fh_probes: Optional[int] = None
@@ -104,7 +107,7 @@ class TPUCheckEngine:
         # last compaction before an idle period still reaches disk
         self._persist_mu = threading.Lock()
         self._pending_persist: Optional[GraphSnapshot] = None
-        self._persist_scheduled = False
+        self._persist_timer: Optional[threading.Timer] = None
         self._last_persist = 0.0
         self.persist_min_interval = float(
             config.get("check.mirror_persist_interval", 60.0)
@@ -157,25 +160,38 @@ class TPUCheckEngine:
             return
         with self._persist_mu:
             self._pending_persist = snap
+            if self._persist_timer is not None:
+                return  # an already-scheduled flush will pick this up
             delay = 0.0
             if self._last_persist:
-                delay = (
+                delay = max(
+                    0.0,
                     self._last_persist
                     + self.persist_min_interval
-                    - time.monotonic()
+                    - time.monotonic(),
                 )
-            if delay <= 0:
-                self._flush_pending_locked(cache_path)
-            elif not self._persist_scheduled:
-                self._persist_scheduled = True
-                timer = threading.Timer(delay, self._flush_deferred)
-                timer.daemon = True
-                timer.start()
+            # ALWAYS deferred to the timer thread (even delay 0): the
+            # O(edges) compressed write never runs on the check/serve
+            # thread that happened to trigger the rebuild
+            timer = threading.Timer(delay, self._flush_deferred)
+            timer.daemon = True
+            self._persist_timer = timer
+            timer.start()
+
+    def flush_checkpoints(self) -> None:
+        """Write any pending mirror checkpoint NOW (synchronously).
+        Called by the daemon on graceful shutdown and by tests that
+        assert on-disk state; safe to call concurrently."""
+        with self._persist_mu:
+            timer, self._persist_timer = self._persist_timer, None
+        if timer is not None:
+            timer.cancel()
+        self._flush_deferred()
 
     def _flush_deferred(self) -> None:
         cache_path = self._mirror_cache_path()
         with self._persist_mu:
-            self._persist_scheduled = False
+            self._persist_timer = None
             if cache_path is not None:
                 self._flush_pending_locked(cache_path)
 
@@ -247,6 +263,7 @@ class TPUCheckEngine:
             base_version=state.base_version,
             covered_version=store_version,
             config_fp=state.config_fp,
+            has_delta=True,
         )
         # carry the base full-CSR + base decoder forward; the dirty tables
         # and overlay extension re-derive from the fresh delta (O(delta))
@@ -545,27 +562,45 @@ class TPUCheckEngine:
         else:
             launch_cap = self.frontier_cap
 
+        # islands: one ctx block of K leaves per instance; cap scales with
+        # the batch so island-heavy workloads don't immediately overflow
+        # to host replay (overflow is safe, just slow)
+        island_cap = 2 * B if state.snapshot.island_circuits else 0
         if self.mesh is not None:
             from ..parallel.kernel import sharded_check_kernel, sharded_static_config
 
             statics = sharded_static_config(
-                state.sharded, global_max, launch_cap
+                state.sharded, global_max, launch_cap,
+                n_island_cap=island_cap, has_delta=state.has_delta,
             )
             sharded_tables, replicated_tables = state.tables
-            member, needs_host = sharded_check_kernel(
+            ctx_hit, needs_host, isl_parent, isl_pid, n_isl = sharded_check_kernel(
                 self.mesh, sharded_tables, replicated_tables,
                 q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid,
                 statics=statics, axis=self.mesh.axis_names[0],
             )
         else:
-            cfg = kernel_static_config(state.snapshot, global_max, launch_cap)
-            member, needs_host = check_kernel(
+            cfg = kernel_static_config(
+                state.snapshot, global_max, launch_cap,
+                n_island_cap=island_cap, has_delta=state.has_delta,
+            )
+            ctx_hit, needs_host, isl_parent, isl_pid, n_isl = check_kernel(
                 state.tables,
                 q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid,
                 **cfg,
             )
-        member = np.asarray(member)
+        ctx_hit = np.asarray(ctx_hit).copy()
         needs_host = np.asarray(needs_host)
+        n_isl = int(n_isl)
+        if n_isl:
+            from .islands import combine_islands
+
+            member = combine_islands(
+                ctx_hit, np.asarray(isl_parent), np.asarray(isl_pid),
+                n_isl, state.snapshot.island_circuits, B, state.snapshot.K,
+            )
+        else:
+            member = ctx_hit[:B]
 
         results: list[CheckResult] = []
         n_host = 0

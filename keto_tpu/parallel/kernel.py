@@ -57,6 +57,7 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
     (
         K, dh_probes, rh_probes, max_steps,
         wildcard_rel, n_config_rels, frontier_cap,
+        n_island_cap, has_delta,
     ) = statics
     F = frontier_cap
 
@@ -68,24 +69,37 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
         def step_fn(st: _State) -> _State:
             idx = jnp.arange(F, dtype=jnp.int32)
             q = st.t_q
-            live = (idx < st.n_tasks) & ~(st.member | st.needs_host)[q]
+            ctx = st.t_ctx
+            root_done = st.ctx_hit[:B] | st.needs_host
+            live = (idx < st.n_tasks) & ~root_done[q] & ~st.ctx_hit[ctx]
             obj, rel, depth = st.t_obj, st.t_rel, st.t_depth
 
             # flags depend only on replicated tables: identical everywhere
-            flagged = flag_phase(tables, obj, rel, live, n_config_rels=n_config_rels)
+            flagged = flag_phase(
+                tables, obj, rel, live,
+                n_config_rels=n_config_rels,
+                island_is_host=(n_island_cap == 0),
+            )
             hit_local = probe_phase(
                 tables, obj, rel, q_skind[q], q_sa[q], q_sb[q], depth, live,
-                dh_probes=dh_probes,
+                dh_probes=dh_probes, has_delta=has_delta,
             )
+            # a direct edge lives on exactly one shard: OR-merge the hits
             hit = jax.lax.psum(hit_local.astype(jnp.int32), axis) > 0
-            member = st.member.at[q].max(hit)
+            ctx_hit = st.ctx_hit.at[ctx].max(hit)
             needs_host = st.needs_host.at[q].max(flagged)
-            live = live & ~(member | needs_host)[q]
+            live = live & ~(ctx_hit[:B] | needs_host)[q] & ~ctx_hit[ctx]
 
-            children, overflow_q = expand_phase(
-                tables, q, obj, rel, depth, live,
+            # island allocation inside expand_phase is a pure function of
+            # the REPLICATED frontier + program tables, so every shard
+            # derives the identical island table and leaf-ctx assignment
+            # with no collective
+            children, overflow_q, isl_state = expand_phase(
+                tables, q, ctx, obj, rel, depth, live,
+                (st.isl_parent, st.isl_pid, st.n_isl),
                 K=K, rh_probes=rh_probes, n_config_rels=n_config_rels,
                 wildcard_rel=wildcard_rel, n_queries=B,
+                n_island_cap=n_island_cap, has_delta=has_delta,
             )
             needs_host = needs_host | (
                 jax.lax.psum(overflow_q.astype(jnp.int32), axis) > 0
@@ -98,24 +112,24 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
                     for part in children
                 )
             )
-            nt_q, nt_obj, nt_rel, nt_depth, n_new, overflow2 = dedupe_phase(
+            nt_q, nt_ctx, nt_obj, nt_rel, nt_depth, n_new, overflow2 = dedupe_phase(
                 gathered, F, B
             )
             needs_host = needs_host | overflow2
             return _State(
-                nt_q, nt_obj, nt_rel, nt_depth, n_new,
-                member, needs_host, st.step + 1,
+                nt_q, nt_ctx, nt_obj, nt_rel, nt_depth, n_new,
+                ctx_hit, needs_host, *isl_state, st.step + 1,
             )
 
-        init = seed_state(q_obj, q_rel, q_depth, q_valid, F)
-        final = jax.lax.while_loop(loop_cond(max_steps), step_fn, init)
-        return finalize(final, max_steps)
+        init = seed_state(q_obj, q_rel, q_depth, q_valid, F, n_island_cap, K)
+        final = jax.lax.while_loop(loop_cond(max_steps, B), step_fn, init)
+        return finalize(final, max_steps, B)
 
     mapped = _shard_map(
         run,
         mesh=mesh,
         in_specs=(P(axis), P(), P(), P(), P(), P(), P(), P(), P()),
-        out_specs=(P(), P()),
+        out_specs=(P(), P(), P(), P(), P()),
         check_vma=False,
     )
     return jax.jit(mapped)
@@ -134,16 +148,24 @@ def get_sharded_kernel(mesh: Mesh, statics: tuple, axis: str = "x"):
 
 
 def sharded_static_config(
-    snap: ShardedSnapshot, max_depth: int, frontier_cap: int
+    snap: ShardedSnapshot,
+    max_depth: int,
+    frontier_cap: int,
+    n_island_cap: int = 0,
+    has_delta: bool = True,
 ) -> tuple:
     """Single-chip static config (one source of truth for the step-budget
     formula) with the per-shard probe maxima patched in."""
-    cfg = kernel_static_config(snap.base, max_depth, frontier_cap)
+    cfg = kernel_static_config(
+        snap.base, max_depth, frontier_cap,
+        n_island_cap=n_island_cap, has_delta=has_delta,
+    )
     cfg["dh_probes"] = snap.dh_probes
     cfg["rh_probes"] = snap.rh_probes
     return (
         cfg["K"], cfg["dh_probes"], cfg["rh_probes"], cfg["max_steps"],
         cfg["wildcard_rel"], cfg["n_config_rels"], cfg["frontier_cap"],
+        cfg["n_island_cap"], cfg["has_delta"],
     )
 
 

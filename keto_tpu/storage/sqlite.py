@@ -151,14 +151,48 @@ SELECT t.namespace, mo.string_representation, t.relation,
 class SQLitePersister:
     """dsn: a filesystem path, or 'memory' / ':memory:' for in-process."""
 
+    # connect backoff mirrors the reference's DB connector resilience
+    # (internal/driver/pop_connection.go:40-66: exponential retry, capped
+    # total wait): a file DB briefly locked by a sibling process (WAL
+    # checkpoint, backup) must not fail startup
+    CONNECT_MAX_WAIT = 60.0
+    CONNECT_BASE_DELAY = 0.1
+
     def __init__(self, dsn: str = "memory", auto_migrate: bool = True):
         path = ":memory:" if dsn in ("memory", ":memory:") else dsn
-        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn = self._connect_with_backoff(path)
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA foreign_keys=ON")
         self._lock = threading.RLock()
         if auto_migrate:
             self.migrate_up()
+
+    @classmethod
+    def _connect_with_backoff(cls, path: str) -> sqlite3.Connection:
+        import time as _time
+
+        delay = cls.CONNECT_BASE_DELAY
+        deadline = _time.monotonic() + cls.CONNECT_MAX_WAIT
+        while True:
+            conn = None
+            try:
+                conn = sqlite3.connect(path, check_same_thread=False)
+                # probe the connection like the reference's conn.Open +
+                # ping: a locked/corrupt file fails here, not at first use
+                conn.execute("SELECT 1").fetchone()
+                return conn
+            except sqlite3.OperationalError as err:
+                if conn is not None:
+                    conn.close()
+                # only TRANSIENT contention retries; a permanent error
+                # (missing directory, permissions) fails startup now
+                msg = str(err).lower()
+                if "locked" not in msg and "busy" not in msg:
+                    raise
+                if _time.monotonic() + delay > deadline:
+                    raise
+                _time.sleep(delay)
+                delay = min(delay * 2, 5.0)
 
     # -- migration box (popx stand-in) ----------------------------------------
 

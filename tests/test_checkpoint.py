@@ -87,6 +87,7 @@ class TestEngineWarmRestart:
         e1 = TPUCheckEngine(m, self._config(tmp_path))
         assert e1.check_is_member(ts("files:a#view@bob")[0])
         assert e1.stats["snapshot_builds"] == 1
+        e1.flush_checkpoints()  # persistence is deferred off the check path
 
         # "restart": fresh engine over the same store + cache dir
         e2 = TPUCheckEngine(m, self._config(tmp_path))
@@ -100,6 +101,7 @@ class TestEngineWarmRestart:
         m.write_relation_tuples(TUPLES)
         e1 = TPUCheckEngine(m, self._config(tmp_path))
         e1.check_is_member(ts("files:a#view@bob")[0])
+        e1.flush_checkpoints()
 
         # the store moves beyond the checkpointed version; a fresh engine
         # cannot prove delta coverage from version 0, so it rebuilds
@@ -113,6 +115,7 @@ class TestEngineWarmRestart:
         m.write_relation_tuples(TUPLES)
         e1 = TPUCheckEngine(m, self._config(tmp_path))
         e1.check_is_member(ts("files:a#view@bob")[0])
+        e1.flush_checkpoints()
 
         cfg2 = Config({"check": {"mirror_cache": str(tmp_path)}})
         cfg2.set_namespaces([Namespace(name="files", relations=[Relation(name="owner")])])
@@ -126,9 +129,11 @@ class TestEngineWarmRestart:
         m.write_relation_tuples(TUPLES)
         e1 = TPUCheckEngine(m, self._config(tmp_path))
         e1.check_is_member(ts("files:a#view@bob")[0])
+        e1.flush_checkpoints()
         m.write_relation_tuples(ts("files:new#owner@zoe"))
         e2 = TPUCheckEngine(m, self._config(tmp_path))
         e2.check_is_member(ts("files:new#owner@zoe")[0])  # rebuild + save
+        e2.flush_checkpoints()
         e3 = TPUCheckEngine(m, self._config(tmp_path))
         assert e3.check_is_member(ts("files:new#owner@zoe")[0])
         assert e3.stats.get("snapshot_loads") == 1

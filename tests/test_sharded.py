@@ -170,6 +170,18 @@ class TestShardedDifferential:
                 ref = oracle.check_relation_tuple(q, 12)
                 assert g.membership == ref.membership, f"trial {trial}: {q}"
 
+    def test_islands_on_mesh_no_host_replay(self):
+        """AND/NOT islands under shard_map: island allocation is derived
+        from replicated tables so every shard builds the identical island
+        state; the whole REWRITE_CASES set answers on-device (the one
+        unknown-object query is the documented exact-host path)."""
+        e = make_mesh_engine(REWRITE_NAMESPACES, REWRITE_TUPLES, max_depth=100)
+        rts = [RelationTuple.from_string(q) for q, _ in REWRITE_CASES]
+        got = e.check_batch(rts, 100)
+        for (q, expected), g in zip(REWRITE_CASES, got):
+            assert (g.membership == Membership.IS_MEMBER) == expected, q
+        assert e.stats["host_checks"] == 1  # doc:another_doc (unknown vocab)
+
     def test_read_your_writes_on_mesh(self):
         cfg = Config({"limit": {"max_read_depth": 5}})
         cfg.set_namespaces([Namespace(name="n")])

@@ -123,6 +123,8 @@ def main() -> int:
     st = seed_state(qd["q_obj"], qd["q_rel"], qd["q_depth"], qd["q_valid"], F)
     live = jnp.arange(F) < st.n_tasks
     obj, rel, depth, q = st.t_obj, st.t_rel, st.t_depth, st.t_q
+    ctx = st.t_ctx
+    isl_state = (st.isl_parent, st.isl_pid, st.n_isl)
 
     n_cr = statics["n_config_rels"]
 
@@ -130,7 +132,12 @@ def main() -> int:
     ms, _ = timed(f_flag, tables, obj, rel, live)
     print(json.dumps({"phase": "flag", "ms": round(ms, 3)}))
 
-    f_probe = jax.jit(functools.partial(probe_phase, dh_probes=statics["dh_probes"]))
+    f_probe = jax.jit(
+        functools.partial(
+            probe_phase,
+            dh_probes=statics["dh_probes"], has_delta=statics["has_delta"],
+        )
+    )
     ms, _ = timed(
         f_probe, tables, obj, rel, qd["q_skind"][q], qd["q_sa"][q],
         qd["q_sb"][q], depth, live,
@@ -142,10 +149,13 @@ def main() -> int:
             expand_phase,
             K=statics["K"], rh_probes=statics["rh_probes"],
             n_config_rels=n_cr, wildcard_rel=statics["wildcard_rel"],
-            n_queries=B,
+            n_queries=B, n_island_cap=statics["n_island_cap"],
+            has_delta=statics["has_delta"],
         )
     )
-    ms, (children, _) = timed(f_expand, tables, q, obj, rel, depth, live)
+    ms, (children, _, _) = timed(
+        f_expand, tables, q, ctx, obj, rel, depth, live, isl_state
+    )
     print(json.dumps({"phase": "expand", "ms": round(ms, 3)}))
 
     f_dedupe = jax.jit(functools.partial(dedupe_phase, F=F, n_queries=B))
