@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 class _Pending:
     tuple: object
     max_depth: int
+    nid: object = None  # None = the registry's default network
     future: Future = field(default_factory=Future)
 
 
@@ -37,8 +38,14 @@ class CheckBatcher:
         max_batch: int = 1024,
         window_s: float = 0.002,
         pipeline_depth: int = 2,
+        engine_resolver=None,
     ):
+        # per-request tenancy: batches are grouped by nid and dispatched
+        # to that tenant's engine (ref: ketoctx Contextualizer,
+        # /root/reference/ketoctx/contextualizer.go:12-19); the default
+        # resolver pins everything to the constructor engine
         self.engine = engine
+        self._resolve = engine_resolver or (lambda nid: engine)
         self.max_batch = max_batch
         self.window_s = window_s
         self._queue: queue.Queue[_Pending | None] = queue.Queue()
@@ -60,11 +67,11 @@ class CheckBatcher:
 
     # -- caller side ----------------------------------------------------------
 
-    def check(self, tuple, max_depth: int = 0):
+    def check(self, tuple, max_depth: int = 0, nid=None):
         """Blocking single check; returns a CheckResult."""
         if self._closed:
             raise RuntimeError("CheckBatcher is closed")
-        p = _Pending(tuple, max_depth)
+        p = _Pending(tuple, max_depth, nid)
         self._queue.put(p)
         return p.future.result()
 
@@ -106,9 +113,10 @@ class CheckBatcher:
             batch.append(item)
         return batch
 
-    def _evaluate(self, group: list[_Pending], depth: int) -> None:
+    def _evaluate(self, group: list[_Pending], depth: int, nid=None) -> None:
         try:
-            results = self.engine.check_batch([p.tuple for p in group], depth)
+            engine = self._resolve(nid)
+            results = engine.check_batch([p.tuple for p in group], depth)
         except Exception as e:  # engine-level failure fails the batch
             for p in group:
                 p.future.set_exception(e)
@@ -123,8 +131,8 @@ class CheckBatcher:
                 self._pool.shutdown(wait=True)
                 return
             batch = self._drain(item)
-            by_depth: dict[int, list[_Pending]] = {}
+            by_key: dict[tuple, list[_Pending]] = {}
             for p in batch:
-                by_depth.setdefault(p.max_depth, []).append(p)
-            for depth, group in by_depth.items():
-                self._pool.submit(self._evaluate, group, depth)
+                by_key.setdefault((p.max_depth, p.nid), []).append(p)
+            for (depth, nid), group in by_key.items():
+                self._pool.submit(self._evaluate, group, depth, nid)

@@ -146,7 +146,10 @@ class Daemon:
         self.metrics_addr = cfg.metrics_api_address()
         if host is not None:
             self.read_addr.host = self.write_addr.host = self.metrics_addr.host = host
-        self.batcher = CheckBatcher(registry.check_engine())
+        self.batcher = CheckBatcher(
+            registry.check_engine(),
+            engine_resolver=registry.check_engine,
+        )
         self._grpc_read = None
         self._grpc_write = None
         self._rest = {}
@@ -222,12 +225,10 @@ class Daemon:
         for s in self._rest.values():
             s.stop()
         self.batcher.close()
-        # persist any pending device-mirror checkpoint before exiting so
-        # the next start warm-restarts from the latest compaction
-        engine = self.registry.check_engine()
-        flush = getattr(engine, "flush_checkpoints", None)
-        if flush is not None:
-            flush()
+        # persist any pending device-mirror checkpoints (default network
+        # AND all tenant engines) before exiting so the next start
+        # warm-restarts from the latest compaction
+        self.registry.flush_checkpoints()
 
     def serve_forever(self) -> None:
         """Blocks until SIGINT/SIGTERM (ref: daemon.go:93-117 graceful)."""

@@ -83,13 +83,21 @@ class _Services:
     def _observed(self, method, context, fn, request):
         with self.metrics.observe_request("grpc", method) as outcome:
             try:
-                return fn(request, context)
+                # span-per-RPC (ref: otelgrpc interceptors, daemon.go:360-380)
+                with self.registry.tracer().span(f"grpc.{method}"):
+                    return fn(request, context)
             except KetoError as e:
                 outcome["code"] = _grpc_code(e).name
                 context.abort(_grpc_code(e), e.message)
             except Exception as e:  # noqa: BLE001 — RPC boundary
                 outcome["code"] = "INTERNAL"
                 context.abort(grpc.StatusCode.INTERNAL, str(e))
+
+    def _nid(self, context) -> str:
+        """Per-request network id from gRPC invocation metadata (ref:
+        ketoctx/contextualizer.go:12-19)."""
+        md = {m.key: m.value for m in context.invocation_metadata()}
+        return self.registry.nid_for(md)
 
     def _check_tuple(self, req) -> RelationTuple:
         src = req.tuple if req.HasField("tuple") else req
@@ -114,10 +122,11 @@ class _Services:
     def check(self, req, context):
         t = self._check_tuple(req)
         self.registry.validate_namespaces(t)
+        nid = self._nid(context)
         if self.batcher is not None:
-            res = self.batcher.check(t, int(req.max_depth))
+            res = self.batcher.check(t, int(req.max_depth), nid=nid)
         else:
-            res = self.registry.check_engine().check_relation_tuple(
+            res = self.registry.check_engine(nid).check_relation_tuple(
                 t, int(req.max_depth)
             )
         if res.error is not None:
@@ -137,7 +146,9 @@ class _Services:
                 resp.tree.subject.CopyFrom(subject_to_proto(sub))
             return resp
         self.registry.validate_namespaces(sub)
-        tree = self.registry.expand_engine().expand(sub, int(req.max_depth))
+        tree = self.registry.expand_engine(self._nid(context)).expand(
+            sub, int(req.max_depth)
+        )
         if tree is None:
             return pb.ExpandResponse()
         resp = pb.ExpandResponse()
@@ -155,7 +166,7 @@ class _Services:
             q,
             page_token=req.page_token,
             page_size=page_size,
-            nid=self.registry.nid,
+            nid=self._nid(context),
         )
         resp = pb.ListRelationTuplesResponse(next_page_token=next_token)
         for t in tuples:
@@ -175,7 +186,7 @@ class _Services:
             # ACTION_UNSPECIFIED deltas are ignored (transact_server.go:20-31)
         self.registry.validate_namespaces(*inserts, *deletes)
         self.registry.relation_tuple_manager().transact_relation_tuples(
-            inserts, deletes, nid=self.registry.nid
+            inserts, deletes, nid=self._nid(context)
         )
         return pb.TransactRelationTuplesResponse(
             snaptokens=[NOT_IMPLEMENTED_SNAPTOKEN] * len(inserts)
@@ -192,7 +203,7 @@ class _Services:
             raise MalformedInputError("invalid request")
         self.registry.validate_namespaces(q)
         self.registry.relation_tuple_manager().delete_all_relation_tuples(
-            q, nid=self.registry.nid
+            q, nid=self._nid(context)
         )
         return pb.DeleteRelationTuplesResponse()
 

@@ -125,7 +125,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._json(404, NotFoundError("route not found").to_dict())
                 return
             try:
-                resolved[1]()
+                # span-per-request (ref: otelx.TraceHandler, daemon.go:131-133)
+                with self.registry.tracer().span(f"http.{label}"):
+                    resolved[1]()
             except KetoError as e:
                 outcome["code"] = str(e.status)
                 self._error(e)
@@ -194,6 +196,11 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- read handlers --------------------------------------------------------
 
+    def _nid(self) -> str:
+        """Per-request network id via the Contextualizer hook (ref:
+        ketoctx/contextualizer.go:12-19); default: the registry nid."""
+        return self.registry.nid_for(self.headers)
+
     def _get_relations(self) -> None:
         """ref: read_server.go:122-175."""
         params = self._params()
@@ -204,7 +211,7 @@ class _Handler(BaseHTTPRequestHandler):
             query,
             page_token=params.get("page_token", ""),
             page_size=page_size,
-            nid=self.registry.nid,
+            nid=self._nid(),
         )
         self._json(200, GetResponse(tuples, next_token).to_dict())
 
@@ -227,10 +234,11 @@ class _Handler(BaseHTTPRequestHandler):
             code = 403 if mirror_status else 200
             self._json(code, {"allowed": False})
             return
+        nid = self._nid()
         if self.batcher is not None:
-            res = self.batcher.check(t, max_depth)
+            res = self.batcher.check(t, max_depth, nid=nid)
         else:
-            res = self.registry.check_engine().check_relation_tuple(t, max_depth)
+            res = self.registry.check_engine(nid).check_relation_tuple(t, max_depth)
         if res.error is not None:
             raise res.error
         code = 403 if (mirror_status and not res.allowed) else 200
@@ -251,7 +259,7 @@ class _Handler(BaseHTTPRequestHandler):
                 debug="expand requires namespace, object, and relation"
             )
         self.registry.validate_namespaces(subject_set)
-        tree = self.registry.expand_engine().expand(subject_set, max_depth)
+        tree = self.registry.expand_engine(self._nid()).expand(subject_set, max_depth)
         if tree is None:
             from ..errors import NotFoundError
 
@@ -269,7 +277,7 @@ class _Handler(BaseHTTPRequestHandler):
         t = RelationTuple.from_dict(body)
         self.registry.validate_namespaces(t)
         self.registry.relation_tuple_manager().write_relation_tuples(
-            [t], nid=self.registry.nid
+            [t], nid=self._nid()
         )
         location = READ_ROUTE_BASE + "?" + urllib.parse.urlencode(t.to_url_query())
         self._json(201, t.to_dict(), location=location)
@@ -279,7 +287,7 @@ class _Handler(BaseHTTPRequestHandler):
         query = RelationQuery.from_url_query(self._params())
         self.registry.validate_namespaces(query)
         self.registry.relation_tuple_manager().delete_all_relation_tuples(
-            query, nid=self.registry.nid
+            query, nid=self._nid()
         )
         self._write(204, b"", content_type="application/json")
 
@@ -293,7 +301,7 @@ class _Handler(BaseHTTPRequestHandler):
         deletes = [d.relation_tuple for d in deltas if d.action.value == "delete"]
         self.registry.validate_namespaces(*inserts, *deletes)
         self.registry.relation_tuple_manager().transact_relation_tuples(
-            inserts, deletes, nid=self.registry.nid
+            inserts, deletes, nid=self._nid()
         )
         self._write(204, b"", content_type="application/json")
 

@@ -189,6 +189,23 @@ class NamespaceFileManager:
         return False
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _schema_validator():
+    """Compiled config-schema validator; the embedded schema file never
+    changes at runtime, so parse + compile exactly once per process."""
+    import jsonschema
+
+    schema_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "config_schema.json"
+    )
+    with open(schema_path, "rb") as f:
+        schema = json.load(f)
+    return jsonschema.Draft7Validator(schema)
+
+
 class Config:
     """Config provider. ref: internal/driver/config/provider.go.
 
@@ -197,9 +214,29 @@ class Config:
 
     IMMUTABLE_KEYS = ("dsn", "serve")
 
-    def __init__(self, values: Optional[Mapping[str, Any]] = None):
+    def __init__(
+        self, values: Optional[Mapping[str, Any]] = None, validate: bool = True
+    ):
         self._values: dict[str, Any] = dict(values or {})
+        if validate and self._values:
+            self.validate(self._values)
         self._namespace_manager = None
+
+    @staticmethod
+    def validate(values: Mapping[str, Any]) -> None:
+        """JSON-schema validation against the embedded config schema
+        (keto_tpu/config_schema.json) — bad config fails AT LOAD with a
+        pointer to the offending key, not at first use
+        (ref: embedx/config.schema.json validated in provider.go:58-96).
+        """
+        validator = _schema_validator()
+        errors = sorted(validator.iter_errors(dict(values)), key=lambda e: e.path)
+        if errors:
+            e = errors[0]
+            where = ".".join(str(p) for p in e.absolute_path) or "(root)"
+            raise ConfigError(
+                f"invalid configuration at {where!r}: {e.message}"
+            )
 
     @classmethod
     def from_file(cls, path: str) -> "Config":
@@ -226,14 +263,21 @@ class Config:
         return cur
 
     def set(self, key: str, value: Any) -> None:
+        import copy
+
         root = key.split(".")[0]
         if root in self.IMMUTABLE_KEYS:
             raise ConfigError(f"config key {root!r} is immutable")
         parts = key.split(".")
-        cur = self._values
+        # validate on a candidate copy so a rejected set leaves the
+        # running config untouched
+        candidate = copy.deepcopy(self._values)
+        cur = candidate
         for part in parts[:-1]:
             cur = cur.setdefault(part, {})
         cur[parts[-1]] = value
+        self.validate(candidate)
+        self._values = candidate
         if root == "namespaces":
             self._namespace_manager = None  # invalidate, like the watcher reset
 

@@ -29,6 +29,9 @@ from .snapshot import GraphSnapshot
 
 FORMAT_VERSION = 2  # v2: island circuits (AND/NOT device programs)
 
+# vocabularies larger than this reload as ArrayMaps, not Python dicts
+_ARRAY_VOCAB_THRESHOLD = 200_000
+
 _ARRAY_FIELDS = (
     "objslot_ns", "ns_has_config",
     "dh_obj", "dh_rel", "dh_skind", "dh_sa", "dh_sb", "dh_val",
@@ -49,7 +52,11 @@ def stable_fingerprint(obj) -> int:
     return int.from_bytes(hashlib.sha256(payload).digest()[:8], "big") >> 1
 
 
-def _names_by_id(d: dict, n: int) -> np.ndarray:
+def _names_by_id(d, n: int) -> np.ndarray:
+    from .snapshot import ArrayMap
+
+    if isinstance(d, ArrayMap):
+        return np.asarray(d.keys_by_id_array(), dtype="U")
     out = [""] * n
     for name, i in d.items():
         out[i] = name
@@ -57,13 +64,23 @@ def _names_by_id(d: dict, n: int) -> np.ndarray:
 
 
 def save_snapshot(snapshot: GraphSnapshot, path: str) -> None:
-    """Atomic write of the snapshot to `path` (an .npz file)."""
+    """Atomic write of the snapshot to `path` (an .npz file). ArrayMap
+    vocabularies (the columnar builder's) serialize via their vectorized
+    id-ordered key arrays — never a per-entry Python loop at 1e7+."""
+    from .snapshot import _SEP, ArrayMap
+
     n_obj = len(snapshot.obj_slots)
-    obj_ns = np.zeros(n_obj, dtype=np.int32)
-    obj_names = [""] * n_obj
-    for (ns, obj), slot in snapshot.obj_slots.items():
-        obj_ns[slot] = ns
-        obj_names[slot] = obj
+    if isinstance(snapshot.obj_slots, ArrayMap):
+        keys_by_id = snapshot.obj_slots.keys_by_id_array()
+        parts = np.char.partition(keys_by_id, _SEP)
+        obj_ns = parts[:, 0].astype(np.int32)
+        obj_names = parts[:, 2]
+    else:
+        obj_ns = np.zeros(n_obj, dtype=np.int32)
+        obj_names = [""] * n_obj
+        for (ns, obj), slot in snapshot.obj_slots.items():
+            obj_ns[slot] = ns
+            obj_names[slot] = obj
     payload = {k: getattr(snapshot, k) for k in _ARRAY_FIELDS}
     payload.update(
         {
@@ -122,14 +139,42 @@ def load_snapshot(path: str) -> Optional[GraphSnapshot]:
             }
     except (OSError, KeyError, ValueError, BadZipFile):
         return None
+    # big vocabs reload as ArrayMaps (sorted keys + explicit id values):
+    # rebuilding 1e7-entry Python dicts would pay the exact memory/CPU
+    # wall the columnar builder exists to avoid — defeating warm restart
+    if len(obj_names) > _ARRAY_VOCAB_THRESHOLD:
+        from .snapshot import (
+            ArrayMap,
+            _compose_keys,
+            _decode_obj_key,
+            _encode_obj_key,
+        )
+
+        composite = _compose_keys(obj_ns.astype(np.int64), obj_names)
+        order = np.argsort(composite, kind="stable")
+        obj_slots = ArrayMap(
+            composite[order],
+            encode=_encode_obj_key,
+            decode=_decode_obj_key,
+            values=order,
+        )
+    else:
+        obj_slots = {
+            (int(obj_ns[i]), str(obj_names[i])): i for i in range(len(obj_names))
+        }
+    if len(subj_names) > _ARRAY_VOCAB_THRESHOLD:
+        from .snapshot import ArrayMap
+
+        order = np.argsort(subj_names, kind="stable")
+        subj_ids = ArrayMap(subj_names[order], values=order)
+    else:
+        subj_ids = {str(n): i for i, n in enumerate(subj_names)}
     return GraphSnapshot(
         island_circuits=circuits,
         ns_ids={str(n): i for i, n in enumerate(ns_names)},
         rel_ids={str(n): i for i, n in enumerate(rel_names)},
-        obj_slots={
-            (int(obj_ns[i]), str(obj_names[i])): i for i in range(len(obj_names))
-        },
-        subj_ids={str(n): i for i, n in enumerate(subj_names)},
+        obj_slots=obj_slots,
+        subj_ids=subj_ids,
         **arrays,
         **ints,
     )

@@ -309,15 +309,37 @@ class _ChainLookup:
         return v
 
 
+class _ArrayIdLookup:
+    """id -> decoded key over an ArrayMap (no dict materialization: at
+    1e7+ slots inverting into a Python dict costs GBs and minutes —
+    exactly what the columnar vocab path exists to avoid)."""
+
+    __slots__ = ("_amap",)
+
+    def __init__(self, amap):
+        self._amap = amap
+
+    def __getitem__(self, i):
+        return self._amap.key_by_id(int(i))
+
+
 class ExpandDecoder:
     """Reverse vocabularies for decoding device ids back to strings."""
 
     def __init__(self, snapshot: Optional[GraphSnapshot]):
         if snapshot is not None:
+            from .snapshot import ArrayMap
+
             self.ns_names = {v: k for k, v in snapshot.ns_ids.items()}
             self.rel_names = {v: k for k, v in snapshot.rel_ids.items()}
-            self.slot_to_obj = {v: k for k, v in snapshot.obj_slots.items()}
-            self.subj_names = {v: k for k, v in snapshot.subj_ids.items()}
+            if isinstance(snapshot.obj_slots, ArrayMap):
+                self.slot_to_obj = _ArrayIdLookup(snapshot.obj_slots)
+            else:
+                self.slot_to_obj = {v: k for k, v in snapshot.obj_slots.items()}
+            if isinstance(snapshot.subj_ids, ArrayMap):
+                self.subj_names = _ArrayIdLookup(snapshot.subj_ids)
+            else:
+                self.subj_names = {v: k for k, v in snapshot.subj_ids.items()}
 
     def extended(self, overlay) -> "ExpandDecoder":
         """Decoder view including a VocabOverlay's additions; O(overlay),

@@ -258,15 +258,107 @@ def build_edge_tables(
     }
 
 
+_SEP = "\x1f"
+
+
+class ArrayMap:
+    """Sorted-numpy-backed replacement for the big vocab dicts.
+
+    At 1e7+ object slots a Python dict costs GBs and seconds of
+    insertion loop; this keeps the sorted unique key array from
+    np.unique (slot id == sorted position) and answers .get() with one
+    searchsorted. Encode/decode adapt composite keys ((ns_id, obj) <->
+    "ns_id\\x1fobj"). Implements the dict surface the snapshot/delta/
+    checkpoint code uses: get, in, len, items."""
+
+    def __init__(
+        self, sorted_keys: np.ndarray, encode=None, decode=None, values=None
+    ):
+        # values=None means the id IS the sorted position (the columnar
+        # builder's slot assignment); an explicit array supports key
+        # orders that differ from id order (checkpoint reload)
+        self._keys = sorted_keys
+        self._values = values
+        self._by_id: Optional[np.ndarray] = None  # lazy id -> raw key
+        self._encode = encode or (lambda k: k)
+        self._decode = decode or (lambda s: s)
+
+    def keys_by_id_array(self) -> np.ndarray:
+        """Raw (encoded) keys ordered by id — one vectorized inverse
+        permutation, cached. The reverse-lookup primitive for decoders
+        and checkpoint writes (never per-entry Python loops)."""
+        if self._by_id is None:
+            if self._values is None:
+                self._by_id = self._keys
+            else:
+                inv = np.empty(len(self._keys), dtype=np.int64)
+                inv[np.asarray(self._values, dtype=np.int64)] = np.arange(
+                    len(self._keys), dtype=np.int64
+                )
+                self._by_id = self._keys[inv]
+        return self._by_id
+
+    def key_by_id(self, i: int):
+        """Decoded key for one id (O(1) after the cached inverse)."""
+        return self._decode(str(self.keys_by_id_array()[i]))
+
+    def get(self, key, default=None):
+        k = self._encode(key)
+        i = int(np.searchsorted(self._keys, k))
+        if i < len(self._keys) and self._keys[i] == k:
+            return int(self._values[i]) if self._values is not None else i
+        return default
+
+    def __contains__(self, key) -> bool:
+        return self.get(key) is not None
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+    def items(self):
+        for i, k in enumerate(self._keys):
+            v = int(self._values[i]) if self._values is not None else i
+            yield self._decode(str(k)), v
+
+
+def _encode_obj_key(key) -> str:
+    ns_id, obj = key
+    return f"{ns_id}{_SEP}{obj}"
+
+
+def _decode_obj_key(s: str):
+    ns, _, obj = s.partition(_SEP)
+    return (int(ns), obj)
+
+
+def _compose_keys(ns_ids_arr: np.ndarray, objs: np.ndarray) -> np.ndarray:
+    """Vectorized "%d\\x1f%s" composite keys (the ns_id prefix contains
+    no separator, so the first separator always delimits correctly)."""
+    return np.char.add(
+        np.char.add(ns_ids_arr.astype("U11"), _SEP), objs.astype("U")
+    )
+
+
+def _sorted_lookup(keys_sorted, vals_sorted, queries, default=-1):
+    """Vectorized map lookup: queries -> vals via binary search."""
+    n = len(keys_sorted)
+    if n == 0:
+        return np.full(len(queries), default, dtype=np.int32)
+    idx = np.clip(np.searchsorted(keys_sorted, queries), 0, n - 1)
+    ok = keys_sorted[idx] == queries
+    return np.where(ok, vals_sorted[idx], default).astype(np.int32)
+
+
 @dataclass
 class GraphSnapshot:
     """Immutable device-ready mirror of one network's relation graph."""
 
-    # vocabularies (host-side dicts for query encoding)
+    # vocabularies for query encoding: plain dicts from the object-path
+    # builder, ArrayMaps from the columnar builder (same .get interface)
     ns_ids: dict[str, int]
     rel_ids: dict[str, int]
-    obj_slots: dict[tuple[int, str], int]  # (ns_id, object) -> slot
-    subj_ids: dict[str, int]  # plain subject string -> id
+    obj_slots: dict  # (ns_id, object) -> slot (dict or ArrayMap)
+    subj_ids: dict  # plain subject string -> id (dict or ArrayMap)
     n_config_rels: int  # rel ids < this may have rewrite programs
     wildcard_rel: int  # rel id of "..."
 
@@ -505,18 +597,7 @@ def build_snapshot(
     def subj_id(s: str) -> int:
         return subj_ids.setdefault(s, len(subj_ids))
 
-    # config-referenced relations first, so rewrite-capable rel ids are
-    # dense in [0, n_config_rels) and the program table stays small
-    rel_id(WILDCARD_RELATION)
-    for ns in namespaces:
-        ns_id(ns.name)
-        for rel in ns.relations:
-            rel_id(rel.name)
-            if rel.subject_set_rewrite is not None:
-                for kind, a, b in _walk_rewrite_relations(rel.subject_set_rewrite):
-                    rel_id(a)
-                    if b:
-                        rel_id(b)
+    _register_config_vocab(namespaces, ns_id, rel_id)
     n_config_rels = len(rel_ids)
 
     for t in tuples:
@@ -563,8 +644,35 @@ def build_snapshot(
     e_obj, e_rel = tables["e_obj"], tables["e_rel"]
 
     # ---- rewrite programs ---------------------------------------------------
-    # two passes: compile everything first so the stored K is the
-    # EFFECTIVE max program length (per-step kernel cost scales with K)
+    (
+        instr_kind, instr_rel, instr_rel2, prog_flags, K_eff, island_circuits,
+    ) = _build_programs(namespaces, ns_ids, rel_ids, n_config_rels, n_ns, K)
+
+    return GraphSnapshot(
+        ns_ids=ns_ids,
+        rel_ids=rel_ids,
+        obj_slots=obj_slots,
+        subj_ids=subj_ids,
+        n_config_rels=n_config_rels,
+        wildcard_rel=rel_ids[WILDCARD_RELATION],
+        objslot_ns=objslot_ns,
+        ns_has_config=ns_has_config,
+        dh_obj=dh_obj, dh_rel=dh_rel, dh_skind=dh_skind,
+        dh_sa=dh_sa, dh_sb=dh_sb, dh_val=dh_val, dh_probes=dh_probes,
+        rh_obj=rh_obj, rh_rel=rh_rel, rh_row=rh_row, rh_probes=rh_probes,
+        row_ptr=row_ptr, e_obj=e_obj, e_rel=e_rel,
+        instr_kind=instr_kind, instr_rel=instr_rel, instr_rel2=instr_rel2,
+        prog_flags=prog_flags, K=K_eff,
+        island_circuits=island_circuits,
+        version=version, n_tuples=n_t,
+    )
+
+
+def _build_programs(namespaces, ns_ids, rel_ids, n_config_rels, n_ns, K):
+    """Compile every namespace relation's rewrite into the dense program
+    tables; shared by the object-path and columnar builders. Two passes
+    so the stored K is the EFFECTIVE max program length (per-step kernel
+    cost scales with K)."""
     NR = n_ns * max(n_config_rels, 1)
     compiled: dict[int, tuple] = {}
     missing_flags: list[int] = []
@@ -601,6 +709,111 @@ def build_snapshot(
             instr_kind[pidx, k] = kind
             instr_rel[pidx, k] = a
             instr_rel2[pidx, k] = b
+    return instr_kind, instr_rel, instr_rel2, prog_flags, K_eff, island_circuits
+
+
+def _register_config_vocab(namespaces, ns_id, rel_id) -> None:
+    """Config-referenced relations first, so rewrite-capable rel ids are
+    dense in [0, n_config_rels) and the program table stays small."""
+    rel_id(WILDCARD_RELATION)
+    for ns in namespaces:
+        ns_id(ns.name)
+        for rel in ns.relations:
+            rel_id(rel.name)
+            if rel.subject_set_rewrite is not None:
+                for _kind, a, b in _walk_rewrite_relations(rel.subject_set_rewrite):
+                    rel_id(a)
+                    if b:
+                        rel_id(b)
+
+
+def build_snapshot_columnar(
+    cols,
+    namespaces: Sequence[Namespace],
+    K: int = 8,
+    version: int = 0,
+) -> GraphSnapshot:
+    """Columnar snapshot build: every per-tuple operation is a numpy
+    primitive (np.unique factorization + searchsorted joins), no Python
+    loop over tuples — the path that makes 1e7..1e8-edge ingest feasible
+    (round-1 VERDICT item 3; the reference's load generator tops out at
+    1e6 via CLI, scripts/create-many-tuples.sh).
+
+    `cols` is a storage.columns.TupleColumns. Vocabulary ids differ from
+    build_snapshot's insertion order (sorted-unique instead), which is
+    semantically irrelevant: ids never leave the engine. Big vocabs
+    (object slots, subjects) become ArrayMaps instead of dicts."""
+    from ..storage.columns import TupleColumns  # noqa: F401 (doc anchor)
+
+    ns_ids: dict[str, int] = {}
+    rel_ids: dict[str, int] = {}
+    _register_config_vocab(
+        namespaces,
+        lambda name: ns_ids.setdefault(name, len(ns_ids)),
+        lambda name: rel_ids.setdefault(name, len(rel_ids)),
+    )
+    n_config_rels = len(rel_ids)
+
+    is_set = cols.skind == 1
+    n_t = len(cols)
+
+    # data namespaces/relations join the small dicts in sorted order
+    for name in np.unique(np.concatenate([cols.ns, cols.sns[is_set]])):
+        ns_ids.setdefault(str(name), len(ns_ids))
+    for name in np.unique(np.concatenate([cols.rel, cols.srel[is_set]])):
+        rel_ids.setdefault(str(name), len(rel_ids))
+
+    def small_lookup(d: dict, queries: np.ndarray) -> np.ndarray:
+        keys = np.array(sorted(d.keys()), dtype="U")
+        vals = np.array([d[str(k)] for k in keys], dtype=np.int32)
+        return _sorted_lookup(keys, vals, queries.astype("U"))
+
+    t_ns = small_lookup(ns_ids, cols.ns)
+    t_rel = small_lookup(rel_ids, cols.rel)
+    s_ns = np.where(is_set, small_lookup(ns_ids, cols.sns), 0)
+    s_rel = np.where(is_set, small_lookup(rel_ids, cols.srel), 0)
+
+    # object slots: sorted-unique composite (ns_id, object) keys; the
+    # slot id IS the sorted position, so encoding = one searchsorted
+    own_keys = _compose_keys(t_ns, cols.obj)
+    set_keys = _compose_keys(s_ns[is_set], cols.sobj[is_set])
+    all_keys = np.concatenate([own_keys, set_keys])
+    all_ns = np.concatenate([t_ns, s_ns[is_set]])
+    uniq_keys, first_idx = (
+        np.unique(all_keys, return_index=True)
+        if len(all_keys)
+        else (np.array([], dtype="U1"), np.array([], dtype=np.int64))
+    )
+    obj_slots = ArrayMap(uniq_keys, encode=_encode_obj_key, decode=_decode_obj_key)
+    t_obj = np.searchsorted(uniq_keys, own_keys).astype(np.int32)
+    sa_set = np.searchsorted(uniq_keys, set_keys).astype(np.int32)
+
+    plain = ~is_set
+    subj_keys = np.unique(cols.sobj[plain]) if plain.any() else np.array([], "U1")
+    subj_ids = ArrayMap(subj_keys)
+    sa_plain = np.searchsorted(subj_keys, cols.sobj[plain]).astype(np.int32)
+
+    t_skind = cols.skind.astype(np.int32)
+    t_sa = np.zeros(n_t, dtype=np.int32)
+    t_sb = np.zeros(n_t, dtype=np.int32)
+    t_sa[is_set] = sa_set
+    t_sb[is_set] = s_rel[is_set]
+    t_sa[plain] = sa_plain
+
+    tables = build_edge_tables(t_obj, t_rel, t_skind, t_sa, t_sb)
+
+    n_ns = max(len(ns_ids), 1)
+    objslot_ns = np.zeros(pad_headroom(max(len(uniq_keys), 1)), dtype=np.int32)
+    if len(uniq_keys):
+        objslot_ns[: len(uniq_keys)] = all_ns[first_idx]
+    ns_has_config = np.zeros(pad_headroom(n_ns, 64), dtype=np.int32)
+    for ns in namespaces:
+        if ns.relations:
+            ns_has_config[ns_ids[ns.name]] = 1
+
+    (
+        instr_kind, instr_rel, instr_rel2, prog_flags, K_eff, island_circuits,
+    ) = _build_programs(namespaces, ns_ids, rel_ids, n_config_rels, n_ns, K)
 
     return GraphSnapshot(
         ns_ids=ns_ids,
@@ -611,10 +824,14 @@ def build_snapshot(
         wildcard_rel=rel_ids[WILDCARD_RELATION],
         objslot_ns=objslot_ns,
         ns_has_config=ns_has_config,
-        dh_obj=dh_obj, dh_rel=dh_rel, dh_skind=dh_skind,
-        dh_sa=dh_sa, dh_sb=dh_sb, dh_val=dh_val, dh_probes=dh_probes,
-        rh_obj=rh_obj, rh_rel=rh_rel, rh_row=rh_row, rh_probes=rh_probes,
-        row_ptr=row_ptr, e_obj=e_obj, e_rel=e_rel,
+        dh_obj=tables["dh_obj"], dh_rel=tables["dh_rel"],
+        dh_skind=tables["dh_skind"], dh_sa=tables["dh_sa"],
+        dh_sb=tables["dh_sb"], dh_val=tables["dh_val"],
+        dh_probes=tables["dh_probes"],
+        rh_obj=tables["rh_obj"], rh_rel=tables["rh_rel"],
+        rh_row=tables["rh_row"], rh_probes=tables["rh_probes"],
+        row_ptr=tables["row_ptr"], e_obj=tables["e_obj"],
+        e_rel=tables["e_rel"],
         instr_kind=instr_kind, instr_rel=instr_rel, instr_rel2=instr_rel2,
         prog_flags=prog_flags, K=K_eff,
         island_circuits=island_circuits,

@@ -40,7 +40,7 @@ from .definitions import CheckResult, Membership
 from .delta import SnapshotView, empty_delta_tables
 from .kernel import check_kernel, kernel_static_config, snapshot_tables
 from .reference import ReferenceEngine
-from .snapshot import GraphSnapshot, build_snapshot
+from .snapshot import GraphSnapshot, build_snapshot, build_snapshot_columnar
 
 _BUCKETS = (16, 256, 1024, 4096)
 
@@ -79,6 +79,7 @@ class TPUCheckEngine:
         rewrite_instr_cap: int = 8,
         mesh=None,
         metrics=None,
+        tracer=None,
         auto_frontier: bool = True,
     ):
         self.manager = manager
@@ -106,6 +107,7 @@ class TPUCheckEngine:
         # throttled snapshots are DEFERRED (timer), never dropped, so the
         # last compaction before an idle period still reaches disk
         self._persist_mu = threading.Lock()
+        self._write_mu = threading.Lock()
         self._pending_persist: Optional[GraphSnapshot] = None
         self._persist_timer: Optional[threading.Timer] = None
         self._last_persist = 0.0
@@ -116,6 +118,11 @@ class TPUCheckEngine:
         # `metrics` is an optional observability.Metrics mirror of the same
         self.stats = {"device_checks": 0, "host_checks": 0, "snapshot_builds": 0}
         self.metrics = metrics
+        if tracer is None:
+            from ..observability import _NoopTracer
+
+            tracer = _NoopTracer()
+        self.tracer = tracer
 
     # -- snapshot lifecycle ---------------------------------------------------
 
@@ -141,9 +148,11 @@ class TPUCheckEngine:
                 state = self._delta_refresh(state, store_version)
                 rebuild = state is None
             if rebuild:
-                state, persist_snap = self._rebuild(
-                    store_version, config_fp, namespaces
-                )
+                with self.tracer.span("engine.snapshot_build") as sp:
+                    state, persist_snap = self._rebuild(
+                        store_version, config_fp, namespaces
+                    )
+                    sp.set_attribute("tuples", state.snapshot.n_tuples)
             self._state = state
         if persist_snap is not None:
             self._maybe_persist(persist_snap)
@@ -189,23 +198,24 @@ class TPUCheckEngine:
         self._flush_deferred()
 
     def _flush_deferred(self) -> None:
+        """Take the pending snapshot under the mutex, write it OUTSIDE —
+        _persist_mu protects only the pending/timer fields, never the
+        O(edges) compressed write, so a serve thread scheduling the next
+        persist can't stall behind an in-flight one. _write_mu serializes
+        the actual file writes (rename ordering)."""
+        from .checkpoint import save_snapshot
+
         cache_path = self._mirror_cache_path()
         with self._persist_mu:
             self._persist_timer = None
-            if cache_path is not None:
-                self._flush_pending_locked(cache_path)
-
-    def _flush_pending_locked(self, cache_path: str) -> None:
-        """Write the pending snapshot (caller holds _persist_mu)."""
-        from .checkpoint import save_snapshot
-
-        snap = self._pending_persist
-        self._pending_persist = None
-        if snap is None:
+            snap, self._pending_persist = self._pending_persist, None
+        if cache_path is None or snap is None:
             return
         try:
-            save_snapshot(snap, cache_path)
-            self._last_persist = time.monotonic()
+            with self._write_mu:
+                save_snapshot(snap, cache_path)
+            with self._persist_mu:
+                self._last_persist = time.monotonic()
         except OSError as err:  # cache write failure must not block serving
             import logging
 
@@ -268,12 +278,23 @@ class TPUCheckEngine:
         # carry the base full-CSR + base decoder forward; the dirty tables
         # and overlay extension re-derive from the fresh delta (O(delta))
         if state.expand_tables is not None:
-            base_csr = {
-                k: v
-                for k, v in state.expand_tables.items()
-                if not k.startswith("dirty_")
-            }
-            new_state.expand_tables = self._merge_expand_dirty(base_csr, delta)
+            if self.mesh is not None:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                sharded_csr, _ = state.expand_tables
+                fresh_dirty = {
+                    k: jax.device_put(delta[k], NamedSharding(self.mesh, P()))
+                    for k in ("dirty_obj", "dirty_rel", "dirty_val")
+                }
+                new_state.expand_tables = (sharded_csr, fresh_dirty)
+            else:
+                base_csr = {
+                    k: v
+                    for k, v in state.expand_tables.items()
+                    if not k.startswith("dirty_")
+                }
+                new_state.expand_tables = self._merge_expand_dirty(base_csr, delta)
             new_state.fh_probes = state.fh_probes
             new_state.base_decoder = state.base_decoder
             new_state.decoder = state.base_decoder.extended(overlay)
@@ -325,6 +346,34 @@ class TPUCheckEngine:
                 self.stats["snapshot_loads"] = self.stats.get("snapshot_loads", 0) + 1
                 return state, None
         build_start = time.perf_counter()
+        # columnar fast path: stores exposing all_tuple_columns feed the
+        # vectorized builder directly — no per-tuple Python objects on
+        # the ingest path (the 1e7..1e8-scale requirement)
+        columns_fn = getattr(self.manager, "all_tuple_columns", None)
+        if columns_fn is not None and self.mesh is None:
+            snap = build_snapshot_columnar(
+                columns_fn(nid=self.nid), namespaces,
+                K=self.rewrite_instr_cap, version=version,
+            )
+            tables = snapshot_tables(snap)
+            state = _EngineState(
+                snapshot=snap,
+                view=SnapshotView(snap),
+                sharded=None,
+                tables=tables,
+                delta_np=empty_delta_tables(),
+                base_version=store_version,
+                covered_version=store_version,
+                config_fp=config_fp,
+            )
+            self.stats["snapshot_builds"] += 1
+            if self.metrics is not None:
+                self.metrics.snapshot_builds_total.inc()
+                self.metrics.snapshot_tuples.set(snap.n_tuples)
+                self.metrics.snapshot_build_duration.observe(
+                    time.perf_counter() - build_start
+                )
+            return state, (snap if self.mesh is None else None)
         tuples = self.manager.all_relation_tuples(nid=self.nid)
         sharded = None
         if self.mesh is not None:
@@ -389,6 +438,23 @@ class TPUCheckEngine:
             if state.expand_tables is not None:  # raced with another filler
                 return state
             tuples = self.manager.all_relation_tuples(nid=self.nid)
+            if self.mesh is not None:
+                # sharded full CSR: same object-slot partition as check
+                from ..parallel.expand import place_sharded_expand_tables
+                from ..parallel.sharding import build_sharded_full_csr
+
+                stacked, fh_probes = build_sharded_full_csr(
+                    list(tuples), state.snapshot,
+                    n_shards=self.mesh.devices.size, view=state.view,
+                )
+                state.fh_probes = fh_probes
+                state.base_decoder = ExpandDecoder(state.snapshot)
+                state.decoder = state.base_decoder.extended(state.view.overlay)
+                state.expand_tables = place_sharded_expand_tables(
+                    stacked, state.delta_np, self.mesh,
+                    axis=self.mesh.axis_names[0],
+                )
+                return state
             csr = build_full_csr(list(tuples), state.snapshot, view=state.view)
             fh_probes = csr.pop("fh_probes")
             device_csr = {k: jnp.asarray(v) for k, v in csr.items()}
@@ -471,19 +537,35 @@ class TPUCheckEngine:
             q_obj[i], q_rel[i] = node
             q_valid[i] = True
 
-        eb = expand_kernel(
-            state.expand_tables,
-            q_obj, q_rel,
-            np.full(B, depth, dtype=np.int32),
-            q_valid,
-            fh_probes=state.fh_probes,
-            # static step budget keyed to the GLOBAL depth cap, not the
-            # per-call depth (avoids one recompile per requested depth);
-            # the loop exits early once the frontier drains
-            max_steps=global_max + 2,
-            frontier_cap=max(frontier_cap, B),
-            edge_cap=edge_cap,
-        )
+        if self.mesh is not None:
+            from ..parallel.expand import sharded_expand_kernel
+
+            sharded_csr, replicated_dirty = state.expand_tables
+            eb = sharded_expand_kernel(
+                self.mesh, sharded_csr, replicated_dirty,
+                q_obj, q_rel,
+                np.full(B, depth, dtype=np.int32),
+                q_valid,
+                fh_probes=state.fh_probes,
+                max_steps=global_max + 2,
+                frontier_cap=max(frontier_cap, B),
+                edge_cap=edge_cap,
+                axis=self.mesh.axis_names[0],
+            )
+        else:
+            eb = expand_kernel(
+                state.expand_tables,
+                q_obj, q_rel,
+                np.full(B, depth, dtype=np.int32),
+                q_valid,
+                fh_probes=state.fh_probes,
+                # static step budget keyed to the GLOBAL depth cap, not the
+                # per-call depth (avoids one recompile per requested depth);
+                # the loop exits early once the frontier drains
+                max_steps=global_max + 2,
+                frontier_cap=max(frontier_cap, B),
+                edge_cap=edge_cap,
+            )
         eb_pobj, eb_prel, eb_skind, eb_sa, eb_sb = (np.asarray(x) for x in eb[:5])
         eb_count = np.asarray(eb[5])
         root_has_children = np.asarray(eb[6])
@@ -566,32 +648,40 @@ class TPUCheckEngine:
         # the batch so island-heavy workloads don't immediately overflow
         # to host replay (overflow is safe, just slow)
         island_cap = 2 * B if state.snapshot.island_circuits else 0
-        if self.mesh is not None:
-            from ..parallel.kernel import sharded_check_kernel, sharded_static_config
+        with self.tracer.span(
+            "engine.kernel_launch", batch=B, frontier=launch_cap
+        ):
+            if self.mesh is not None:
+                from ..parallel.kernel import (
+                    sharded_check_kernel,
+                    sharded_static_config,
+                )
 
-            statics = sharded_static_config(
-                state.sharded, global_max, launch_cap,
-                n_island_cap=island_cap, has_delta=state.has_delta,
-            )
-            sharded_tables, replicated_tables = state.tables
-            ctx_hit, needs_host, isl_parent, isl_pid, n_isl = sharded_check_kernel(
-                self.mesh, sharded_tables, replicated_tables,
-                q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid,
-                statics=statics, axis=self.mesh.axis_names[0],
-            )
-        else:
-            cfg = kernel_static_config(
-                state.snapshot, global_max, launch_cap,
-                n_island_cap=island_cap, has_delta=state.has_delta,
-            )
-            ctx_hit, needs_host, isl_parent, isl_pid, n_isl = check_kernel(
-                state.tables,
-                q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid,
-                **cfg,
-            )
-        ctx_hit = np.asarray(ctx_hit).copy()
-        needs_host = np.asarray(needs_host)
-        n_isl = int(n_isl)
+                statics = sharded_static_config(
+                    state.sharded, global_max, launch_cap,
+                    n_island_cap=island_cap, has_delta=state.has_delta,
+                )
+                sharded_tables, replicated_tables = state.tables
+                ctx_hit, needs_host, isl_parent, isl_pid, n_isl = (
+                    sharded_check_kernel(
+                        self.mesh, sharded_tables, replicated_tables,
+                        q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid,
+                        statics=statics, axis=self.mesh.axis_names[0],
+                    )
+                )
+            else:
+                cfg = kernel_static_config(
+                    state.snapshot, global_max, launch_cap,
+                    n_island_cap=island_cap, has_delta=state.has_delta,
+                )
+                ctx_hit, needs_host, isl_parent, isl_pid, n_isl = check_kernel(
+                    state.tables,
+                    q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid,
+                    **cfg,
+                )
+            ctx_hit = np.asarray(ctx_hit).copy()
+            needs_host = np.asarray(needs_host)
+            n_isl = int(n_isl)
         if n_isl:
             from .islands import combine_islands
 
@@ -604,18 +694,22 @@ class TPUCheckEngine:
 
         results: list[CheckResult] = []
         n_host = 0
-        for i, t in enumerate(tuples):
-            if i < B and q_valid[i] and not needs_host[i]:
-                results.append(
-                    CheckResult(
-                        Membership.IS_MEMBER if member[i] else Membership.NOT_MEMBER
+        with self.tracer.span("engine.resolve_batch", batch=n) as sp:
+            for i, t in enumerate(tuples):
+                if i < B and q_valid[i] and not needs_host[i]:
+                    results.append(
+                        CheckResult(
+                            Membership.IS_MEMBER
+                            if member[i]
+                            else Membership.NOT_MEMBER
+                        )
                     )
-                )
-            else:
-                n_host += 1
-                results.append(
-                    self.reference.check_relation_tuple(t, max_depth, self.nid)
-                )
+                else:
+                    n_host += 1
+                    results.append(
+                        self.reference.check_relation_tuple(t, max_depth, self.nid)
+                    )
+            sp.set_attribute("host_replays", n_host)
         self.stats["device_checks"] += n - n_host
         self.stats["host_checks"] += n_host
         if self.metrics is not None:

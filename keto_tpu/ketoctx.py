@@ -1,0 +1,61 @@
+"""Embedding/extension hooks: per-request tenancy (Contextualizer).
+
+Parity with the reference's ketoctx package: embedders derive the
+network id per request instead of pinning one at startup
+(/root/reference/ketoctx/contextualizer.go:12-19 `Contextualizer.
+Network(ctx, fallback)`; the SQL persister resolves it per query,
+internal/persistence/sql/persister.go:93-95).
+
+Here the request context is the transport metadata mapping (HTTP
+headers / gRPC invocation metadata, case-insensitive keys). The stores
+are already nid-scoped (every Manager method takes nid=) and the TPU
+engine keeps one device mirror per network, so the registry only needs
+the hook plus a per-nid engine cache (registry.check_engine(nid)).
+
+Enable via config:
+
+    tenancy:
+      header: x-keto-network   # derive nid from this header/metadata key
+
+or programmatically: Registry(cfg, contextualizer=MyContextualizer()).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Protocol
+
+
+class Contextualizer(Protocol):
+    def network(self, metadata: Mapping[str, str], fallback: str) -> str:
+        """The network id for one request; `fallback` is the registry's
+        configured default."""
+        ...
+
+
+class DefaultContextualizer:
+    """Single-tenant: always the configured network (the reference's
+    defaultContextualizer)."""
+
+    def network(self, metadata: Mapping[str, str], fallback: str) -> str:
+        return fallback
+
+
+class HeaderContextualizer:
+    """Tenant id from a transport metadata key (HTTP header or gRPC
+    metadata); missing/empty falls back to the default network."""
+
+    def __init__(self, header: str):
+        self.header = header.lower()
+
+    def network(self, metadata: Mapping[str, str], fallback: str) -> str:
+        for k, v in metadata.items():
+            if str(k).lower() == self.header and v:
+                return str(v)
+        return fallback
+
+
+def from_config(config) -> Optional[Contextualizer]:
+    header = config.get("tenancy.header", None)
+    if header:
+        return HeaderContextualizer(str(header))
+    return None

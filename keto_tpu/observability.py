@@ -101,6 +101,70 @@ class _NoopTracer:
         yield _NoopSpan()
 
 
+class RecordedSpan:
+    __slots__ = ("name", "attrs")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+
+    def set_attribute(self, key, value):
+        self.attrs[key] = value
+
+    def record_exception(self, err):
+        self.attrs["exception"] = repr(err)
+
+
+class RecordingTracer:
+    """In-memory span recorder (`tracing.provider: memory`): the test/
+    debug exporter — this image ships only the OTel API, not the SDK, so
+    span visibility needs a built-in sink. Thread-safe append-only."""
+
+    def __init__(self, cap: int = 4096):
+        import collections
+
+        self.spans = collections.deque(maxlen=cap)
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        s = RecordedSpan(name, dict(attrs))
+        self.spans.append(s)
+        yield s
+
+    def span_names(self) -> list:
+        return [s.name for s in self.spans]
+
+
+class TracedManager:
+    """Span-per-store-op proxy around any Manager implementation — the
+    analog of the reference's otel spans in every persister method
+    (internal/persistence/sql/relationtuples.go:203-205 etc.) without
+    touching the store classes."""
+
+    _TRACED = (
+        "get_relation_tuples", "write_relation_tuples",
+        "delete_relation_tuples", "delete_all_relation_tuples",
+        "transact_relation_tuples", "relation_tuple_exists",
+        "all_relation_tuples",
+    )
+
+    def __init__(self, inner, tracer):
+        self._inner = inner
+        self._tracer = tracer
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name in self._TRACED and callable(attr):
+            tracer = self._tracer
+
+            def traced(*args, **kwargs):
+                with tracer.span(f"persistence.{name}"):
+                    return attr(*args, **kwargs)
+
+            return traced
+        return attr
+
+
 class _OtelTracer:
     def __init__(self, service_name: str):
         from opentelemetry import trace
@@ -116,8 +180,11 @@ class _OtelTracer:
 
 
 def build_tracer(config):
-    """ref: otelx tracer built once from config (registry_default.go:118-129)."""
+    """ref: otelx tracer built once from config (registry_default.go:118-129).
+    `tracing.provider: memory` selects the in-process recording sink."""
     if config.get("tracing.enabled", False):
+        if config.get("tracing.provider", "otel") == "memory":
+            return RecordingTracer()
         try:
             return _OtelTracer(config.get("tracing.service_name", "keto_tpu"))
         except Exception as e:  # otel mis-setup must never block serving

@@ -162,6 +162,95 @@ def build_sharded_snapshot(
     )
 
 
+_EXPAND_SHARDED_KEYS = (
+    "fh_obj", "fh_rel", "fh_row", "f_row_ptr", "f_skind", "f_sa", "f_sb",
+)
+
+
+def build_sharded_full_csr(
+    tuples: Sequence[RelationTuple],
+    snapshot: GraphSnapshot,
+    n_shards: int,
+    view=None,
+) -> tuple[dict[str, np.ndarray], int]:
+    """Shard the expand kernel's FULL-edge CSR (subject-id leaves AND
+    subject-set children) by object slot — the same partition as the
+    check tables, so a row lives on exactly one shard and expansion is
+    local to the owner (VERDICT round-1 item 6: expand previously placed
+    the whole CSR on one device even under a mesh).
+
+    Returns (stacked tables [n_shards, ...], fh_probes)."""
+    from ..engine.delta import SnapshotView
+    from ..engine.snapshot import group_rows_csr
+
+    view = view or SnapshotView(snapshot)
+    n_t = len(tuples)
+    t_obj = np.zeros(n_t, dtype=np.int32)
+    t_rel = np.zeros(n_t, dtype=np.int32)
+    t_skind = np.zeros(n_t, dtype=np.int32)
+    t_sa = np.zeros(n_t, dtype=np.int32)
+    t_sb = np.zeros(n_t, dtype=np.int32)
+    keep = np.zeros(n_t, dtype=bool)
+    for i, t in enumerate(tuples):
+        node = view.encode_node(t.namespace, t.object, t.relation)
+        subject = view.encode_subject(t)
+        if node is None or subject is None:
+            continue
+        t_obj[i], t_rel[i] = node
+        t_skind[i], t_sa[i], t_sb[i] = subject
+        keep[i] = True
+    t_obj, t_rel = t_obj[keep], t_rel[keep]
+    t_skind, t_sa, t_sb = t_skind[keep], t_sa[keep], t_sb[keep]
+
+    shard = shard_of_objslot(t_obj, n_shards)
+    masks = [shard == s for s in range(n_shards)]
+    def n_rows_of(m) -> int:
+        if not m.any():
+            return 0
+        key = t_obj[m].astype(np.int64) * (1 << 31) + t_rel[m].astype(np.int64)
+        return int(np.unique(key).size)
+
+    fh_cap = max(hash_table_capacity(n_rows_of(m)) for m in masks)
+    while True:
+        per_shard = []
+        for m in masks:
+            fh_obj, fh_rel, fh_row, probes, row_ptr, (sk, sa, sb) = (
+                group_rows_csr(
+                    t_obj[m], t_rel[m],
+                    (t_skind[m], t_sa[m], t_sb[m]),
+                    min_capacity=fh_cap,
+                )
+            )
+            per_shard.append({
+                "fh_obj": fh_obj, "fh_rel": fh_rel, "fh_row": fh_row,
+                "fh_probes": probes, "f_row_ptr": row_ptr,
+                "f_skind": sk, "f_sa": sa, "f_sb": sb,
+            })
+        got = max(t["fh_obj"].shape[0] for t in per_shard)
+        if got == fh_cap:
+            break
+        fh_cap = got  # pathological clustering: rebuild at the new cap
+
+    max_rows = max(t["f_row_ptr"].shape[0] for t in per_shard)
+    max_edges = max(t["f_skind"].shape[0] for t in per_shard)
+    stacked: dict[str, np.ndarray] = {}
+    for key in _EXPAND_SHARDED_KEYS:
+        parts = []
+        for t in per_shard:
+            a = t[key]
+            if key == "f_row_ptr" and a.shape[0] < max_rows:
+                a = np.concatenate(
+                    [a, np.full(max_rows - a.shape[0], a[-1], dtype=a.dtype)]
+                )
+            elif key in ("f_skind", "f_sa", "f_sb") and a.shape[0] < max_edges:
+                a = np.concatenate(
+                    [a, np.zeros(max_edges - a.shape[0], dtype=a.dtype)]
+                )
+            parts.append(a)
+        stacked[key] = np.stack(parts)
+    return stacked, max(t["fh_probes"] for t in per_shard)
+
+
 def default_mesh(n_devices: int = 0, axis: str = "x"):
     """A 1-D device mesh over the first `n_devices` (all when 0)."""
     import jax

@@ -85,6 +85,7 @@ class Registry:
         config: Optional[Config] = None,
         nid: str = DEFAULT_NETWORK,
         mesh=None,
+        contextualizer=None,
     ):
         self.config = config or Config()
         self.nid = nid
@@ -93,6 +94,19 @@ class Registry:
         self._lock = threading.RLock()
         self._manager = None
         self._engine = None
+        # per-request tenancy (ketoctx.Contextualizer analog): nid_for()
+        # derives the network from transport metadata; engines are cached
+        # per nid (each network has its own device mirror)
+        if contextualizer is None:
+            from . import ketoctx
+
+            contextualizer = ketoctx.from_config(self.config)
+        self.contextualizer = contextualizer
+        import collections
+
+        self._nid_engines: "collections.OrderedDict[str, object]" = (
+            collections.OrderedDict()
+        )
         self._metrics = None
         self._tracer = None
         # health: flipped by the daemon around serving
@@ -107,46 +121,119 @@ class Registry:
                 dsn = self.config.dsn
                 if dsn == "memory":
                     self._manager = MemoryManager()
+                elif dsn == "columnar":
+                    # scale tier: numpy-column store (1e8-tuple ingest)
+                    from .storage.columnar import ColumnarStore
+
+                    self._manager = ColumnarStore()
                 elif dsn.startswith("sqlite://"):
                     self._manager = SQLitePersister(dsn.removeprefix("sqlite://"))
                 else:
                     raise ValueError(f"unsupported DSN: {dsn!r}")
+                # span-per-store-op when tracing (ref: otel spans in every
+                # persister method, relationtuples.go:203-205)
+                if self.config.get("tracing.enabled", False):
+                    from .observability import TracedManager
+
+                    self._manager = TracedManager(self._manager, self.tracer())
             return self._manager
 
     # -- engines --------------------------------------------------------------
 
-    def check_engine(self):
-        """The configured check engine; `check.engine` selects `tpu`
-        (batched device kernel + exact host fallback) or `host` (pure
-        reference semantics)."""
+    # client-supplied tenant ids are untrusted input: they become store
+    # scopes, engine-cache keys, and checkpoint file names — constrain
+    # the alphabet (no path separators) and length before any of that
+    _NID_RE = __import__("re").compile(r"^[A-Za-z0-9._-]{1,128}$")
+
+    def nid_for(self, metadata=None) -> str:
+        """The network id for one request (ref: Contextualizer.Network,
+        /root/reference/ketoctx/contextualizer.go:12-19); metadata is the
+        transport's header/metadata mapping. A malformed tenant id is a
+        client error (400), never a silent fallback to the default
+        network (that would serve another tenant's data)."""
+        if self.contextualizer is None or metadata is None:
+            return self.nid
+        nid = self.contextualizer.network(metadata, self.nid)
+        if nid != self.nid and not self._NID_RE.match(nid):
+            from .errors import MalformedInputError
+
+            raise MalformedInputError(debug=f"invalid network id {nid!r}")
+        return nid
+
+    def check_engine(self, nid: Optional[str] = None):
+        """The configured check engine for one network; `check.engine`
+        selects `tpu` (batched device kernel + exact host fallback) or
+        `host` (pure reference semantics). Engines are cached per nid
+        with an LRU bound (`tenancy.max_networks`) so arbitrary tenant
+        ids can't grow memory without limit; evicted engines flush any
+        pending mirror checkpoint and are rebuilt on demand."""
+        if nid is None or nid == self.nid:
+            with self._lock:
+                if self._engine is None:
+                    self._engine = self._build_engine(self.nid)
+                return self._engine
+        evicted: list = []
         with self._lock:
-            if self._engine is None:
-                kind = self.config.get("check.engine", "tpu")
-                manager = self.relation_tuple_manager()
-                if kind == "tpu":
-                    from .engine.tpu_engine import TPUCheckEngine
+            engine = self._nid_engines.pop(nid, None)
+            if engine is None:
+                engine = self._build_engine(nid)
+                cap = int(self.config.get("tenancy.max_networks", 64))
+                while len(self._nid_engines) >= max(cap, 1):
+                    evicted.append(self._nid_engines.popitem(last=False)[1])
+            self._nid_engines[nid] = engine  # (re-)insert at MRU
+        if evicted:
+            # flush EVERY evicted engine's pending checkpoint, off the
+            # request thread (the compressed write can take seconds)
+            def _flush_evicted(engines=tuple(evicted)):
+                for e in engines:
+                    flush = getattr(e, "flush_checkpoints", None)
+                    if flush is not None:
+                        flush()
 
-                    self._engine = TPUCheckEngine(
-                        manager, self.config, nid=self.nid, mesh=self.mesh,
-                        metrics=self.metrics(),
-                        frontier_cap=int(
-                            self.config.get("check.frontier_cap", 1 << 14)
-                        ),
-                        auto_frontier=bool(
-                            self.config.get("check.auto_frontier", True)
-                        ),
-                    )
-                elif kind == "host":
-                    self._engine = _HostEngineFacade(
-                        ReferenceEngine(manager, self.config), self.nid,
-                        metrics=self.metrics(),
-                    )
-                else:
-                    raise ValueError(f"unknown check.engine: {kind!r}")
-            return self._engine
+            t = threading.Thread(
+                target=_flush_evicted, name="keto-evict-flush", daemon=True
+            )
+            t.start()
+        return engine
 
-    def expand_engine(self):
-        return self.check_engine()
+    def flush_checkpoints(self) -> None:
+        """Flush pending device-mirror checkpoints for EVERY cached
+        engine (default network + all tenants); the daemon calls this on
+        graceful shutdown."""
+        with self._lock:
+            engines = list(self._nid_engines.values())
+            if self._engine is not None:
+                engines.append(self._engine)
+        for engine in engines:
+            flush = getattr(engine, "flush_checkpoints", None)
+            if flush is not None:
+                flush()
+
+    def _build_engine(self, nid: str):
+        kind = self.config.get("check.engine", "tpu")
+        manager = self.relation_tuple_manager()
+        if kind == "tpu":
+            from .engine.tpu_engine import TPUCheckEngine
+
+            return TPUCheckEngine(
+                manager, self.config, nid=nid, mesh=self.mesh,
+                metrics=self.metrics(), tracer=self.tracer(),
+                frontier_cap=int(
+                    self.config.get("check.frontier_cap", 1 << 14)
+                ),
+                auto_frontier=bool(
+                    self.config.get("check.auto_frontier", True)
+                ),
+            )
+        if kind == "host":
+            return _HostEngineFacade(
+                ReferenceEngine(manager, self.config), nid,
+                metrics=self.metrics(),
+            )
+        raise ValueError(f"unknown check.engine: {kind!r}")
+
+    def expand_engine(self, nid: Optional[str] = None):
+        return self.check_engine(nid)
 
     def namespace_manager(self):
         return self.config.namespace_manager()

@@ -1,0 +1,337 @@
+"""Columnar authoritative tuple store: numpy arrays instead of objects.
+
+The scale tier of the storage layer (SURVEY §2.5 / §7 "1e8-tuple ingest
+to HBM"): tuples live as seven parallel numpy columns per network
+(storage/columns.py) plus a small Python-list write buffer, so resident
+cost is ~100 bytes/tuple instead of the ~500+ of a Python RelationTuple
+in MemoryManager — 1e8 tuples fit in tens of GB of host RAM and every
+bulk transformation (dedupe, filter, snapshot encode) is a numpy
+primitive, never a Python loop.
+
+Implements the same Manager surface as MemoryManager/SQLitePersister
+(storage/definitions.py) with the same semantics:
+  - idempotent insert per (nid, tuple) (UUID-keyed upsert analog,
+    internal/persistence/sql/relationtuples.go:246-258)
+  - keyset pagination ordered by deterministic shard id with the N+1
+    next-page probe (:203-244). The filter runs vectorized over the
+    columns first; the per-row Python costs (uuid5 shard id,
+    RelationTuple object) are paid only for MATCHING rows, so forward
+    queries on a 1e8-row store stay proportional to the row length
+  - per-nid isolation (QueryWithNetwork, persister.go:85-87)
+  - bounded change log for the engine's delta overlay; bulk_load resets
+    the log floor so the engine correctly falls back to a full rebuild
+
+Extra surface for the scale path:
+  - bulk_load(cols, nid): columnar append, dedup included
+  - all_tuple_columns(nid): zero-copy view the columnar snapshot
+    builder consumes directly (engine/snapshot.build_snapshot_columnar)
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import deque
+from typing import Iterable, Optional, Sequence
+
+import numpy as np
+
+from ..ketoapi import RelationQuery, RelationTuple
+from .columns import TupleColumns, concat_columns
+from .definitions import (
+    DEFAULT_NETWORK,
+    DEFAULT_PAGE_SIZE,
+    shard_id,
+    validate_page_token,
+)
+
+CHANGE_LOG_CAP = 1 << 16
+_SEP = "\x1f"
+# merge the write buffer into the columnar base past this size
+_BUFFER_MERGE_THRESHOLD = 4096
+
+
+def _identity_keys(cols: TupleColumns) -> np.ndarray:
+    """Vectorized canonical identity key per row (insert idempotence)."""
+    parts = [
+        cols.ns, cols.obj, cols.rel,
+        cols.skind.astype("U1"), cols.sns, cols.sobj, cols.srel,
+    ]
+    out = parts[0].astype("U")
+    for p in parts[1:]:
+        out = np.char.add(np.char.add(out, _SEP), p.astype("U"))
+    return out
+
+
+def _tuple_identity(t: RelationTuple) -> str:
+    if t.subject_set is not None:
+        s = t.subject_set
+        return _SEP.join(
+            (t.namespace, t.object, t.relation, "1", s.namespace, s.object, s.relation)
+        )
+    return _SEP.join(
+        (t.namespace, t.object, t.relation, "0", "", t.subject_id or "", "")
+    )
+
+
+class _ColumnarNetwork:
+    """All tuples of one network id."""
+
+    def __init__(self):
+        self.base = TupleColumns.empty()
+        self.base_keys = np.array([], dtype="U1")  # sorted identity keys
+        self.base_order = np.array([], dtype=np.int64)  # key-sorted -> row
+        self.alive = np.array([], dtype=bool)
+        self.buffer: list[RelationTuple] = []
+        self.buffer_keys: dict[str, int] = {}  # identity -> buffer index
+        self.version = 0
+        self.log: deque = deque(maxlen=CHANGE_LOG_CAP)
+        self.log_floor = 0  # versions <= floor are unreconstructable
+
+    # -- base maintenance -------------------------------------------------
+
+    def rebuild_base_index(self) -> None:
+        keys = _identity_keys(self.base)
+        order = np.argsort(keys, kind="stable")
+        self.base_keys = keys[order]
+        self.base_order = order
+
+    def base_find(self, identity: str) -> Optional[int]:
+        """Row index of an alive base tuple with this identity key."""
+        i = int(np.searchsorted(self.base_keys, identity))
+        if i < len(self.base_keys) and self.base_keys[i] == identity:
+            row = int(self.base_order[i])
+            if self.alive[row]:
+                return row
+        return None
+
+    def merge_buffer(self) -> None:
+        """Fold the write buffer into the columnar base (numpy concat)."""
+        if not self.buffer:
+            return
+        add = TupleColumns.from_tuples(self.buffer)
+        keep = self.alive
+        self.base = concat_columns([self.base.take(np.flatnonzero(keep)), add])
+        self.alive = np.ones(len(self.base), dtype=bool)
+        self.buffer = []
+        self.buffer_keys = {}
+        self.rebuild_base_index()
+
+
+class ColumnarStore:
+    """Manager implementation over columnar per-network stores."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._networks: dict[str, _ColumnarNetwork] = {}
+
+    _EMPTY = _ColumnarNetwork()
+
+    def _net(self, nid: str) -> _ColumnarNetwork:
+        net = self._networks.get(nid)
+        if net is None:
+            net = self._networks[nid] = _ColumnarNetwork()
+        return net
+
+    def _net_ro(self, nid: str) -> _ColumnarNetwork:
+        return self._networks.get(nid, self._EMPTY)
+
+    # -- scale-path surface ------------------------------------------------
+
+    def bulk_load(self, cols: TupleColumns, nid: str = DEFAULT_NETWORK) -> None:
+        """Columnar ingest: dedupes against itself and the existing base,
+        appends in one concat, bumps the version, and RESETS the change-
+        log floor (a bulk load is not representable as a delta — the
+        engine sees changes_since() == None and compacts)."""
+        with self._lock:
+            net = self._net(nid)
+            net.merge_buffer()
+            keys = _identity_keys(cols)
+            _, first = np.unique(keys, return_index=True)
+            cols = cols.take(np.sort(first))
+            if len(net.base):
+                keys = _identity_keys(cols)
+                idx = np.clip(
+                    np.searchsorted(net.base_keys, keys),
+                    0, max(len(net.base_keys) - 1, 0),
+                )
+                dup = (
+                    (net.base_keys[idx] == keys)
+                    if len(net.base_keys)
+                    else np.zeros(len(keys), dtype=bool)
+                )
+                # duplicates of DEAD rows resurrect: keep them
+                dup &= net.alive[net.base_order[idx]]
+                cols = cols.take(np.flatnonzero(~dup))
+            if not len(cols):
+                return
+            net.base = concat_columns(
+                [net.base.take(np.flatnonzero(net.alive)), cols]
+            )
+            net.alive = np.ones(len(net.base), dtype=bool)
+            net.rebuild_base_index()
+            net.version += 1
+            net.log.clear()
+            net.log_floor = net.version
+
+    def all_tuple_columns(self, nid: str = DEFAULT_NETWORK) -> TupleColumns:
+        """One consistent columnar view (buffer folded in)."""
+        with self._lock:
+            net = self._net_ro(nid)
+            if net is self._EMPTY:
+                return TupleColumns.empty()
+            net.merge_buffer()
+            if net.alive.all():
+                return net.base
+            return net.base.take(np.flatnonzero(net.alive))
+
+    # -- Manager surface ---------------------------------------------------
+
+    def version(self, nid: str = DEFAULT_NETWORK) -> int:
+        with self._lock:
+            return self._net_ro(nid).version
+
+    def changes_since(
+        self, version: int, nid: str = DEFAULT_NETWORK
+    ) -> Optional[list]:
+        with self._lock:
+            net = self._net_ro(nid)
+            if version < net.log_floor or (
+                net.log and net.log[0][0] > version + 1
+            ):
+                return None  # truncated / bulk-loaded: caller compacts
+            return [(op, t) for v, op, t in net.log if v > version]
+
+    def write_relation_tuples(
+        self, tuples: Sequence[RelationTuple], nid: str = DEFAULT_NETWORK
+    ) -> None:
+        with self._lock:
+            net = self._net(nid)
+            for t in tuples:
+                ident = _tuple_identity(t)
+                if ident in net.buffer_keys or net.base_find(ident) is not None:
+                    continue  # idempotent insert
+                net.buffer_keys[ident] = len(net.buffer)
+                net.buffer.append(t)
+                net.version += 1
+                net.log.append((net.version, "insert", t))
+            if len(net.buffer) >= _BUFFER_MERGE_THRESHOLD:
+                net.merge_buffer()
+
+    def delete_relation_tuples(
+        self, tuples: Sequence[RelationTuple], nid: str = DEFAULT_NETWORK
+    ) -> None:
+        with self._lock:
+            net = self._net(nid)
+            for t in tuples:
+                ident = _tuple_identity(t)
+                bi = net.buffer_keys.pop(ident, None)
+                removed = False
+                if bi is not None:
+                    net.buffer[bi] = None  # type: ignore[assignment]
+                    removed = True
+                row = net.base_find(ident)
+                if row is not None:
+                    net.alive[row] = False
+                    removed = True
+                if removed:
+                    net.version += 1
+                    net.log.append((net.version, "delete", t))
+            net.buffer = [t for t in net.buffer if t is not None]
+            net.buffer_keys = {
+                _tuple_identity(t): i for i, t in enumerate(net.buffer)
+            }
+
+    def transact_relation_tuples(
+        self,
+        insert: Sequence[RelationTuple],
+        delete: Sequence[RelationTuple],
+        nid: str = DEFAULT_NETWORK,
+    ) -> None:
+        with self._lock:
+            self.write_relation_tuples(insert, nid=nid)
+            self.delete_relation_tuples(delete, nid=nid)
+
+    def delete_all_relation_tuples(
+        self, query: RelationQuery, nid: str = DEFAULT_NETWORK
+    ) -> None:
+        with self._lock:
+            net = self._net(nid)
+            net.merge_buffer()
+            mask = self._query_mask(net, query)
+            for row in np.flatnonzero(mask & net.alive):
+                t = net.base.row(int(row))
+                net.alive[row] = False
+                net.version += 1
+                net.log.append((net.version, "delete", t))
+
+    def relation_tuple_exists(
+        self, t: RelationTuple, nid: str = DEFAULT_NETWORK
+    ) -> bool:
+        with self._lock:
+            net = self._net_ro(nid)
+            ident = _tuple_identity(t)
+            return ident in net.buffer_keys or net.base_find(ident) is not None
+
+    def all_relation_tuples(
+        self, nid: str = DEFAULT_NETWORK
+    ) -> Iterable[RelationTuple]:
+        cols = self.all_tuple_columns(nid)
+        return list(cols.iter_tuples())
+
+    # -- queries -----------------------------------------------------------
+
+    @staticmethod
+    def _query_mask(net: _ColumnarNetwork, q: RelationQuery) -> np.ndarray:
+        mask = np.ones(len(net.base), dtype=bool)
+        if q.namespace is not None:
+            mask &= net.base.ns == q.namespace
+        if q.object is not None:
+            mask &= net.base.obj == q.object
+        if q.relation is not None:
+            mask &= net.base.rel == q.relation
+        if q.subject_id is not None:
+            mask &= (net.base.skind == 0) & (net.base.sobj == q.subject_id)
+        if q.subject_set is not None:
+            s = q.subject_set
+            mask &= (
+                (net.base.skind == 1)
+                & (net.base.sns == s.namespace)
+                & (net.base.sobj == s.object)
+                & (net.base.srel == s.relation)
+            )
+        return mask
+
+    def get_relation_tuples(
+        self,
+        query: RelationQuery,
+        page_token: str = "",
+        page_size: int = DEFAULT_PAGE_SIZE,
+        nid: str = DEFAULT_NETWORK,
+    ) -> tuple[list[RelationTuple], str]:
+        """Keyset pagination over the MATCH SET only: the filter runs
+        vectorized over the columns, and the Python-loop costs (uuid5
+        shard ids, RelationTuple objects) are paid per matching row —
+        forward queries on a 1e8-row store touch ~row-length tuples, not
+        the whole store. A fully-unfiltered scan still materializes
+        everything; that is inherent to the API, not this store."""
+        token = validate_page_token(page_token)
+        if page_size <= 0:
+            page_size = DEFAULT_PAGE_SIZE
+        with self._lock:
+            net = self._net_ro(nid)
+            if net is self._EMPTY:
+                return [], ""
+            mask = self._query_mask(net, query) & net.alive
+            matches = [net.base.row(int(r)) for r in np.flatnonzero(mask)]
+            matches.extend(t for t in net.buffer if query.matches(t))
+        entries = sorted(
+            ((shard_id(nid, t), t) for t in matches), key=lambda e: e[0]
+        )
+        shard_ids = [sid for sid, _ in entries]
+        start = bisect.bisect_right(shard_ids, token) if token else 0
+        page = entries[start : start + page_size]
+        out = [t for _, t in page]
+        # N+1 probe: any further match means another page exists
+        next_token = page[-1][0] if page and start + page_size < len(entries) else ""
+        return out, next_token

@@ -1,0 +1,134 @@
+"""Columnar relation-tuple representation.
+
+The scale-tier interchange format between the store and the snapshot
+compiler: seven parallel numpy arrays instead of one Python object per
+tuple. At 1e8 tuples the object form costs tens of GB and a Python loop
+per tuple (the round-1 ingest wall, VERDICT item 2); the columnar form
+is hundreds of MB and every transformation on it is a numpy primitive.
+
+Layout (all arrays share one length):
+  ns, obj, rel          unicode arrays: the tuple's own coordinates
+  skind                 int8, 0 = plain subject id, 1 = subject set
+  sns, sobj, srel       subject columns; for plain subjects sobj holds
+                        the subject id and sns/srel are ""
+
+Equivalent role to the reference's DB row schema
+(internal/persistence/sql/relationtuples.go RelationTuple struct with
+nullable subject columns) with the nullable-ness encoded in skind.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Iterator, Sequence
+
+import numpy as np
+
+from ..ketoapi import RelationTuple, SubjectSet
+
+
+@dataclass
+class TupleColumns:
+    ns: np.ndarray
+    obj: np.ndarray
+    rel: np.ndarray
+    skind: np.ndarray
+    sns: np.ndarray
+    sobj: np.ndarray
+    srel: np.ndarray
+
+    def __len__(self) -> int:
+        return len(self.ns)
+
+    def nbytes(self) -> int:
+        return sum(
+            getattr(self, f).nbytes
+            for f in ("ns", "obj", "rel", "skind", "sns", "sobj", "srel")
+        )
+
+    @classmethod
+    def empty(cls) -> "TupleColumns":
+        u = np.array([], dtype="U1")
+        return cls(
+            ns=u.copy(), obj=u.copy(), rel=u.copy(),
+            skind=np.array([], dtype=np.int8),
+            sns=u.copy(), sobj=u.copy(), srel=u.copy(),
+        )
+
+    @classmethod
+    def from_tuples(cls, tuples: Sequence[RelationTuple]) -> "TupleColumns":
+        n = len(tuples)
+        ns = [""] * n
+        obj = [""] * n
+        rel = [""] * n
+        skind = np.zeros(n, dtype=np.int8)
+        sns = [""] * n
+        sobj = [""] * n
+        srel = [""] * n
+        for i, t in enumerate(tuples):
+            ns[i] = t.namespace
+            obj[i] = t.object
+            rel[i] = t.relation
+            if t.subject_set is not None:
+                skind[i] = 1
+                sns[i] = t.subject_set.namespace
+                sobj[i] = t.subject_set.object
+                srel[i] = t.subject_set.relation
+            else:
+                sobj[i] = t.subject_id or ""
+        return cls(
+            ns=np.asarray(ns, dtype="U"),
+            obj=np.asarray(obj, dtype="U"),
+            rel=np.asarray(rel, dtype="U"),
+            skind=skind,
+            sns=np.asarray(sns, dtype="U"),
+            sobj=np.asarray(sobj, dtype="U"),
+            srel=np.asarray(srel, dtype="U"),
+        )
+
+    def row(self, i: int) -> RelationTuple:
+        if self.skind[i]:
+            return RelationTuple(
+                namespace=str(self.ns[i]),
+                object=str(self.obj[i]),
+                relation=str(self.rel[i]),
+                subject_set=SubjectSet(
+                    namespace=str(self.sns[i]),
+                    object=str(self.sobj[i]),
+                    relation=str(self.srel[i]),
+                ),
+            )
+        return RelationTuple(
+            namespace=str(self.ns[i]),
+            object=str(self.obj[i]),
+            relation=str(self.rel[i]),
+            subject_id=str(self.sobj[i]),
+        )
+
+    def iter_tuples(self) -> Iterator[RelationTuple]:
+        for i in range(len(self)):
+            yield self.row(i)
+
+    def take(self, idx: np.ndarray) -> "TupleColumns":
+        return TupleColumns(
+            ns=self.ns[idx], obj=self.obj[idx], rel=self.rel[idx],
+            skind=self.skind[idx],
+            sns=self.sns[idx], sobj=self.sobj[idx], srel=self.srel[idx],
+        )
+
+
+def concat_columns(parts: Iterable[TupleColumns]) -> TupleColumns:
+    parts = [p for p in parts if len(p)]
+    if not parts:
+        return TupleColumns.empty()
+    if len(parts) == 1:
+        return parts[0]
+    return TupleColumns(
+        ns=np.concatenate([p.ns for p in parts]),
+        obj=np.concatenate([p.obj for p in parts]),
+        rel=np.concatenate([p.rel for p in parts]),
+        skind=np.concatenate([p.skind for p in parts]),
+        sns=np.concatenate([p.sns for p in parts]),
+        sobj=np.concatenate([p.sobj for p in parts]),
+        srel=np.concatenate([p.srel for p in parts]),
+    )

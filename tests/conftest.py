@@ -21,3 +21,11 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402  (must come after the env setup above)
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "tpu: runs on the real TPU backend (subprocess; skipped unless "
+        "KETO_TPU_TESTS=1 and the backend is healthy)",
+    )

@@ -137,3 +137,28 @@ class TestEngineWarmRestart:
         e3 = TPUCheckEngine(m, self._config(tmp_path))
         assert e3.check_is_member(ts("files:new#owner@zoe")[0])
         assert e3.stats.get("snapshot_loads") == 1
+
+
+class TestArrayVocabReload:
+    def test_big_vocab_reloads_as_arraymap(self, tmp_path, monkeypatch):
+        """Past the size threshold, vocabularies reload as ArrayMaps
+        (sorted keys + explicit values) — identical lookups, no giant
+        Python dicts on the warm-restart path."""
+        from keto_tpu.engine import checkpoint as cp
+        from keto_tpu.engine.snapshot import ArrayMap, build_snapshot
+
+        tuples = ts(*[f"files:o{i}#view@u{i % 13}" for i in range(64)])
+        snap = build_snapshot(tuples, NAMESPACES)
+        path = str(tmp_path / "m.npz")
+        cp.save_snapshot(snap, path)
+
+        monkeypatch.setattr(cp, "_ARRAY_VOCAB_THRESHOLD", 4)
+        loaded = cp.load_snapshot(path)
+        assert isinstance(loaded.obj_slots, ArrayMap)
+        assert isinstance(loaded.subj_ids, ArrayMap)
+        # exact same id assignment as the saved (dict-built) snapshot
+        for key, slot in snap.obj_slots.items():
+            assert loaded.obj_slots.get(key) == slot
+        for key, sid in snap.subj_ids.items():
+            assert loaded.subj_ids.get(key) == sid
+        assert len(loaded.obj_slots) == len(snap.obj_slots)

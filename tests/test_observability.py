@@ -1,0 +1,96 @@
+"""Tracing instrumentation + config schema validation (VERDICT round-1
+item 8): spans visible in a test exporter; bad config rejected at load
+with a pointer to the offending key."""
+
+import json
+import urllib.request
+
+import pytest
+
+from keto_tpu.config import Config, ConfigError
+from keto_tpu.api.daemon import Daemon
+from keto_tpu.ketoapi import RelationTuple
+from keto_tpu.namespace import Namespace
+from keto_tpu.registry import Registry
+
+
+class TestConfigSchema:
+    def test_unknown_top_level_key_rejected(self):
+        with pytest.raises(ConfigError) as e:
+            Config({"dns": "memory"})  # typo of dsn
+        assert "dns" in str(e.value)
+
+    def test_bad_nested_value_names_the_key(self):
+        with pytest.raises(ConfigError) as e:
+            Config({"limit": {"max_read_depth": "five"}})
+        assert "limit.max_read_depth" in str(e.value)
+
+    def test_bad_engine_enum(self):
+        with pytest.raises(ConfigError):
+            Config({"check": {"engine": "gpu"}})
+
+    def test_set_validates_and_rolls_back(self):
+        cfg = Config({"limit": {"max_read_depth": 5}})
+        with pytest.raises(ConfigError):
+            cfg.set("limit.max_read_depth", -3)
+        assert cfg.max_read_depth() == 5  # untouched after rejection
+
+    def test_immutable_keys_still_enforced(self):
+        cfg = Config({"dsn": "memory"})
+        with pytest.raises(ConfigError):
+            cfg.set("dsn", "columnar")
+
+    def test_valid_config_passes(self):
+        Config({
+            "dsn": "memory",
+            "check": {"engine": "tpu", "frontier_cap": 4096},
+            "serve": {"read": {"host": "127.0.0.1", "port": 0}},
+            "tracing": {"enabled": True, "provider": "memory"},
+            "tenancy": {"header": "x-keto-network"},
+        })
+
+
+class TestTracing:
+    def test_spans_cover_store_engine_and_rpc(self):
+        cfg = Config({
+            "dsn": "memory",
+            "check": {"engine": "tpu"},
+            "tracing": {"enabled": True, "provider": "memory"},
+            "serve": {
+                "read": {"host": "127.0.0.1", "port": 0},
+                "write": {"host": "127.0.0.1", "port": 0},
+                "metrics": {"host": "127.0.0.1", "port": 0},
+            },
+        })
+        cfg.set_namespaces([Namespace(name="files")])
+        reg = Registry(cfg)
+        reg.relation_tuple_manager().write_relation_tuples(
+            [RelationTuple.from_string("files:doc#owner@alice")]
+        )
+        d = Daemon(reg)
+        d.start()
+        try:
+            u = (
+                f"http://127.0.0.1:{d.read_port}/relation-tuples/check/openapi"
+                "?namespace=files&object=doc&relation=owner&subject_id=alice"
+            )
+            assert json.load(urllib.request.urlopen(u))["allowed"] is True
+        finally:
+            d.stop()
+        names = reg.tracer().span_names()
+        # store op, snapshot build, kernel launch, result resolution, and
+        # the HTTP request span must all be present
+        assert "persistence.write_relation_tuples" in names
+        assert "engine.snapshot_build" in names
+        assert "engine.kernel_launch" in names
+        assert "engine.resolve_batch" in names
+        assert any(n.startswith("http.") for n in names)
+
+    def test_tracing_disabled_is_noop(self):
+        cfg = Config({"dsn": "memory"})
+        cfg.set_namespaces([Namespace(name="files")])
+        reg = Registry(cfg)
+        t = reg.tracer()
+        with t.span("anything") as s:
+            s.set_attribute("k", "v")
+        assert not hasattr(t, "spans")

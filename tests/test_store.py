@@ -17,10 +17,14 @@ def ts(*strs):
     return [RelationTuple.from_string(s) for s in strs]
 
 
-@pytest.fixture(params=["memory", "sqlite"])
+@pytest.fixture(params=["memory", "sqlite", "columnar"])
 def store(request):
     if request.param == "memory":
         return MemoryManager()
+    if request.param == "columnar":
+        from keto_tpu.storage.columnar import ColumnarStore
+
+        return ColumnarStore()
     return SQLitePersister("memory")
 
 

@@ -1,0 +1,147 @@
+"""Per-request tenancy: the Contextualizer hook (ketoctx analog,
+/root/reference/ketoctx/contextualizer.go:12-19) serving two isolated
+networks through ONE daemon."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from keto_tpu.config import Config
+from keto_tpu.api.daemon import Daemon
+from keto_tpu.ketoapi import RelationTuple
+from keto_tpu.ketoctx import DefaultContextualizer, HeaderContextualizer
+from keto_tpu.namespace import Namespace
+from keto_tpu.registry import Registry
+
+
+def _cfg():
+    cfg = Config({
+        "dsn": "memory",
+        "check": {"engine": "tpu"},
+        "tenancy": {"header": "x-keto-network"},
+        "serve": {
+            "read": {"host": "127.0.0.1", "port": 0},
+            "write": {"host": "127.0.0.1", "port": 0},
+            "metrics": {"host": "127.0.0.1", "port": 0},
+        },
+    })
+    cfg.set_namespaces([Namespace(name="files")])
+    return cfg
+
+
+class TestContextualizer:
+    def test_header_contextualizer(self):
+        c = HeaderContextualizer("X-Keto-Network")
+        assert c.network({"x-keto-network": "t1"}, "default") == "t1"
+        assert c.network({"X-KETO-NETWORK": "t2"}, "default") == "t2"
+        assert c.network({}, "default") == "default"
+        assert c.network({"x-keto-network": ""}, "default") == "default"
+        assert DefaultContextualizer().network({"x-keto-network": "t"}, "d") == "d"
+
+    def test_registry_builds_contextualizer_from_config(self):
+        reg = Registry(_cfg())
+        assert reg.nid_for({"x-keto-network": "tenant-a"}) == "tenant-a"
+        assert reg.nid_for({}) == reg.nid
+        assert reg.nid_for(None) == reg.nid
+
+    def test_per_nid_engine_cache(self):
+        reg = Registry(_cfg())
+        e_default = reg.check_engine()
+        e_a = reg.check_engine("tenant-a")
+        e_b = reg.check_engine("tenant-b")
+        assert e_a is not e_b and e_a is not e_default
+        assert reg.check_engine("tenant-a") is e_a
+        assert reg.check_engine(reg.nid) is e_default
+
+
+class TestTwoTenantDaemon:
+    def test_isolation_through_one_daemon(self):
+        reg = Registry(_cfg())
+        d = Daemon(reg)
+        d.start()
+        try:
+            write = f"http://127.0.0.1:{d.write_port}/admin/relation-tuples"
+            read = (
+                f"http://127.0.0.1:{d.read_port}/relation-tuples/check/openapi"
+                "?namespace=files&object=doc&relation=owner&subject_id=alice"
+            )
+
+            def put(tenant):
+                req = urllib.request.Request(
+                    write,
+                    data=json.dumps(
+                        RelationTuple.from_string("files:doc#owner@alice").to_dict()
+                    ).encode(),
+                    method="PUT",
+                    headers={"x-keto-network": tenant},
+                )
+                return urllib.request.urlopen(req).status
+
+            def check(tenant):
+                req = urllib.request.Request(
+                    read, headers={"x-keto-network": tenant}
+                )
+                return json.load(urllib.request.urlopen(req))["allowed"]
+
+            assert put("tenant-a") == 201
+            assert check("tenant-a") is True
+            # the other tenant and the default network see nothing
+            assert check("tenant-b") is False
+            req = urllib.request.Request(read)
+            assert json.load(urllib.request.urlopen(req))["allowed"] is False
+            # read API is scoped too
+            lst = urllib.request.Request(
+                f"http://127.0.0.1:{d.read_port}/relation-tuples?namespace=files",
+                headers={"x-keto-network": "tenant-b"},
+            )
+            assert json.load(urllib.request.urlopen(lst))["relation_tuples"] == []
+        finally:
+            d.stop()
+
+
+class TestTenancyHardening:
+    def test_malformed_nid_rejected(self):
+        from keto_tpu.errors import MalformedInputError
+
+        reg = Registry(_cfg())
+        for bad in ("../../etc", "a/b", "x" * 200, "a b", ""):
+            if bad == "":
+                # empty header falls back to the default network
+                assert reg.nid_for({"x-keto-network": ""}) == reg.nid
+                continue
+            with pytest.raises(MalformedInputError):
+                reg.nid_for({"x-keto-network": bad})
+
+    def test_engine_cache_lru_bound(self):
+        cfg = _cfg()
+        cfg.set("tenancy.max_networks", 3)
+        reg = Registry(cfg)
+        engines = {t: reg.check_engine(t) for t in ("a", "b", "c")}
+        assert len(reg._nid_engines) == 3
+        reg.check_engine("d")  # evicts "a" (LRU)
+        assert "a" not in reg._nid_engines
+        assert len(reg._nid_engines) == 3
+        # "b" is still cached (same object), and re-use refreshes it
+        assert reg.check_engine("b") is engines["b"]
+        reg.check_engine("e")  # now "c" is the oldest
+        assert "c" not in reg._nid_engines and "b" in reg._nid_engines
+
+    def test_malformed_nid_is_400_through_daemon(self):
+        reg = Registry(_cfg())
+        d = Daemon(reg)
+        d.start()
+        try:
+            read = (
+                f"http://127.0.0.1:{d.read_port}/relation-tuples/check/openapi"
+                "?namespace=files&object=doc&relation=owner&subject_id=alice"
+            )
+            req = urllib.request.Request(
+                read, headers={"x-keto-network": "../../../tmp/evil"}
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 400
+        finally:
+            d.stop()

@@ -1,0 +1,179 @@
+"""Scale proof: columnar ingest + snapshot build + checks at 1e7 tuples.
+
+The stepping stone to BASELINE config 5 (1e8 @ v5e-8): generates a
+drive-style graph (folders with owners, files with parent edges — the
+cat-videos topology scaled) ENTIRELY as numpy columns, bulk-loads the
+ColumnarStore, times the device-mirror build, and differentially
+spot-checks the engine against construction ground truth plus the exact
+host reference engine on sampled queries.
+
+    python tools/scale_bench.py [--tuples 10000000] [--platform cpu]
+
+Prints one JSON line:
+  {"tuples", "ingest_s", "snapshot_build_s", "device_table_bytes",
+   "check_batch_s", "check_qps", "spot_checks", "spot_failures",
+   "ref_spot_checks", "ref_spot_failures", "device"}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def synth_columns(n_target: int, n_users: int, seed: int = 7):
+    """Drive-style topology as pure numpy columns: ~n_target tuples of
+    which ~1% are folder owners and ~99% file->folder parent edges."""
+    from keto_tpu.storage.columns import TupleColumns, concat_columns
+
+    files_per = 80
+    n_folders = max(1, n_target // (files_per + 1))
+    rng = np.random.default_rng(seed)
+
+    folders = np.arange(n_folders)
+    f_names = np.char.add("/d", folders.astype("U10"))
+    owners = np.char.add("u", (rng.integers(0, n_users, n_folders)).astype("U10"))
+
+    own = TupleColumns(
+        ns=np.full(n_folders, "videos", "U6"),
+        obj=f_names,
+        rel=np.full(n_folders, "owner", "U6"),
+        skind=np.zeros(n_folders, np.int8),
+        sns=np.full(n_folders, "", "U1"),
+        sobj=owners,
+        srel=np.full(n_folders, "", "U1"),
+    )
+    n_files = n_folders * files_per
+    parent_names = np.repeat(f_names, files_per)
+    file_names = np.char.add(
+        np.char.add(parent_names, "/v"),
+        np.tile(np.arange(files_per), n_folders).astype("U3"),
+    )
+    par = TupleColumns(
+        ns=np.full(n_files, "videos", "U6"),
+        obj=file_names,
+        rel=np.full(n_files, "parent", "U6"),
+        skind=np.ones(n_files, np.int8),
+        sns=np.full(n_files, "videos", "U6"),
+        sobj=parent_names,
+        srel=np.full(n_files, "...", "U3"),
+    )
+    cols = concat_columns([own, par])
+    return cols, f_names, owners, files_per
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tuples", type=int, default=10_000_000)
+    ap.add_argument("--users", type=int, default=100_000)
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--ref-samples", type=int, default=32)
+    ap.add_argument("--platform", choices=("auto", "cpu"), default="auto")
+    args = ap.parse_args()
+    if args.platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from keto_tpu.config import Config
+    from keto_tpu.engine import Membership
+    from keto_tpu.engine.tpu_engine import TPUCheckEngine
+    from keto_tpu.ketoapi import RelationTuple
+    from keto_tpu.namespace import Namespace
+    from keto_tpu.namespace.ast import (
+        ComputedSubjectSet,
+        Relation,
+        SubjectSetRewrite,
+        TupleToSubjectSet,
+    )
+    from keto_tpu.storage.columnar import ColumnarStore
+
+    record: dict = {"tuples": 0}
+    t0 = time.perf_counter()
+    cols, f_names, owners, files_per = synth_columns(args.tuples, args.users)
+    record["tuples"] = len(cols)
+    record["column_bytes"] = cols.nbytes()
+
+    store = ColumnarStore()
+    store.bulk_load(cols)
+    record["ingest_s"] = round(time.perf_counter() - t0, 2)
+
+    ns = [Namespace(name="videos", relations=[
+        Relation(name="owner"),
+        Relation(name="parent"),
+        Relation(name="view", subject_set_rewrite=SubjectSetRewrite(children=[
+            ComputedSubjectSet(relation="owner"),
+            TupleToSubjectSet(relation="parent",
+                              computed_subject_set_relation="view"),
+        ])),
+    ])]
+    cfg = Config({"limit": {"max_read_depth": 5}})
+    cfg.set_namespaces(ns)
+    engine = TPUCheckEngine(store, cfg)
+
+    # snapshot build (timed separately from XLA compile: run a 1-query
+    # warm-up AFTER grabbing the build time via _ensure_state)
+    t0 = time.perf_counter()
+    state = engine._ensure_state()
+    record["snapshot_build_s"] = round(time.perf_counter() - t0, 2)
+    record["device_table_bytes"] = int(
+        sum(np.asarray(v).nbytes for v in state.snapshot.device_arrays().values())
+    )
+
+    # query batch with construction ground truth: half owner-hits
+    rng = np.random.default_rng(11)
+    B = args.batch
+    fi = rng.integers(0, len(f_names), B)
+    vi = rng.integers(0, files_per, B)
+    hit = rng.random(B) < 0.5
+    subs = np.where(hit, owners[fi], np.char.add("nobody", fi.astype("U10")))
+    queries = [
+        RelationTuple.from_string(
+            f"videos:{f_names[fi[i]]}/v{vi[i]}#view@{subs[i]}"
+        )
+        for i in range(B)
+    ]
+    # ground truth: owner sees every file in the folder; "nobodyX" never
+    # owns anything (the owner vocab is uN)
+    want = hit
+
+    engine.check_batch(queries[:1])  # compile warm-up
+    t0 = time.perf_counter()
+    got = engine.check_batch(queries)
+    record["check_batch_s"] = round(time.perf_counter() - t0, 3)
+    record["check_qps"] = round(B / max(record["check_batch_s"], 1e-9), 1)
+
+    fails = sum(
+        1
+        for g, w in zip(got, want)
+        if (g.membership == Membership.IS_MEMBER) != bool(w)
+    )
+    record["spot_checks"] = B
+    record["spot_failures"] = fails
+    record["host_checks"] = engine.stats["host_checks"]
+
+    # exact reference engine on a sample (paginated store reads)
+    ref_fails = 0
+    for i in rng.integers(0, B, args.ref_samples):
+        ref = engine.reference.check_relation_tuple(queries[int(i)], 0)
+        if (ref.membership == Membership.IS_MEMBER) != bool(want[int(i)]):
+            ref_fails += 1
+    record["ref_spot_checks"] = args.ref_samples
+    record["ref_spot_failures"] = ref_fails
+    record["device"] = str(jax.devices()[0])
+    print(json.dumps(record))
+    return 0 if fails == 0 and ref_fails == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

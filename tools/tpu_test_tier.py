@@ -1,0 +1,198 @@
+"""TPU-hardware correctness tier (VERDICT round-1 item 2).
+
+Runs the differential fixture sets on the REAL attached backend — the
+same code paths the CPU suite exercises, now with actual TPU
+compilation/execution semantics: cat-videos, deep-chain-32, the AND/NOT
+island fixtures, and a randomized differential sweep, each compared
+against the exact host reference engine.
+
+Invoked by tests/test_tpu_hardware.py (pytest marker `tpu`, subprocess
+so a wedged backend can time out without hanging the suite) and runnable
+standalone on the bench machine:
+
+    python tools/tpu_test_tier.py
+
+Prints one JSON line per fixture set plus a final summary line
+{"tier": "tpu", "device", "sets", "cases", "failures"}; exit 0 iff
+failures == 0 AND the device is a real TPU.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"
+))
+
+
+def main() -> int:
+    import jax
+
+    device = jax.devices()[0]
+    if device.platform == "cpu":
+        print(json.dumps({"tier": "tpu", "error": "no TPU (resolved to cpu)"}))
+        return 2
+
+    from keto_tpu.config import Config
+    from keto_tpu.engine import Membership
+    from keto_tpu.engine.tpu_engine import TPUCheckEngine
+    from keto_tpu.ketoapi import RelationTuple
+    from keto_tpu.namespace import Namespace
+    from keto_tpu.namespace.ast import (
+        ComputedSubjectSet,
+        Relation,
+        SubjectSetRewrite,
+        TupleToSubjectSet,
+    )
+    from keto_tpu.storage import MemoryManager
+
+    total_cases = 0
+    total_failures = 0
+    sets = 0
+
+    def engine_for(namespaces, tuples, max_depth=5):
+        cfg = Config({"limit": {"max_read_depth": max_depth}})
+        cfg.set_namespaces(namespaces)
+        m = MemoryManager()
+        m.write_relation_tuples([RelationTuple.from_string(s) for s in tuples])
+        return TPUCheckEngine(m, cfg)
+
+    def report(name, cases, failures, extra=None):
+        nonlocal total_cases, total_failures, sets
+        sets += 1
+        total_cases += cases
+        total_failures += failures
+        line = {"set": name, "cases": cases, "failures": failures}
+        line.update(extra or {})
+        print(json.dumps(line), flush=True)
+
+    # ---- cat-videos (the reference's own example fixture) ----------------
+    import glob
+
+    tuples = []
+    for f in sorted(glob.glob(
+        "/root/reference/contrib/cat-videos-example/relation-tuples/*.json"
+    )):
+        d = json.load(open(f))
+        d.pop("$schema", None)
+        tuples.append(str(RelationTuple.from_dict(d)))
+    e = engine_for([Namespace(name="videos")], tuples)
+    queries = [
+        "videos:/cats/1.mp4#view@*",
+        "videos:/cats/1.mp4#view@cat lady",
+        "videos:/cats/2.mp4#view@cat lady",
+        "videos:/cats/2.mp4#view@john",
+        "videos:/cats#owner@cat lady",
+    ]
+    rts = [RelationTuple.from_string(q) for q in queries]
+    got = e.check_batch(rts)
+    fails = sum(
+        1
+        for t, g in zip(rts, got)
+        if g.membership != e.reference.check_relation_tuple(t, 0).membership
+    )
+    report("cat-videos", len(rts), fails, {"host_checks": e.stats["host_checks"]})
+
+    # ---- deep chain, depth 32 (bench_test.go:56-86 topology) -------------
+    namespaces = [Namespace(name="deep", relations=[
+        Relation(name="owner"),
+        Relation(name="parent"),
+        Relation(name="viewer", subject_set_rewrite=SubjectSetRewrite(children=[
+            ComputedSubjectSet(relation="owner"),
+            TupleToSubjectSet(relation="parent",
+                              computed_subject_set_relation="viewer"),
+        ])),
+    ])]
+    depth = 32
+    tuples = ["deep:f0#parent@(deep:f1#...)"]
+    for i in range(1, depth):
+        tuples.append(f"deep:f{i}#parent@(deep:f{i + 1}#...)")
+    tuples.append(f"deep:f{depth}#owner@alice")
+    e = engine_for(namespaces, tuples, max_depth=2 * depth)
+    cases = [
+        (f"deep:f0#viewer@alice", True),
+        (f"deep:f0#viewer@bob", False),
+        (f"deep:f{depth}#owner@alice", True),
+    ]
+    got = e.check_batch(
+        [RelationTuple.from_string(c) for c, _ in cases], 2 * depth
+    )
+    fails = sum(
+        1
+        for (c, want), g in zip(cases, got)
+        if (g.membership == Membership.IS_MEMBER) != want
+    )
+    report("deep-chain-32", len(cases), fails,
+           {"host_checks": e.stats["host_checks"]})
+
+    # ---- AND/NOT islands (ported rewrites_test fixtures) -----------------
+    from test_reference_engine import (
+        REWRITE_CASES,
+        REWRITE_NAMESPACES,
+        REWRITE_TUPLES,
+    )
+
+    e = engine_for(REWRITE_NAMESPACES, REWRITE_TUPLES, max_depth=100)
+    rts = [RelationTuple.from_string(q) for q, _ in REWRITE_CASES]
+    got = e.check_batch(rts, 100)
+    fails = sum(
+        1
+        for (q, want), g in zip(REWRITE_CASES, got)
+        if (g.membership == Membership.IS_MEMBER) != want
+    )
+    report("rewrites+islands", len(rts), fails,
+           {"host_checks": e.stats["host_checks"]})
+
+    # ---- randomized differential -----------------------------------------
+    rng = random.Random(99)
+    namespaces = [Namespace(name="rnd", relations=[
+        Relation(name="r0"),
+        Relation(name="r1"),
+        Relation(name="r2", subject_set_rewrite=SubjectSetRewrite(children=[
+            ComputedSubjectSet(relation="r0"),
+            TupleToSubjectSet(relation="r1",
+                              computed_subject_set_relation="r2"),
+        ])),
+    ])]
+    rels = ["r0", "r1", "r2"]
+    tup = set()
+    for _ in range(200):
+        obj = f"o{rng.randrange(40)}"
+        rel = rng.choice(rels)
+        if rng.random() < 0.4:
+            sub = f"(rnd:o{rng.randrange(40)}#{rng.choice(rels)})"
+        else:
+            sub = f"u{rng.randrange(10)}"
+        tup.add(f"rnd:{obj}#{rel}@{sub}")
+    e = engine_for(namespaces, sorted(tup), max_depth=12)
+    from keto_tpu.engine import ReferenceEngine
+
+    oracle = ReferenceEngine(e.manager, e.config, visited_pruning=False)
+    queries = [
+        RelationTuple.from_string(
+            f"rnd:o{rng.randrange(40)}#{rng.choice(rels)}@u{rng.randrange(10)}"
+        )
+        for _ in range(128)
+    ]
+    got = e.check_batch(queries, 12)
+    fails = sum(
+        1
+        for q, g in zip(queries, got)
+        if g.membership != oracle.check_relation_tuple(q, 12).membership
+    )
+    report("randomized-differential", len(queries), fails)
+
+    print(json.dumps({
+        "tier": "tpu", "device": str(device), "sets": sets,
+        "cases": total_cases, "failures": total_failures,
+    }))
+    return 0 if total_failures == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
