@@ -28,11 +28,17 @@ _H2_PREFACE = b"PRI * HTTP/2.0"
 
 
 class PortMux:
-    """cmux equivalent: route h2 connections to gRPC, h1 to REST."""
+    """cmux equivalent: route h2 connections to gRPC, h1 to REST.
 
-    def __init__(self, host: str, port: int, grpc_addr, http_addr):
+    With `ssl_context` the mux TERMINATES TLS (serve.<kind>.tls config,
+    ref: daemon.go:289-349): the preface sniff and the loopback splice
+    run over the decrypted stream, so both gRPC and REST backends stay
+    plaintext-internal."""
+
+    def __init__(self, host: str, port: int, grpc_addr, http_addr, ssl_context=None):
         self.grpc_addr = grpc_addr
         self.http_addr = http_addr
+        self.ssl_context = ssl_context
         self._listener = socket.create_server(
             (host, port), family=socket.AF_INET, backlog=128, reuse_port=False
         )
@@ -74,15 +80,45 @@ class PortMux:
     def _handshake(self, conn: socket.socket) -> None:
         try:
             conn.settimeout(10)
-            # Block (PEEK|WAITALL) for the full preface length: an HTTP/1.1
-            # request line is always longer, so a prefix-only peek of a slow
-            # first segment (e.g. just b"P") can never misroute.
-            try:
-                head = conn.recv(
-                    len(_H2_PREFACE), socket.MSG_PEEK | socket.MSG_WAITALL
-                )
-            except socket.timeout:
-                head = b""
+            consumed = b""
+            if self.ssl_context is not None:
+                import ssl as _ssl
+
+                try:
+                    conn = self.ssl_context.wrap_socket(conn, server_side=True)
+                except (_ssl.SSLError, OSError):
+                    conn.close()
+                    return
+                # MSG_PEEK is not supported on TLS sockets: CONSUME the
+                # preface-length prefix from the decrypted stream and
+                # replay it to the chosen backend before splicing
+                while len(consumed) < len(_H2_PREFACE):
+                    try:
+                        chunk = conn.recv(len(_H2_PREFACE) - len(consumed))
+                    except socket.timeout:
+                        chunk = b""
+                    if not chunk:
+                        break
+                    consumed += chunk
+                # drain decrypted bytes already buffered in the TLS layer:
+                # they are invisible to selectors on the raw fd
+                while conn.pending():
+                    more = conn.recv(conn.pending())
+                    if not more:
+                        break
+                    consumed += more
+                head = consumed
+            else:
+                # Block (PEEK|WAITALL) for the full preface length: an
+                # HTTP/1.1 request line is always longer, so a prefix-only
+                # peek of a slow first segment (e.g. just b"P") can never
+                # misroute.
+                try:
+                    head = conn.recv(
+                        len(_H2_PREFACE), socket.MSG_PEEK | socket.MSG_WAITALL
+                    )
+                except socket.timeout:
+                    head = b""
             if not head:
                 conn.close()
                 return
@@ -90,6 +126,8 @@ class PortMux:
                 self.grpc_addr if head.startswith(_H2_PREFACE) else self.http_addr
             )
             backend = socket.create_connection(backend_addr)
+            if consumed:
+                backend.sendall(consumed)
             conn.settimeout(None)
             self._splice(conn, backend)
         except OSError:
@@ -111,6 +149,15 @@ class PortMux:
                     src, dst = key.fileobj, key.data
                     try:
                         data = src.recv(65536)
+                        # TLS sockets buffer whole decrypted records; bytes
+                        # in that buffer never wake the selector, so drain
+                        # pending() before waiting again
+                        pending = getattr(src, "pending", None)
+                        while pending is not None and pending():
+                            more = src.recv(65536)
+                            if not more:
+                                break
+                            data += more
                     except OSError:
                         data = b""
                     if not data:
@@ -168,8 +215,14 @@ class Daemon:
         self._grpc_read.start()
         self._grpc_write.start()
 
-        self._rest["read"] = RESTServer(reg, "read", "127.0.0.1", 0, batcher=self.batcher)
-        self._rest["write"] = RESTServer(reg, "write", "127.0.0.1", 0)
+        cfg = reg.config
+        self._rest["read"] = RESTServer(
+            reg, "read", "127.0.0.1", 0, batcher=self.batcher,
+            cors=cfg.get("serve.read.cors"),
+        )
+        self._rest["write"] = RESTServer(
+            reg, "write", "127.0.0.1", 0, cors=cfg.get("serve.write.cors")
+        )
         for s in self._rest.values():
             s.start()
 
@@ -178,12 +231,14 @@ class Daemon:
             self.read_addr.port,
             ("127.0.0.1", grpc_read_port),
             ("127.0.0.1", self._rest["read"].port),
+            ssl_context=self._tls_context("read"),
         )
         self._muxes["write"] = PortMux(
             self.write_addr.host,
             self.write_addr.port,
             ("127.0.0.1", grpc_write_port),
             ("127.0.0.1", self._rest["write"].port),
+            ssl_context=self._tls_context("write"),
         )
         # metrics is plain HTTP, no mux needed (daemon.go:152-189)
         self._rest["metrics"] = RESTServer(
@@ -200,6 +255,19 @@ class Daemon:
             self.write_addr.host, self.write_port,
             self.metrics_addr.host, self.metrics_port,
         )
+
+    def _tls_context(self, kind: str):
+        """ssl.SSLContext from serve.<kind>.tls {cert_path, key_path},
+        None when unconfigured (ref: daemon.go TLS listener options)."""
+        tls = self.registry.config.get(f"serve.{kind}.tls")
+        if not tls or not tls.get("cert_path"):
+            return None
+        import ssl
+
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.set_alpn_protocols(["h2", "http/1.1"])
+        ctx.load_cert_chain(tls["cert_path"], tls.get("key_path"))
+        return ctx
 
     @property
     def read_port(self) -> int:

@@ -65,6 +65,7 @@ class _Handler(BaseHTTPRequestHandler):
     registry = None
     batcher = None
     kind = "read"  # read | write | metrics
+    cors = None  # serve.<kind>.cors config dict (ref: daemon.go:289-349)
 
     # -- plumbing -------------------------------------------------------------
 
@@ -73,10 +74,38 @@ class _Handler(BaseHTTPRequestHandler):
 
         logger.debug("http %s", fmt % args)
 
+    def _cors_headers(self) -> list[tuple[str, str]]:
+        """CORS response headers for allowed origins (ref: negroni CORS
+        middleware wired per listener, daemon.go:289-349)."""
+        cfg = self.cors
+        if not cfg or not cfg.get("enabled"):
+            return []
+        origin = self.headers.get("Origin")
+        if not origin:
+            return []
+        allowed = cfg.get("allowed_origins") or ["*"]
+        if "*" not in allowed and origin not in allowed:
+            return []
+        methods = cfg.get("allowed_methods") or [
+            "GET", "POST", "PUT", "PATCH", "DELETE", "OPTIONS",
+        ]
+        headers = cfg.get("allowed_headers") or ["Authorization", "Content-Type"]
+        return [
+            (
+                "Access-Control-Allow-Origin",
+                "*" if "*" in allowed else origin,
+            ),
+            ("Access-Control-Allow-Methods", ", ".join(methods)),
+            ("Access-Control-Allow-Headers", ", ".join(headers)),
+            ("Vary", "Origin"),
+        ]
+
     def _write(self, code: int, body: bytes, content_type="application/json") -> None:
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in self._cors_headers():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -87,6 +116,8 @@ class _Handler(BaseHTTPRequestHandler):
         if location is not None:
             self.send_header("Location", location)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in self._cors_headers():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
@@ -322,20 +353,30 @@ class _Handler(BaseHTTPRequestHandler):
     def do_PATCH(self):
         self._route("PATCH")
 
+    def do_OPTIONS(self):
+        # CORS preflight: 204 with the allow headers (no routing)
+        self.send_response(204)
+        for k, v in self._cors_headers():
+            self.send_header(k, v)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
 
-def make_handler_class(registry, kind: str, batcher=None):
+
+def make_handler_class(registry, kind: str, batcher=None, cors=None):
     return type(
         f"KetoHTTP{kind.capitalize()}Handler",
         (_Handler,),
-        {"registry": registry, "kind": kind, "batcher": batcher},
+        {"registry": registry, "kind": kind, "batcher": batcher, "cors": cors},
     )
 
 
 class RESTServer:
     """One HTTP listener (read, write, or metrics router)."""
 
-    def __init__(self, registry, kind: str, host: str, port: int, batcher=None):
-        handler = make_handler_class(registry, kind, batcher)
+    def __init__(
+        self, registry, kind: str, host: str, port: int, batcher=None, cors=None
+    ):
+        handler = make_handler_class(registry, kind, batcher, cors=cors)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
         self.kind = kind

@@ -94,7 +94,7 @@ def build_full_csr(
 def _row_lookup(tables, obj, rel, probes: int):
     from .kernel import _pair_key_probe
 
-    return _pair_key_probe(tables, "fh", "fh_row", obj, rel, probes)
+    return _pair_key_probe(tables, "fh", obj, rel, probes)
 
 
 class _ExpandState(NamedTuple):

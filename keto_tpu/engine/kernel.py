@@ -20,15 +20,15 @@ in-flight checks advance together as one frontier of tasks
     4. dedupe the next frontier on (query, object, relation) keeping the
        deepest remaining-depth instance (safe: more depth explores more)
 
-TPU-specific gather discipline (learned from profiling on v5e): each
-gather op carries a fixed dispatch cost, and a gather whose OUTPUT last
-dimension is tiny gets lane-padded up to 128 — a [F, S, P, 4] packed-row
-gather materializes 32x its logical size (hundreds of MB of temps per
-step). So every logical lookup here (a) keeps tables as 1-D columns, and
-(b) batches ALL its probe rounds/slots into one wide trailing index dim
-per column: slots [F, S*P] -> one gather per key column with a
-[F, ~128]-shaped output. This puts the step budget at ~20 gather ops of
-lane-friendly shape instead of hundreds of scalar-shaped ones.
+TPU-specific gather discipline (measured, tools/microbench2.py): a
+row-gather from a 2-D table moves its whole row for roughly the cost of
+one element (~15ns/row on v5e), while N per-column gathers pay N times.
+So every hash table lives on device as PACKED interleaved rows —
+[cap, 8] for the 5-key edge tables, [cap, 4] for (obj, rel)->value —
+and each logical lookup is ONE [F, P, row]-shaped row-gather, fenced
+with optimization_barrier so XLA emits its fast standalone gather
+kernel instead of scalarizing it inside a fusion. All probe rounds/
+slots batch into one wide trailing index dim per lookup.
 
 The phases are factored as standalone functions so the sharded multi-chip
 kernel (keto_tpu/parallel/kernel.py) can interleave them with mesh
@@ -87,39 +87,47 @@ def _hash_combine(*parts: jnp.ndarray) -> jnp.ndarray:
     return h
 
 
+def _isolate(x: jnp.ndarray) -> jnp.ndarray:
+    """Fence a gather from surrounding fusions: XLA TPU emits a fast
+    standalone gather kernel, but a gather fused into a loop fusion
+    scalarizes (measured ~6x slower on v5e, tools/microbench2.py)."""
+    (x,) = jax.lax.optimization_barrier((x,))
+    return x
+
+
 def _edge_key_probe(tables, prefix, obj, rel, skind, sa, sb, probes: int):
-    """Probe a 5-key edge hash table (columns `{prefix}_obj`...): returns
-    (found[F], value[F]) with value = the matched slot's val column.
-    One [F, P]-shaped gather per column (2-D, lane-friendly)."""
+    """Probe a 5-key edge hash table stored as PACKED rows
+    `{prefix}_pack[cap, 8]` = (obj, rel, skind, sa, sb, val, pad, pad):
+    ONE [F, P, 8] row-gather replaces six per-column gathers — on v5e a
+    row-gather moves its whole row for the cost of one element
+    (~15ns/row, tools/microbench2.py probe_rowgather vs probe_6col).
+    Returns (found[F], value[F])."""
     h1 = _hash_combine(obj, rel, skind, sa, sb)
     h2 = _mix32(h1 ^ _GOLDEN) | jnp.uint32(1)
-    cap_mask = jnp.uint32(tables[f"{prefix}_obj"].shape[0] - 1)
+    pack = tables[f"{prefix}_pack"]
+    cap_mask = jnp.uint32(pack.shape[0] - 1)
     j = jnp.arange(probes, dtype=jnp.uint32)
     slots = ((h1[:, None] + j * h2[:, None]) & cap_mask).astype(jnp.int32)
+    rows = _isolate(pack[slots])  # [F, P, 8]
     match = (
-        (tables[f"{prefix}_obj"][slots] == obj[:, None])
-        & (tables[f"{prefix}_rel"][slots] == rel[:, None])
-        & (tables[f"{prefix}_skind"][slots] == skind[:, None])
-        & (tables[f"{prefix}_sa"][slots] == sa[:, None])
-        & (tables[f"{prefix}_sb"][slots] == sb[:, None])
+        (rows[..., 0] == obj[:, None])
+        & (rows[..., 1] == rel[:, None])
+        & (rows[..., 2] == skind[:, None])
+        & (rows[..., 3] == sa[:, None])
+        & (rows[..., 4] == sb[:, None])
     )
     found = jnp.any(match, axis=-1)
-    val = jnp.max(
-        jnp.where(match, tables[f"{prefix}_val"][slots], EMPTY), axis=-1
-    )
+    val = jnp.max(jnp.where(match, rows[..., 5], EMPTY), axis=-1)
     return found, val
 
 
-def _multi_pair_key_probe(tables, prefix, valcol, obj, rels_cols, probes: int):
-    """Probe a (obj, rel)-keyed table for MANY relations per task at once:
-    `rels_cols` is a list of S [F]-arrays. All S*P probe slots ride one
-    [F, S*P]-shaped gather per column; every intermediate stays 2-D with a
-    wide trailing dim (a [F, S, P] layout would lane-pad P up to 128 and
-    blow hundreds of MB of temps). Returns [F]-value arrays, one per rel.
-    """
+def _multi_pair_key_probe(tables, prefix, obj, rels_cols, probes: int):
+    """Probe a (obj, rel)-keyed packed table `{prefix}_pack[cap, 4]` =
+    (obj, rel, val, pad) for MANY relations per task at once: all S*P
+    probe slots ride ONE [F, S*P, 4] row-gather. Returns [F]-value
+    arrays, one per rel."""
     F = obj.shape[0]
     P = probes
-    # flat repeated key columns [F, S*P], built by 2-D broadcasts only
     rel_flat = jnp.concatenate(
         [jnp.broadcast_to(r[:, None], (F, P)) for r in rels_cols], axis=1
     )
@@ -127,12 +135,12 @@ def _multi_pair_key_probe(tables, prefix, valcol, obj, rels_cols, probes: int):
     h1 = _hash_combine(obj_flat, rel_flat)  # [F, S*P]
     h2 = _mix32(h1 ^ _GOLDEN) | jnp.uint32(1)
     p_flat = jnp.tile(jnp.arange(P, dtype=jnp.uint32), len(rels_cols))
-    cap_mask = jnp.uint32(tables[f"{prefix}_obj"].shape[0] - 1)
+    pack = tables[f"{prefix}_pack"]
+    cap_mask = jnp.uint32(pack.shape[0] - 1)
     slots = ((h1 + p_flat * h2) & cap_mask).astype(jnp.int32)
-    match = (tables[f"{prefix}_obj"][slots] == obj_flat) & (
-        tables[f"{prefix}_rel"][slots] == rel_flat
-    )
-    cand = jnp.where(match, tables[valcol][slots], EMPTY)
+    rows = _isolate(pack[slots])  # [F, S*P, 4]
+    match = (rows[..., 0] == obj_flat) & (rows[..., 1] == rel_flat)
+    cand = jnp.where(match, rows[..., 2], EMPTY)
     # per-slot max over its P probes: 2-D slices, no 3-D relayout
     return [
         jnp.max(cand[:, s * P : (s + 1) * P], axis=1)
@@ -140,15 +148,52 @@ def _multi_pair_key_probe(tables, prefix, valcol, obj, rels_cols, probes: int):
     ]
 
 
-def _pair_key_probe(tables, prefix, valcol, obj, rel, probes: int):
+def _pair_key_probe(tables, prefix, obj, rel, probes: int):
     """Single-relation probe of a (obj, rel)-keyed table -> value or EMPTY."""
-    return _multi_pair_key_probe(tables, prefix, valcol, obj, [rel], probes)[0]
+    return _multi_pair_key_probe(tables, prefix, obj, [rel], probes)[0]
 
 
 def dirty_lookup(tables, obj, rel):
     """Dirty-row bitmask for (obj, rel), 0 when the row is clean."""
-    val = _pair_key_probe(tables, "dirty", "dirty_val", obj, rel, DELTA_PROBES)
+    val = _pair_key_probe(tables, "dirty", obj, rel, DELTA_PROBES)
     return jnp.maximum(val, 0)
+
+
+def pack_edge_table(obj, rel, skind, sa, sb, val) -> np.ndarray:
+    """Interleave six edge-table columns into [cap, 8] rows (pad lanes
+    zeroed) — the device layout every 5-key probe gathers."""
+    import numpy as _np
+
+    cap = obj.shape[0]
+    out = _np.zeros((cap, 8), dtype=_np.int32)
+    for i, col in enumerate((obj, rel, skind, sa, sb, val)):
+        out[:, i] = col
+    return out
+
+
+def pack_pair_table(obj, rel, val) -> np.ndarray:
+    """Interleave three (obj, rel)->val columns into [cap, 4] rows."""
+    import numpy as _np
+
+    cap = obj.shape[0]
+    out = _np.zeros((cap, 4), dtype=_np.int32)
+    for i, col in enumerate((obj, rel, val)):
+        out[:, i] = col
+    return out
+
+
+def pack_delta_tables(delta: dict) -> dict:
+    """The delta overlay's packed device tables (dd_pack + dirty_pack) —
+    the ONE place the delta column-to-row layout is defined."""
+    return {
+        "dd_pack": pack_edge_table(
+            delta["dd_obj"], delta["dd_rel"], delta["dd_skind"],
+            delta["dd_sa"], delta["dd_sb"], delta["dd_val"],
+        ),
+        "dirty_pack": pack_pair_table(
+            delta["dirty_obj"], delta["dirty_rel"], delta["dirty_val"]
+        ),
+    }
 
 
 class _State(NamedTuple):
@@ -285,10 +330,8 @@ def expand_phase(
     # (subject-set row), slots 1..K = the instruction relation
     rels_cols = [rel] + [ir[:, k] for k in range(K)]
 
-    # row lookup for every (obj, slot-relation): 3 gathers, slots batched
-    rows_cols = _multi_pair_key_probe(
-        tables, "rh", "rh_row", obj, rels_cols, rh_probes
-    )
+    # row lookup for every (obj, slot-relation): ONE packed row-gather
+    rows_cols = _multi_pair_key_probe(tables, "rh", obj, rels_cols, rh_probes)
     rows = jnp.stack(rows_cols, axis=1)  # [F, S]
     rows_c = jnp.clip(rows, 0, n_rows)
     starts = tables["row_ptr"][rows_c]  # [F, S]
@@ -312,7 +355,7 @@ def expand_phase(
     # delta-dirty rows (stale CSR contents): slot-0 expansion or TTU rows
     if has_delta:
         dirty_cols = _multi_pair_key_probe(
-            tables, "dirty", "dirty_val", obj, rels_cols, DELTA_PROBES
+            tables, "dirty", obj, rels_cols, DELTA_PROBES
         )
         row_dirty = jnp.stack(
             [(jnp.maximum(d, 0) & DIRTY_FOR_CHECK) != 0 for d in dirty_cols],
@@ -654,12 +697,32 @@ def check_kernel(
     return finalize(final, max_steps, B)
 
 
+PASSTHROUGH_TABLE_KEYS = (
+    "objslot_ns", "ns_has_config", "row_ptr", "e_obj", "e_rel",
+    "instr_kind", "instr_rel", "instr_rel2", "prog_flags",
+)
+
+
+def pack_raw_tables(raw: dict) -> dict:
+    """Interleave the 1-D column arrays into the packed device layout
+    (host-side numpy; GraphSnapshot / checkpoint formats stay columnar)."""
+    out = {k: raw[k] for k in PASSTHROUGH_TABLE_KEYS if k in raw}
+    out["dh_pack"] = pack_edge_table(
+        raw["dh_obj"], raw["dh_rel"], raw["dh_skind"],
+        raw["dh_sa"], raw["dh_sb"], raw["dh_val"],
+    )
+    out["rh_pack"] = pack_pair_table(raw["rh_obj"], raw["rh_rel"], raw["rh_row"])
+    if "dd_obj" in raw:
+        out.update(pack_delta_tables(raw))
+    return out
+
+
 def snapshot_tables(snapshot: GraphSnapshot, delta: dict | None = None) -> dict:
     """Device-resident table dict for check_kernel (uploads once); the
     delta-overlay tables default to empty (fixed shapes either way)."""
     raw = dict(snapshot.device_arrays())
     raw.update(delta or empty_delta_tables())
-    return {k: jnp.asarray(v) for k, v in raw.items()}
+    return {k: jnp.asarray(v) for k, v in pack_raw_tables(raw).items()}
 
 
 def refresh_delta_tables(tables: dict, delta: dict, vocab_arrays: dict) -> dict:
@@ -669,7 +732,7 @@ def refresh_delta_tables(tables: dict, delta: dict, vocab_arrays: dict) -> dict:
     out = dict(tables)
     for k, v in vocab_arrays.items():
         out[k] = jnp.asarray(v)
-    out.update({k: jnp.asarray(v) for k, v in delta.items()})
+    out.update({k: jnp.asarray(v) for k, v in pack_delta_tables(delta).items()})
     return out
 
 

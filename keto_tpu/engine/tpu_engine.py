@@ -256,9 +256,13 @@ class TPUCheckEngine:
             import jax
             from jax.sharding import NamedSharding, PartitionSpec as P
 
+            from .kernel import pack_delta_tables
+
             sharded_tables, replicated = state.tables
             replicated = dict(replicated)
-            for k, v in {**delta, **vocab_arrays}.items():
+            packed = dict(vocab_arrays)
+            packed.update(pack_delta_tables(delta))
+            for k, v in packed.items():
                 replicated[k] = jax.device_put(v, NamedSharding(self.mesh, P()))
             tables = (sharded_tables, replicated)
         else:
@@ -282,10 +286,14 @@ class TPUCheckEngine:
                 import jax
                 from jax.sharding import NamedSharding, PartitionSpec as P
 
+                from .kernel import pack_delta_tables
+
                 sharded_csr, _ = state.expand_tables
                 fresh_dirty = {
-                    k: jax.device_put(delta[k], NamedSharding(self.mesh, P()))
-                    for k in ("dirty_obj", "dirty_rel", "dirty_val")
+                    "dirty_pack": jax.device_put(
+                        pack_delta_tables(delta)["dirty_pack"],
+                        NamedSharding(self.mesh, P()),
+                    )
                 }
                 new_state.expand_tables = (sharded_csr, fresh_dirty)
             else:
@@ -304,9 +312,12 @@ class TPUCheckEngine:
     def _merge_expand_dirty(base_csr: dict, delta_np: dict) -> dict:
         import jax.numpy as jnp
 
+        from .kernel import pack_delta_tables
+
         merged = dict(base_csr)
-        for k in ("dirty_obj", "dirty_rel", "dirty_val"):
-            merged[k] = jnp.asarray(delta_np[k])
+        merged["dirty_pack"] = jnp.asarray(
+            pack_delta_tables(delta_np)["dirty_pack"]
+        )
         return merged
 
     def _mirror_cache_path(self) -> Optional[str]:
@@ -373,7 +384,16 @@ class TPUCheckEngine:
                 self.metrics.snapshot_build_duration.observe(
                     time.perf_counter() - build_start
                 )
-            return state, (snap if self.mesh is None else None)
+            return state, snap
+        columns_fn = getattr(self.manager, "all_tuple_columns", None)
+        if columns_fn is not None:
+            import logging
+
+            logging.getLogger("keto_tpu").warning(
+                "columnar store under a mesh falls back to per-tuple "
+                "ingest (sharded columnar build not yet implemented); "
+                "expect object-path memory/time costs at large scale"
+            )
         tuples = self.manager.all_relation_tuples(nid=self.nid)
         sharded = None
         if self.mesh is not None:
@@ -457,7 +477,17 @@ class TPUCheckEngine:
                 return state
             csr = build_full_csr(list(tuples), state.snapshot, view=state.view)
             fh_probes = csr.pop("fh_probes")
-            device_csr = {k: jnp.asarray(v) for k, v in csr.items()}
+            from .kernel import pack_pair_table
+
+            device_csr = {
+                "fh_pack": jnp.asarray(pack_pair_table(
+                    csr["fh_obj"], csr["fh_rel"], csr["fh_row"]
+                )),
+                "f_row_ptr": jnp.asarray(csr["f_row_ptr"]),
+                "f_skind": jnp.asarray(csr["f_skind"]),
+                "f_sa": jnp.asarray(csr["f_sa"]),
+                "f_sb": jnp.asarray(csr["f_sb"]),
+            }
             state.fh_probes = fh_probes
             state.base_decoder = ExpandDecoder(state.snapshot)
             state.decoder = state.base_decoder.extended(state.view.overlay)

@@ -37,7 +37,7 @@ from ..engine.delta import DIRTY_FOR_EXPAND
 from ..engine.expand_kernel import _ExpandState
 from ..engine.kernel import Expansion, _pair_key_probe, dedupe_phase, dirty_lookup
 from ..engine.snapshot import EMPTY
-from .sharding import _DELTA_KEYS, _EXPAND_SHARDED_KEYS
+from .sharding import _EXPAND_SHARDED_KEYS
 
 _kernel_cache: dict = {}
 _kernel_cache_lock = threading.Lock()
@@ -65,7 +65,7 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
             return start, length
 
         def row_lookup(obj, rel):
-            return _pair_key_probe(tables, "fh", "fh_row", obj, rel, fh_probes)
+            return _pair_key_probe(tables, "fh", obj, rel, fh_probes)
 
         root_row = row_lookup(q_obj, q_rel)
         _, root_len_local = row_span(root_row)
@@ -242,16 +242,37 @@ def get_sharded_expand_kernel(mesh: Mesh, statics: tuple, axis: str = "x"):
 def place_sharded_expand_tables(
     stacked: dict, delta_np: dict, mesh: Mesh, axis: str = "x"
 ) -> tuple[dict, dict]:
+    import numpy as np
+
+    from ..engine.kernel import pack_pair_table
+
     assert set(stacked) == set(_EXPAND_SHARDED_KEYS)
+    n = stacked["fh_obj"].shape[0]
+    fh_pack = np.zeros((n, stacked["fh_obj"].shape[1], 4), dtype=np.int32)
+    for i in range(n):
+        fh_pack[i] = pack_pair_table(
+            stacked["fh_obj"][i], stacked["fh_rel"][i], stacked["fh_row"][i]
+        )
+    raw = {
+        "fh_pack": fh_pack,
+        "f_row_ptr": stacked["f_row_ptr"],
+        "f_skind": stacked["f_skind"],
+        "f_sa": stacked["f_sa"],
+        "f_sb": stacked["f_sb"],
+    }
     sharded = {
         k: jax.device_put(
             v, NamedSharding(mesh, P(axis, *([None] * (v.ndim - 1))))
         )
-        for k, v in stacked.items()
+        for k, v in raw.items()
     }
+    from ..engine.kernel import pack_delta_tables
+
     replicated = {
-        k: jax.device_put(delta_np[k], NamedSharding(mesh, P()))
-        for k in ("dirty_obj", "dirty_rel", "dirty_val")
+        "dirty_pack": jax.device_put(
+            pack_delta_tables(delta_np)["dirty_pack"],
+            NamedSharding(mesh, P()),
+        )
     }
     return sharded, replicated
 

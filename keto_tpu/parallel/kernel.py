@@ -42,7 +42,12 @@ from ..engine.kernel import (
     probe_phase,
     seed_state,
 )
-from .sharding import ShardedSnapshot, _DELTA_KEYS, _REPLICATED_KEYS, _SHARDED_KEYS
+from .sharding import (
+    ShardedSnapshot,
+    _DELTA_DEVICE_KEYS,
+    _REPLICATED_KEYS,
+    _SHARDED_DEVICE_KEYS,
+)
 
 # compiled-executable cache; statics change as the graph grows (probe
 # counts track hash-table clustering), so bound it LRU-style — older
@@ -173,12 +178,36 @@ def place_sharded_tables(
     snap: ShardedSnapshot, mesh: Mesh, axis: str = "x"
 ) -> tuple[dict, dict]:
     """Upload tables once: sharded arrays split along the mesh axis (one
-    shard per device), small tables replicated."""
+    shard per device), small tables replicated. Hash tables pack into
+    interleaved rows per shard (kernel.pack_edge_table layout)."""
+    import numpy as np
+
+    from ..engine.kernel import pack_edge_table, pack_pair_table
+
+    s = snap.sharded
+    n = s["dh_obj"].shape[0]
+    # preallocate + pack in place: a list-of-arrays + np.stack would hold
+    # a second full copy of the dominant tables at peak (GBs at 1e8 edges)
+    dh_pack = np.zeros((n, s["dh_obj"].shape[1], 8), dtype=np.int32)
+    rh_pack = np.zeros((n, s["rh_obj"].shape[1], 4), dtype=np.int32)
+    for i in range(n):
+        dh_pack[i] = pack_edge_table(
+            s["dh_obj"][i], s["dh_rel"][i], s["dh_skind"][i],
+            s["dh_sa"][i], s["dh_sb"][i], s["dh_val"][i],
+        )
+        rh_pack[i] = pack_pair_table(s["rh_obj"][i], s["rh_rel"][i], s["rh_row"][i])
+    raw = {
+        "dh_pack": dh_pack,
+        "rh_pack": rh_pack,
+        "row_ptr": s["row_ptr"],
+        "e_obj": s["e_obj"],
+        "e_rel": s["e_rel"],
+    }
     sharded = {
         k: jax.device_put(
             v, NamedSharding(mesh, P(axis, *([None] * (v.ndim - 1))))
         )
-        for k, v in snap.sharded.items()
+        for k, v in raw.items()
     }
     replicated = {
         k: jax.device_put(v, NamedSharding(mesh, P()))
@@ -197,8 +226,10 @@ def sharded_check_kernel(
     axis: str = "x",
 ):
     """Returns (member[B], needs_host[B]); see engine/kernel.check_kernel."""
-    assert set(sharded_tables) == set(_SHARDED_KEYS)
-    assert set(replicated_tables) == set(_REPLICATED_KEYS) | set(_DELTA_KEYS)
+    assert set(sharded_tables) == set(_SHARDED_DEVICE_KEYS)
+    assert set(replicated_tables) == set(_REPLICATED_KEYS) | set(
+        _DELTA_DEVICE_KEYS
+    )
     fn = get_sharded_kernel(mesh, statics, axis)
     return fn(
         sharded_tables, replicated_tables,

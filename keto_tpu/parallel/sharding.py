@@ -36,19 +36,20 @@ from ..engine.snapshot import (
     mix32,
 )
 
+# host-side stacked column keys (the build format; device upload packs
+# the hash tables into interleaved rows, see kernel.pack_raw_tables)
 _SHARDED_KEYS = (
     "dh_obj", "dh_rel", "dh_skind", "dh_sa", "dh_sb", "dh_val",
     "rh_obj", "rh_rel", "rh_row", "row_ptr", "e_obj", "e_rel",
 )
+# device-side keys after packing
+_SHARDED_DEVICE_KEYS = ("dh_pack", "rh_pack", "row_ptr", "e_obj", "e_rel")
 _REPLICATED_KEYS = (
     "objslot_ns", "ns_has_config",
     "instr_kind", "instr_rel", "instr_rel2", "prog_flags",
 )
 # delta-overlay tables (engine/delta.py): small + fixed-shape, replicated
-_DELTA_KEYS = (
-    "dd_obj", "dd_rel", "dd_skind", "dd_sa", "dd_sb", "dd_val",
-    "dirty_obj", "dirty_rel", "dirty_val",
-)
+_DELTA_DEVICE_KEYS = ("dd_pack", "dirty_pack")
 
 
 def shard_of_objslot(obj_slot: np.ndarray, n_shards: int) -> np.ndarray:
@@ -150,8 +151,9 @@ def build_sharded_snapshot(
 
     replicated = {k: base.device_arrays()[k] for k in _REPLICATED_KEYS}
     from ..engine.delta import empty_delta_tables
+    from ..engine.kernel import pack_delta_tables
 
-    replicated.update(empty_delta_tables())
+    replicated.update(pack_delta_tables(empty_delta_tables()))
     return ShardedSnapshot(
         base=base,
         n_shards=n_shards,

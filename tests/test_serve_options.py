@@ -1,0 +1,129 @@
+"""CORS + TLS serve options (ref: internal/driver/daemon.go:289-349 CORS
+middleware and TLS listener config)."""
+
+import json
+import ssl
+import subprocess
+import urllib.request
+
+import pytest
+
+from keto_tpu.config import Config
+from keto_tpu.api.daemon import Daemon
+from keto_tpu.ketoapi import RelationTuple
+from keto_tpu.namespace import Namespace
+from keto_tpu.registry import Registry
+
+
+def _base_cfg(extra_serve=None):
+    serve = {
+        "read": {"host": "127.0.0.1", "port": 0},
+        "write": {"host": "127.0.0.1", "port": 0},
+        "metrics": {"host": "127.0.0.1", "port": 0},
+    }
+    for k, v in (extra_serve or {}).items():
+        serve[k].update(v)
+    cfg = Config({"dsn": "memory", "serve": serve})
+    cfg.set_namespaces([Namespace(name="files")])
+    return cfg
+
+
+class TestCORS:
+    def _daemon(self, cors):
+        extra = {"read": {"cors": cors}} if cors is not None else {}
+        reg = Registry(_base_cfg(extra))
+        reg.relation_tuple_manager().write_relation_tuples(
+            [RelationTuple.from_string("files:doc#owner@alice")]
+        )
+        d = Daemon(reg)
+        d.start()
+        return d
+
+    def test_allowed_origin_gets_headers(self):
+        d = self._daemon({"enabled": True, "allowed_origins": ["https://app.example"]})
+        try:
+            url = (
+                f"http://127.0.0.1:{d.read_port}/relation-tuples/check/openapi"
+                "?namespace=files&object=doc&relation=owner&subject_id=alice"
+            )
+            req = urllib.request.Request(url, headers={"Origin": "https://app.example"})
+            resp = urllib.request.urlopen(req)
+            assert resp.headers["Access-Control-Allow-Origin"] == "https://app.example"
+            # preflight
+            pre = urllib.request.Request(
+                url, method="OPTIONS", headers={"Origin": "https://app.example"}
+            )
+            p = urllib.request.urlopen(pre)
+            assert p.status == 204
+            assert "GET" in p.headers["Access-Control-Allow-Methods"]
+            # disallowed origin: no CORS headers
+            bad = urllib.request.Request(url, headers={"Origin": "https://evil.example"})
+            b = urllib.request.urlopen(bad)
+            assert b.headers.get("Access-Control-Allow-Origin") is None
+        finally:
+            d.stop()
+
+    def test_disabled_by_default(self):
+        d = self._daemon(None)
+        try:
+            url = (
+                f"http://127.0.0.1:{d.read_port}/relation-tuples/check/openapi"
+                "?namespace=files&object=doc&relation=owner&subject_id=alice"
+            )
+            req = urllib.request.Request(url, headers={"Origin": "https://app.example"})
+            resp = urllib.request.urlopen(req)
+            assert resp.headers.get("Access-Control-Allow-Origin") is None
+        finally:
+            d.stop()
+
+
+class TestTLS:
+    def test_rest_and_grpc_over_tls(self, tmp_path):
+        cert = tmp_path / "cert.pem"
+        key = tmp_path / "key.pem"
+        subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "rsa:2048",
+                "-keyout", str(key), "-out", str(cert),
+                "-days", "1", "-nodes", "-subj", "/CN=127.0.0.1",
+                "-addext", "subjectAltName=IP:127.0.0.1",
+            ],
+            check=True, capture_output=True,
+        )
+        reg = Registry(_base_cfg({
+            "read": {"tls": {"cert_path": str(cert), "key_path": str(key)}}
+        }))
+        reg.relation_tuple_manager().write_relation_tuples(
+            [RelationTuple.from_string("files:doc#owner@alice")]
+        )
+        d = Daemon(reg)
+        d.start()
+        try:
+            ctx = ssl.create_default_context(cafile=str(cert))
+            url = (
+                f"https://127.0.0.1:{d.read_port}/relation-tuples/check/openapi"
+                "?namespace=files&object=doc&relation=owner&subject_id=alice"
+            )
+            resp = json.load(urllib.request.urlopen(url, context=ctx))
+            assert resp == {"allowed": True}
+            # gRPC over the same TLS port
+            import grpc
+            from keto_tpu.api.descriptors import pb
+
+            creds = grpc.ssl_channel_credentials(cert.read_bytes())
+            ch = grpc.secure_channel(f"127.0.0.1:{d.read_port}", creds)
+            stub = ch.unary_unary(
+                "/ory.keto.relation_tuples.v1alpha2.CheckService/Check",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.CheckResponse.FromString,
+            )
+            req = pb.CheckRequest()
+            req.tuple.namespace = "files"
+            req.tuple.object = "doc"
+            req.tuple.relation = "owner"
+            req.tuple.subject.id = "alice"
+            out = stub(req, timeout=60)
+            assert out.allowed is True
+            ch.close()
+        finally:
+            d.stop()
