@@ -202,6 +202,126 @@ def bench_kernel(namespaces, tuples, queries) -> dict:
     }
 
 
+def bench_config3_islands() -> dict:
+    """BASELINE config 3: rewrite-heavy namespace with AND + NOT (the
+    island path). Round 1 flagged these host-only; they now run on
+    device — this measures that."""
+    from keto_tpu.config import Config
+    from keto_tpu.engine.tpu_engine import TPUCheckEngine
+    from keto_tpu.ketoapi import RelationTuple
+    from keto_tpu.namespace import Namespace
+    from keto_tpu.namespace.ast import (
+        ComputedSubjectSet,
+        InvertResult,
+        Operator,
+        Relation,
+        SubjectSetRewrite,
+    )
+    from keto_tpu.storage import MemoryManager
+
+    n_docs, n_users = 3000, 512
+    ns = [Namespace(name="acl", relations=[
+        Relation(name="allow"),
+        Relation(name="deny"),
+        Relation(name="access", subject_set_rewrite=SubjectSetRewrite(
+            operation=Operator.AND,
+            children=[
+                ComputedSubjectSet(relation="allow"),
+                InvertResult(child=ComputedSubjectSet(relation="deny")),
+            ])),
+    ])]
+    rng = random.Random(5)
+    tuples = []
+    for d in range(n_docs):
+        for _ in range(3):
+            tuples.append(RelationTuple.from_string(
+                f"acl:doc{d}#allow@u{rng.randrange(n_users)}"
+            ))
+        if rng.random() < 0.3:
+            tuples.append(RelationTuple.from_string(
+                f"acl:doc{d}#deny@u{rng.randrange(n_users)}"
+            ))
+    queries = [
+        RelationTuple.from_string(
+            f"acl:doc{rng.randrange(n_docs)}#access@u{rng.randrange(n_users)}"
+        )
+        for _ in range(BATCH)
+    ]
+    cfg = Config({"limit": {"max_read_depth": 5}})
+    cfg.set_namespaces(ns)
+    m = MemoryManager()
+    m.write_relation_tuples(tuples)
+    engine = TPUCheckEngine(m, cfg, frontier_cap=2 * BATCH)
+    engine.check_batch(queries)  # warm-up/compile
+    rounds = 5
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        engine.check_batch(queries)
+    wall = time.perf_counter() - t0
+    return {
+        "islands_qps": round(rounds * BATCH / wall, 1),
+        "islands_host_checks": engine.stats["host_checks"],
+    }
+
+
+def bench_config4_deep() -> dict:
+    """BASELINE config 4: drive-style nested folders, depth-20 recursive
+    Check (scaled bench_test.go:56-86 'deep' namespace)."""
+    from keto_tpu.config import Config
+    from keto_tpu.engine.tpu_engine import TPUCheckEngine
+    from keto_tpu.ketoapi import RelationTuple
+    from keto_tpu.namespace import Namespace
+    from keto_tpu.namespace.ast import (
+        ComputedSubjectSet,
+        Relation,
+        SubjectSetRewrite,
+        TupleToSubjectSet,
+    )
+    from keto_tpu.storage import MemoryManager
+
+    depth, n_chains, n_users = 20, 200, 128
+    ns = [Namespace(name="deep", relations=[
+        Relation(name="owner"),
+        Relation(name="parent"),
+        Relation(name="viewer", subject_set_rewrite=SubjectSetRewrite(children=[
+            ComputedSubjectSet(relation="owner"),
+            TupleToSubjectSet(relation="parent",
+                              computed_subject_set_relation="viewer"),
+        ])),
+    ])]
+    rng = random.Random(6)
+    tuples = []
+    owners = {}
+    for c in range(n_chains):
+        for i in range(depth):
+            tuples.append(RelationTuple.from_string(
+                f"deep:c{c}f{i}#parent@(deep:c{c}f{i + 1}#...)"
+            ))
+        owner = f"u{rng.randrange(n_users)}"
+        owners[c] = owner
+        tuples.append(RelationTuple.from_string(f"deep:c{c}f{depth}#owner@{owner}"))
+    queries = []
+    for i in range(BATCH):
+        c = rng.randrange(n_chains)
+        sub = owners[c] if i % 2 == 0 else f"u{rng.randrange(n_users)}"
+        queries.append(RelationTuple.from_string(f"deep:c{c}f0#viewer@{sub}"))
+    cfg = Config({"limit": {"max_read_depth": depth + 4}})
+    cfg.set_namespaces(ns)
+    m = MemoryManager()
+    m.write_relation_tuples(tuples)
+    engine = TPUCheckEngine(m, cfg, frontier_cap=2 * BATCH)
+    engine.check_batch(queries)
+    rounds = 5
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        engine.check_batch(queries)
+    wall = time.perf_counter() - t0
+    return {
+        "deep20_qps": round(rounds * BATCH / wall, 1),
+        "deep20_host_checks": engine.stats["host_checks"],
+    }
+
+
 def bench_served(namespaces, tuples, queries) -> dict:
     """Served path per BASELINE.md: a real daemon (port mux + batcher +
     device engine) under concurrent gRPC clients; per-REQUEST latency
@@ -351,6 +471,9 @@ def main() -> int:
         record["value"] = kernel.pop("value")
         record["vs_baseline"] = round(record["value"] / NORTH_STAR_QPS, 4)
         record.update(kernel)
+
+        record.update(bench_config3_islands())
+        record.update(bench_config4_deep())
 
         if not args.skip_serve:
             record.update(bench_served(namespaces, tuples, queries))

@@ -128,7 +128,11 @@ class PortMux:
             backend = socket.create_connection(backend_addr)
             if consumed:
                 backend.sendall(consumed)
-            conn.settimeout(None)
+            # TLS sockets keep a recv timeout in the splice: a partial TLS
+            # record makes the raw fd selectable while SSLSocket.recv
+            # blocks for the rest of the record — a stalled client must
+            # not freeze the pump thread forever
+            conn.settimeout(60 if self.ssl_context is not None else None)
             self._splice(conn, backend)
         except OSError:
             try:
@@ -158,6 +162,8 @@ class PortMux:
                             if not more:
                                 break
                             data += more
+                    except socket.timeout:
+                        continue  # partial TLS record: not a close
                     except OSError:
                         data = b""
                     if not data:

@@ -209,13 +209,17 @@ class TPUCheckEngine:
         with self._persist_mu:
             self._persist_timer = None
             snap, self._pending_persist = self._pending_persist, None
-        if cache_path is None or snap is None:
-            return
         try:
+            # ALWAYS pass through _write_mu, even with nothing to write:
+            # flush_checkpoints() may race a timer thread that already took
+            # the pending snapshot — the empty-handed caller must BARRIER
+            # on the in-flight write so "flushed" means "on disk"
             with self._write_mu:
-                save_snapshot(snap, cache_path)
-            with self._persist_mu:
-                self._last_persist = time.monotonic()
+                if cache_path is not None and snap is not None:
+                    save_snapshot(snap, cache_path)
+            if snap is not None:
+                with self._persist_mu:
+                    self._last_persist = time.monotonic()
         except OSError as err:  # cache write failure must not block serving
             import logging
 
@@ -385,7 +389,6 @@ class TPUCheckEngine:
                     time.perf_counter() - build_start
                 )
             return state, snap
-        columns_fn = getattr(self.manager, "all_tuple_columns", None)
         if columns_fn is not None:
             import logging
 

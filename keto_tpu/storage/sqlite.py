@@ -38,7 +38,45 @@ from .definitions import (
 )
 from .mapping import map_string_to_uuid
 
-MIGRATIONS: list[tuple[str, list[str], list[str]]] = [
+# each migration is (version, up_steps, down_steps); every step is
+# IDEMPOTENT (IF [NOT] EXISTS / idempotent inserts) so a run interrupted
+# mid-version converges on retry; a step is either a
+# SQL string or the registered name of a Python data migration — the
+# reference's popx.WithGoMigrations data migrations
+# (internal/persistence/sql/migrations/uuidmapping/uuid_mapping_migrator.go)
+MIGRATIONS: list[tuple[str, list, list]] = [
+    (
+        "20210623162417_create_legacy_relation_tuples",
+        [
+            # the reference's FIRST schema (string object, numeric
+            # namespace id; 20210623162417000000_relationtuple.*.up.sql)
+            # — kept so pre-UUID databases can data-migrate forward
+            """
+            CREATE TABLE IF NOT EXISTS keto_relation_tuples (
+                shard_id TEXT NOT NULL,
+                nid TEXT NOT NULL,
+                namespace_id INTEGER NOT NULL,
+                object TEXT NOT NULL,
+                relation TEXT NOT NULL,
+                subject_id TEXT NULL,
+                subject_set_namespace_id INTEGER NULL,
+                subject_set_object TEXT NULL,
+                subject_set_relation TEXT NULL,
+                commit_time REAL NOT NULL DEFAULT (strftime('%s','now')),
+                PRIMARY KEY (shard_id, nid),
+                CONSTRAINT chk_keto_rt_subject_type CHECK
+                    ((subject_id IS NULL AND subject_set_namespace_id IS NOT NULL
+                      AND subject_set_object IS NOT NULL
+                      AND subject_set_relation IS NOT NULL)
+                     OR
+                     (subject_id IS NOT NULL AND subject_set_namespace_id IS NULL
+                      AND subject_set_object IS NULL
+                      AND subject_set_relation IS NULL))
+            )
+            """
+        ],
+        ["DROP TABLE IF EXISTS keto_relation_tuples"],
+    ),
     (
         "20220513200300_create_uuid_mappings",
         [
@@ -48,7 +86,7 @@ MIGRATIONS: list[tuple[str, list[str], list[str]]] = [
             # so the composite key costs nothing and prevents cross-tenant
             # string disclosure.
             """
-            CREATE TABLE keto_uuid_mappings (
+            CREATE TABLE IF NOT EXISTS keto_uuid_mappings (
                 id TEXT NOT NULL,
                 nid TEXT NOT NULL,
                 string_representation TEXT NOT NULL,
@@ -56,19 +94,19 @@ MIGRATIONS: list[tuple[str, list[str], list[str]]] = [
             )
             """
         ],
-        ["DROP TABLE keto_uuid_mappings"],
+        ["DROP TABLE IF EXISTS keto_uuid_mappings"],
     ),
     (
         "20220513200302_create_store_version",
         [
             """
-            CREATE TABLE keto_store_version (
+            CREATE TABLE IF NOT EXISTS keto_store_version (
                 nid TEXT PRIMARY KEY,
                 version INTEGER NOT NULL DEFAULT 0
             )
             """
         ],
-        ["DROP TABLE keto_store_version"],
+        ["DROP TABLE IF EXISTS keto_store_version"],
     ),
     (
         "20220513200303_create_change_log",
@@ -77,7 +115,7 @@ MIGRATIONS: list[tuple[str, list[str], list[str]]] = [
             # overlay (incremental device-mirror refresh); no reference
             # equivalent — Keto replicas re-read SQL on every query
             """
-            CREATE TABLE keto_change_log (
+            CREATE TABLE IF NOT EXISTS keto_change_log (
                 seq INTEGER PRIMARY KEY AUTOINCREMENT,
                 nid TEXT NOT NULL,
                 version INTEGER NOT NULL,
@@ -86,17 +124,17 @@ MIGRATIONS: list[tuple[str, list[str], list[str]]] = [
             )
             """,
             """
-            CREATE INDEX keto_change_log_nid_version_idx
+            CREATE INDEX IF NOT EXISTS keto_change_log_nid_version_idx
                 ON keto_change_log (nid, version)
             """,
         ],
-        ["DROP TABLE keto_change_log"],
+        ["DROP TABLE IF EXISTS keto_change_log"],
     ),
     (
         "20220513200301_create_relation_tuples_uuid",
         [
             """
-            CREATE TABLE keto_relation_tuples_uuid (
+            CREATE TABLE IF NOT EXISTS keto_relation_tuples_uuid (
                 shard_id TEXT NOT NULL,
                 nid TEXT NOT NULL,
                 namespace TEXT NOT NULL,
@@ -118,24 +156,103 @@ MIGRATIONS: list[tuple[str, list[str], list[str]]] = [
             )
             """,
             """
-            CREATE INDEX keto_relation_tuples_uuid_full_idx
+            CREATE INDEX IF NOT EXISTS keto_relation_tuples_uuid_full_idx
                 ON keto_relation_tuples_uuid (nid, namespace, object, relation)
             """,
             """
-            CREATE INDEX keto_relation_tuples_uuid_reverse_subject_ids_idx
+            CREATE INDEX IF NOT EXISTS keto_relation_tuples_uuid_reverse_subject_ids_idx
                 ON keto_relation_tuples_uuid (nid, subject_id, relation, namespace)
                 WHERE subject_id IS NOT NULL
             """,
             """
-            CREATE INDEX keto_relation_tuples_uuid_reverse_subject_sets_idx
+            CREATE INDEX IF NOT EXISTS keto_relation_tuples_uuid_reverse_subject_sets_idx
                 ON keto_relation_tuples_uuid
                    (nid, subject_set_namespace, subject_set_object, subject_set_relation)
                 WHERE subject_set_namespace IS NOT NULL
             """,
         ],
-        ["DROP TABLE keto_relation_tuples_uuid"],
+        ["DROP TABLE IF EXISTS keto_relation_tuples_uuid"],
+    ),
+    (
+        # popx.WithGoMigrations analog: code, not SQL (uuid_mapping_migrator
+        # .go:150-330) — batches legacy string rows into the UUID-encoded
+        # table, writing the string->UUID mappings as it goes
+        "20220513200400_migrate_strings_to_uuids",
+        ["__migrate_strings_to_uuids__"],
+        [],
     ),
 ]
+
+
+def _migrate_strings_to_uuids(persister) -> None:
+    """Data migration: legacy keto_relation_tuples (string object, numeric
+    namespace_id) -> keto_relation_tuples_uuid + keto_uuid_mappings.
+
+    Mirrors the reference migrator's shape (keyset batches of 100 ordered
+    by shard id, batched mapping writes, then batched inserts,
+    uuid_mapping_migrator.go:150-330). Namespace ids resolve through
+    `persister.legacy_namespaces` (the config namespaces' deprecated
+    numeric ids); unknown ids fail the migration loudly, like the
+    reference's namespaceIDtoName error."""
+    conn = persister._conn
+    names = persister.legacy_namespaces or {}
+    # composite keyset cursor: the legacy PK is (shard_id, nid), so two
+    # networks may share a shard_id — paginating on shard_id alone would
+    # silently skip same-shard rows of the next nid at batch boundaries
+    last_sid, last_nid = "", ""
+    migrated_nids = set()
+    while True:
+        rows = conn.execute(
+            """SELECT shard_id, nid, namespace_id, object, relation,
+                      subject_id, subject_set_namespace_id,
+                      subject_set_object, subject_set_relation
+                 FROM keto_relation_tuples
+                WHERE shard_id > ? OR (shard_id = ? AND nid > ?)
+                ORDER BY shard_id, nid LIMIT 100""",
+            (last_sid, last_sid, last_nid),
+        ).fetchall()
+        if not rows:
+            break
+        last_sid, last_nid = rows[-1][0], rows[-1][1]
+        inserts = []
+        for (_sid, nid, ns_id, obj, rel, sub_id, ss_ns_id, ss_obj, ss_rel) in rows:
+            if ns_id not in names:
+                raise NotFoundError(
+                    f"cannot migrate: unknown legacy namespace id {ns_id}"
+                )
+            ns = names[ns_id]
+            if sub_id is not None:
+                t = RelationTuple(
+                    namespace=ns, object=obj, relation=rel, subject_id=sub_id
+                )
+            else:
+                if ss_ns_id not in names:
+                    raise NotFoundError(
+                        f"cannot migrate: unknown legacy namespace id {ss_ns_id}"
+                    )
+                t = RelationTuple(
+                    namespace=ns, object=obj, relation=rel,
+                    subject_set=SubjectSet(
+                        namespace=names[ss_ns_id],
+                        object=ss_obj,
+                        relation=ss_rel,
+                    ),
+                )
+            inserts.append((nid, t))
+            migrated_nids.add(nid)
+        # write through the normal (idempotent) insert path: mappings,
+        # deterministic shard ids, store-version bump, and change log all
+        # behave exactly like ordinary writes (the lock is re-entrant)
+        by_nid: dict[str, list[RelationTuple]] = {}
+        for nid, t in inserts:
+            by_nid.setdefault(nid, []).append(t)
+        for nid, ts in by_nid.items():
+            persister.write_relation_tuples(ts, nid=nid)
+
+
+_DATA_MIGRATIONS = {
+    "__migrate_strings_to_uuids__": _migrate_strings_to_uuids,
+}
 
 _SELECT = """
 SELECT t.namespace, mo.string_representation, t.relation,
@@ -158,12 +275,20 @@ class SQLitePersister:
     CONNECT_MAX_WAIT = 60.0
     CONNECT_BASE_DELAY = 0.1
 
-    def __init__(self, dsn: str = "memory", auto_migrate: bool = True):
+    def __init__(
+        self,
+        dsn: str = "memory",
+        auto_migrate: bool = True,
+        legacy_namespaces: dict | None = None,
+    ):
         path = ":memory:" if dsn in ("memory", ":memory:") else dsn
         self._conn = self._connect_with_backoff(path)
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA foreign_keys=ON")
         self._lock = threading.RLock()
+        # numeric namespace-id -> name map for the strings-to-uuids data
+        # migration (the reference resolves via namespace.Manager configs)
+        self.legacy_namespaces = legacy_namespaces
         if auto_migrate:
             self.migrate_up()
 
@@ -228,7 +353,11 @@ class SQLitePersister:
                 if version in applied:
                     continue
                 for stmt in ups:
-                    self._conn.execute(stmt)
+                    runner = _DATA_MIGRATIONS.get(stmt)
+                    if runner is not None:
+                        runner(self)
+                    else:
+                        self._conn.execute(stmt)
                 self._conn.execute(
                     "INSERT INTO keto_migrations (version) VALUES (?)", (version,)
                 )
@@ -246,7 +375,11 @@ class SQLitePersister:
             by_version = {v: downs for v, _, downs in MIGRATIONS}
             for version in reversed(applied[-steps:] if steps > 0 else []):
                 for stmt in by_version.get(version, []):
-                    self._conn.execute(stmt)
+                    runner = _DATA_MIGRATIONS.get(stmt)
+                    if runner is not None:
+                        runner(self)
+                    else:
+                        self._conn.execute(stmt)
                 self._conn.execute(
                     "DELETE FROM keto_migrations WHERE version = ?", (version,)
                 )

@@ -230,3 +230,73 @@ class TestParser:
     def test_empty_input(self):
         ns, errs = parse("")
         assert ns == [] and errs == []
+
+
+class TestParserFuzz:
+    """Fuzz harness analog of the reference's go114-fuzz-build target
+    (internal/schema/parser_fuzzer.go, Makefile:16): the parser must
+    never raise an unhandled exception or hang — any input yields either
+    namespaces or a well-formed error list."""
+
+    SEED_CORPUS = [
+        "",
+        "class Doc implements Namespace {}",
+        """class User implements Namespace {}
+class Doc implements Namespace {
+  related: { owners: User[], viewers: (User | SubjectSet<Doc, "viewers">)[] }
+  permits = { view: (ctx) => this.related.owners.includes(ctx.subject) ||
+                             this.related.viewers.includes(ctx.subject) }
+}""",
+        "class A implements Namespace { permits = { p: (ctx) => !this.related.x.includes(ctx.subject) } }",
+    ]
+
+    def _check(self, source: str) -> None:
+        import keto_tpu.opl.parser as opl_parser
+
+        namespaces, errs = opl_parser.parse(source)
+        assert isinstance(namespaces, list)
+        assert isinstance(errs, list)
+        for e in errs:
+            assert isinstance(e.msg, str) and e.msg
+
+    def test_byte_soup(self):
+        import random
+
+        rng = random.Random(0xF22)
+        alphabet = (
+            "class implements Namespace related permits this ctx subject "
+            "includes traverse => ( ) { } [ ] < > | & ! , : . \" ' 0 1 x\n\t"
+        ).split(" ") + ['"unterminated', "\\", "\x00", "é", "🙂"]
+        for _ in range(300):
+            source = "".join(
+                rng.choice(alphabet) + rng.choice([" ", ""])
+                for _ in range(rng.randrange(0, 120))
+            )
+            self._check(source)
+
+    def test_mutated_corpus(self):
+        import random
+
+        rng = random.Random(0xF23)
+        for base in self.SEED_CORPUS:
+            for _ in range(150):
+                chars = list(base)
+                for _ in range(rng.randrange(1, 6)):
+                    op = rng.randrange(3)
+                    pos = rng.randrange(len(chars) + 1) if chars else 0
+                    if op == 0 and chars:
+                        del chars[min(pos, len(chars) - 1)]
+                    elif op == 1:
+                        chars.insert(pos, rng.choice("{}()[]<>|&!.,:\"x "))
+                    elif chars:
+                        chars[min(pos, len(chars) - 1)] = rng.choice("{}()!|&")
+                self._check("".join(chars))
+
+    def test_pathological_nesting(self):
+        # nesting caps must reject, not recurse to a crash
+        deep = ("(" * 2000) + "ctx" + (")" * 2000)
+        self._check(
+            "class A implements Namespace { permits = { p: (ctx) => "
+            + deep + " } }"
+        )
+        self._check("class A implements Namespace {" * 500)

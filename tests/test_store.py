@@ -206,8 +206,11 @@ class TestMigrations:
         assert all(s == "Pending" for _, s in p.migration_status())
         p.migrate_up()
         assert all(s == "Applied" for _, s in p.migration_status())
-        p.migrate_down(3)
+        # peel 4: the strings-to-uuids data migration, the uuid table,
+        # the change log, and the store-version table
+        p.migrate_down(4)
         status = dict(p.migration_status())
+        assert status["20220513200400_migrate_strings_to_uuids"] == "Pending"
         assert status["20220513200302_create_store_version"] == "Pending"
         assert status["20220513200303_create_change_log"] == "Pending"
         assert status["20220513200301_create_relation_tuples_uuid"] == "Pending"
@@ -260,3 +263,127 @@ class TestRegressions:
         store.write_relation_tuples(ts("n:o#r@u"), nid="a")
         assert store.version(nid="a") == v0 + 1
         assert store.version(nid="b") == 0
+
+
+class TestLegacyDataMigration:
+    """Golden-fixture upgrade test: plant reference-era legacy rows
+    (string object, numeric namespace ids) in a pre-UUID database, run
+    the migration box, and assert the modern API serves them — the
+    migratest analog (internal/persistence/sql/migrations/migratest/,
+    uuid_mapping_migrator.go:150-330)."""
+
+    # golden rows in the 20210623162417 schema
+    GOLDEN = [
+        # (shard_id, nid, ns_id, object, relation, subject_id, ss_ns, ss_obj, ss_rel)
+        ("00000000-0000-0000-0000-000000000001", "net1", 1, "/photos", "owner",
+         "maureen", None, None, None),
+        ("00000000-0000-0000-0000-000000000002", "net1", 1, "/photos/summer.jpg",
+         "view", None, 1, "/photos", "owner"),
+        ("00000000-0000-0000-0000-000000000003", "net2", 2, "report", "editor",
+         "amy", None, None, None),
+    ]
+
+    def _plant(self, p):
+        for row in self.GOLDEN:
+            p._conn.execute(
+                """INSERT INTO keto_relation_tuples
+                   (shard_id, nid, namespace_id, object, relation, subject_id,
+                    subject_set_namespace_id, subject_set_object,
+                    subject_set_relation)
+                   VALUES (?,?,?,?,?,?,?,?,?)""",
+                row,
+            )
+        p._conn.commit()
+
+    def test_golden_upgrade(self):
+        p = SQLitePersister(
+            "memory", auto_migrate=False,
+            legacy_namespaces={1: "files", 2: "docs"},
+        )
+        # apply only the legacy schema, then plant the golden data
+        from keto_tpu.storage.sqlite import MIGRATIONS
+
+        with p._lock:
+            p._ensure_migration_table()
+            version, ups, _ = MIGRATIONS[0]
+            for stmt in ups:
+                p._conn.execute(stmt)
+            p._conn.execute(
+                "INSERT INTO keto_migrations (version) VALUES (?)", (version,)
+            )
+            p._conn.commit()
+        self._plant(p)
+
+        p.migrate_up()  # the remaining schema + the data migration
+
+        got1 = sorted(str(t) for t in p.all_relation_tuples(nid="net1"))
+        assert got1 == [
+            "files:/photos#owner@maureen",
+            "files:/photos/summer.jpg#view@(files:/photos#owner)",
+        ]
+        got2 = [str(t) for t in p.all_relation_tuples(nid="net2")]
+        assert got2 == ["docs:report#editor@amy"]
+        # nid isolation survived the migration
+        assert p.all_relation_tuples(nid="net1") != p.all_relation_tuples(nid="net2")
+        # the modern exists-probe sees migrated rows
+        assert p.relation_tuple_exists(ts("files:/photos#owner@maureen")[0], nid="net1")
+        # idempotent: re-running the data migration duplicates nothing
+        from keto_tpu.storage.sqlite import _migrate_strings_to_uuids
+
+        _migrate_strings_to_uuids(p)
+        assert len(p.all_relation_tuples(nid="net1")) == 2
+
+    def test_unknown_namespace_id_fails_loudly(self):
+        import pytest as _pytest
+
+        from keto_tpu.errors import NotFoundError
+        from keto_tpu.storage.sqlite import MIGRATIONS
+
+        p = SQLitePersister("memory", auto_migrate=False, legacy_namespaces={})
+        with p._lock:
+            p._ensure_migration_table()
+            version, ups, _ = MIGRATIONS[0]
+            for stmt in ups:
+                p._conn.execute(stmt)
+            p._conn.execute(
+                "INSERT INTO keto_migrations (version) VALUES (?)", (version,)
+            )
+            p._conn.commit()
+        self._plant(p)
+        with _pytest.raises(NotFoundError):
+            p.migrate_up()
+
+
+class TestMigrationKeysetBoundary:
+    def test_same_shard_id_across_nids_not_skipped(self):
+        """Composite (shard_id, nid) keyset: >100 rows where consecutive
+        nids share shard ids must all migrate (the shard_id-only cursor
+        silently dropped same-shard rows of the next nid)."""
+        p = SQLitePersister(
+            "memory", auto_migrate=False, legacy_namespaces={1: "n"}
+        )
+        from keto_tpu.storage.sqlite import MIGRATIONS
+
+        with p._lock:
+            p._ensure_migration_table()
+            version, ups, _ = MIGRATIONS[0]
+            for stmt in ups:
+                p._conn.execute(stmt)
+            p._conn.execute(
+                "INSERT INTO keto_migrations (version) VALUES (?)", (version,)
+            )
+        # 120 shard ids, each present in TWO networks -> 240 rows, so a
+        # batch boundary lands inside some shared-shard_id pair
+        for i in range(120):
+            sid = f"00000000-0000-0000-0000-{i:012d}"
+            for nid in ("net-a", "net-b"):
+                p._conn.execute(
+                    """INSERT INTO keto_relation_tuples
+                       (shard_id, nid, namespace_id, object, relation, subject_id)
+                       VALUES (?,?,?,?,?,?)""",
+                    (sid, nid, 1, f"o{i}", "r", f"u{i}"),
+                )
+        p._conn.commit()
+        p.migrate_up()
+        assert len(p.all_relation_tuples(nid="net-a")) == 120
+        assert len(p.all_relation_tuples(nid="net-b")) == 120
