@@ -175,7 +175,17 @@ class PortMux:
                             pass
                         continue
                     try:
-                        dst.sendall(data)
+                        # the recv timeout must not govern sends: a slow
+                        # but alive client with a full receive window is
+                        # not a dead peer — clear it for the write
+                        prev = dst.gettimeout()
+                        if prev:
+                            dst.settimeout(None)
+                        try:
+                            dst.sendall(data)
+                        finally:
+                            if prev:
+                                dst.settimeout(prev)
                     except OSError:
                         return
         finally:

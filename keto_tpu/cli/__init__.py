@@ -147,17 +147,10 @@ def cmd_migrate(args) -> int:
     if not dsn.startswith("sqlite://"):
         print(f"dsn {dsn!r} needs no migrations")
         return 0
-    # deprecated numeric namespace ids feed the strings->UUIDs data
-    # migration (ref: cmd/migrate builds the box with config namespaces)
-    legacy = {
-        ns.id: ns.name
-        for ns in config.namespace_manager().namespaces()
-        if ns.id is not None
-    }
     p = SQLitePersister(
         dsn.removeprefix("sqlite://"),
         auto_migrate=False,
-        legacy_namespaces=legacy or None,
+        legacy_namespaces=config.legacy_namespace_ids(),
     )
     if args.action == "status":
         for name, status in p.migration_status():

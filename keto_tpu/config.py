@@ -329,6 +329,18 @@ class Config:
             raise ConfigError("invalid `namespaces` config value")
         return self._namespace_manager
 
+    def legacy_namespace_ids(self) -> Optional[dict]:
+        """Deprecated numeric namespace-id -> name map for the legacy
+        strings->UUIDs data migration (the reference resolves these via
+        namespace.Manager; uuid_mapping_migrator.go namespaceIDtoName).
+        None when no configured namespace carries a numeric id."""
+        legacy = {
+            ns.id: ns.name
+            for ns in self.namespace_manager().namespaces()
+            if ns.id is not None
+        }
+        return legacy or None
+
     def set_namespaces(self, namespaces: list[Namespace]) -> None:
         """Programmatic namespace injection (the embedders' path; mirrors
         tests in the reference setting Namespace.Relations directly)."""

@@ -727,6 +727,10 @@ class TPUCheckEngine:
 
         results: list[CheckResult] = []
         n_host = 0
+        # identical host-replayed queries within one batch evaluate once
+        # (an adversarial batch of 4096 same-tuple fallbacks would
+        # otherwise serialize 4096 recursive walks)
+        replay_memo: dict[tuple, CheckResult] = {}
         with self.tracer.span("engine.resolve_batch", batch=n) as sp:
             for i, t in enumerate(tuples):
                 if i < B and q_valid[i] and not needs_host[i]:
@@ -739,9 +743,20 @@ class TPUCheckEngine:
                     )
                 else:
                     n_host += 1
-                    results.append(
-                        self.reference.check_relation_tuple(t, max_depth, self.nid)
+                    # field-structured key: the display string is NOT
+                    # injective (a subject_id spelled "(ns:obj#rel)"
+                    # renders like a real subject set)
+                    key = (
+                        t.namespace, t.object, t.relation, t.subject_id,
+                        t.subject_set, max_depth,
                     )
+                    res = replay_memo.get(key)
+                    if res is None:
+                        res = self.reference.check_relation_tuple(
+                            t, max_depth, self.nid
+                        )
+                        replay_memo[key] = res
+                    results.append(res)
             sp.set_attribute("host_replays", n_host)
         self.stats["device_checks"] += n - n_host
         self.stats["host_checks"] += n_host

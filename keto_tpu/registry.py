@@ -127,18 +127,9 @@ class Registry:
 
                     self._manager = ColumnarStore()
                 elif dsn.startswith("sqlite://"):
-                    # deprecated numeric namespace ids from config feed the
-                    # legacy strings->UUIDs data migration (the reference
-                    # resolves them via namespace.Manager; uuid_mapping_
-                    # migrator.go namespaceIDtoName)
-                    legacy = {
-                        ns.id: ns.name
-                        for ns in self.namespace_manager().namespaces()
-                        if ns.id is not None
-                    }
                     self._manager = SQLitePersister(
                         dsn.removeprefix("sqlite://"),
-                        legacy_namespaces=legacy or None,
+                        legacy_namespaces=self.config.legacy_namespace_ids(),
                     )
                 else:
                     raise ValueError(f"unsupported DSN: {dsn!r}")

@@ -200,7 +200,6 @@ def _migrate_strings_to_uuids(persister) -> None:
     # networks may share a shard_id — paginating on shard_id alone would
     # silently skip same-shard rows of the next nid at batch boundaries
     last_sid, last_nid = "", ""
-    migrated_nids = set()
     while True:
         rows = conn.execute(
             """SELECT shard_id, nid, namespace_id, object, relation,
@@ -239,7 +238,6 @@ def _migrate_strings_to_uuids(persister) -> None:
                     ),
                 )
             inserts.append((nid, t))
-            migrated_nids.add(nid)
         # write through the normal (idempotent) insert path: mappings,
         # deterministic shard ids, store-version bump, and change log all
         # behave exactly like ordinary writes (the lock is re-entrant)
