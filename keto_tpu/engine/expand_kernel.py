@@ -251,7 +251,9 @@ def expand_kernel(
         nt_q, _nt_ctx, nt_obj, nt_rel, nt_depth, n_new, overflow_q = dedupe_phase(
             children, F, B
         )
-        needs_host = needs_host | overflow_q
+        # dedupe reports int32 cause codes (shared with the check kernel);
+        # the expand state keeps a boolean flag
+        needs_host = needs_host | (overflow_q > 0)
         return _ExpandState(
             nt_q, nt_obj, nt_rel, nt_depth, n_new,
             eb_pobj, eb_prel, eb_skind, eb_sa, eb_sb,

@@ -69,6 +69,36 @@ from .snapshot import (
 
 _GOLDEN = jnp.uint32(0x9E3779B9)
 
+# Host-replay cause codes, priority-ordered (VERDICT r2 item 7: a host
+# fallback because of an AND/NOT cap must be distinguishable from one
+# because of an error). Each flag site scatter-maxes its code into the
+# per-query needs_host array — the SAME single scatter per site as the
+# old boolean scheme, so observability costs no extra device work. A
+# query flagged for several reasons reports the highest code (more
+# specific/semantic causes outrank capacity ones).
+CAUSE_NONE = 0
+CAUSE_STEP_EXHAUSTED = 1  # step budget ran out with live tasks
+CAUSE_FRONTIER_OVERFLOW = 2  # expansion truncated / dedupe survivors > F
+CAUSE_ISLAND_OVERFLOW = 3  # island instance table full (island_cap)
+CAUSE_DIRTY = 4  # delta-dirty CSR row (stale compacted data)
+CAUSE_REL_NOT_FOUND = 5  # relation missing from a configured namespace
+CAUSE_CONFIG_MISSING = 6  # FLAG_CONFIG_MISSING program
+CAUSE_REWRITE_CAP = 7  # FLAG_HOST_ONLY: rewrite exceeds instr/circuit caps
+CAUSE_ISLAND_HOST = 8  # AND/NOT program, kernel compiled without islands
+
+CAUSE_NAMES = {
+    CAUSE_STEP_EXHAUSTED: "step_exhausted",
+    CAUSE_FRONTIER_OVERFLOW: "frontier_overflow",
+    CAUSE_ISLAND_OVERFLOW: "island_overflow",
+    CAUSE_DIRTY: "dirty_row",
+    CAUSE_REL_NOT_FOUND: "relation_not_found",
+    CAUSE_CONFIG_MISSING: "config_missing",
+    CAUSE_REWRITE_CAP: "rewrite_cap",
+    CAUSE_ISLAND_HOST: "island_host",
+}
+# host-side-only cause (query vocabulary never reached the device)
+CAUSE_NAME_UNINDEXED = "unindexed"
+
 
 def _mix32(x: jnp.ndarray) -> jnp.ndarray:
     x = x ^ (x >> jnp.uint32(16))
@@ -206,7 +236,7 @@ class _State(NamedTuple):
     # ctx_hit[:B] is the per-query root verdict (the old `member`);
     # ctx_hit[B + i*K + k] accumulates island i's leaf-k sub-check
     ctx_hit: jnp.ndarray  # [B + NI*K] bool
-    needs_host: jnp.ndarray  # [B] bool
+    needs_host: jnp.ndarray  # [B] int32 cause code (CAUSE_*; 0 = on device)
     # island instance table (populated only when NI > 0)
     isl_parent: jnp.ndarray  # [max(NI,1)] ctx the island's result ORs into
     isl_pid: jnp.ndarray  # [max(NI,1)] program id (selects the circuit)
@@ -228,27 +258,30 @@ class Expansion(NamedTuple):
 def flag_phase(
     tables, obj, rel, live, *, n_config_rels: int, island_is_host: bool = False,
 ):
-    """Per-task host-replay flags; pure function of replicated tables, so
-    every shard computes the identical result (no collective needed).
-    ref: engine.go:219-228 (relation-not-found), snapshot FLAG_* bits.
-    `island_is_host=True` (a kernel compiled with n_island_cap=0) routes
-    AND/NOT programs to exact host replay — evaluating them with the
-    pure-union fast path would silently corrupt verdicts."""
+    """Per-task host-replay CAUSE codes (0 = stay on device); pure
+    function of replicated tables, so every shard computes the identical
+    result (no collective needed). ref: engine.go:219-228
+    (relation-not-found), snapshot FLAG_* bits. `island_is_host=True`
+    (a kernel compiled with n_island_cap=0) routes AND/NOT programs to
+    exact host replay — evaluating them with the pure-union fast path
+    would silently corrupt verdicts. The per-task causes here are
+    mutually exclusive by construction (a program compiles to exactly one
+    of HOST_ONLY / ISLAND / plain; CONFIG_MISSING programs are never
+    compiled), so one int code loses nothing vs a bitmask."""
     ns = tables["objslot_ns"][jnp.clip(obj, 0, None)]
     has_prog = (rel < n_config_rels) & live
     pid = jnp.where(has_prog, ns * n_config_rels + rel, 0)
     flags = jnp.where(has_prog, tables["prog_flags"][pid], 0)
-    host_mask = FLAG_HOST_ONLY | FLAG_CONFIG_MISSING
+    code = jnp.where((flags & FLAG_HOST_ONLY) != 0, CAUSE_REWRITE_CAP, 0)
+    code = jnp.where((flags & FLAG_CONFIG_MISSING) != 0, CAUSE_CONFIG_MISSING, code)
     if island_is_host:
-        host_mask |= FLAG_ISLAND
-    flagged = (flags & host_mask) != 0
+        code = jnp.where((flags & FLAG_ISLAND) != 0, CAUSE_ISLAND_HOST, code)
     # a data-only relation (id >= n_config_rels) visited inside a
     # namespace that HAS a relation config is the reference's
     # "relation not found" error (engine.go:219-228): host replay
-    flagged = flagged | (
-        (rel >= n_config_rels) & tables["ns_has_config"][ns].astype(bool)
-    )
-    return flagged & live
+    rel_nf = (rel >= n_config_rels) & tables["ns_has_config"][ns].astype(bool)
+    code = jnp.maximum(code, jnp.where(rel_nf, CAUSE_REL_NOT_FOUND, 0))
+    return jnp.where(live, code, 0).astype(jnp.int32)
 
 
 def probe_phase(
@@ -350,7 +383,9 @@ def expand_phase(
         axis=1,
     )  # [F, S]
 
-    overflow_q = jnp.zeros(n_queries, dtype=bool)
+    # per-query host-replay cause codes raised by this phase (int32;
+    # scatter-max per flag site — same scatter count as the old booleans)
+    overflow_q = jnp.zeros(n_queries, dtype=jnp.int32)
 
     # delta-dirty rows (stale CSR contents): slot-0 expansion or TTU rows
     if has_delta:
@@ -364,7 +399,9 @@ def expand_phase(
         dirty = (can_expand & row_dirty[:, 0]) | jnp.any(
             is_ttu & row_dirty[:, 1:], axis=1
         )
-        overflow_q = overflow_q.at[q].max(dirty)
+        overflow_q = overflow_q.at[q].max(
+            jnp.where(dirty, CAUSE_DIRTY, 0).astype(jnp.int32)
+        )
 
     # island allocation: one instance per live task whose program has
     # AND/NOT; its instruction slots seed leaf ctxs B + idx*K + (k-1)
@@ -377,7 +414,11 @@ def expand_phase(
         idx = n_isl + rank
         isl_ok = is_island & (idx < NI)
         # island-table overflow: exact host replay for those queries
-        overflow_q = overflow_q.at[q].max(is_island & (idx >= NI))
+        overflow_q = overflow_q.at[q].max(
+            jnp.where(is_island & (idx >= NI), CAUSE_ISLAND_OVERFLOW, 0).astype(
+                jnp.int32
+            )
+        )
         dest = jnp.where(isl_ok, idx, NI)
         isl_parent = isl_parent.at[dest].set(ctx, mode="drop")
         isl_pid = isl_pid.at[dest].set(pid, mode="drop")
@@ -426,7 +467,11 @@ def expand_phase(
     # queries whose expansions overflow the frontier need host replay
     truncated_seg = (offsets + flat_counts) > F
     seg_q = jnp.repeat(q, S, total_repeat_length=F * S)
-    overflow_q = overflow_q.at[seg_q].max(truncated_seg & (flat_counts > 0))
+    overflow_q = overflow_q.at[seg_q].max(
+        jnp.where(
+            truncated_seg & (flat_counts > 0), CAUSE_FRONTIER_OVERFLOW, 0
+        ).astype(jnp.int32)
+    )
 
     # build candidate children by segmented gather; all per-(task, slot)
     # source columns flatten to [F*S] 1-D arrays (no small-lane layouts)
@@ -540,9 +585,14 @@ def dedupe_phase(
     kept_in_cap = keep & (pos < F)
     # survivors that don't fit in the frontier: their queries go to host
     overflow_q = (
-        jnp.zeros(n_queries, dtype=bool)
+        jnp.zeros(n_queries, dtype=jnp.int32)
         .at[children.q]
-        .max(keep & (pos >= F), mode="drop")
+        .max(
+            jnp.where(
+                keep & (pos >= F), CAUSE_FRONTIER_OVERFLOW, 0
+            ).astype(jnp.int32),
+            mode="drop",
+        )
     )
     # non-kept entries park at index F: out-of-bounds scatter drops them
     dest = jnp.where(kept_in_cap, pos, F)
@@ -579,7 +629,7 @@ def seed_state(
         t_depth=depth0,
         n_tasks=jnp.int32(B),
         ctx_hit=jnp.zeros(NC, dtype=bool),
-        needs_host=jnp.zeros(B, dtype=bool),
+        needs_host=jnp.zeros(B, dtype=jnp.int32),
         isl_parent=jnp.zeros(max(n_island_cap, 1), jnp.int32),
         isl_pid=jnp.zeros(max(n_island_cap, 1), jnp.int32),
         n_isl=jnp.int32(0),
@@ -592,7 +642,7 @@ def loop_cond(max_steps: int, n_queries: int):
         return (
             (st.step < max_steps)
             & (st.n_tasks > 0)
-            & ~jnp.all(st.ctx_hit[:n_queries] | st.needs_host)
+            & ~jnp.all(st.ctx_hit[:n_queries] | (st.needs_host > 0))
         )
 
     return cond_fn
@@ -607,11 +657,14 @@ def finalize(
 
     Returns (ctx_hit, needs_host, isl_parent, isl_pid, n_isl) — the
     engine combines island circuits on host and reads the per-query
-    verdict from ctx_hit[:B] (engine/islands.py)."""
+    verdict from ctx_hit[:B] (engine/islands.py). needs_host carries the
+    CAUSE_* code (nonzero => host replay)."""
     F = final.t_q.shape[0]
     exhausted = (final.step >= max_steps) & (final.n_tasks > 0)
     live = jnp.arange(F, dtype=jnp.int32) < final.n_tasks
-    needs_host = final.needs_host.at[final.t_q].max(exhausted & live)
+    needs_host = final.needs_host.at[final.t_q].max(
+        jnp.where(exhausted & live, CAUSE_STEP_EXHAUSTED, 0).astype(jnp.int32)
+    )
     return final.ctx_hit, needs_host, final.isl_parent, final.isl_pid, final.n_isl
 
 
@@ -653,7 +706,7 @@ def check_kernel(
         idx = jnp.arange(F, dtype=jnp.int32)
         q = st.t_q
         ctx = st.t_ctx
-        root_done = st.ctx_hit[:B] | st.needs_host
+        root_done = st.ctx_hit[:B] | (st.needs_host > 0)
         # a task dies when its query is resolved (top-level or short-
         # circuit) or its own accumulator already hit (per-ctx
         # short-circuit: an island leaf is an OR accumulation too)
@@ -672,7 +725,7 @@ def check_kernel(
         needs_host = st.needs_host.at[q].max(flagged)
 
         # refresh liveness after accumulator updates (short-circuit)
-        live = live & ~(ctx_hit[:B] | needs_host)[q] & ~ctx_hit[ctx]
+        live = live & ~(ctx_hit[:B] | (needs_host > 0))[q] & ~ctx_hit[ctx]
 
         children, overflow_q, isl_state = expand_phase(
             tables, q, ctx, obj, rel, depth, live,
@@ -681,12 +734,12 @@ def check_kernel(
             wildcard_rel=wildcard_rel, n_queries=B,
             n_island_cap=n_island_cap, has_delta=has_delta,
         )
-        needs_host = needs_host | overflow_q
+        needs_host = jnp.maximum(needs_host, overflow_q)
 
         nt_q, nt_ctx, nt_obj, nt_rel, nt_depth, n_new, overflow2 = dedupe_phase(
             children, F, B
         )
-        needs_host = needs_host | overflow2
+        needs_host = jnp.maximum(needs_host, overflow2)
         return _State(
             nt_q, nt_ctx, nt_obj, nt_rel, nt_depth, n_new,
             ctx_hit, needs_host, *isl_state, st.step + 1,

@@ -38,7 +38,13 @@ from ..ketoapi import RelationTuple, Subject, Tree
 from ..storage.definitions import DEFAULT_NETWORK, Manager
 from .definitions import CheckResult, Membership
 from .delta import SnapshotView, empty_delta_tables
-from .kernel import check_kernel, kernel_static_config, snapshot_tables
+from .kernel import (
+    CAUSE_NAME_UNINDEXED,
+    CAUSE_NAMES,
+    check_kernel,
+    kernel_static_config,
+    snapshot_tables,
+)
 from .reference import ReferenceEngine
 from .snapshot import GraphSnapshot, build_snapshot, build_snapshot_columnar
 
@@ -115,8 +121,16 @@ class TPUCheckEngine:
             config.get("check.mirror_persist_interval", 60.0)
         )
         # device-path observability (served vs host-fallback checks);
-        # `metrics` is an optional observability.Metrics mirror of the same
-        self.stats = {"device_checks": 0, "host_checks": 0, "snapshot_builds": 0}
+        # `metrics` is an optional observability.Metrics mirror of the same.
+        # host_cause splits host_checks by kernel CAUSE_* code (VERDICT r2
+        # item 7: "host because AND/NOT overflow" must be distinguishable
+        # from "host because error")
+        self.stats = {
+            "device_checks": 0,
+            "host_checks": 0,
+            "snapshot_builds": 0,
+            "host_cause": {},
+        }
         self.metrics = metrics
         if tracer is None:
             from ..observability import _NoopTracer
@@ -727,6 +741,7 @@ class TPUCheckEngine:
 
         results: list[CheckResult] = []
         n_host = 0
+        host_causes: dict[str, int] = {}
         # identical host-replayed queries within one batch evaluate once
         # (an adversarial batch of 4096 same-tuple fallbacks would
         # otherwise serialize 4096 recursive walks)
@@ -743,6 +758,17 @@ class TPUCheckEngine:
                     )
                 else:
                     n_host += 1
+                    # cause bookkeeping: the kernel reports a CAUSE_* code
+                    # per query; queries that never reached the device
+                    # (unknown vocabulary / oversized batch tail) count as
+                    # "unindexed"
+                    if i < B and q_valid[i]:
+                        cause = CAUSE_NAMES.get(
+                            int(needs_host[i]), CAUSE_NAME_UNINDEXED
+                        )
+                    else:
+                        cause = CAUSE_NAME_UNINDEXED
+                    host_causes[cause] = host_causes.get(cause, 0) + 1
                     # field-structured key: the display string is NOT
                     # injective (a subject_id spelled "(ns:obj#rel)"
                     # renders like a real subject set)
@@ -760,9 +786,15 @@ class TPUCheckEngine:
             sp.set_attribute("host_replays", n_host)
         self.stats["device_checks"] += n - n_host
         self.stats["host_checks"] += n_host
+        for cause, cnt in host_causes.items():
+            self.stats["host_cause"][cause] = (
+                self.stats["host_cause"].get(cause, 0) + cnt
+            )
         if self.metrics is not None:
             self.metrics.check_batch_size.observe(n)
             self.metrics.checks_total.labels("device").inc(n - n_host)
             if n_host:
                 self.metrics.checks_total.labels("host").inc(n_host)
+            for cause, cnt in host_causes.items():
+                self.metrics.host_fallback_total.labels(cause).inc(cnt)
         return results

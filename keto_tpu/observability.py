@@ -48,6 +48,16 @@ class Metrics:
             ["path"],  # device | host
             registry=self.registry,
         )
+        self.host_fallback_total = prom.Counter(
+            "keto_tpu_host_fallback_total",
+            "Check() queries replayed on the exact host engine, by kernel "
+            "cause code (engine/kernel.py CAUSE_*) — distinguishes "
+            "capacity cliffs (island_overflow, frontier_overflow, "
+            "rewrite_cap) from semantic causes (relation_not_found, "
+            "config_missing) and staleness (dirty_row)",
+            ["cause"],
+            registry=self.registry,
+        )
         self.check_batch_size = prom.Histogram(
             "keto_tpu_check_batch_size",
             "Queries per device batch",

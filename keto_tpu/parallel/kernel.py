@@ -75,7 +75,7 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
             idx = jnp.arange(F, dtype=jnp.int32)
             q = st.t_q
             ctx = st.t_ctx
-            root_done = st.ctx_hit[:B] | st.needs_host
+            root_done = st.ctx_hit[:B] | (st.needs_host > 0)
             live = (idx < st.n_tasks) & ~root_done[q] & ~st.ctx_hit[ctx]
             obj, rel, depth = st.t_obj, st.t_rel, st.t_depth
 
@@ -93,7 +93,7 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
             hit = jax.lax.psum(hit_local.astype(jnp.int32), axis) > 0
             ctx_hit = st.ctx_hit.at[ctx].max(hit)
             needs_host = st.needs_host.at[q].max(flagged)
-            live = live & ~(ctx_hit[:B] | needs_host)[q] & ~ctx_hit[ctx]
+            live = live & ~(ctx_hit[:B] | (needs_host > 0))[q] & ~ctx_hit[ctx]
 
             # island allocation inside expand_phase is a pure function of
             # the REPLICATED frontier + program tables, so every shard
@@ -106,8 +106,11 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
                 wildcard_rel=wildcard_rel, n_queries=B,
                 n_island_cap=n_island_cap, has_delta=has_delta,
             )
-            needs_host = needs_host | (
-                jax.lax.psum(overflow_q.astype(jnp.int32), axis) > 0
+            # per-shard expansions differ (CSR rows are shard-local), so
+            # the cause codes merge with pmax — same priority semantics
+            # as the single-chip maximum
+            needs_host = jnp.maximum(
+                needs_host, jax.lax.pmax(overflow_q, axis)
             )
 
             # merge candidate frontiers: [ndev, F] -> [ndev * F]
@@ -120,7 +123,7 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
             nt_q, nt_ctx, nt_obj, nt_rel, nt_depth, n_new, overflow2 = dedupe_phase(
                 gathered, F, B
             )
-            needs_host = needs_host | overflow2
+            needs_host = jnp.maximum(needs_host, overflow2)
             return _State(
                 nt_q, nt_ctx, nt_obj, nt_rel, nt_depth, n_new,
                 ctx_hit, needs_host, *isl_state, st.step + 1,
@@ -225,7 +228,8 @@ def sharded_check_kernel(
     statics: tuple,
     axis: str = "x",
 ):
-    """Returns (member[B], needs_host[B]); see engine/kernel.check_kernel."""
+    """Returns (ctx_hit, needs_host[B] cause codes, isl_parent, isl_pid,
+    n_isl); see engine/kernel.check_kernel."""
     assert set(sharded_tables) == set(_SHARDED_DEVICE_KEYS)
     assert set(replicated_tables) == set(_REPLICATED_KEYS) | set(
         _DELTA_DEVICE_KEYS

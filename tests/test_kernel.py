@@ -580,3 +580,119 @@ class TestIslands:
             for q, g in zip(queries, got):
                 ref = oracle.check_relation_tuple(q, 10)
                 assert g.membership == ref.membership, f"trial {trial}: {q}"
+
+
+class TestHostFallbackCauses:
+    """VERDICT r2 item 7: host fallback must be observable by cause —
+    "host because AND/NOT overflow" distinguishable from "host because
+    error" — via stats["host_cause"] and the labeled Prometheus counter."""
+
+    def test_rewrite_cap_pinned(self):
+        # a union rewrite with > rewrite_instr_cap children compiles to
+        # FLAG_HOST_ONLY (snapshot.py _compile); its queries host-replay
+        # with cause "rewrite_cap" and still return exact verdicts
+        K = 8  # TPUCheckEngine default rewrite_instr_cap
+        rels = [Relation(name=f"r{i}") for i in range(K + 1)]
+        wide = Relation(
+            name="wide",
+            subject_set_rewrite=SubjectSetRewrite(
+                children=[
+                    ComputedSubjectSet(relation=f"r{i}") for i in range(K + 1)
+                ]
+            ),
+        )
+        ns = Namespace(name="w", relations=rels + [wide])
+        e = make_tpu_engine([ns], [f"w:o#r{K}@alice"])  # hit via LAST branch
+        got = e.check_batch(
+            [
+                RelationTuple.from_string("w:o#wide@alice"),
+                RelationTuple.from_string("w:o#wide@bob"),
+            ]
+        )
+        assert got[0].membership == Membership.IS_MEMBER
+        assert got[1].membership == Membership.NOT_MEMBER
+        assert e.stats["host_checks"] == 2
+        assert e.stats["host_cause"] == {"rewrite_cap": 2}
+
+    def test_relation_not_found_cause(self):
+        e = make_tpu_engine(
+            [Namespace(name="n", relations=[Relation(name="known")])],
+            ["n:o#rogue@u"],
+        )
+        res = e.check_batch([RelationTuple.from_string("n:o#rogue@v")])[0]
+        assert res.error is not None
+        assert e.stats["host_cause"] == {"relation_not_found": 1}
+
+    def test_unindexed_cause(self):
+        e = make_tpu_engine([Namespace(name="n")], ["n:o#r@u"])
+        e.check_batch([RelationTuple.from_string("ghost:o#r@u")])
+        assert e.stats["host_cause"] == {"unindexed": 1}
+
+    def test_island_overflow_cause(self):
+        # one query fanning out (via TTU) to more AND/NOT islands than
+        # island_cap = 2*B can hold: exact verdict via host replay,
+        # cause "island_overflow" — the capacity cliff the cause split
+        # exists to expose
+        from keto_tpu.namespace.ast import InvertResult, Operator
+
+        n_docs = 40  # > island_cap (2 * bucket16 = 32)
+        ns = Namespace(
+            name="acl",
+            relations=[
+                Relation(name="allow"),
+                Relation(name="deny"),
+                Relation(name="parent"),
+                Relation(
+                    name="access",
+                    subject_set_rewrite=SubjectSetRewrite(
+                        operation=Operator.AND,
+                        children=[
+                            ComputedSubjectSet(relation="allow"),
+                            InvertResult(
+                                child=ComputedSubjectSet(relation="deny")
+                            ),
+                        ],
+                    ),
+                ),
+                Relation(
+                    name="super",
+                    subject_set_rewrite=SubjectSetRewrite(
+                        children=[
+                            TupleToSubjectSet(
+                                relation="parent",
+                                computed_subject_set_relation="access",
+                            )
+                        ]
+                    ),
+                ),
+            ],
+        )
+        tuples = [f"acl:root#parent@(acl:doc{i}#...)" for i in range(n_docs)]
+        tuples.append(f"acl:doc{n_docs - 1}#allow@alice")
+        e = make_tpu_engine([ns], tuples)
+        res = e.check_batch([RelationTuple.from_string("acl:root#super@alice")])
+        assert res[0].membership == Membership.IS_MEMBER
+        assert e.stats["host_cause"] == {"island_overflow": 1}
+
+    def test_prometheus_counter_labels(self):
+        from keto_tpu.observability import Metrics
+
+        K = 8
+        rels = [Relation(name=f"r{i}") for i in range(K + 1)]
+        wide = Relation(
+            name="wide",
+            subject_set_rewrite=SubjectSetRewrite(
+                children=[
+                    ComputedSubjectSet(relation=f"r{i}") for i in range(K + 1)
+                ]
+            ),
+        )
+        cfg = Config({"limit": {"max_read_depth": 5}})
+        cfg.set_namespaces([Namespace(name="w", relations=rels + [wide])])
+        m = MemoryManager()
+        m.write_relation_tuples([RelationTuple.from_string("w:o#r0@u")])
+        metrics = Metrics()
+        e = TPUCheckEngine(m, cfg, metrics=metrics)
+        e.check_batch([RelationTuple.from_string("w:o#wide@u")] * 2)
+        text = metrics.export().decode()
+        assert 'keto_tpu_host_fallback_total{cause="rewrite_cap"} 2.0' in text

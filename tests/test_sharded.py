@@ -241,3 +241,135 @@ class TestShardedDifferential:
         assert e.check_batch([q])[0].membership == Membership.NOT_MEMBER
         m.write_relation_tuples([q])
         assert e.check_batch([q])[0].membership == Membership.IS_MEMBER
+
+
+class TestMeshCapacityBoundaries:
+    """VERDICT r2 item 8: pin behavior near the dedupe index-bit limit
+    (kernel.py dedupe_phase) and prove the sharding is correct past the
+    8-device mesh the rest of the suite uses."""
+
+    def test_dedupe_at_28_bit_boundary_traces(self):
+        # G = 2^28 candidates (e.g. 16 shards x 16M frontier) needs
+        # exactly 28 index bits: the largest legal configuration. Traced
+        # via eval_shape so no memory is allocated.
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        from keto_tpu.engine.kernel import Expansion, dedupe_phase
+
+        G = 1 << 28
+        cand = Expansion(
+            q=jax.ShapeDtypeStruct((G,), jnp.int32),
+            ctx=jax.ShapeDtypeStruct((G,), jnp.int32),
+            obj=jax.ShapeDtypeStruct((G,), jnp.int32),
+            rel=jax.ShapeDtypeStruct((G,), jnp.int32),
+            depth=jax.ShapeDtypeStruct((G,), jnp.int32),
+            valid=jax.ShapeDtypeStruct((G,), jnp.bool_),
+        )
+        out = jax.eval_shape(
+            functools.partial(dedupe_phase, F=1 << 14, n_queries=4096), cand
+        )
+        assert out[0].shape == (1 << 14,)
+
+    def test_dedupe_past_28_bits_fails_loud(self):
+        # one bit past the limit must raise (silent priority truncation
+        # would corrupt dedupe winners), naming the remedy
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        import pytest as _pytest
+
+        from keto_tpu.engine.kernel import Expansion, dedupe_phase
+
+        G = 1 << 29
+        cand = Expansion(
+            q=jax.ShapeDtypeStruct((G,), jnp.int32),
+            ctx=jax.ShapeDtypeStruct((G,), jnp.int32),
+            obj=jax.ShapeDtypeStruct((G,), jnp.int32),
+            rel=jax.ShapeDtypeStruct((G,), jnp.int32),
+            depth=jax.ShapeDtypeStruct((G,), jnp.int32),
+            valid=jax.ShapeDtypeStruct((G,), jnp.bool_),
+        )
+        with _pytest.raises(ValueError, match="frontier_cap"):
+            jax.eval_shape(
+                functools.partial(dedupe_phase, F=1 << 14, n_queries=4096),
+                cand,
+            )
+
+    def test_16_shard_differential_subprocess(self):
+        # the suite's mesh is 8 virtual devices (conftest); a 16-shard
+        # run needs its own backend, so it executes in a subprocess with
+        # xla_force_host_platform_device_count=16
+        import json
+        import os
+        import subprocess
+        import sys
+
+        script = r"""
+import json, os, random, sys
+sys.path.insert(0, os.environ["KETO_REPO"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+from keto_tpu.config import Config
+from keto_tpu.engine import Membership
+from keto_tpu.engine.tpu_engine import TPUCheckEngine
+from keto_tpu.ketoapi import RelationTuple
+from keto_tpu.namespace import Namespace
+from keto_tpu.namespace.ast import (
+    ComputedSubjectSet, Relation, SubjectSetRewrite, TupleToSubjectSet,
+)
+from keto_tpu.parallel import default_mesh
+from keto_tpu.storage import MemoryManager
+
+assert len(jax.devices()) == 16, jax.devices()
+rng = random.Random(77)
+ns = [Namespace(name="g", relations=[
+    Relation(name="r0"),
+    Relation(name="r1"),
+    Relation(name="r2", subject_set_rewrite=SubjectSetRewrite(children=[
+        ComputedSubjectSet(relation="r0"),
+        TupleToSubjectSet(relation="r1", computed_subject_set_relation="r2"),
+    ])),
+])]
+tup = set()
+for _ in range(400):
+    obj = f"o{rng.randrange(60)}"
+    rel = rng.choice(["r0", "r1", "r2"])
+    if rng.random() < 0.4:
+        sub = f"(g:o{rng.randrange(60)}#{rng.choice(['r0','r1','r2'])})"
+    else:
+        sub = f"u{rng.randrange(12)}"
+    tup.add(f"g:{obj}#{rel}@{sub}")
+cfg = Config({"limit": {"max_read_depth": 8}})
+cfg.set_namespaces(ns)
+m = MemoryManager()
+m.write_relation_tuples([RelationTuple.from_string(s) for s in sorted(tup)])
+e = TPUCheckEngine(m, cfg, mesh=default_mesh(16))
+queries = [RelationTuple.from_string(
+    f"g:o{rng.randrange(60)}#{rng.choice(['r0','r1','r2'])}@u{rng.randrange(12)}"
+) for _ in range(64)]
+got = e.check_batch(queries, 8)
+mismatch = sum(
+    1 for q, g in zip(queries, got)
+    if g.membership != e.reference.check_relation_tuple(q, 8).membership
+)
+print(json.dumps({
+    "devices": len(jax.devices()), "mismatches": mismatch,
+    "host_checks": e.stats["host_checks"],
+}))
+"""
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=16"
+        env["KETO_REPO"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True, text=True, timeout=600, env=env,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        rec = json.loads(out.stdout.strip().splitlines()[-1])
+        assert rec["devices"] == 16
+        assert rec["mismatches"] == 0
