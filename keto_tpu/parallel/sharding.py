@@ -91,19 +91,20 @@ class ShardedSnapshot:
         return self.base.wildcard_rel
 
 
-def build_sharded_snapshot(
-    tuples: Sequence[RelationTuple],
-    namespaces: Sequence[Namespace],
+def _stack_sharded_edge_tables(
+    t_obj: np.ndarray,
+    t_rel: np.ndarray,
+    t_skind: np.ndarray,
+    t_sa: np.ndarray,
+    t_sb: np.ndarray,
     n_shards: int,
-    K: int = 8,
-    version: int = 0,
-) -> ShardedSnapshot:
-    base = build_snapshot(
-        tuples, namespaces, K=K, version=version, with_edge_tables=False
-    )
-    t_obj, t_rel, t_skind, t_sa, t_sb = encode_edge_arrays(
-        tuples, base.ns_ids, base.rel_ids, base.obj_slots, base.subj_ids
-    )
+) -> tuple[dict[str, np.ndarray], int, int]:
+    """Partition encoded edge arrays by object-slot shard (vectorized
+    masks — no per-tuple Python) and build per-shard edge tables at EQUAL
+    capacities, stacked along a leading device axis. Shared by the
+    object-path and columnar sharded builders.
+
+    Returns (stacked tables, dh_probes, rh_probes)."""
     shard = shard_of_objslot(t_obj, n_shards)
     masks = [shard == s for s in range(n_shards)]
 
@@ -148,19 +149,78 @@ def build_sharded_snapshot(
                 )
             parts.append(a)
         stacked[key] = np.stack(parts)
+    return (
+        stacked,
+        max(t["dh_probes"] for t in per_shard),
+        max(t["rh_probes"] for t in per_shard),
+    )
 
+
+def _replicated_tables(base: GraphSnapshot) -> dict[str, np.ndarray]:
     replicated = {k: base.device_arrays()[k] for k in _REPLICATED_KEYS}
     from ..engine.delta import empty_delta_tables
     from ..engine.kernel import pack_delta_tables
 
     replicated.update(pack_delta_tables(empty_delta_tables()))
+    return replicated
+
+
+def build_sharded_snapshot(
+    tuples: Sequence[RelationTuple],
+    namespaces: Sequence[Namespace],
+    n_shards: int,
+    K: int = 8,
+    version: int = 0,
+) -> ShardedSnapshot:
+    base = build_snapshot(
+        tuples, namespaces, K=K, version=version, with_edge_tables=False
+    )
+    t_obj, t_rel, t_skind, t_sa, t_sb = encode_edge_arrays(
+        tuples, base.ns_ids, base.rel_ids, base.obj_slots, base.subj_ids
+    )
+    stacked, dh_probes, rh_probes = _stack_sharded_edge_tables(
+        t_obj, t_rel, t_skind, t_sa, t_sb, n_shards
+    )
     return ShardedSnapshot(
         base=base,
         n_shards=n_shards,
         sharded=stacked,
-        replicated=replicated,
-        dh_probes=max(t["dh_probes"] for t in per_shard),
-        rh_probes=max(t["rh_probes"] for t in per_shard),
+        replicated=_replicated_tables(base),
+        dh_probes=dh_probes,
+        rh_probes=rh_probes,
+    )
+
+
+def build_sharded_snapshot_columnar(
+    cols,
+    namespaces: Sequence[Namespace],
+    n_shards: int,
+    K: int = 8,
+    version: int = 0,
+) -> ShardedSnapshot:
+    """Sharded snapshot from a columnar store (storage.columns.
+    TupleColumns): the vectorized ingest of build_snapshot_columnar
+    composed with the per-shard equal-capacity stacking — closing the
+    round-2 gap where the columnar scale tier and the device mesh were
+    mutually exclusive (the 1e8 north-star config needs BOTH: per-chip
+    tables at 1e8 edges exceed one chip's HBM, and per-tuple Python
+    ingest exceeds the host budget; ref analog: stateless replicas over
+    one DB, internal/persistence/sql/persister.go:85-95)."""
+    from ..engine.snapshot import columnar_encode
+
+    base, (t_obj, t_rel, t_skind, t_sa, t_sb) = columnar_encode(
+        cols, namespaces, K=K, version=version
+    )
+    stacked, dh_probes, rh_probes = _stack_sharded_edge_tables(
+        t_obj, t_rel, t_skind, t_sa, t_sb, n_shards
+    )
+    return ShardedSnapshot(
+        base=base,
+        n_shards=n_shards,
+        sharded=stacked,
+        replicated=_replicated_tables(base),
+        dh_probes=dh_probes,
+        rh_probes=rh_probes,
     )
 
 

@@ -243,6 +243,96 @@ class TestShardedDifferential:
         assert e.check_batch([q])[0].membership == Membership.IS_MEMBER
 
 
+class TestShardedColumnar:
+    """Columnar store + device mesh (round-3 VERDICT item 3): the
+    vectorized columnar ingest must feed the sharded snapshot — these
+    two were mutually exclusive in round 2 (the engine silently fell
+    back to per-tuple ingest under a mesh)."""
+
+    def test_columnar_store_builds_sharded_snapshot(self):
+        from keto_tpu.storage.columnar import ColumnarStore
+        from keto_tpu.storage.columns import TupleColumns
+
+        cfg = Config({"limit": {"max_read_depth": 100}})
+        cfg.set_namespaces(REWRITE_NAMESPACES)
+        store = ColumnarStore()
+        store.bulk_load(TupleColumns.from_tuples(
+            [RelationTuple.from_string(s) for s in REWRITE_TUPLES]
+        ))
+        e = TPUCheckEngine(store, cfg, mesh=default_mesh(8))
+        rts = [RelationTuple.from_string(q) for q, _ in REWRITE_CASES]
+        got = e.check_batch(rts, 100)
+        for (q, expected), g in zip(REWRITE_CASES, got):
+            assert g.error is None, q
+            assert (g.membership == Membership.IS_MEMBER) == expected, q
+        # the mesh path must have built a SHARDED snapshot from columns
+        state = e._state
+        assert state.sharded is not None
+        assert state.sharded.n_shards == 8
+        # only the one unknown-object query replays on host
+        assert e.stats["host_checks"] == 1
+
+    def test_columnar_mesh_randomized_differential(self):
+        from keto_tpu.storage.columnar import ColumnarStore
+        from keto_tpu.storage.columns import TupleColumns
+
+        rng = random.Random(99)
+        ns = [Namespace(name="g", relations=[
+            Relation(name="r0"),
+            Relation(name="r1"),
+            Relation(name="r2", subject_set_rewrite=SubjectSetRewrite(children=[
+                ComputedSubjectSet(relation="r0"),
+                TupleToSubjectSet(relation="r1",
+                                  computed_subject_set_relation="r2"),
+            ])),
+        ])]
+        tup = set()
+        for _ in range(600):
+            obj = f"o{rng.randrange(80)}"
+            rel = rng.choice(["r0", "r1", "r2"])
+            if rng.random() < 0.4:
+                sub = f"(g:o{rng.randrange(80)}#{rng.choice(['r0', 'r1', 'r2'])})"
+            else:
+                sub = f"u{rng.randrange(16)}"
+            tup.add(f"g:{obj}#{rel}@{sub}")
+        cfg = Config({"limit": {"max_read_depth": 8}})
+        cfg.set_namespaces(ns)
+        store = ColumnarStore()
+        store.bulk_load(TupleColumns.from_tuples(
+            [RelationTuple.from_string(s) for s in sorted(tup)]
+        ))
+        e = TPUCheckEngine(store, cfg, mesh=default_mesh(8))
+        queries = [RelationTuple.from_string(
+            f"g:o{rng.randrange(80)}#{rng.choice(['r0', 'r1', 'r2'])}"
+            f"@u{rng.randrange(16)}"
+        ) for _ in range(64)]
+        got = e.check_batch(queries, 8)
+        for q, g in zip(queries, got):
+            want = e.reference.check_relation_tuple(q, 8)
+            assert g.membership == want.membership, q.to_string()
+
+    def test_columnar_mesh_read_your_writes(self):
+        """Writes after a columnar bulk load under a mesh ride the
+        replicated delta overlay, not a rebuild."""
+        from keto_tpu.storage.columnar import ColumnarStore
+        from keto_tpu.storage.columns import TupleColumns
+
+        cfg = Config({"limit": {"max_read_depth": 5}})
+        cfg.set_namespaces([Namespace(name="n")])
+        store = ColumnarStore()
+        store.bulk_load(TupleColumns.from_tuples(
+            [RelationTuple.from_string("n:o#r@u")]
+        ))
+        e = TPUCheckEngine(store, cfg, mesh=default_mesh(8))
+        q0 = RelationTuple.from_string("n:o#r@u")
+        assert e.check_batch([q0])[0].membership == Membership.IS_MEMBER
+        builds_before = e.stats["snapshot_builds"]
+        q = RelationTuple.from_string("n:o2#r@u")
+        store.write_relation_tuples([q])
+        assert e.check_batch([q])[0].membership == Membership.IS_MEMBER
+        assert e.stats["snapshot_builds"] == builds_before
+
+
 class TestMeshCapacityBoundaries:
     """VERDICT r2 item 8: pin behavior near the dedupe index-bit limit
     (kernel.py dedupe_phase) and prove the sharding is correct past the

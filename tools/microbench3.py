@@ -1,0 +1,130 @@
+"""TPU primitive microbench for the round-3 kernel rewrite.
+
+The round-2 TPU profile (BENCH_TPU_r03_first.json + profile_kernel.py)
+shows dedupe 82x and expand 6.7x slower than CPU; both phases are
+scatter-heavy. This measures every candidate replacement primitive at
+kernel-realistic shapes so the rewrite is driven by numbers, not the
+cost model (VERDICT r2 "Next round" item 1).
+
+Run:  python tools/microbench3.py [--platform cpu]
+Prints one JSON line per primitive: {"prim", "ms", ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", choices=("auto", "cpu"), default="auto")
+    ap.add_argument("--F", type=int, default=8192, help="frontier length")
+    ap.add_argument("--B", type=int, default=4096, help="batch (ctx count)")
+    args = ap.parse_args()
+    if args.platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    F, B = args.F, args.B
+    G = 3 * F  # candidate count after expansion (pre-dedupe), S=3 slots
+    CAP = 1 << (2 * G - 1).bit_length()  # dedupe bucket table
+
+    rng = np.random.default_rng(0)
+    idx_F_B = jnp.asarray(rng.integers(0, B, F), jnp.int32)
+    idx_G_CAP = jnp.asarray(rng.integers(0, CAP, G), jnp.int32)
+    idx_G_F = jnp.asarray(rng.integers(0, F, G), jnp.int32)
+    vals_F = jnp.asarray(rng.integers(0, 2, F), jnp.int32)
+    vals_G = jnp.asarray(rng.integers(0, 1 << 20, G), jnp.uint32)
+    rows_G = jnp.asarray(rng.integers(0, 1 << 20, (G, 8)), jnp.int32)
+    bool_F = jnp.asarray(rng.integers(0, 2, F) == 1)
+    keys_G = jnp.asarray(rng.integers(0, 1 << 30, G), jnp.uint32)
+    payload_G = jnp.asarray(rng.integers(0, 1 << 30, (G,)), jnp.int32)
+    table_1d = jnp.asarray(rng.integers(0, 1 << 20, CAP), jnp.int32)
+    sorted_tab = jnp.asarray(np.sort(rng.integers(0, 1 << 30, G)), jnp.int32)
+    q_F = jnp.asarray(rng.integers(0, 1 << 30, F), jnp.int32)
+
+    def timed(name, fn, *xs, n=30, **extra):
+        f = jax.jit(fn)
+        out = f(*xs)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = f(*xs)
+        jax.block_until_ready(out)
+        ms = (time.perf_counter() - t0) / n * 1e3
+        print(json.dumps({"prim": name, "ms": round(ms, 4), **extra}))
+
+    # --- scatters (the round-2 design) -----------------------------------
+    timed("scatter_set_1d_G_to_F", lambda d, v: jnp.zeros(F, jnp.int32).at[d].set(v, mode="drop"),
+          idx_G_F, payload_G, G=G)
+    timed("scatter_set_rows_G_to_F8",
+          lambda d, v: jnp.zeros((F, 8), jnp.int32).at[d].set(v, mode="drop"),
+          idx_G_F, rows_G, G=G)
+    timed("scatter_max_G_to_CAP",
+          lambda d, v: jnp.zeros(CAP, jnp.uint32).at[d].max(v, mode="drop"),
+          idx_G_CAP, vals_G, CAP=CAP)
+    timed("scatter_max_F_to_B",
+          lambda d, v: jnp.zeros(B, jnp.int32).at[d].max(v, mode="drop"),
+          idx_F_B, vals_F)
+
+    # --- one-hot matmul segment reductions (MXU path) --------------------
+    def seg_or_matmul(seg, v):
+        onehot = (seg[:, None] == jnp.arange(B, dtype=jnp.int32)[None, :])
+        return (v.astype(jnp.float32) @ onehot.astype(jnp.float32)) > 0
+
+    timed("segOR_onehot_matmul_F_B", seg_or_matmul, idx_F_B, bool_F)
+
+    def seg_or_matmul_bf16(seg, v):
+        onehot = (seg[:, None] == jnp.arange(B, dtype=jnp.int32)[None, :])
+        return (v.astype(jnp.bfloat16) @ onehot.astype(jnp.bfloat16)) > 0
+
+    timed("segOR_onehot_bf16_F_B", seg_or_matmul_bf16, idx_F_B, bool_F)
+
+    def seg_max_fused(seg, v):
+        onehot = seg[:, None] == jnp.arange(B, dtype=jnp.int32)[None, :]
+        return jnp.max(jnp.where(onehot, v[:, None], 0), axis=0)
+
+    timed("segMAX_fused_F_B", seg_max_fused, idx_F_B, vals_F)
+
+    # --- sort-based dedupe candidates ------------------------------------
+    timed("sort_1key_G", lambda k: jax.lax.sort(k), keys_G, G=G)
+    timed("sort_2key_payload_G",
+          lambda k, p, v: jax.lax.sort((k, p, v), num_keys=2),
+          keys_G, vals_G, payload_G, G=G)
+
+    # --- misc loop machinery ---------------------------------------------
+    timed("cumsum_G", lambda v: jnp.cumsum(v), payload_G, G=G)
+    timed("searchsorted_F_in_G", lambda t, q: jnp.searchsorted(t, q), sorted_tab, q_F)
+    timed("gather_1d_G_from_CAP", lambda t, i: t[i], table_1d, idx_G_CAP, G=G)
+    timed("gather_rows_F_P8_from_32k",
+          lambda t, i: t[i],
+          jnp.asarray(rng.integers(0, 1 << 20, (32768, 8)), jnp.int32),
+          jnp.asarray(rng.integers(0, 32768, (F, 8)), jnp.int32))
+    timed("repeat_F_S", lambda q: jnp.repeat(q, 3, total_repeat_length=3 * F), q_F)
+
+    def wl(x):
+        def body(c):
+            i, y = c
+            return i + 1, y * 2 - y
+        return jax.lax.while_loop(lambda c: c[0] < 13, body, (0, x))
+
+    timed("while_loop_13_trivial", wl, vals_F)
+
+    print(json.dumps({"prim": "device", "name": str(jax.devices()[0])}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,179 @@
+"""Bisect the dedupe/expand phase slowdown on TPU.
+
+microbench3 shows every primitive standalone at ~20us, yet
+profile_kernel shows dedupe_phase at 26.8ms — the composition, not the
+primitives, is slow (XLA fuses scatters/gathers into a loop fusion that
+scalarizes, the same effect kernel._isolate already fences for gathers).
+This times dedupe_phase as-is vs a variant with optimization_barrier
+fences between stages, and bisected sub-compositions.
+
+Run:  python tools/microbench4.py [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", choices=("auto", "cpu"), default="auto")
+    ap.add_argument("--F", type=int, default=8192)
+    ap.add_argument("--B", type=int, default=4096)
+    args = ap.parse_args()
+    if args.platform == "cpu":
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from keto_tpu.engine.kernel import Expansion, _hash_combine, dedupe_phase
+
+    F, B = args.F, args.B
+    G = F  # single-device dedupe input length
+    rng = np.random.default_rng(1)
+    children = Expansion(
+        q=jnp.asarray(rng.integers(0, B, G), jnp.int32),
+        ctx=jnp.asarray(rng.integers(0, B, G), jnp.int32),
+        obj=jnp.asarray(rng.integers(0, 1 << 16, G), jnp.int32),
+        rel=jnp.asarray(rng.integers(0, 8, G), jnp.int32),
+        depth=jnp.asarray(rng.integers(0, 6, G), jnp.int32),
+        valid=jnp.asarray(rng.integers(0, 2, G) == 1),
+    )
+
+    def timed(name, fn, *xs, n=20, **extra):
+        f = jax.jit(fn)
+        out = f(*xs)
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = f(*xs)
+        jax.block_until_ready(out)
+        ms = (time.perf_counter() - t0) / n * 1e3
+        print(json.dumps({"prim": name, "ms": round(ms, 4), **extra}))
+
+    timed("dedupe_phase_asis", functools.partial(dedupe_phase, F=F, n_queries=B),
+          children)
+
+    fence = lambda *xs: jax.lax.optimization_barrier(xs)
+
+    # stage 1: hash + bucket + prio + winner scatter-max
+    def stage1(ch):
+        cap = 1
+        while cap < 2 * G:
+            cap *= 2
+        h = _hash_combine(ch.ctx, ch.obj, ch.rel)
+        bucket = (h & jnp.uint32(cap - 1)).astype(jnp.int32)
+        bucket = jnp.where(ch.valid, bucket, cap)
+        idx_bits = max(1, (G - 1).bit_length())
+        idx = jnp.arange(G, dtype=jnp.int32)
+        prio = (
+            jnp.clip(ch.depth, 0, (1 << (32 - idx_bits)) - 1).astype(jnp.uint32)
+            << jnp.uint32(idx_bits)
+        ) | idx.astype(jnp.uint32)
+        winner_prio = jnp.zeros(cap, jnp.uint32).at[bucket].max(prio, mode="drop")
+        return winner_prio, bucket, prio
+
+    timed("stage1_hash_scattermax", stage1, children)
+
+    def stage1_fenced(ch):
+        cap = 1
+        while cap < 2 * G:
+            cap *= 2
+        h = _hash_combine(ch.ctx, ch.obj, ch.rel)
+        bucket = (h & jnp.uint32(cap - 1)).astype(jnp.int32)
+        bucket = jnp.where(ch.valid, bucket, cap)
+        idx_bits = max(1, (G - 1).bit_length())
+        idx = jnp.arange(G, dtype=jnp.int32)
+        prio = (
+            jnp.clip(ch.depth, 0, (1 << (32 - idx_bits)) - 1).astype(jnp.uint32)
+            << jnp.uint32(idx_bits)
+        ) | idx.astype(jnp.uint32)
+        bucket, prio = fence(bucket, prio)
+        winner_prio = jnp.zeros(cap, jnp.uint32).at[bucket].max(prio, mode="drop")
+        return winner_prio, bucket, prio
+
+    timed("stage1_fenced", stage1_fenced, children)
+
+    # stage 2: winner readback gather + key compare
+    def stage23(ch):
+        winner_prio, bucket, prio = stage1_fenced(ch)
+        cap = winner_prio.shape[0]
+        idx_bits = max(1, (G - 1).bit_length())
+        idx = jnp.arange(G, dtype=jnp.int32)
+        (wp,) = fence(winner_prio)
+        winner_idx = (
+            wp[jnp.clip(bucket, 0, cap - 1)] & jnp.uint32((1 << idx_bits) - 1)
+        ).astype(jnp.int32)
+        won = ch.valid & (winner_idx == idx)
+        same_key = (
+            (ch.ctx[winner_idx] == ch.ctx)
+            & (ch.obj[winner_idx] == ch.obj)
+            & (ch.rel[winner_idx] == ch.rel)
+        )
+        keep = ch.valid & (won | ~same_key)
+        return keep
+
+    timed("stage123_fenced", stage23, children)
+
+    # stage 4: cumsum + packed-row single-scatter compaction
+    def stage4_packed(ch):
+        keep = stage23(ch)
+        (keep,) = fence(keep)
+        pos = jnp.cumsum(keep) - 1
+        n_keep = keep.sum().astype(jnp.int32)
+        kept_in_cap = keep & (pos < F)
+        dest = jnp.where(kept_in_cap, pos, F)
+        packed = jnp.stack(
+            [ch.q, ch.ctx, ch.obj, ch.rel, ch.depth,
+             jnp.zeros(G, jnp.int32), jnp.zeros(G, jnp.int32),
+             jnp.zeros(G, jnp.int32)],
+            axis=1,
+        )
+        dest, packed = fence(dest, packed)
+        out = jnp.zeros((F, 8), jnp.int32).at[dest].set(packed, mode="drop")
+        return out, n_keep
+
+    timed("stage1234_packedscatter_fenced", stage4_packed, children)
+
+    # full fenced dedupe incl. overflow scatter-max
+    def full_fenced(ch):
+        keep = stage23(ch)
+        (keep,) = fence(keep)
+        pos = jnp.cumsum(keep) - 1
+        n_keep = keep.sum().astype(jnp.int32)
+        kept_in_cap = keep & (pos < F)
+        ov = jnp.where(keep & (pos >= F), 2, 0).astype(jnp.int32)
+        (ovf,) = fence(ov)
+        overflow_q = jnp.zeros(B, jnp.int32).at[ch.q].max(ovf, mode="drop")
+        dest = jnp.where(kept_in_cap, pos, F)
+        packed = jnp.stack(
+            [ch.q, ch.ctx, ch.obj, ch.rel, ch.depth,
+             jnp.zeros(G, jnp.int32), jnp.zeros(G, jnp.int32),
+             jnp.zeros(G, jnp.int32)],
+            axis=1,
+        )
+        dest, packed = fence(dest, packed)
+        out = jnp.zeros((F, 8), jnp.int32).at[dest].set(packed, mode="drop")
+        return out, n_keep, overflow_q
+
+    timed("dedupe_full_fenced", full_fenced, children)
+
+    print(json.dumps({"prim": "device", "name": str(jax.devices()[0])}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
