@@ -62,6 +62,17 @@ class CheckBatcher:
             max_workers=max(pipeline_depth, 1),
             thread_name_prefix="keto-check-dispatch",
         )
+        # launch thread: device submits run here, NOT on the collector —
+        # a first-seen bucket's XLA compile or a post-write snapshot
+        # rebuild must not stop the collector from draining the queue
+        self._launcher = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="keto-check-launch"
+        )
+        # backpressure: at most max_inflight launched-but-unresolved
+        # device batches (an unbounded launch queue can wedge the TPU
+        # tunnel and holds a full engine state per handle)
+        self.max_inflight = max(2 * pipeline_depth, 4)
+        self._inflight = threading.BoundedSemaphore(self.max_inflight)
         self._closed = False
         self._thread.start()
 
@@ -124,10 +135,51 @@ class CheckBatcher:
         for p, res in zip(group, results):
             p.future.set_result(res)
 
+    def _resolve_inflight(self, engine, handle, group: list[_Pending]) -> None:
+        try:
+            results = engine.check_batch_resolve(handle)
+        except Exception as e:
+            for p in group:
+                p.future.set_exception(e)
+            return
+        finally:
+            self._inflight.release()
+        for p, res in zip(group, results):
+            p.future.set_result(res)
+
+    def _launch(self, group: list[_Pending], depth: int, nid=None) -> None:
+        """Split-phase dispatch (runs on the launch thread): LAUNCH the
+        device batch — async jax dispatch, returns before the device
+        finishes — and hand only the readback to the pool. Batch N+1's
+        launch no longer waits for batch N's round-trip (the axon TPU
+        tunnel costs ~70 ms per synchronized round-trip; pipelining
+        hides it). The in-flight semaphore bounds launched-but-
+        unresolved batches."""
+        try:
+            engine = self._resolve(nid)
+        except Exception as e:
+            for p in group:
+                p.future.set_exception(e)
+            return
+        submit = getattr(engine, "check_batch_submit", None)
+        if submit is None:
+            self._pool.submit(self._evaluate, group, depth, nid)
+            return
+        self._inflight.acquire()
+        try:
+            handle = submit([p.tuple for p in group], depth)
+        except Exception as e:
+            self._inflight.release()
+            for p in group:
+                p.future.set_exception(e)
+            return
+        self._pool.submit(self._resolve_inflight, engine, handle, group)
+
     def _run(self) -> None:
         while True:
             item = self._queue.get()
             if item is None:
+                self._launcher.shutdown(wait=True)
                 self._pool.shutdown(wait=True)
                 return
             batch = self._drain(item)
@@ -135,4 +187,4 @@ class CheckBatcher:
             for p in batch:
                 by_key.setdefault((p.max_depth, p.nid), []).append(p)
             for (depth, nid), group in by_key.items():
-                self._pool.submit(self._evaluate, group, depth, nid)
+                self._launcher.submit(self._launch, group, depth, nid)

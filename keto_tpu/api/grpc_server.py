@@ -95,7 +95,11 @@ class _Services:
 
     def _nid(self, context) -> str:
         """Per-request network id from gRPC invocation metadata (ref:
-        ketoctx/contextualizer.go:12-19)."""
+        ketoctx/contextualizer.go:12-19). Without a contextualizer the
+        metadata is never consulted — skip materializing it (per-RPC
+        hot path)."""
+        if self.registry.contextualizer is None:
+            return self.registry.nid
         md = {m.key: m.value for m in context.invocation_metadata()}
         return self.registry.nid_for(md)
 

@@ -79,22 +79,68 @@ class Metrics:
             "Device graph-mirror rebuild latency",
             registry=self.registry,
         )
+        # hot-path cache: (transport, method) -> (duration child,
+        # {code: counter child})
+        self._observe_cache: dict = {}
 
     def export(self) -> bytes:
         return prom.generate_latest(self.registry)
 
-    @contextlib.contextmanager
     def observe_request(self, transport: str, method: str):
-        """Times a request and counts its outcome code."""
-        start = time.perf_counter()
-        outcome = {"code": "OK"}
-        try:
-            yield outcome
-        finally:
-            self.request_duration.labels(transport, method).observe(
-                time.perf_counter() - start
+        """Times a request and counts its outcome code.
+
+        Label-child resolution (`.labels(...)`) walks locked dicts in
+        prometheus_client; on the serve hot path (thousands of calls/sec
+        on a 1-core host) that shows up, so children are cached per
+        (transport, method[, code]). Label sets stay route-constant by
+        construction — the cache cannot grow unboundedly."""
+        key = (transport, method)
+        cached = self._observe_cache.get(key)
+        if cached is None:
+            cached = (
+                self.request_duration.labels(transport, method),
+                {"OK": self.requests_total.labels(transport, method, "OK")},
             )
-            self.requests_total.labels(transport, method, outcome["code"]).inc()
+            self._observe_cache[key] = cached
+        return _RequestObservation(self, key, cached)
+
+
+class _RequestObservation:
+    """Plain-class context manager for observe_request (a generator CM
+    costs ~2x more per request; this path runs per RPC)."""
+
+    __slots__ = ("_metrics", "_key", "_cached", "_start", "code")
+
+    def __init__(self, metrics, key, cached):
+        self._metrics = metrics
+        self._key = key
+        self._cached = cached
+        self.code = "OK"
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        duration_child, counters = self._cached
+        duration_child.observe(time.perf_counter() - self._start)
+        counter = counters.get(self.code)
+        if counter is None:
+            counter = self._metrics.requests_total.labels(*self._key, self.code)
+            counters[self.code] = counter
+        counter.inc()
+        return False
+
+    # dict-style writes kept for handler compatibility
+    # (handlers do `outcome["code"] = ...`)
+    def __setitem__(self, k, v):
+        if k == "code":
+            self.code = v
+
+    def __getitem__(self, k):
+        if k == "code":
+            return self.code
+        raise KeyError(k)
 
 
 class _NoopSpan:
@@ -104,11 +150,20 @@ class _NoopSpan:
     def record_exception(self, *a, **k):
         pass
 
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
 
 class _NoopTracer:
-    @contextlib.contextmanager
     def span(self, name: str, **attrs):
-        yield _NoopSpan()
+        # singleton CM: no generator frame per call on the serve path
+        return _NOOP_SPAN
 
 
 class RecordedSpan:

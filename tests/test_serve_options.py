@@ -127,3 +127,153 @@ class TestTLS:
             ch.close()
         finally:
             d.stop()
+
+
+class TestDirectGRPCListener:
+    """serve.<kind>.grpc: a second, unmuxed public gRPC port (the
+    high-throughput path — no preface sniff, no byte splice; measured
+    ~1.5x served QPS on a 1-core host). The muxed port keeps working."""
+
+    def test_direct_and_muxed_ports_both_serve(self):
+        from keto_tpu.api import ReadClient, open_channel
+
+        reg = Registry(_base_cfg(
+            {"read": {"grpc": {"host": "127.0.0.1", "port": 0}}}
+        ))
+        reg.relation_tuple_manager().write_relation_tuples(
+            [RelationTuple.from_string("files:doc#owner@alice")]
+        )
+        d = Daemon(reg)
+        d.start()
+        try:
+            assert d.read_grpc_port not in (None, d.read_port)
+            q = RelationTuple.from_string("files:doc#owner@alice")
+            for port in (d.read_grpc_port, d.read_port):
+                c = ReadClient(open_channel(f"127.0.0.1:{port}"))
+                try:
+                    assert c.check(q, timeout=30) is True
+                finally:
+                    c.close()
+        finally:
+            d.stop()
+
+    def test_unconfigured_stays_off(self):
+        reg = Registry(_base_cfg())
+        d = Daemon(reg)
+        d.start()
+        try:
+            assert d.read_grpc_port is None
+            assert d.write_grpc_port is None
+        finally:
+            d.stop()
+
+    def test_direct_port_inherits_tls(self, tmp_path):
+        """A TLS-configured listener's direct gRPC port must serve TLS
+        too — the side door never downgrades the deployment."""
+        cert = tmp_path / "cert.pem"
+        key = tmp_path / "key.pem"
+        subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "rsa:2048",
+                "-keyout", str(key), "-out", str(cert),
+                "-days", "1", "-nodes", "-subj", "/CN=127.0.0.1",
+                "-addext", "subjectAltName=IP:127.0.0.1",
+            ],
+            check=True, capture_output=True,
+        )
+        reg = Registry(_base_cfg({
+            "read": {
+                "tls": {"cert_path": str(cert), "key_path": str(key)},
+                "grpc": {"host": "127.0.0.1", "port": 0},
+            }
+        }))
+        reg.relation_tuple_manager().write_relation_tuples(
+            [RelationTuple.from_string("files:doc#owner@alice")]
+        )
+        d = Daemon(reg)
+        d.start()
+        try:
+            import grpc
+            from keto_tpu.api.descriptors import pb
+
+            creds = grpc.ssl_channel_credentials(cert.read_bytes())
+            ch = grpc.secure_channel(f"127.0.0.1:{d.read_grpc_port}", creds)
+            stub = ch.unary_unary(
+                "/ory.keto.relation_tuples.v1alpha2.CheckService/Check",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.CheckResponse.FromString,
+            )
+            req = pb.CheckRequest()
+            req.tuple.namespace = "files"
+            req.tuple.object = "doc"
+            req.tuple.relation = "owner"
+            req.tuple.subject.id = "alice"
+            assert stub(req, timeout=60).allowed is True
+            ch.close()
+            # and PLAINTEXT against the TLS direct port must fail
+            ch2 = grpc.insecure_channel(f"127.0.0.1:{d.read_grpc_port}")
+            stub2 = ch2.unary_unary(
+                "/ory.keto.relation_tuples.v1alpha2.CheckService/Check",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.CheckResponse.FromString,
+            )
+            with pytest.raises(grpc.RpcError):
+                stub2(req, timeout=10)
+            ch2.close()
+        finally:
+            d.stop()
+
+
+class TestSubmitResolvePipeline:
+    """check_batch == resolve(submit(...)); several batches can be in
+    flight at once and resolve in any order (the TPU-tunnel pipelining
+    contract the batcher and bench rely on)."""
+
+    def test_overlapping_batches_resolve_correctly(self):
+        from keto_tpu.engine import Membership
+        from keto_tpu.engine.tpu_engine import TPUCheckEngine
+        from keto_tpu.storage import MemoryManager
+
+        cfg = Config({"limit": {"max_read_depth": 5}})
+        cfg.set_namespaces([Namespace(name="files")])
+        m = MemoryManager()
+        m.write_relation_tuples([
+            RelationTuple.from_string(f"files:doc{i}#owner@u{i}")
+            for i in range(20)
+        ])
+        e = TPUCheckEngine(m, cfg)
+        hits = [RelationTuple.from_string(f"files:doc{i}#owner@u{i}")
+                for i in range(20)]
+        misses = [RelationTuple.from_string(f"files:doc{i}#owner@nope")
+                  for i in range(20)]
+        h1 = e.check_batch_submit(hits)
+        h2 = e.check_batch_submit(misses)
+        h3 = e.check_batch_submit(hits[:3] + misses[:3])
+        # resolve out of submission order
+        r3 = e.check_batch_resolve(h3)
+        r1 = e.check_batch_resolve(h1)
+        r2 = e.check_batch_resolve(h2)
+        assert all(r.membership == Membership.IS_MEMBER for r in r1)
+        assert all(r.membership == Membership.NOT_MEMBER for r in r2)
+        assert [r.membership == Membership.IS_MEMBER for r in r3] == (
+            [True] * 3 + [False] * 3
+        )
+
+    def test_oversized_submit_splits_and_pipelines(self):
+        from keto_tpu.engine import Membership
+        from keto_tpu.engine.tpu_engine import TPUCheckEngine
+        from keto_tpu.storage import MemoryManager
+
+        cfg = Config({"limit": {"max_read_depth": 5}})
+        cfg.set_namespaces([Namespace(name="files")])
+        m = MemoryManager()
+        m.write_relation_tuples(
+            [RelationTuple.from_string("files:doc#owner@alice")]
+        )
+        e = TPUCheckEngine(m, cfg, frontier_cap=64)  # largest bucket = 64
+        qs = [RelationTuple.from_string("files:doc#owner@alice")] * 130
+        h = e.check_batch_submit(qs)
+        assert h[0] == "multi" and len(h[1]) == 3
+        res = e.check_batch_resolve(h)
+        assert len(res) == 130
+        assert all(r.membership == Membership.IS_MEMBER for r in res)
