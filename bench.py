@@ -286,6 +286,101 @@ def bench_config3_islands() -> dict:
     }
 
 
+def bench_config3_expand() -> dict:
+    """BASELINE config 3: Expand() trees on an RBAC role-chain rewrite
+    namespace (the rewrites_test.go:20-100 topology class: documents
+    whose viewer ⊇ editor ⊇ owner via computed-subject-set rewrites,
+    editors granted through role groups whose member sets nest other
+    roles). Expand engine parity: internal/expand/engine.go:35-104."""
+    from keto_tpu.config import Config
+    from keto_tpu.engine.tpu_engine import TPUCheckEngine
+    from keto_tpu.ketoapi import RelationTuple, SubjectSet
+    from keto_tpu.namespace import Namespace
+    from keto_tpu.namespace.ast import (
+        ComputedSubjectSet,
+        Relation,
+        SubjectSetRewrite,
+    )
+    from keto_tpu.storage import MemoryManager
+
+    n_docs, n_roles, n_users = 2000, 64, 512
+    ns = [
+        Namespace(name="role", relations=[Relation(name="member")]),
+        Namespace(name="doc", relations=[
+            Relation(name="owner"),
+            Relation(name="editor", subject_set_rewrite=SubjectSetRewrite(
+                children=[ComputedSubjectSet(relation="owner")]
+            )),
+            Relation(name="viewer", subject_set_rewrite=SubjectSetRewrite(
+                children=[ComputedSubjectSet(relation="editor")]
+            )),
+        ]),
+    ]
+    rng = random.Random(7)
+    tuples = []
+    # role hierarchy: each role has direct members and may nest one role
+    for r in range(n_roles):
+        for _ in range(4):
+            tuples.append(RelationTuple.from_string(
+                f"role:r{r}#member@u{rng.randrange(n_users)}"
+            ))
+        if r and rng.random() < 0.5:
+            tuples.append(RelationTuple.from_string(
+                f"role:r{r}#member@(role:r{rng.randrange(r)}#member)"
+            ))
+    for d in range(n_docs):
+        tuples.append(RelationTuple.from_string(
+            f"doc:d{d}#owner@u{rng.randrange(n_users)}"
+        ))
+        tuples.append(RelationTuple.from_string(
+            f"doc:d{d}#editor@(role:r{rng.randrange(n_roles)}#member)"
+        ))
+        if rng.random() < 0.3:
+            tuples.append(RelationTuple.from_string(
+                f"doc:d{d}#viewer@u{rng.randrange(n_users)}"
+            ))
+    cfg = Config({"limit": {"max_read_depth": 6}})
+    cfg.set_namespaces(ns)
+    m = MemoryManager()
+    m.write_relation_tuples(tuples)
+    engine = TPUCheckEngine(m, cfg)
+    exp_batch = 256
+    # expand the role member sets: real tuple fanout (direct members +
+    # nested roles), the "who holds this role" question — expand follows
+    # STORED subject-set edges, not rewrites (engine.go:35-104), so doc
+    # viewer sets (rewrite-derived) would expand to leaves
+    subjects = [
+        SubjectSet(namespace="role", object=f"r{rng.randrange(n_roles)}",
+                   relation="member")
+        for _ in range(exp_batch)
+    ]
+    trees = engine.expand_batch(subjects, 6)  # warm-up/compile
+    n_nodes = sum(_tree_size(t) for t in trees if t is not None)
+    host_after_warmup = engine.stats.get("host_expands", 0)
+    rounds = 5
+    lat = []
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        s = time.perf_counter()
+        engine.expand_batch(subjects, 6)
+        lat.append(time.perf_counter() - s)
+    wall = time.perf_counter() - t0
+    return {
+        "expand_qps": round(rounds * exp_batch / wall, 1),
+        "expand_batch": exp_batch,
+        "expand_p50_batch_ms": round(float(np.percentile(np.array(lat) * 1e3, 50)), 2),
+        "expand_tree_nodes_avg": round(n_nodes / max(len(trees), 1), 1),
+        # timed-region fallbacks only (warm-up batch excluded)
+        "expand_host": engine.stats.get("host_expands", 0) - host_after_warmup,
+    }
+
+
+def _tree_size(tree) -> int:
+    if tree is None:
+        return 0
+    return 1 + sum(_tree_size(c) for c in (tree.children or ()))
+
+
 def bench_config4_deep() -> dict:
     """BASELINE config 4: drive-style nested folders, depth-20 recursive
     Check (scaled bench_test.go:56-86 'deep' namespace)."""
@@ -564,6 +659,7 @@ def main() -> int:
         record.update(kernel)
 
         record.update(bench_config3_islands())
+        record.update(bench_config3_expand())
         record.update(bench_config4_deep())
 
         if not args.skip_serve:

@@ -1,0 +1,273 @@
+"""Machine-readable REST API spec, generated from the route table.
+
+The reference ships a generated Swagger document and serves API docs
+(/root/reference/spec/swagger.json, /root/reference/doc_swagger.go:1,
+swagger param shims internal/relationtuple/swagger_definitions.go); here
+the OpenAPI 3.0 document is BUILT from the same route constants
+rest_server.py dispatches on, so the spec cannot drift from the router.
+Served at GET /.well-known/openapi.json on the read and write routers.
+"""
+
+from __future__ import annotations
+
+from .rest_server import (
+    ALIVE_PATH,
+    CHECK_OPENAPI_ROUTE,
+    CHECK_ROUTE_BASE,
+    EXPAND_ROUTE,
+    READ_ROUTE_BASE,
+    READY_PATH,
+    SPEC_ROUTE,
+    VERSION_PATH,
+    WRITE_ROUTE_BASE,
+)
+
+SPEC_PATH = SPEC_ROUTE
+
+_SUBJECT_QUERY_PARAMS = [
+    {"name": "namespace", "in": "query", "schema": {"type": "string"}},
+    {"name": "object", "in": "query", "schema": {"type": "string"}},
+    {"name": "relation", "in": "query", "schema": {"type": "string"}},
+    {"name": "subject_id", "in": "query", "schema": {"type": "string"}},
+    {
+        "name": "subject_set.namespace",
+        "in": "query",
+        "schema": {"type": "string"},
+    },
+    {"name": "subject_set.object", "in": "query", "schema": {"type": "string"}},
+    {
+        "name": "subject_set.relation",
+        "in": "query",
+        "schema": {"type": "string"},
+    },
+]
+
+_MAX_DEPTH_PARAM = {
+    "name": "max-depth",
+    "in": "query",
+    "schema": {"type": "integer"},
+    "description": "Maximum traversal depth (0 = server default)",
+}
+
+
+def _schemas() -> dict:
+    subject_set = {
+        "type": "object",
+        "required": ["namespace", "object", "relation"],
+        "properties": {
+            "namespace": {"type": "string"},
+            "object": {"type": "string"},
+            "relation": {"type": "string"},
+        },
+    }
+    relation_tuple = {
+        "type": "object",
+        "required": ["namespace", "object", "relation"],
+        "properties": {
+            "namespace": {"type": "string"},
+            "object": {"type": "string"},
+            "relation": {"type": "string"},
+            "subject_id": {"type": "string"},
+            "subject_set": {"$ref": "#/components/schemas/subjectSet"},
+        },
+    }
+    return {
+        "subjectSet": subject_set,
+        "relationTuple": relation_tuple,
+        "checkResponse": {
+            "type": "object",
+            "required": ["allowed"],
+            "properties": {"allowed": {"type": "boolean"}},
+        },
+        "getResponse": {
+            "type": "object",
+            "required": ["relation_tuples"],
+            "properties": {
+                "relation_tuples": {
+                    "type": "array",
+                    "items": {"$ref": "#/components/schemas/relationTuple"},
+                },
+                "next_page_token": {"type": "string"},
+            },
+        },
+        "expandTree": {
+            "type": "object",
+            "required": ["type"],
+            "properties": {
+                "type": {
+                    "type": "string",
+                    "enum": ["union", "exclusion", "intersection",
+                             "leaf", "unspecified"],
+                },
+                "tuple": {"$ref": "#/components/schemas/relationTuple"},
+                "children": {
+                    "type": "array",
+                    "items": {"$ref": "#/components/schemas/expandTree"},
+                },
+            },
+        },
+        "patchDelta": {
+            "type": "object",
+            "required": ["action", "relation_tuple"],
+            "properties": {
+                "action": {"type": "string", "enum": ["insert", "delete"]},
+                "relation_tuple": {
+                    "$ref": "#/components/schemas/relationTuple"
+                },
+            },
+        },
+        "version": {
+            "type": "object",
+            "required": ["version"],
+            "properties": {"version": {"type": "string"}},
+        },
+        "healthStatus": {
+            "type": "object",
+            "properties": {"status": {"type": "string"}},
+        },
+        "errorGeneric": {
+            "type": "object",
+            "required": ["error"],
+            "properties": {
+                "error": {
+                    "type": "object",
+                    "properties": {
+                        "code": {"type": "integer"},
+                        "status": {"type": "string"},
+                        "message": {"type": "string"},
+                    },
+                },
+            },
+        },
+    }
+
+
+def _json_response(desc: str, ref: str | None = None) -> dict:
+    out: dict = {"description": desc}
+    if ref is not None:
+        out["content"] = {
+            "application/json": {
+                "schema": {"$ref": f"#/components/schemas/{ref}"}
+            }
+        }
+    return out
+
+
+def build_spec(version: str = "") -> dict:
+    """The OpenAPI 3.0 document for the REST surface (read + write +
+    shared routes). Route strings come from rest_server's constants."""
+    check_op = {
+        "parameters": _SUBJECT_QUERY_PARAMS + [_MAX_DEPTH_PARAM],
+        "responses": {
+            "200": _json_response("membership verdict", "checkResponse"),
+            "400": _json_response("malformed input", "errorGeneric"),
+        },
+    }
+    check_bare = {
+        **check_op,
+        "responses": {
+            **check_op["responses"],
+            "403": _json_response("denied (bare route mirrors the verdict "
+                                  "as the status code)", "checkResponse"),
+        },
+    }
+    paths = {
+        READ_ROUTE_BASE: {
+            "get": {
+                "summary": "List relation tuples matching a query",
+                "parameters": _SUBJECT_QUERY_PARAMS + [
+                    {"name": "page_token", "in": "query",
+                     "schema": {"type": "string"}},
+                    {"name": "page_size", "in": "query",
+                     "schema": {"type": "integer"}},
+                ],
+                "responses": {
+                    "200": _json_response("matching tuples", "getResponse"),
+                    "400": _json_response("malformed input", "errorGeneric"),
+                    "404": _json_response("unknown namespace", "errorGeneric"),
+                },
+            }
+        },
+        CHECK_ROUTE_BASE: {"get": check_bare, "post": check_bare},
+        CHECK_OPENAPI_ROUTE: {"get": check_op, "post": check_op},
+        EXPAND_ROUTE: {
+            "get": {
+                "summary": "Expand a subject set into its membership tree",
+                "parameters": [
+                    {"name": "namespace", "in": "query", "required": True,
+                     "schema": {"type": "string"}},
+                    {"name": "object", "in": "query", "required": True,
+                     "schema": {"type": "string"}},
+                    {"name": "relation", "in": "query", "required": True,
+                     "schema": {"type": "string"}},
+                    _MAX_DEPTH_PARAM,
+                ],
+                "responses": {
+                    "200": _json_response("expansion tree", "expandTree"),
+                    "400": _json_response("malformed input", "errorGeneric"),
+                    "404": _json_response("no such subject set",
+                                          "errorGeneric"),
+                },
+            }
+        },
+        WRITE_ROUTE_BASE: {
+            "put": {
+                "summary": "Create one relation tuple",
+                "requestBody": {
+                    "required": True,
+                    "content": {"application/json": {"schema": {
+                        "$ref": "#/components/schemas/relationTuple"
+                    }}},
+                },
+                "responses": {
+                    "201": _json_response("created", "relationTuple"),
+                    "400": _json_response("malformed input", "errorGeneric"),
+                    "404": _json_response("unknown namespace", "errorGeneric"),
+                },
+            },
+            "delete": {
+                "summary": "Delete all relation tuples matching the query",
+                "parameters": _SUBJECT_QUERY_PARAMS,
+                "responses": {
+                    "204": {"description": "deleted"},
+                    "400": _json_response("malformed input", "errorGeneric"),
+                    "404": _json_response("unknown namespace", "errorGeneric"),
+                },
+            },
+            "patch": {
+                "summary": "Apply insert/delete deltas transactionally",
+                "requestBody": {
+                    "required": True,
+                    "content": {"application/json": {"schema": {
+                        "type": "array",
+                        "items": {"$ref": "#/components/schemas/patchDelta"},
+                    }}},
+                },
+                "responses": {
+                    "204": {"description": "applied"},
+                    "400": _json_response("malformed input", "errorGeneric"),
+                    "404": _json_response("unknown namespace", "errorGeneric"),
+                },
+            },
+        },
+        ALIVE_PATH: {"get": {"responses": {
+            "200": _json_response("process is alive", "healthStatus")}}},
+        READY_PATH: {"get": {"responses": {
+            "200": _json_response("ready to serve", "healthStatus"),
+            "503": _json_response("not ready", "errorGeneric")}}},
+        VERSION_PATH: {"get": {"responses": {
+            "200": _json_response("build version", "version")}}},
+    }
+    return {
+        "openapi": "3.0.3",
+        "info": {
+            "title": "keto_tpu read/write API",
+            "version": version or "dev",
+            "description": (
+                "Wire-compatible REST surface of the keto_tpu daemon "
+                "(reference parity: spec/swagger.json)"
+            ),
+        },
+        "paths": paths,
+        "components": {"schemas": _schemas()},
+    }

@@ -44,6 +44,7 @@ ALIVE_PATH = "/health/alive"
 READY_PATH = "/health/ready"
 VERSION_PATH = "/version"
 METRICS_PATH = "/metrics/prometheus"
+SPEC_ROUTE = "/.well-known/openapi.json"
 
 
 def _get_max_depth(params: dict[str, str]) -> int:
@@ -190,6 +191,15 @@ class _Handler(BaseHTTPRequestHandler):
                 return VERSION_PATH, lambda: self._json(
                     200, {"version": self.registry.version}
                 )
+            if path == SPEC_ROUTE and self.kind in ("read", "write"):
+                # generated-from-route-table OpenAPI document (ref serves
+                # its swagger spec + docs, doc_swagger.go:1)
+                def spec():
+                    from .openapi import build_spec
+
+                    self._json(200, build_spec(self.registry.version))
+
+                return SPEC_ROUTE, spec
 
         if self.kind == "metrics":
             if method == "GET" and path == METRICS_PATH:

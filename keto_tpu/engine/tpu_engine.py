@@ -629,8 +629,10 @@ class TPUCheckEngine:
         needs_host = np.asarray(eb[7])
 
         results = []
+        n_host_exp = 0
         for i, sub in enumerate(subjects):
             if i in host_idx or not q_valid[i] or needs_host[i]:
+                n_host_exp += 1
                 results.append(self.reference.expand(sub, max_depth, self.nid))
                 continue
             adjacency = decode_edge_buffer(
@@ -643,6 +645,10 @@ class TPUCheckEngine:
                     adjacency, bool(root_has_children[i]), state.decoder,
                 )
             )
+        self.stats["device_expands"] = (
+            self.stats.get("device_expands", 0) + n - n_host_exp
+        )
+        self.stats["host_expands"] = self.stats.get("host_expands", 0) + n_host_exp
         return results
 
     def check_batch(

@@ -76,9 +76,22 @@ def main() -> int:
     ap.add_argument("--batch", type=int, default=4096)
     ap.add_argument("--ref-samples", type=int, default=32)
     ap.add_argument("--platform", choices=("auto", "cpu"), default="auto")
+    ap.add_argument(
+        "--mesh", type=int, default=0,
+        help="shard the build over an N-device mesh (with --platform cpu "
+        "this forces N virtual host devices — the 1e7 sharded-columnar "
+        "proof for BASELINE config 5)",
+    )
     args = ap.parse_args()
     if args.platform == "cpu":
         os.environ["JAX_PLATFORMS"] = "cpu"
+        if args.mesh:
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags
+                    + f" --xla_force_host_platform_device_count={args.mesh}"
+                ).strip()
 
     import jax
 
@@ -119,16 +132,39 @@ def main() -> int:
     ])]
     cfg = Config({"limit": {"max_read_depth": 5}})
     cfg.set_namespaces(ns)
-    engine = TPUCheckEngine(store, cfg)
+    mesh = None
+    if args.mesh:
+        from keto_tpu.parallel import default_mesh
+
+        mesh = default_mesh(args.mesh)
+        # default_mesh truncates to the devices that exist — record and
+        # index by the ACTUAL shard count, not the requested one
+        record["mesh_devices"] = int(mesh.devices.size)
+    engine = TPUCheckEngine(store, cfg, mesh=mesh)
 
     # snapshot build (timed separately from XLA compile: run a 1-query
     # warm-up AFTER grabbing the build time via _ensure_state)
     t0 = time.perf_counter()
     state = engine._ensure_state()
     record["snapshot_build_s"] = round(time.perf_counter() - t0, 2)
-    record["device_table_bytes"] = int(
-        sum(np.asarray(v).nbytes for v in state.snapshot.device_arrays().values())
-    )
+    if mesh is not None:
+        per_shard = [
+            int(sum(v[s].nbytes for v in state.sharded.sharded.values()))
+            for s in range(state.sharded.n_shards)
+        ]
+        record["per_shard_bytes"] = per_shard
+        record["device_table_bytes"] = int(
+            sum(per_shard)
+            + sum(np.asarray(v).nbytes
+                  for v in state.sharded.replicated.values())
+        )
+    else:
+        record["device_table_bytes"] = int(
+            sum(
+                np.asarray(v).nbytes
+                for v in state.snapshot.device_arrays().values()
+            )
+        )
 
     # query batch with construction ground truth: half owner-hits
     rng = np.random.default_rng(11)

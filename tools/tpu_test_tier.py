@@ -187,6 +187,35 @@ def main() -> int:
     )
     report("randomized-differential", len(queries), fails)
 
+    # ---- expand differential (device BFS gather vs exact host trees) -----
+    from keto_tpu.ketoapi import SubjectSet
+
+    namespaces = [
+        Namespace(name="role", relations=[Relation(name="member")]),
+    ]
+    tup = set()
+    for r in range(24):
+        for _ in range(3):
+            tup.add(f"role:r{r}#member@u{rng.randrange(12)}")
+        if r and rng.random() < 0.6:
+            tup.add(f"role:r{r}#member@(role:r{rng.randrange(r)}#member)")
+    e = engine_for(namespaces, sorted(tup), max_depth=6)
+    subs = [
+        SubjectSet(namespace="role", object=f"r{rng.randrange(24)}",
+                   relation="member")
+        for _ in range(32)
+    ]
+    trees = e.expand_batch(subs, 6)
+    fails = 0
+    for s, t in zip(subs, trees):
+        want = e.reference.expand(s, 6)
+        got_d = t.to_dict() if t is not None else None
+        want_d = want.to_dict() if want is not None else None
+        if got_d != want_d:
+            fails += 1
+    report("expand-differential", len(subs), fails,
+           {"host_expands": e.stats.get("host_expands", 0)})
+
     print(json.dumps({
         "tier": "tpu", "device": str(device), "sets": sets,
         "cases": total_cases, "failures": total_failures,
