@@ -213,6 +213,16 @@ def bench_kernel(namespaces, tuples, queries) -> dict:
     lat = np.array(latencies) * 1e3
     p50b = float(np.percentile(lat, 50))
     p95b = float(np.percentile(lat, 95))
+
+    # BASELINE config 1: single-check latency floor (one blocked check,
+    # smallest bucket — what an unloaded caller sees end-to-end through
+    # the engine, including any device round-trip)
+    engine.check_batch(queries[:1])  # small-bucket compile warm-up
+    single = []
+    for i in range(20):
+        s = time.perf_counter()
+        engine.check_batch([queries[i % len(queries)]])
+        single.append(time.perf_counter() - s)
     return {
         "value": round(qps, 1),
         "warmup_s": round(warmup_s, 2),
@@ -220,6 +230,9 @@ def bench_kernel(namespaces, tuples, queries) -> dict:
         "p95_batch_ms": round(p95b, 2),
         # amortized device cost per check at steady state (pipelined)
         "per_check_us_pipelined": round(wall * 1e6 / (ROUNDS * BATCH), 3),
+        "single_check_p50_ms": round(
+            float(np.percentile(np.array(single) * 1e3, 50)), 2
+        ),
     }
 
 

@@ -22,7 +22,6 @@ from .rest_server import (
     WRITE_ROUTE_BASE,
 )
 
-SPEC_PATH = SPEC_ROUTE
 
 _SUBJECT_QUERY_PARAMS = [
     {"name": "namespace", "in": "query", "schema": {"type": "string"}},
@@ -153,9 +152,17 @@ def _json_response(desc: str, ref: str | None = None) -> dict:
     return out
 
 
-def build_spec(version: str = "") -> dict:
-    """The OpenAPI 3.0 document for the REST surface (read + write +
-    shared routes). Route strings come from rest_server's constants."""
+_READ_ONLY_PATHS = (
+    READ_ROUTE_BASE, CHECK_ROUTE_BASE, CHECK_OPENAPI_ROUTE, EXPAND_ROUTE,
+)
+_WRITE_ONLY_PATHS = (WRITE_ROUTE_BASE,)
+
+
+def build_spec(version: str = "", kind: str | None = None) -> dict:
+    """The OpenAPI 3.0 document for the REST surface. Route strings come
+    from rest_server's constants. `kind` ("read" | "write" | None)
+    filters to the paths THAT router answers — each port's served spec
+    must not advertise routes the port 404s."""
     check_op = {
         "parameters": _SUBJECT_QUERY_PARAMS + [_MAX_DEPTH_PARAM],
         "responses": {
@@ -258,6 +265,12 @@ def build_spec(version: str = "") -> dict:
         VERSION_PATH: {"get": {"responses": {
             "200": _json_response("build version", "version")}}},
     }
+    if kind == "read":
+        for p in _WRITE_ONLY_PATHS:
+            paths.pop(p, None)
+    elif kind == "write":
+        for p in _READ_ONLY_PATHS:
+            paths.pop(p, None)
     return {
         "openapi": "3.0.3",
         "info": {

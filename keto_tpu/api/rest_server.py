@@ -197,7 +197,9 @@ class _Handler(BaseHTTPRequestHandler):
                 def spec():
                     from .openapi import build_spec
 
-                    self._json(200, build_spec(self.registry.version))
+                    self._json(
+                        200, build_spec(self.registry.version, kind=self.kind)
+                    )
 
                 return SPEC_ROUTE, spec
 

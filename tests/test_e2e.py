@@ -1,15 +1,19 @@
-"""End-to-end matrix: one shared case suite through three client types.
+"""End-to-end matrix: one shared case suite through four client types.
 
 Port of the reference's e2e strategy (internal/e2e/full_suit_test.go +
 cases_test.go): a real in-process server (mux'd gRPC+REST ports, TPU
-check engine) exercised through raw gRPC, raw REST, and the CLI — every
-case runs once per client type, like the reference's
-grpc/rest/cli/sdk × DSN matrix. Our ReadClient/WriteClient doubles as
-the SDK (there is no generated client to diverge from).
+check engine) exercised through raw gRPC, raw REST, the CLI, AND a
+protoc-GENERATED client (the reference's sdk leg,
+internal/e2e/sdk_client_test.go) — every case runs once per client
+type, like the reference's grpc/rest/cli/sdk × DSN matrix. The sdk leg
+generates message classes from api/protos/keto.proto with the system
+protoc at test time, so wire compatibility is proven against an
+INDEPENDENT code generator, not just our own runtime descriptor pool.
 """
 
 import itertools
 import json
+import os
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -268,7 +272,158 @@ class CLIClientAdapter:
         pass
 
 
-ADAPTERS = ["grpc", "rest", "cli"]
+class SDKClientAdapter:
+    """protoc-generated message classes over a raw channel (the
+    reference's generated-SDK client leg, sdk_client_test.go)."""
+
+    def __init__(self, daemon, pb2):
+        self.pb2 = pb2
+        self.read_ch = open_channel(f"127.0.0.1:{daemon.read_port}")
+        self.write_ch = open_channel(f"127.0.0.1:{daemon.write_port}")
+        base = "ory.keto.relation_tuples.v1alpha2"
+        self._check = self.read_ch.unary_unary(
+            f"/{base}.CheckService/Check",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb2.CheckResponse.FromString,
+        )
+        self._expand = self.read_ch.unary_unary(
+            f"/{base}.ExpandService/Expand",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb2.ExpandResponse.FromString,
+        )
+        self._list = self.read_ch.unary_unary(
+            f"/{base}.ReadService/ListRelationTuples",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb2.ListRelationTuplesResponse.FromString,
+        )
+        self._transact = self.write_ch.unary_unary(
+            f"/{base}.WriteService/TransactRelationTuples",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb2.TransactRelationTuplesResponse.FromString,
+        )
+        self._delete = self.write_ch.unary_unary(
+            f"/{base}.WriteService/DeleteRelationTuples",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb2.DeleteRelationTuplesResponse.FromString,
+        )
+
+    def _pb_tuple(self, t: RelationTuple):
+        m = self.pb2.RelationTuple(
+            namespace=t.namespace, object=t.object, relation=t.relation
+        )
+        if t.subject_set is not None:
+            m.subject.set.namespace = t.subject_set.namespace
+            m.subject.set.object = t.subject_set.object
+            m.subject.set.relation = t.subject_set.relation
+        else:
+            m.subject.id = t.subject_id or ""
+        return m
+
+    def create(self, t: RelationTuple):
+        req = self.pb2.TransactRelationTuplesRequest()
+        d = req.relation_tuple_deltas.add()
+        d.action = self.pb2.RelationTupleDelta.Action.ACTION_INSERT
+        d.relation_tuple.CopyFrom(self._pb_tuple(t))
+        self._transact(req, timeout=60)
+
+    def delete(self, t: RelationTuple):
+        req = self.pb2.TransactRelationTuplesRequest()
+        d = req.relation_tuple_deltas.add()
+        d.action = self.pb2.RelationTupleDelta.Action.ACTION_DELETE
+        d.relation_tuple.CopyFrom(self._pb_tuple(t))
+        self._transact(req, timeout=60)
+
+    def _pb_query(self, q: RelationQuery):
+        m = self.pb2.RelationQuery()
+        if q.namespace is not None:
+            m.namespace = q.namespace
+        if q.object is not None:
+            m.object = q.object
+        if q.relation is not None:
+            m.relation = q.relation
+        if q.subject_id is not None:
+            m.subject.id = q.subject_id
+        elif q.subject_set is not None:
+            m.subject.set.namespace = q.subject_set.namespace
+            m.subject.set.object = q.subject_set.object
+            m.subject.set.relation = q.subject_set.relation
+        return m
+
+    def delete_all(self, q: RelationQuery):
+        req = self.pb2.DeleteRelationTuplesRequest()
+        req.relation_query.CopyFrom(self._pb_query(q))
+        self._delete(req, timeout=60)
+
+    def query(self, q: RelationQuery, page_size=0, page_token="") -> GetResponse:
+        from keto_tpu.api.messages import tuple_from_proto
+
+        req = self.pb2.ListRelationTuplesRequest(
+            page_size=page_size, page_token=page_token
+        )
+        req.relation_query.CopyFrom(self._pb_query(q))
+        resp = self._list(req, timeout=60)
+        return GetResponse(
+            relation_tuples=[tuple_from_proto(m) for m in resp.relation_tuples],
+            next_page_token=resp.next_page_token,
+        )
+
+    def check(self, t: RelationTuple, max_depth=0) -> bool:
+        req = self.pb2.CheckRequest(max_depth=max_depth)
+        req.tuple.CopyFrom(self._pb_tuple(t))
+        return self._check(req, timeout=60).allowed
+
+    def expand(self, s: SubjectSet, max_depth=0) -> Tree:
+        from keto_tpu.api.messages import tree_from_proto
+
+        req = self.pb2.ExpandRequest(max_depth=max_depth)
+        req.subject.set.namespace = s.namespace
+        req.subject.set.object = s.object
+        req.subject.set.relation = s.relation
+        return tree_from_proto(self._expand(req, timeout=60).tree)
+
+    def query_unknown_namespace_error(self, q: RelationQuery):
+        req = self.pb2.ListRelationTuplesRequest()
+        req.relation_query.CopyFrom(self._pb_query(q))
+        with pytest.raises(grpc.RpcError) as exc:
+            self._list(req, timeout=60)
+        assert exc.value.code() == grpc.StatusCode.NOT_FOUND
+
+    def close(self):
+        self.read_ch.close()
+        self.write_ch.close()
+
+
+@pytest.fixture(scope="module")
+def generated_pb2(tmp_path_factory):
+    """Generate message classes from the shipped proto with the SYSTEM
+    protoc — an independent implementation of the wire format."""
+    import shutil
+    import subprocess
+    import sys as _sys
+
+    if shutil.which("protoc") is None:
+        pytest.skip("protoc not available")
+    out = tmp_path_factory.mktemp("sdkgen")
+    proto_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "keto_tpu", "api", "protos",
+    )
+    subprocess.run(
+        ["protoc", "-I", proto_dir, f"--python_out={out}",
+         os.path.join(proto_dir, "keto.proto")],
+        check=True, capture_output=True,
+    )
+    _sys.path.insert(0, str(out))
+    try:
+        import keto_pb2
+
+        yield keto_pb2
+    finally:
+        _sys.path.remove(str(out))
+        _sys.modules.pop("keto_pb2", None)
+
+
+ADAPTERS = ["grpc", "rest", "cli", "sdk"]
 
 
 @pytest.fixture(params=ADAPTERS)
@@ -277,6 +432,8 @@ def client(request, daemon, capsys, tmp_path):
         c = GRPCClientAdapter(daemon)
     elif request.param == "rest":
         c = RESTClientAdapter(daemon)
+    elif request.param == "sdk":
+        c = SDKClientAdapter(daemon, request.getfixturevalue("generated_pb2"))
     else:
         c = CLIClientAdapter(daemon, capsys, tmp_path)
     yield c

@@ -54,21 +54,29 @@ def _schema_for(spec, path, method, code):
 
 class TestServedSpec:
     def test_spec_served_on_read_and_write(self, daemon):
-        for port in (daemon.read_port, daemon.write_port):
-            spec = json.load(_get(port, SPEC_ROUTE))
-            assert spec["openapi"].startswith("3.")
-            assert "/relation-tuples/check" in spec["paths"]
+        """Each port's spec advertises only routes THAT port answers."""
+        read = json.load(_get(daemon.read_port, SPEC_ROUTE))
+        write = json.load(_get(daemon.write_port, SPEC_ROUTE))
+        assert read["openapi"].startswith("3.")
+        assert "/relation-tuples/check" in read["paths"]
+        assert "/admin/relation-tuples" not in read["paths"]
+        assert "/admin/relation-tuples" in write["paths"]
+        assert "/relation-tuples/check" not in write["paths"]
 
     def test_spec_routes_match_router_constants(self, daemon):
         from keto_tpu.api import rest_server as r
 
-        spec = json.load(_get(daemon.read_port, SPEC_ROUTE))
+        read = json.load(_get(daemon.read_port, SPEC_ROUTE))
+        write = json.load(_get(daemon.write_port, SPEC_ROUTE))
         for route in (
             r.READ_ROUTE_BASE, r.CHECK_ROUTE_BASE, r.CHECK_OPENAPI_ROUTE,
-            r.EXPAND_ROUTE, r.WRITE_ROUTE_BASE, r.ALIVE_PATH, r.READY_PATH,
-            r.VERSION_PATH,
+            r.EXPAND_ROUTE, r.ALIVE_PATH, r.READY_PATH, r.VERSION_PATH,
         ):
-            assert route in spec["paths"], route
+            assert route in read["paths"], route
+        for route in (
+            r.WRITE_ROUTE_BASE, r.ALIVE_PATH, r.READY_PATH, r.VERSION_PATH,
+        ):
+            assert route in write["paths"], route
 
     @pytest.mark.parametrize("path,method,code,live", [
         ("/relation-tuples/check/openapi", "get",

@@ -9,6 +9,12 @@ without reading an XLA trace. Run on the bench machine:
 Prints one JSON line per phase: {"phase", "ms", "shapes"} plus a
 "step_total" line and the table/probe stats that drive the costs
 (dh_probes / rh_probes multiply every probe gather's width).
+
+Timing discipline for the axon tunnel (round-3 finding): the tunnel's
+synchronized round-trip costs ~70 ms, so per-call blocking measures the
+tunnel, not the chip. Phases are timed with a DEEP async-dispatch loop
+(block once at the end) and the amortized per-call cost reported; the
+blocked one-shot latency is reported separately for the full kernel.
 """
 
 from __future__ import annotations
@@ -22,22 +28,26 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def timed(fn, *args, n=20, **kw):
+def timed(fn, *args, n=100, window=8, **kw):
+    """Amortized per-call ms with a BOUNDED in-flight window: deep
+    unbounded dispatch queues wedge the axon tunnel and hold n result
+    buffers on-device."""
     out = fn(*args, **kw)
     jax_block(out)
     t0 = time.perf_counter()
+    pending = []
     for _ in range(n):
-        out = fn(*args, **kw)
-    jax_block(out)
+        pending.append(fn(*args, **kw))
+        if len(pending) > window:
+            jax_block(pending.pop(0))
+    jax_block(pending)
     return (time.perf_counter() - t0) / n * 1e3, out
 
 
 def jax_block(out):
     import jax
 
-    for leaf in jax.tree_util.tree_leaves(out):
-        if hasattr(leaf, "block_until_ready"):
-            leaf.block_until_ready()
+    jax.block_until_ready(out)
 
 
 def main() -> int:
@@ -162,17 +172,34 @@ def main() -> int:
     ms, _ = timed(f_dedupe, children)
     print(json.dumps({"phase": "dedupe", "ms": round(ms, 3)}))
 
-    # full kernel for the step_total denominator
+    # full kernel: pipelined steady state with a BOUNDED window (deep
+    # unbounded queues of while_loop kernels have wedged the tunnel)
     full = functools.partial(check_kernel, **statics)
-    ms, _ = timed(
-        full, tables, qd["q_obj"], qd["q_rel"], qd["q_depth"],
-        qd["q_skind"], qd["q_sa"], qd["q_sb"], qd["q_valid"], n=5,
+    fargs = (
+        tables, qd["q_obj"], qd["q_rel"], qd["q_depth"],
+        qd["q_skind"], qd["q_sa"], qd["q_sb"], qd["q_valid"],
     )
+    out = full(*fargs)
+    jax_block(out)
+    n, window = 20, 6
+    t0 = time.perf_counter()
+    pending = []
+    for _ in range(n):
+        pending.append(full(*fargs))
+        if len(pending) > window:
+            jax_block(pending.pop(0))
+    jax_block(pending)
+    ms = (time.perf_counter() - t0) / n * 1e3
+    # blocked one-shot latency (includes one tunnel round-trip)
+    t0 = time.perf_counter()
+    jax_block(full(*fargs))
+    one_ms = (time.perf_counter() - t0) * 1e3
     print(
         json.dumps(
             {
                 "phase": "full_kernel",
                 "ms": round(ms, 3),
+                "blocked_one_shot_ms": round(one_ms, 3),
                 "per_step_ms": round(ms / statics["max_steps"], 3),
                 "max_steps": statics["max_steps"],
             }

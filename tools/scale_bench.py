@@ -152,11 +152,16 @@ def main() -> int:
             int(sum(v[s].nbytes for v in state.sharded.sharded.values()))
             for s in range(state.sharded.n_shards)
         ]
+        replicated_bytes = int(
+            sum(np.asarray(v).nbytes for v in state.sharded.replicated.values())
+        )
         record["per_shard_bytes"] = per_shard
+        record["replicated_bytes_per_device"] = replicated_bytes
+        # per-device HBM = its shard + a full replicated copy; the total
+        # across the mesh pays replicated_bytes on EVERY device
+        record["per_device_bytes_max"] = max(per_shard) + replicated_bytes
         record["device_table_bytes"] = int(
-            sum(per_shard)
-            + sum(np.asarray(v).nbytes
-                  for v in state.sharded.replicated.values())
+            sum(per_shard) + len(per_shard) * replicated_bytes
         )
     else:
         record["device_table_bytes"] = int(
