@@ -152,17 +152,11 @@ def _json_response(desc: str, ref: str | None = None) -> dict:
     return out
 
 
-_READ_ONLY_PATHS = (
-    READ_ROUTE_BASE, CHECK_ROUTE_BASE, CHECK_OPENAPI_ROUTE, EXPAND_ROUTE,
-)
-_WRITE_ONLY_PATHS = (WRITE_ROUTE_BASE,)
-
-
 def build_spec(version: str = "", kind: str | None = None) -> dict:
-    """The OpenAPI 3.0 document for the REST surface. Route strings come
-    from rest_server's constants. `kind` ("read" | "write" | None)
-    filters to the paths THAT router answers — each port's served spec
-    must not advertise routes the port 404s."""
+    """The OpenAPI 3.0 document for the REST surface. Route strings AND
+    route→port ownership come from rest_server (ROUTE_KINDS), so `kind`
+    ("read" | "write" | None) filters to the paths THAT router answers —
+    each port's served spec must not advertise routes the port 404s."""
     check_op = {
         "parameters": _SUBJECT_QUERY_PARAMS + [_MAX_DEPTH_PARAM],
         "responses": {
@@ -265,12 +259,14 @@ def build_spec(version: str = "", kind: str | None = None) -> dict:
         VERSION_PATH: {"get": {"responses": {
             "200": _json_response("build version", "version")}}},
     }
-    if kind == "read":
-        for p in _WRITE_ONLY_PATHS:
-            paths.pop(p, None)
-    elif kind == "write":
-        for p in _READ_ONLY_PATHS:
-            paths.pop(p, None)
+    if kind in ("read", "write"):
+        from .rest_server import ROUTE_KINDS
+
+        paths = {
+            p: ops
+            for p, ops in paths.items()
+            if ROUTE_KINDS.get(p, "shared") in (kind, "shared")
+        }
     return {
         "openapi": "3.0.3",
         "info": {

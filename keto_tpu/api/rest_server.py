@@ -46,6 +46,22 @@ VERSION_PATH = "/version"
 METRICS_PATH = "/metrics/prometheus"
 SPEC_ROUTE = "/.well-known/openapi.json"
 
+# route -> router kind, the ONE ownership table (consumed by the spec
+# builder so a port's served spec can never advertise a route the port
+# 404s; keep in sync with _resolve when adding routes)
+ROUTE_KINDS = {
+    READ_ROUTE_BASE: "read",
+    CHECK_ROUTE_BASE: "read",
+    CHECK_OPENAPI_ROUTE: "read",
+    EXPAND_ROUTE: "read",
+    WRITE_ROUTE_BASE: "write",
+    ALIVE_PATH: "shared",
+    READY_PATH: "shared",
+    VERSION_PATH: "shared",
+    SPEC_ROUTE: "shared",
+    METRICS_PATH: "metrics",
+}
+
 
 def _get_max_depth(params: dict[str, str]) -> int:
     """ref: internal/x/max_depth.go (param name "max-depth", 0 if absent)."""
