@@ -17,7 +17,7 @@ from .rest_server import (
     EXPAND_ROUTE,
     READ_ROUTE_BASE,
     READY_PATH,
-    SPEC_ROUTE,
+    ROUTE_KINDS,
     VERSION_PATH,
     WRITE_ROUTE_BASE,
 )
@@ -260,12 +260,13 @@ def build_spec(version: str = "", kind: str | None = None) -> dict:
             "200": _json_response("build version", "version")}}},
     }
     if kind in ("read", "write"):
-        from .rest_server import ROUTE_KINDS
-
+        # ROUTE_KINDS[p] (not .get): a path missing from the ownership
+        # table must raise here — failing open to "shared" would put the
+        # route in BOTH ports' specs, the drift this filter exists to stop
         paths = {
             p: ops
             for p, ops in paths.items()
-            if ROUTE_KINDS.get(p, "shared") in (kind, "shared")
+            if ROUTE_KINDS[p] in (kind, "shared")
         }
     return {
         "openapi": "3.0.3",

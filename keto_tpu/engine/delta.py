@@ -42,8 +42,13 @@ import numpy as np
 from ..ketoapi import RelationTuple
 from .snapshot import EMPTY, GraphSnapshot, _build_hash_table
 
-DELTA_CAPACITY = 8192  # fixed table shape; <= 1/4 load at the threshold
-DIRTY_CAPACITY = 4096
+# Fixed table shapes sized for hash_table_capacity's load factor (0.25:
+# cap = next pow2 >= 4n). Each op contributes one dd entry and at most
+# one distinct dirty (obj, rel) row, so BOTH tables must hold
+# 4 * DELTA_COMPACT_THRESHOLD = 8192 — at the old 4096 a batch touching
+# >1024 distinct rows would spuriously force a full compaction.
+DELTA_CAPACITY = 8192
+DIRTY_CAPACITY = 8192
 DELTA_COMPACT_THRESHOLD = 2048
 DELTA_PROBES = 8  # static probe unroll; a build needing deeper probing
 # signals compaction instead of growing the fixed-shape table

@@ -267,3 +267,28 @@ class TestShardedDelta:
         assert not e.check_is_member(ts("files:a#owner@alice")[0])
         assert e.stats["snapshot_builds"] == 1
         assert e.stats["host_checks"] == 0
+
+
+class TestDeltaCapacityWindow:
+    def test_wide_write_batch_rides_delta_without_compaction(self):
+        """A batch touching well over 1024 distinct (obj, rel) rows must
+        stay inside the fixed-shape overlay (round-3 regression: the
+        load-0.25 capacity change halved the dirty table's effective
+        window until DIRTY_CAPACITY was retuned to 4x the op threshold)."""
+        from keto_tpu.engine.delta import DELTA_COMPACT_THRESHOLD
+
+        manager = MemoryManager()
+        config = Config({"namespaces": []})
+        config.set_namespaces([Namespace(name="files")])
+        e = TPUCheckEngine(manager, config)
+        manager.write_relation_tuples(ts("files:seed#owner@alice"))
+        assert e.check_is_member(ts("files:seed#owner@alice")[0])
+        builds = e.stats["snapshot_builds"]
+        n = DELTA_COMPACT_THRESHOLD - 8  # just under the op window
+        manager.write_relation_tuples(
+            [RelationTuple.from_string(f"files:w{i}#owner@u{i % 7}")
+             for i in range(n)]
+        )
+        assert e.check_is_member(ts("files:w3#owner@u3")[0])
+        assert not e.check_is_member(ts("files:w3#owner@u4")[0])
+        assert e.stats["snapshot_builds"] == builds  # overlay, no rebuild

@@ -417,8 +417,15 @@ def generated_pb2(tmp_path_factory):
     try:
         try:
             import keto_pb2
-        except Exception as e:  # gencode/runtime version mismatch
-            pytest.skip(f"generated protobuf code unusable here: {e}")
+        except (ImportError, TypeError, ValueError) as e:
+            # protoc gencode vs installed protobuf runtime mismatch only
+            # ("Descriptors cannot be created directly" / runtime_version
+            # validation); anything else should FAIL, not skip — a broken
+            # keto.proto must not silently hollow out the sdk leg
+            msg = str(e)
+            if "Descriptor" in msg or "runtime" in msg.lower():
+                pytest.skip(f"protobuf gencode/runtime mismatch: {e}")
+            raise
         yield keto_pb2
     finally:
         _sys.path.remove(str(out))

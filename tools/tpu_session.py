@@ -1,0 +1,120 @@
+"""One-shot TPU artifact session for round 3.
+
+TPU access through the axon tunnel is fragile (a wedge can outlast a
+process by hours — see BENCH_TPU_r03_first.json's history), so when the
+chip IS healthy every artifact must be captured in one sitting, most
+important first, each step in its OWN subprocess with a timeout so a
+mid-step wedge cannot take the rest of the session down:
+
+  1. bench.py            -> BENCH_TPU_r03.json   (the round's headline)
+  2. tpu_test_tier.py    -> TPU_TIER_r03.json    (hardware correctness)
+  3. profile_kernel.py   -> TPU_PROFILE_r03.json (per-phase steady state)
+  4. scale_bench 1e6     -> TPU_SCALE_r03.json   (table-size scaling on chip)
+
+Usage:  python tools/tpu_session.py [--skip-scale]
+Prints one JSON status line per step; exits 0 iff step 1 succeeded.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PROBE = (
+    "import jax, jax.numpy as jnp; d = jax.devices();"
+    "x = jnp.ones((256, 256)); (x @ x).block_until_ready();"
+    "print('PROBE_OK', d[0].platform)"
+)
+
+
+def run_step(name: str, argv: list[str], out_path: str | None,
+             timeout_s: float) -> dict:
+    t0 = time.monotonic()
+    try:
+        r = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout_s,
+            cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return {"step": name, "ok": False,
+                "error": f"timeout after {timeout_s:.0f}s (likely wedge)"}
+    lines = [l for l in r.stdout.strip().splitlines() if l.startswith("{")]
+    if out_path is not None and lines:
+        with open(os.path.join(REPO, out_path), "w") as f:
+            f.write("\n".join(lines) + "\n")
+    tail = (r.stderr or r.stdout).strip().splitlines()
+    return {
+        "step": name,
+        "ok": r.returncode == 0,
+        "rc": r.returncode,
+        "wall_s": round(time.monotonic() - t0, 1),
+        "artifact": out_path if (out_path and lines) else None,
+        "last_line": (lines[-1][:400] if lines else
+                      (tail[-1][:200] if tail else "")),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip-scale", action="store_true")
+    ap.add_argument("--probe-timeout", type=float, default=120.0)
+    args = ap.parse_args()
+
+    # health gate (subprocess: a wedged backend must not hang THIS process)
+    try:
+        p = subprocess.run([sys.executable, "-c", PROBE],
+                           capture_output=True, text=True,
+                           timeout=args.probe_timeout)
+    except subprocess.TimeoutExpired:
+        print(json.dumps({"step": "probe", "ok": False,
+                          "error": "backend init timeout (wedged)"}))
+        return 2
+    if "PROBE_OK" not in p.stdout:
+        print(json.dumps({"step": "probe", "ok": False,
+                          "error": (p.stderr or p.stdout)[-200:]}))
+        return 2
+    print(json.dumps({"step": "probe", "ok": True,
+                      "platform": p.stdout.split()[1]}), flush=True)
+
+    steps = [
+        ("bench", [sys.executable, "bench.py", "--probe-timeout", "120"],
+         "BENCH_TPU_r03.json", 1800),
+        ("tier", [sys.executable, "tools/tpu_test_tier.py"],
+         "TPU_TIER_r03.json", 1200),
+        ("profile", [sys.executable, "tools/profile_kernel.py"],
+         "TPU_PROFILE_r03.json", 1200),
+    ]
+    if not args.skip_scale:
+        steps.append((
+            "scale-1e6",
+            [sys.executable, "tools/scale_bench.py", "--tuples", "1000000",
+             "--ref-samples", "8"],
+            "TPU_SCALE_r03.json", 2400,
+        ))
+
+    results = []
+    for name, argv, out_path, timeout_s in steps:
+        res = run_step(name, argv, out_path, timeout_s)
+        results.append(res)
+        print(json.dumps(res), flush=True)
+        if not res["ok"] and "timeout" in str(res.get("error", "")):
+            # a wedge kills everything after it anyway — stop cleanly
+            print(json.dumps({"step": "session", "ok": False,
+                              "error": f"aborted after {name} wedge"}))
+            break
+
+    bench_ok = any(r["step"] == "bench" and r["ok"] for r in results)
+    print(json.dumps({"step": "session", "ok": bench_ok,
+                      "steps_ok": sum(1 for r in results if r["ok"]),
+                      "steps": len(results)}))
+    return 0 if bench_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
