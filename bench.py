@@ -527,7 +527,10 @@ def bench_served(namespaces, tuples, queries) -> dict:
     cfg = Config(
         {
             "dsn": "memory",
-            "check": {"engine": "tpu"},
+            # pipeline depth 8: on a tunneled TPU the ~70 ms round-trip
+            # dwarfs batch compute, so served throughput scales with
+            # launched-but-unresolved batches in flight
+            "check": {"engine": "tpu", "pipeline_depth": 8},
             "limit": {"max_read_depth": 5},
             "serve": {
                 "read": {"host": "127.0.0.1", "port": 0,

@@ -209,9 +209,14 @@ class Daemon:
         self.metrics_addr = cfg.metrics_api_address()
         if host is not None:
             self.read_addr.host = self.write_addr.host = self.metrics_addr.host = host
+        # pipeline depth bounds launched-but-unresolved device batches
+        # (in-flight cap = 2x depth); raise it for remote/tunneled TPUs
+        # where the device round-trip dwarfs per-batch compute
         self.batcher = CheckBatcher(
             registry.check_engine(),
             engine_resolver=registry.check_engine,
+            pipeline_depth=int(cfg.get("check.pipeline_depth", 2)),
+            window_s=float(cfg.get("check.batch_window_ms", 2.0)) / 1e3,
         )
         self._grpc_read = None
         self._grpc_write = None

@@ -69,14 +69,18 @@ def build_full_csr(
         t_skind[i], t_sa[i], t_sb[i] = subject
         keep[i] = True
 
+    return full_csr_from_encoded(
+        t_obj[keep], t_rel[keep], t_skind[keep], t_sa[keep], t_sb[keep]
+    )
+
+
+def full_csr_from_encoded(t_obj, t_rel, t_skind, t_sa, t_sb) -> dict:
+    """Group pre-encoded full edges (subject-id leaves AND subject-set
+    children) into the expand kernel's row-hash + CSR tables."""
     from .snapshot import group_rows_csr
 
     fh_obj, fh_rel, fh_row, fh_probes, row_ptr, (f_skind, f_sa, f_sb) = (
-        group_rows_csr(
-            t_obj[keep],
-            t_rel[keep],
-            (t_skind[keep], t_sa[keep], t_sb[keep]),
-        )
+        group_rows_csr(t_obj, t_rel, (t_skind, t_sa, t_sb))
     )
     return {
         "fh_obj": fh_obj, "fh_rel": fh_rel, "fh_row": fh_row,
@@ -86,6 +90,36 @@ def build_full_csr(
         "f_sa": f_sa,
         "f_sb": f_sb,
     }
+
+
+def columnar_subject_order(cols, keep):
+    """Within-row child order for columnar CSR builds: the store's
+    identity-key total order restricted to the subject fields (the
+    (ns, obj, rel) prefix is constant within a CSR row). Matches the
+    host oracle's paginated read order so device-assembled trees list
+    children exactly as the reference engine does."""
+    k = np.flatnonzero(np.asarray(keep))
+    return k[np.lexsort((
+        cols.srel[k], cols.sobj[k], cols.sns[k],
+        np.asarray(cols.skind)[k],
+    ))]
+
+
+def build_full_csr_columnar(cols, snapshot: GraphSnapshot) -> dict:
+    """build_full_csr from TupleColumns: vectorized encoding against the
+    snapshot's vocabularies (engine/snapshot.py encode_edge_columns) —
+    the columnar store's expand state never materializes per-tuple
+    Python objects (the 1e7..1e8-scale requirement, mirroring the check
+    path's columnar ingest)."""
+    from .snapshot import encode_edge_columns
+
+    t_obj, t_rel, t_skind, t_sa, t_sb, keep = encode_edge_columns(
+        cols, snapshot
+    )
+    order = columnar_subject_order(cols, keep)
+    return full_csr_from_encoded(
+        t_obj[order], t_rel[order], t_skind[order], t_sa[order], t_sb[order]
+    )
 
 
 # -- device kernel -------------------------------------------------------------

@@ -882,6 +882,76 @@ def build_snapshot_columnar(
     )
 
 
+def _map_sorted_arrays(mapping, composite: bool = False):
+    """(sorted_keys, values) numpy arrays from a vocab dict or ArrayMap,
+    ready for _sorted_lookup. `composite` encodes dict keys of the
+    (ns_id, object) form into the ArrayMap's "ns\\x1fobj" string form."""
+    if isinstance(mapping, ArrayMap):
+        keys = mapping._keys
+        vals = (
+            np.arange(len(keys), dtype=np.int64)
+            if mapping._values is None
+            else np.asarray(mapping._values, dtype=np.int64)
+        )
+        return keys, vals
+    if composite:
+        items = [
+            (f"{ns}{_SEP}{obj}", v) for (ns, obj), v in mapping.items()
+        ]
+    else:
+        items = list(mapping.items())
+    if not items:
+        return np.array([], dtype="U1"), np.array([], dtype=np.int64)
+    keys = np.array([k for k, _ in items], dtype="U")
+    vals = np.array([v for _, v in items], dtype=np.int64)
+    order = np.argsort(keys)
+    return keys[order], vals[order]
+
+
+def encode_edge_columns(cols, snapshot: GraphSnapshot):
+    """Vectorized (t_obj, t_rel, t_skind, t_sa, t_sb, keep) encoding of
+    TupleColumns under an EXISTING snapshot's vocabularies — the scale
+    path for expand-state builds (no per-tuple Python). Names unknown to
+    the snapshot drop via `keep`: that matches build_full_csr's
+    view-skip semantics, because any tuple written after the base
+    snapshot rides the delta overlay and its (obj, rel) row is
+    dirty-flagged, which routes the affected queries to exact host
+    replay regardless of CSR contents."""
+    n_t = len(cols)
+    is_set = np.asarray(cols.skind) == 1
+    plain = ~is_set
+
+    ns_keys, ns_vals = _map_sorted_arrays(snapshot.ns_ids)
+    rel_keys, rel_vals = _map_sorted_arrays(snapshot.rel_ids)
+    t_ns = _sorted_lookup(ns_keys, ns_vals, cols.ns.astype("U"))
+    t_rel = _sorted_lookup(rel_keys, rel_vals, cols.rel.astype("U"))
+    s_ns = np.where(
+        is_set, _sorted_lookup(ns_keys, ns_vals, cols.sns.astype("U")), -1
+    )
+    s_rel = np.where(
+        is_set, _sorted_lookup(rel_keys, rel_vals, cols.srel.astype("U")), -1
+    )
+
+    obj_keys, obj_vals = _map_sorted_arrays(snapshot.obj_slots, composite=True)
+    # unknown namespaces compose to "-1\x1f..." which matches nothing
+    t_obj = _sorted_lookup(obj_keys, obj_vals, _compose_keys(t_ns, cols.obj))
+    s_slot = _sorted_lookup(
+        obj_keys, obj_vals, _compose_keys(s_ns, cols.sobj)
+    )
+
+    subj_keys, subj_vals = _map_sorted_arrays(snapshot.subj_ids)
+    sa_plain = _sorted_lookup(subj_keys, subj_vals, cols.sobj.astype("U"))
+
+    t_skind = np.asarray(cols.skind, dtype=np.int32)
+    t_sa = np.where(is_set, s_slot, sa_plain).astype(np.int32)
+    t_sb = np.where(is_set, np.maximum(s_rel, 0), 0).astype(np.int32)
+    subject_ok = np.where(
+        is_set, (s_slot != -1) & (s_rel != -1), sa_plain != -1
+    )
+    keep = (t_obj != -1) & (t_rel != -1) & subject_ok
+    return t_obj, t_rel, t_skind, t_sa, t_sb, keep
+
+
 def _walk_rewrite_relations(rw: ast.SubjectSetRewrite):
     """Yield (kind, relation, relation2) for every leaf referenced by a
     rewrite tree (used only to pre-register relation names in the vocab)."""

@@ -484,16 +484,28 @@ class TPUCheckEngine:
         with self._lock:
             if state.expand_tables is not None:  # raced with another filler
                 return state
-            tuples = self.manager.all_relation_tuples(nid=self.nid)
+            # columnar stores feed the vectorized CSR builders — no
+            # per-tuple Python objects on the expand-state build either
+            columns_fn = getattr(self.manager, "all_tuple_columns", None)
             if self.mesh is not None:
                 # sharded full CSR: same object-slot partition as check
                 from ..parallel.expand import place_sharded_expand_tables
-                from ..parallel.sharding import build_sharded_full_csr
-
-                stacked, fh_probes = build_sharded_full_csr(
-                    list(tuples), state.snapshot,
-                    n_shards=self.mesh.devices.size, view=state.view,
+                from ..parallel.sharding import (
+                    build_sharded_full_csr,
+                    build_sharded_full_csr_columnar,
                 )
+
+                if columns_fn is not None:
+                    stacked, fh_probes = build_sharded_full_csr_columnar(
+                        columns_fn(nid=self.nid), state.snapshot,
+                        n_shards=self.mesh.devices.size,
+                    )
+                else:
+                    stacked, fh_probes = build_sharded_full_csr(
+                        list(self.manager.all_relation_tuples(nid=self.nid)),
+                        state.snapshot,
+                        n_shards=self.mesh.devices.size, view=state.view,
+                    )
                 state.fh_probes = fh_probes
                 state.base_decoder = ExpandDecoder(state.snapshot)
                 state.decoder = state.base_decoder.extended(state.view.overlay)
@@ -502,7 +514,17 @@ class TPUCheckEngine:
                     axis=self.mesh.axis_names[0],
                 )
                 return state
-            csr = build_full_csr(list(tuples), state.snapshot, view=state.view)
+            if columns_fn is not None:
+                from .expand_kernel import build_full_csr_columnar
+
+                csr = build_full_csr_columnar(
+                    columns_fn(nid=self.nid), state.snapshot
+                )
+            else:
+                csr = build_full_csr(
+                    list(self.manager.all_relation_tuples(nid=self.nid)),
+                    state.snapshot, view=state.view,
+                )
             fh_probes = csr.pop("fh_probes")
             from .kernel import pack_pair_table
 

@@ -243,7 +243,6 @@ def build_sharded_full_csr(
 
     Returns (stacked tables [n_shards, ...], fh_probes)."""
     from ..engine.delta import SnapshotView
-    from ..engine.snapshot import group_rows_csr
 
     view = view or SnapshotView(snapshot)
     n_t = len(tuples)
@@ -261,8 +260,37 @@ def build_sharded_full_csr(
         t_obj[i], t_rel[i] = node
         t_skind[i], t_sa[i], t_sb[i] = subject
         keep[i] = True
-    t_obj, t_rel = t_obj[keep], t_rel[keep]
-    t_skind, t_sa, t_sb = t_skind[keep], t_sa[keep], t_sb[keep]
+    return sharded_full_csr_from_encoded(
+        t_obj[keep], t_rel[keep], t_skind[keep], t_sa[keep], t_sb[keep],
+        n_shards,
+    )
+
+
+def build_sharded_full_csr_columnar(
+    cols, snapshot: GraphSnapshot, n_shards: int
+) -> tuple[dict[str, np.ndarray], int]:
+    """Sharded full CSR from TupleColumns: vectorized encode against the
+    snapshot's vocabularies — no per-tuple Python on the expand-state
+    build, matching the check path's columnar ingest at scale. Edges are
+    pre-sorted into the store's identity order so per-row child order
+    matches the host oracle's paginated reads."""
+    from ..engine.expand_kernel import columnar_subject_order
+    from ..engine.snapshot import encode_edge_columns
+
+    t_obj, t_rel, t_skind, t_sa, t_sb, keep = encode_edge_columns(
+        cols, snapshot
+    )
+    order = columnar_subject_order(cols, keep)
+    return sharded_full_csr_from_encoded(
+        t_obj[order], t_rel[order], t_skind[order], t_sa[order], t_sb[order],
+        n_shards,
+    )
+
+
+def sharded_full_csr_from_encoded(
+    t_obj, t_rel, t_skind, t_sa, t_sb, n_shards: int
+) -> tuple[dict[str, np.ndarray], int]:
+    from ..engine.snapshot import group_rows_csr
 
     shard = shard_of_objslot(t_obj, n_shards)
     masks = [shard == s for s in range(n_shards)]

@@ -29,7 +29,6 @@ Extra surface for the scale path:
 
 from __future__ import annotations
 
-import bisect
 import threading
 from collections import deque
 from typing import Iterable, Optional, Sequence
@@ -41,8 +40,6 @@ from .columns import TupleColumns, concat_columns
 from .definitions import (
     DEFAULT_NETWORK,
     DEFAULT_PAGE_SIZE,
-    shard_id,
-    validate_page_token,
 )
 
 CHANGE_LOG_CAP = 1 << 16
@@ -61,6 +58,35 @@ def _identity_keys(cols: TupleColumns) -> np.ndarray:
     for p in parts[1:]:
         out = np.char.add(np.char.add(out, _SEP), p.astype("U"))
     return out
+
+
+def _encode_token(key: str) -> str:
+    import base64
+
+    return "ck1." + base64.urlsafe_b64encode(key.encode()).decode()
+
+def _decode_token(token: str) -> str:
+    """Columnar page tokens: "ck1." + urlsafe-b64 of the identity key.
+    Garbage still raises InvalidPageTokenError (API parity)."""
+    if not token:
+        return ""
+    import base64
+
+    from ..errors import InvalidPageTokenError
+
+    if token.startswith("ck1."):
+        try:
+            # validate=True: non-alphabet bytes must RAISE, not be
+            # silently discarded (a corrupted cursor would otherwise
+            # decode to b"" and restart pagination from page 1)
+            key = base64.b64decode(
+                token[4:].encode(), altchars=b"-_", validate=True
+            )
+            if key:
+                return key.decode()
+        except Exception:
+            pass
+    raise InvalidPageTokenError(debug=f"invalid pagination token {token!r}")
 
 
 def _tuple_identity(t: RelationTuple) -> str:
@@ -309,13 +335,19 @@ class ColumnarStore:
         page_size: int = DEFAULT_PAGE_SIZE,
         nid: str = DEFAULT_NETWORK,
     ) -> tuple[list[RelationTuple], str]:
-        """Keyset pagination over the MATCH SET only: the filter runs
-        vectorized over the columns, and the Python-loop costs (uuid5
-        shard ids, RelationTuple objects) are paid per matching row —
-        forward queries on a 1e8-row store touch ~row-length tuples, not
-        the whole store. A fully-unfiltered scan still materializes
-        everything; that is inherent to the API, not this store."""
-        token = validate_page_token(page_token)
+        """Keyset pagination ordered by the VECTORIZED identity key
+        (the same "ns\\x1fobj\\x1frel\\x1fskind\\x1f..." strings the
+        dedupe index sorts) instead of per-row uuid5 shard ids: the
+        filter AND the ordering run as numpy primitives, and Python-loop
+        costs (RelationTuple objects) are paid only for the PAGE — a
+        forward query on a 1e8-row store touches page_size rows.
+
+        The order is this store's total order everywhere: pagination,
+        the host oracle's paged reads, and the expand CSR builders all
+        agree (tree child order is observable behavior). Tokens are
+        opaque "ck1."-prefixed strings; other backends keep UUID shard
+        tokens (the wire contract only requires opaque tokens)."""
+        token_key = _decode_token(page_token)
         if page_size <= 0:
             page_size = DEFAULT_PAGE_SIZE
         with self._lock:
@@ -323,15 +355,37 @@ class ColumnarStore:
             if net is self._EMPTY:
                 return [], ""
             mask = self._query_mask(net, query) & net.alive
-            matches = [net.base.row(int(r)) for r in np.flatnonzero(mask)]
-            matches.extend(t for t in net.buffer if query.matches(t))
-        entries = sorted(
-            ((shard_id(nid, t), t) for t in matches), key=lambda e: e[0]
+            if len(net.base):
+                # the maintained sorted identity index does the ordering:
+                # reorder the match mask into key order and slice — no
+                # per-page key rebuild or argsort over the match set
+                sel = mask[net.base_order]
+                keys_sorted = net.base_keys[sel]
+                rows_sorted = net.base_order[sel]
+            else:
+                keys_sorted = np.array([], dtype="U1")
+                rows_sorted = np.array([], dtype=np.int64)
+            start = (
+                int(np.searchsorted(keys_sorted, token_key, side="right"))
+                if token_key
+                else 0
+            )
+            base_window = [
+                (str(keys_sorted[i]), None, int(rows_sorted[i]))
+                for i in range(start, min(start + page_size + 1, len(rows_sorted)))
+            ]
+            buf_window = sorted(
+                (_tuple_identity(t), t, -1)
+                for t in net.buffer
+                if query.matches(t) and _tuple_identity(t) > token_key
+            )
+            merged = sorted(base_window + buf_window, key=lambda e: e[0])
+            remaining = (len(keys_sorted) - start) + len(buf_window)
+            page = merged[:page_size]
+            out = [
+                t if t is not None else net.base.row(r) for _, t, r in page
+            ]
+        next_token = (
+            _encode_token(page[-1][0]) if page and remaining > page_size else ""
         )
-        shard_ids = [sid for sid, _ in entries]
-        start = bisect.bisect_right(shard_ids, token) if token else 0
-        page = entries[start : start + page_size]
-        out = [t for _, t in page]
-        # N+1 probe: any further match means another page exists
-        next_token = page[-1][0] if page and start + page_size < len(entries) else ""
         return out, next_token

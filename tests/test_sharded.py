@@ -311,6 +311,37 @@ class TestShardedColumnar:
             want = e.reference.check_relation_tuple(q, 8)
             assert g.membership == want.membership, q.to_string()
 
+    def test_columnar_mesh_expand_differential(self):
+        """The expand state under columnar+mesh builds through the
+        vectorized sharded CSR (no per-tuple Python) and must produce
+        the exact host trees."""
+        from keto_tpu.ketoapi import SubjectSet
+        from keto_tpu.storage.columnar import ColumnarStore
+        from keto_tpu.storage.columns import TupleColumns
+
+        rng = random.Random(41)
+        tuples = []
+        for r in range(16):
+            for _ in range(3):
+                tuples.append(RelationTuple.from_string(
+                    f"role:r{r}#member@u{rng.randrange(10)}"
+                ))
+            if r:
+                tuples.append(RelationTuple.from_string(
+                    f"role:r{r}#member@(role:r{rng.randrange(r)}#member)"
+                ))
+        cfg = Config({"limit": {"max_read_depth": 5}})
+        cfg.set_namespaces([Namespace(name="role")])
+        store = ColumnarStore()
+        store.bulk_load(TupleColumns.from_tuples(tuples))
+        e = TPUCheckEngine(store, cfg, mesh=default_mesh(8))
+        subs = [SubjectSet("role", f"r{i}", "member") for i in range(16)]
+        trees = e.expand_batch(subs, 5)
+        for s, t in zip(subs, trees):
+            want = e.reference.expand(s, 5)
+            got = t.to_dict() if t is not None else None
+            assert got == (want.to_dict() if want is not None else None), s
+
     def test_columnar_mesh_read_your_writes(self):
         """Writes after a columnar bulk load under a mesh ride the
         replicated delta overlay, not a rebuild."""
