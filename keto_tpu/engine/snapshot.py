@@ -917,9 +917,7 @@ def encode_edge_columns(cols, snapshot: GraphSnapshot):
     snapshot rides the delta overlay and its (obj, rel) row is
     dirty-flagged, which routes the affected queries to exact host
     replay regardless of CSR contents."""
-    n_t = len(cols)
     is_set = np.asarray(cols.skind) == 1
-    plain = ~is_set
 
     ns_keys, ns_vals = _map_sorted_arrays(snapshot.ns_ids)
     rel_keys, rel_vals = _map_sorted_arrays(snapshot.rel_ids)

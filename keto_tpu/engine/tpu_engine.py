@@ -394,7 +394,8 @@ class TPUCheckEngine:
                 )
                 snap = sharded.base
                 tables = place_sharded_tables(
-                    sharded, self.mesh, axis=self.mesh.axis_names[0]
+                    sharded, self.mesh, axis=self.mesh.axis_names[0],
+                    release_columns=True,
                 )
             else:
                 sharded = None
@@ -436,7 +437,8 @@ class TPUCheckEngine:
             )
             snap = sharded.base
             tables = place_sharded_tables(
-                sharded, self.mesh, axis=self.mesh.axis_names[0]
+                sharded, self.mesh, axis=self.mesh.axis_names[0],
+                release_columns=True,
             )
         else:
             snap = build_snapshot(

@@ -178,40 +178,61 @@ def sharded_static_config(
 
 
 def place_sharded_tables(
-    snap: ShardedSnapshot, mesh: Mesh, axis: str = "x"
+    snap: ShardedSnapshot, mesh: Mesh, axis: str = "x",
+    release_columns: bool = False,
 ) -> tuple[dict, dict]:
     """Upload tables once: sharded arrays split along the mesh axis (one
     shard per device), small tables replicated. Hash tables pack into
-    interleaved rows per shard (kernel.pack_edge_table layout)."""
+    interleaved rows per shard (kernel.pack_edge_table layout).
+
+    `release_columns=True` (the engine's setting) drops each raw column
+    array from snap.sharded as soon as its packed form is uploaded, and
+    uploads one table at a time: at 1e8 edges the raw columns + packed
+    copy + device copy held simultaneously cost ~3x the table bytes and
+    OOM-killed the 1e8 virtual-mesh run on a 128 GB host. The statics
+    only need snap's scalar probe counts afterwards."""
     import numpy as np
 
     from ..engine.kernel import pack_edge_table, pack_pair_table
 
     s = snap.sharded
     n = s["dh_obj"].shape[0]
+
+    def put_sharded(v):
+        return jax.device_put(
+            v, NamedSharding(mesh, P(axis, *([None] * (v.ndim - 1))))
+        )
+
+    sharded = {}
     # preallocate + pack in place: a list-of-arrays + np.stack would hold
     # a second full copy of the dominant tables at peak (GBs at 1e8 edges)
     dh_pack = np.zeros((n, s["dh_obj"].shape[1], 8), dtype=np.int32)
-    rh_pack = np.zeros((n, s["rh_obj"].shape[1], 4), dtype=np.int32)
     for i in range(n):
         dh_pack[i] = pack_edge_table(
             s["dh_obj"][i], s["dh_rel"][i], s["dh_skind"][i],
             s["dh_sa"][i], s["dh_sb"][i], s["dh_val"][i],
         )
-        rh_pack[i] = pack_pair_table(s["rh_obj"][i], s["rh_rel"][i], s["rh_row"][i])
-    raw = {
-        "dh_pack": dh_pack,
-        "rh_pack": rh_pack,
-        "row_ptr": s["row_ptr"],
-        "e_obj": s["e_obj"],
-        "e_rel": s["e_rel"],
-    }
-    sharded = {
-        k: jax.device_put(
-            v, NamedSharding(mesh, P(axis, *([None] * (v.ndim - 1))))
+    if release_columns:
+        for k in ("dh_obj", "dh_rel", "dh_skind", "dh_sa", "dh_sb", "dh_val"):
+            s[k] = None
+    sharded["dh_pack"] = put_sharded(dh_pack)
+    del dh_pack
+
+    rh_pack = np.zeros((n, s["rh_obj"].shape[1], 4), dtype=np.int32)
+    for i in range(n):
+        rh_pack[i] = pack_pair_table(
+            s["rh_obj"][i], s["rh_rel"][i], s["rh_row"][i]
         )
-        for k, v in raw.items()
-    }
+    if release_columns:
+        for k in ("rh_obj", "rh_rel", "rh_row"):
+            s[k] = None
+    sharded["rh_pack"] = put_sharded(rh_pack)
+    del rh_pack
+
+    for k in ("row_ptr", "e_obj", "e_rel"):
+        sharded[k] = put_sharded(s[k])
+        if release_columns:
+            s[k] = None
     replicated = {
         k: jax.device_put(v, NamedSharding(mesh, P()))
         for k, v in snap.replicated.items()

@@ -375,9 +375,10 @@ class ColumnarStore:
                 for i in range(start, min(start + page_size + 1, len(rows_sorted)))
             ]
             buf_window = sorted(
-                (_tuple_identity(t), t, -1)
+                (k, t, -1)
                 for t in net.buffer
-                if query.matches(t) and _tuple_identity(t) > token_key
+                for k in (_tuple_identity(t),)
+                if query.matches(t) and k > token_key
             )
             merged = sorted(base_window + buf_window, key=lambda e: e[0])
             remaining = (len(keys_sorted) - start) + len(buf_window)
