@@ -1,0 +1,18 @@
+#!/bin/bash
+# Watch for axon TPU recovery; on the first healthy probe, capture the
+# full round-3 artifact session (tools/tpu_session.py) immediately —
+# healthy windows between tunnel wedges can be short.
+cd "$(dirname "$0")/.." || exit 1
+for i in $(seq 1 "${TPU_WATCH_ATTEMPTS:-200}"); do
+  ts=$(date +%H:%M:%S)
+  out=$(timeout 90 python -c "import jax, jax.numpy as jnp; x=jnp.ones((128,128)); (x@x).block_until_ready(); print('PROBE_OK', jax.devices()[0])" 2>/dev/null)
+  if echo "$out" | grep -q PROBE_OK; then
+    echo "$ts RECOVERED: $out" >> "${TPU_WATCH_LOG:-/tmp/tpu_probe.log}"
+    python tools/tpu_session.py >> "${TPU_WATCH_LOG:-/tmp/tpu_probe.log}" 2>&1
+    exit $?
+  fi
+  echo "$ts still wedged" >> "${TPU_WATCH_LOG:-/tmp/tpu_probe.log}"
+  sleep "${TPU_WATCH_INTERVAL:-60}"
+done
+echo "watch exhausted" >> "${TPU_WATCH_LOG:-/tmp/tpu_probe.log}"
+exit 1
