@@ -357,7 +357,17 @@ class TestBatcherBackpressure:
         for t in threads:
             t.start()
             time.sleep(0.02)
-        time.sleep(0.3)  # let launches exhaust the in-flight semaphore
+        # REQUIRE saturation before closing (resolves are gated, callers
+        # keep arriving, so the semaphore must fill) — without this the
+        # test can close an idle pipeline and pass vacuously
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with eng.lock:
+                if len(eng.launched) >= b.max_inflight:
+                    break
+            time.sleep(0.01)
+        with eng.lock:
+            assert len(eng.launched) >= b.max_inflight, "never saturated"
         # close() starts while resolves are STILL GATED (the saturated
         # state under test); the gate opens shortly after from another
         # thread — close's own drain must then complete without deadlock

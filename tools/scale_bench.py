@@ -148,20 +148,25 @@ def main() -> int:
     state = engine._ensure_state()
     record["snapshot_build_s"] = round(time.perf_counter() - t0, 2)
     if mesh is not None:
-        per_shard = [
-            int(sum(v[s].nbytes for v in state.sharded.sharded.values()))
-            for s in range(state.sharded.n_shards)
-        ]
+        # account from the DEVICE arrays (the engine releases the raw
+        # host columns during placement); shards are equal-capacity by
+        # construction, so per-shard = global sharded bytes / n_shards
+        sharded_tables, replicated_tables = state.tables
+        n_shards = state.sharded.n_shards
+        sharded_bytes = int(sum(v.nbytes for v in sharded_tables.values()))
         replicated_bytes = int(
-            sum(np.asarray(v).nbytes for v in state.sharded.replicated.values())
+            sum(v.nbytes for v in replicated_tables.values())
         )
-        record["per_shard_bytes"] = per_shard
+        record["n_shards"] = n_shards
+        record["per_shard_bytes"] = sharded_bytes // n_shards
         record["replicated_bytes_per_device"] = replicated_bytes
         # per-device HBM = its shard + a full replicated copy; the total
         # across the mesh pays replicated_bytes on EVERY device
-        record["per_device_bytes_max"] = max(per_shard) + replicated_bytes
-        record["device_table_bytes"] = int(
-            sum(per_shard) + len(per_shard) * replicated_bytes
+        record["per_device_bytes"] = (
+            sharded_bytes // n_shards + replicated_bytes
+        )
+        record["device_table_bytes"] = (
+            sharded_bytes + n_shards * replicated_bytes
         )
     else:
         record["device_table_bytes"] = int(

@@ -64,6 +64,11 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--skip-scale", action="store_true")
     ap.add_argument("--probe-timeout", type=float, default=120.0)
+    ap.add_argument(
+        "--probe-only", action="store_true",
+        help="run ONLY the health gate (exit 0 healthy / 2 not) — the "
+        "watcher's probe, so 'healthy' has one definition",
+    )
     args = ap.parse_args()
 
     # health gate (subprocess: a wedged backend must not hang THIS process)
@@ -81,6 +86,8 @@ def main() -> int:
         return 2
     print(json.dumps({"step": "probe", "ok": True,
                       "platform": p.stdout.split()[1]}), flush=True)
+    if args.probe_only:
+        return 0
 
     steps = [
         ("bench", [sys.executable, "bench.py", "--probe-timeout", "120"],

@@ -1,17 +1,24 @@
 #!/bin/bash
-# Watch for axon TPU recovery; on the first healthy probe, capture the
-# full round-3 artifact session (tools/tpu_session.py) immediately —
-# healthy windows between tunnel wedges can be short.
+# Watch for axon TPU recovery; on a healthy probe, capture the full
+# round-3 artifact session (tools/tpu_session.py) immediately — healthy
+# windows between tunnel wedges can be short. The probe IS the session's
+# own health gate (tpu_session.py --probe-only): one definition of
+# "healthy", one subprocess-timeout discipline (the gate never kills an
+# in-flight dispatch from THIS process — the child owns the backend).
+# A failed session resumes the watch: the tunnel may have re-wedged
+# mid-session and recovered again later.
 cd "$(dirname "$0")/.." || exit 1
 for i in $(seq 1 "${TPU_WATCH_ATTEMPTS:-200}"); do
   ts=$(date +%H:%M:%S)
-  out=$(timeout 90 python -c "import jax, jax.numpy as jnp; x=jnp.ones((128,128)); (x@x).block_until_ready(); print('PROBE_OK', jax.devices()[0])" 2>/dev/null)
-  if echo "$out" | grep -q PROBE_OK; then
-    echo "$ts RECOVERED: $out" >> "${TPU_WATCH_LOG:-/tmp/tpu_probe.log}"
-    python tools/tpu_session.py >> "${TPU_WATCH_LOG:-/tmp/tpu_probe.log}" 2>&1
-    exit $?
+  if python tools/tpu_session.py --probe-only >/dev/null 2>&1; then
+    echo "$ts RECOVERED, capturing session" >> "${TPU_WATCH_LOG:-/tmp/tpu_probe.log}"
+    if python tools/tpu_session.py >> "${TPU_WATCH_LOG:-/tmp/tpu_probe.log}" 2>&1; then
+      exit 0
+    fi
+    echo "$ts session incomplete, resuming watch" >> "${TPU_WATCH_LOG:-/tmp/tpu_probe.log}"
+  else
+    echo "$ts still wedged" >> "${TPU_WATCH_LOG:-/tmp/tpu_probe.log}"
   fi
-  echo "$ts still wedged" >> "${TPU_WATCH_LOG:-/tmp/tpu_probe.log}"
   sleep "${TPU_WATCH_INTERVAL:-60}"
 done
 echo "watch exhausted" >> "${TPU_WATCH_LOG:-/tmp/tpu_probe.log}"
