@@ -301,6 +301,21 @@ class TestBatcherBackpressure:
             assert self.gate.wait(timeout=30), "resolve gate never opened"
             return [CheckResult(Membership.IS_MEMBER) for _ in handle[1]]
 
+    @staticmethod
+    def _wait_saturated(eng, b, timeout: float = 10.0) -> None:
+        """Block until gated launches fill the in-flight cap (asserts —
+        a test proceeding unsaturated would pass vacuously)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with eng.lock:
+                if len(eng.launched) >= b.max_inflight:
+                    return
+            time.sleep(0.01)
+        with eng.lock:
+            raise AssertionError(
+                f"cap never exercised: {len(eng.launched)} launches"
+            )
+
     def test_inflight_cap_bounds_launches(self):
         eng = self._SlowSplitEngine()
         b = CheckBatcher(eng, window_s=0.0, pipeline_depth=2)
@@ -320,17 +335,7 @@ class TestBatcherBackpressure:
                 # the bound under test would go unexercised)
                 time.sleep(0.02)
             # resolves are gated shut: launches must REACH the cap...
-            deadline = time.monotonic() + 10
-            while time.monotonic() < deadline:
-                with eng.lock:
-                    n = len(eng.launched)
-                if n >= b.max_inflight:
-                    break
-                time.sleep(0.01)
-            with eng.lock:
-                assert len(eng.launched) >= b.max_inflight, (
-                    f"cap never exercised: {len(eng.launched)} launches"
-                )
+            self._wait_saturated(eng, b)
             time.sleep(0.3)  # ...and an over-launch must not appear
             with eng.lock:
                 assert len(eng.launched) <= b.max_inflight
@@ -360,14 +365,7 @@ class TestBatcherBackpressure:
         # REQUIRE saturation before closing (resolves are gated, callers
         # keep arriving, so the semaphore must fill) — without this the
         # test can close an idle pipeline and pass vacuously
-        deadline = time.monotonic() + 10
-        while time.monotonic() < deadline:
-            with eng.lock:
-                if len(eng.launched) >= b.max_inflight:
-                    break
-            time.sleep(0.01)
-        with eng.lock:
-            assert len(eng.launched) >= b.max_inflight, "never saturated"
+        self._wait_saturated(eng, b)
         # close() starts while resolves are STILL GATED (the saturated
         # state under test); the gate opens shortly after from another
         # thread — close's own drain must then complete without deadlock
