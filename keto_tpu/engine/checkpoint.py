@@ -56,7 +56,7 @@ def _names_by_id(d, n: int) -> np.ndarray:
     from .snapshot import ArrayMap
 
     if isinstance(d, ArrayMap):
-        return np.asarray(d.keys_by_id_array(), dtype="U")
+        return np.asarray(d.keys_by_id_str_array(), dtype="U")
     out = [""] * n
     for name, i in d.items():
         out[i] = name
@@ -71,7 +71,7 @@ def save_snapshot(snapshot: GraphSnapshot, path: str) -> None:
 
     n_obj = len(snapshot.obj_slots)
     if isinstance(snapshot.obj_slots, ArrayMap):
-        keys_by_id = snapshot.obj_slots.keys_by_id_array()
+        keys_by_id = snapshot.obj_slots.keys_by_id_str_array()
         parts = np.char.partition(keys_by_id, _SEP)
         obj_ns = parts[:, 0].astype(np.int32)
         obj_names = parts[:, 2]

@@ -49,14 +49,21 @@ _BUFFER_MERGE_THRESHOLD = 4096
 
 
 def _identity_keys(cols: TupleColumns) -> np.ndarray:
-    """Vectorized canonical identity key per row (insert idempotence)."""
+    """Vectorized canonical identity key per row (insert idempotence),
+    as UTF-8 bytes (S dtype): 4x less data through every dedupe sort and
+    pagination ordering than numpy U, with identical ordering (UTF-8
+    byte order == code-point order). str-side comparisons encode via
+    _tuple_identity(...).encode()."""
+    sep = _SEP.encode()
     parts = [
         cols.ns, cols.obj, cols.rel,
         cols.skind.astype("U1"), cols.sns, cols.sobj, cols.srel,
     ]
-    out = parts[0].astype("U")
+    out = np.char.encode(parts[0].astype("U"), "utf-8")
     for p in parts[1:]:
-        out = np.char.add(np.char.add(out, _SEP), p.astype("U"))
+        out = np.char.add(
+            np.char.add(out, sep), np.char.encode(p.astype("U"), "utf-8")
+        )
     return out
 
 
@@ -105,7 +112,7 @@ class _ColumnarNetwork:
 
     def __init__(self):
         self.base = TupleColumns.empty()
-        self.base_keys = np.array([], dtype="U1")  # sorted identity keys
+        self.base_keys = np.array([], dtype="S1")  # sorted identity keys
         self.base_order = np.array([], dtype=np.int64)  # key-sorted -> row
         self.alive = np.array([], dtype=bool)
         self.buffer: list[RelationTuple] = []
@@ -124,8 +131,9 @@ class _ColumnarNetwork:
 
     def base_find(self, identity: str) -> Optional[int]:
         """Row index of an alive base tuple with this identity key."""
-        i = int(np.searchsorted(self.base_keys, identity))
-        if i < len(self.base_keys) and self.base_keys[i] == identity:
+        ident_b = identity.encode("utf-8")
+        i = int(np.searchsorted(self.base_keys, ident_b))
+        if i < len(self.base_keys) and self.base_keys[i] == ident_b:
             row = int(self.base_order[i])
             if self.alive[row]:
                 return row
@@ -363,15 +371,17 @@ class ColumnarStore:
                 keys_sorted = net.base_keys[sel]
                 rows_sorted = net.base_order[sel]
             else:
-                keys_sorted = np.array([], dtype="U1")
+                keys_sorted = np.array([], dtype="S1")
                 rows_sorted = np.array([], dtype=np.int64)
             start = (
-                int(np.searchsorted(keys_sorted, token_key, side="right"))
+                int(np.searchsorted(
+                    keys_sorted, token_key.encode("utf-8"), side="right"
+                ))
                 if token_key
                 else 0
             )
             base_window = [
-                (str(keys_sorted[i]), None, int(rows_sorted[i]))
+                (bytes(keys_sorted[i]).decode("utf-8"), None, int(rows_sorted[i]))
                 for i in range(start, min(start + page_size + 1, len(rows_sorted)))
             ]
             buf_window = sorted(
