@@ -409,13 +409,16 @@ def _compose_keys_like(
 
 
 def _sorted_lookup(keys_sorted, vals_sorted, queries, default=-1):
-    """Vectorized map lookup: queries -> vals via binary search."""
+    """Vectorized map lookup: queries -> vals via binary search.
+    vals_sorted=None means the value IS the sorted position (ArrayMap's
+    columnar form) — no materialized arange over a 1e7-entry vocab."""
     n = len(keys_sorted)
     if n == 0:
         return np.full(len(queries), default, dtype=np.int32)
     idx = np.clip(np.searchsorted(keys_sorted, queries), 0, n - 1)
     ok = keys_sorted[idx] == queries
-    return np.where(ok, vals_sorted[idx], default).astype(np.int32)
+    vals = idx if vals_sorted is None else vals_sorted[idx]
+    return np.where(ok, vals, default).astype(np.int32)
 
 
 @dataclass
@@ -473,6 +476,13 @@ class GraphSnapshot:
 
     version: int = 0
     n_tuples: int = 0
+
+    # lazy per-snapshot cache of _map_sorted_arrays results (sorted key/
+    # value arrays per vocab — rebuilt per batch they cost O(V log V)
+    # string sorting on the serve hot path; the snapshot is immutable)
+    _vocab_cache: dict = field(
+        default_factory=dict, repr=False, compare=False
+    )
 
     # -- query encoding helpers ----------------------------------------------
 
@@ -957,8 +967,10 @@ def _map_sorted_arrays(mapping, composite: bool = False):
     (ns_id, object) form into the ArrayMap's "ns\\x1fobj" string form."""
     if isinstance(mapping, ArrayMap):
         keys = mapping._keys
+        # None value array = id IS the sorted position (_sorted_lookup
+        # handles it without materializing an arange over the vocab)
         vals = (
-            np.arange(len(keys), dtype=np.int64)
+            None
             if mapping._values is None
             else np.asarray(mapping._values, dtype=np.int64)
         )
@@ -977,6 +989,47 @@ def _map_sorted_arrays(mapping, composite: bool = False):
     return keys[order], vals[order]
 
 
+def _vocab_arrays(snap: GraphSnapshot, name: str, mapping, composite=False):
+    """Per-snapshot cached _map_sorted_arrays (the snapshot is
+    immutable; rebuilding the dict-vocab sorted arrays per batch costs
+    O(V log V) string sorting on the serve hot path)."""
+    cached = snap._vocab_cache.get(name)
+    if cached is None:
+        cached = _map_sorted_arrays(mapping, composite=composite)
+        snap._vocab_cache[name] = cached
+    return cached
+
+
+def _lookup_name_columns(
+    snap: GraphSnapshot, ns_a, obj_a, rel_a, is_set, sns_a, sobj_a, srel_a
+):
+    """Vectorized base-vocab lookups over U name columns — the ONE
+    pipeline shared by encode_edge_columns (expand-CSR builds) and
+    encode_query_batch (check query encoding). Unknown namespaces
+    compose to "-1\\x1f..." which matches nothing; query arrays convert
+    to the vocab key dtype via _queries_like/_compose_keys_like.
+
+    Returns (t_ns, t_rel, t_obj, s_ns, s_rel, s_slot, sid), all int32
+    with -1 for not-in-base."""
+    ns_keys, ns_vals = _vocab_arrays(snap, "ns", snap.ns_ids)
+    rel_keys, rel_vals = _vocab_arrays(snap, "rel", snap.rel_ids)
+    obj_keys, obj_vals = _vocab_arrays(snap, "obj", snap.obj_slots, True)
+    subj_keys, subj_vals = _vocab_arrays(snap, "subj", snap.subj_ids)
+
+    t_ns = _sorted_lookup(ns_keys, ns_vals, ns_a)
+    t_rel = _sorted_lookup(rel_keys, rel_vals, rel_a)
+    t_obj = _sorted_lookup(
+        obj_keys, obj_vals, _compose_keys_like(obj_keys, t_ns, obj_a)
+    )
+    s_ns = np.where(is_set, _sorted_lookup(ns_keys, ns_vals, sns_a), -1)
+    s_rel = np.where(is_set, _sorted_lookup(rel_keys, rel_vals, srel_a), -1)
+    s_slot = _sorted_lookup(
+        obj_keys, obj_vals, _compose_keys_like(obj_keys, s_ns, sobj_a)
+    )
+    sid = _sorted_lookup(subj_keys, subj_vals, _queries_like(subj_keys, sobj_a))
+    return t_ns, t_rel, t_obj, s_ns, s_rel, s_slot, sid
+
+
 def encode_edge_columns(cols, snapshot: GraphSnapshot):
     """Vectorized (t_obj, t_rel, t_skind, t_sa, t_sb, keep) encoding of
     TupleColumns under an EXISTING snapshot's vocabularies — the scale
@@ -987,32 +1040,10 @@ def encode_edge_columns(cols, snapshot: GraphSnapshot):
     dirty-flagged, which routes the affected queries to exact host
     replay regardless of CSR contents."""
     is_set = np.asarray(cols.skind) == 1
-
-    ns_keys, ns_vals = _map_sorted_arrays(snapshot.ns_ids)
-    rel_keys, rel_vals = _map_sorted_arrays(snapshot.rel_ids)
-    t_ns = _sorted_lookup(ns_keys, ns_vals, cols.ns.astype("U"))
-    t_rel = _sorted_lookup(rel_keys, rel_vals, cols.rel.astype("U"))
-    s_ns = np.where(
-        is_set, _sorted_lookup(ns_keys, ns_vals, cols.sns.astype("U")), -1
-    )
-    s_rel = np.where(
-        is_set, _sorted_lookup(rel_keys, rel_vals, cols.srel.astype("U")), -1
-    )
-
-    obj_keys, obj_vals = _map_sorted_arrays(snapshot.obj_slots, composite=True)
-    # queries match the vocab's key dtype via _queries_like (S from the
-    # columnar builder, U from dict vocab); unknown namespaces compose
-    # to "-1\x1f..." which matches nothing
-    t_obj = _sorted_lookup(
-        obj_keys, obj_vals, _compose_keys_like(obj_keys, t_ns, cols.obj)
-    )
-    s_slot = _sorted_lookup(
-        obj_keys, obj_vals, _compose_keys_like(obj_keys, s_ns, cols.sobj)
-    )
-
-    subj_keys, subj_vals = _map_sorted_arrays(snapshot.subj_ids)
-    sa_plain = _sorted_lookup(
-        subj_keys, subj_vals, _queries_like(subj_keys, cols.sobj)
+    _, t_rel, t_obj, _, s_rel, s_slot, sa_plain = _lookup_name_columns(
+        snapshot,
+        cols.ns.astype("U"), cols.obj, cols.rel.astype("U"),
+        is_set, cols.sns.astype("U"), cols.sobj, cols.srel.astype("U"),
     )
 
     t_skind = np.asarray(cols.skind, dtype=np.int32)
@@ -1023,6 +1054,115 @@ def encode_edge_columns(cols, snapshot: GraphSnapshot):
     )
     keep = (t_obj != -1) & (t_rel != -1) & subject_ok
     return t_obj, t_rel, t_skind, t_sa, t_sb, keep
+
+
+def encode_query_batch(view, tuples, B: int):
+    """Vectorized batch query encoding against an ArrayMap-vocab
+    snapshot: ONE composed-key searchsorted per column for the whole
+    batch instead of 2-3 scalar ArrayMap.get calls per query — at 1e7
+    vocab the per-query path costs ~1 ms each and dominated
+    check_batch (engine 988 checks/s vs 77k/s for the kernel alone,
+    measured round 3). Queries the base vocab can't resolve are
+    re-encoded per-query through `view` (the delta overlay may know
+    names written after the base snapshot); exact same semantics as the
+    per-tuple loop.
+
+    Returns (q_obj, q_rel, q_skind, q_sa, q_sb, q_valid) arrays of
+    length B (tail rows beyond len(tuples) stay invalid)."""
+    snap = view.snapshot
+    n = len(tuples)
+    ns_l = [""] * n
+    obj_l = [""] * n
+    rel_l = [""] * n
+    skind_l = np.zeros(n, dtype=np.int32)
+    sns_l = [""] * n
+    sobj_l = [""] * n
+    srel_l = [""] * n
+    for i, t in enumerate(tuples):
+        ns_l[i] = t.namespace
+        obj_l[i] = t.object
+        rel_l[i] = t.relation
+        if t.subject_set is not None:
+            skind_l[i] = 1
+            sns_l[i] = t.subject_set.namespace
+            sobj_l[i] = t.subject_set.object
+            srel_l[i] = t.subject_set.relation
+        else:
+            sobj_l[i] = t.subject_id or ""
+
+    is_set = skind_l == 1
+    t_ns, t_rel, t_obj, s_ns, s_rel, s_slot, sid = _lookup_name_columns(
+        snap,
+        np.asarray(ns_l, dtype="U"), np.asarray(obj_l, dtype="U"),
+        np.asarray(rel_l, dtype="U"),
+        is_set, np.asarray(sns_l, "U"), np.asarray(sobj_l, dtype="U"),
+        np.asarray(srel_l, "U"),
+    )
+
+    valid = (t_ns != -1) & (t_rel != -1) & (t_obj != -1)
+    set_ok = is_set & (s_slot != -1) & (s_rel != -1)
+    plain_ok = ~is_set & (sid != -1)
+
+    q_obj = np.zeros(B, dtype=np.int32)
+    q_rel = np.zeros(B, dtype=np.int32)
+    q_skind = np.zeros(B, dtype=np.int32)
+    q_sa = np.full(B, -2, dtype=np.int32)  # sentinel: matches nothing
+    q_sb = np.zeros(B, dtype=np.int32)
+    q_valid = np.zeros(B, dtype=bool)
+    q_obj[:n] = np.where(valid, t_obj, 0)
+    q_rel[:n] = np.where(valid, t_rel, 0)
+    q_valid[:n] = valid
+    q_skind[:n] = np.where(set_ok, 1, 0)
+    q_sa[:n] = np.where(set_ok, s_slot, np.where(plain_ok, sid, -2))
+    q_sb[:n] = np.where(set_ok, s_rel, 0)
+
+    ov = view.overlay
+    if ov is not None:
+        # patch base-unresolved rows from the SMALL overlay dicts only —
+        # the vectorized pass already gave the base verdict for every
+        # component, so no scalar big-vocab lookups happen here (an
+        # overlay-era namespace can only own overlay-era objects)
+        unresolved = np.flatnonzero(~valid | ~(set_ok | plain_ok))
+        for i in unresolved:
+            i = int(i)
+            t = tuples[i]
+            ns = int(t_ns[i])
+            if ns == -1:
+                ns = ov.ns_ids.get(t.namespace, -1)
+            rel = int(t_rel[i])
+            if rel == -1:
+                rel = ov.rel_ids.get(t.relation, -1)
+            slot = int(t_obj[i])
+            if slot == -1 and ns != -1:
+                slot = ov.obj_slots.get((ns, t.object), -1)
+            if ns == -1 or rel == -1 or slot == -1:
+                q_valid[i] = False
+                continue
+            q_obj[i], q_rel[i], q_valid[i] = slot, rel, True
+            if t.subject_set is not None:
+                s = t.subject_set
+                sns = int(s_ns[i])
+                if sns == -1:
+                    sns = ov.ns_ids.get(s.namespace, -1)
+                srl = int(s_rel[i])
+                if srl == -1:
+                    srl = ov.rel_ids.get(s.relation, -1)
+                ssl = int(s_slot[i])
+                if ssl == -1 and sns != -1:
+                    ssl = ov.obj_slots.get((sns, s.object), -1)
+                if sns != -1 and srl != -1 and ssl != -1:
+                    q_skind[i], q_sa[i], q_sb[i] = 1, ssl, srl
+                else:
+                    q_skind[i], q_sa[i], q_sb[i] = 0, -2, 0
+            else:
+                sv = int(sid[i])
+                if sv == -1:
+                    sv = ov.subj_ids.get(t.subject_id or "", -1)
+                if sv != -1:
+                    q_skind[i], q_sa[i], q_sb[i] = 0, sv, 0
+                else:
+                    q_skind[i], q_sa[i], q_sb[i] = 0, -2, 0
+    return q_obj, q_rel, q_skind, q_sa, q_sb, q_valid
 
 
 def _walk_rewrite_relations(rw: ast.SubjectSetRewrite):
