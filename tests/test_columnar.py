@@ -180,3 +180,64 @@ class TestColumnarExpand:
         store.write_relation_tuples(ts("g:a#r@u2"))
         t1 = e.expand_batch([SubjectSet("g", "a", "r")], 3)[0]
         assert {c.tuple.subject_id for c in t1.children} == {"u1", "u2"}
+
+
+class TestVectorizedQueryEncoding:
+    """encode_query_batch's overlay fallback (round-3): base-unresolved
+    rows patch from the small overlay dicts only. Every combination of
+    base-era and overlay-era name components must match the per-tuple
+    view encoding — checked end-to-end against the host oracle."""
+
+    def _engine(self):
+        cfg = Config({"limit": {"max_read_depth": 5}})
+        cfg.set_namespaces([Namespace(name="b"), Namespace(name="o")])
+        store = ColumnarStore()
+        # base era: namespace b, objects x/y, subjects u1/u2, a subject set
+        store.bulk_load(TupleColumns.from_tuples(ts(
+            "b:x#r@u1",
+            "b:y#r@u2",
+            "b:x#s@(b:y#r)",
+        )))
+        e = TPUCheckEngine(store, cfg)
+        assert e.check_batch(ts("b:x#r@u1"))[0].membership == Membership.IS_MEMBER
+        # overlay era: new namespace o, new object z under base ns b,
+        # new subject u9, new subject-set references both eras
+        store.write_relation_tuples(ts(
+            "o:w#r@u9",            # overlay ns + overlay obj + overlay subj
+            "b:z#r@u1",            # base ns + overlay obj + base subj
+            "b:x#s@(o:w#r)",       # base node + overlay subject set
+            "o:w#s@(b:x#r)",       # overlay node + base subject set
+        ))
+        return e
+
+    @pytest.mark.parametrize("query,expected", [
+        ("b:x#r@u1", True),            # all base
+        ("b:x#r@u2", False),
+        ("o:w#r@u9", True),            # all overlay
+        ("o:w#r@u1", False),           # overlay node, base subj, no edge
+        ("b:z#r@u1", True),            # overlay obj under base ns
+        ("b:z#r@u2", False),
+        ("b:x#s@(o:w#r)", True),       # base node + overlay subject set
+        ("o:w#s@(b:x#r)", True),       # overlay node + base subject set
+        ("b:x#s@(b:y#r)", True),       # all-base subject set
+        ("b:x#s@(b:zzz#r)", False),    # unknown subject set object
+        ("nope:q#r@u1", False),        # unknown namespace entirely
+    ])
+    def test_overlay_matrix(self, query, expected):
+        e = self._engine()
+        t = RelationTuple.from_string(query)
+        got = e.check_batch([t])[0]
+        want = e.reference.check_relation_tuple(t, 0)
+        assert got.membership == want.membership, query
+        assert (got.membership == Membership.IS_MEMBER) == expected, query
+
+    def test_batch_mixes_eras_in_one_launch(self):
+        e = self._engine()
+        queries = ts(
+            "b:x#r@u1", "o:w#r@u9", "b:z#r@u1", "b:x#s@(o:w#r)",
+            "o:w#s@(b:x#r)", "b:x#r@u2", "o:w#r@u1", "b:z#r@nobody",
+        )
+        got = e.check_batch(queries)
+        for q, g in zip(queries, got):
+            want = e.reference.check_relation_tuple(q, 0)
+            assert g.membership == want.membership, q.to_string()
