@@ -1056,6 +1056,70 @@ def encode_edge_columns(cols, snapshot: GraphSnapshot):
     return t_obj, t_rel, t_skind, t_sa, t_sb, keep
 
 
+def _encode_nodes(view, ns_l, obj_l, rel_l, present):
+    """Vectorized base lookups + overlay-dict node patch — the node-half
+    shared by encode_query_batch and encode_node_batch (ONE copy of the
+    overlay-fallback invariant: resolve ns, then rel, then the slot
+    keyed on the resolved ns — an overlay-era namespace can only own
+    overlay-era objects, so no big-vocab scalar lookups happen here).
+
+    Returns (slot, rel, valid) arrays of length n."""
+    snap = view.snapshot
+    ns_keys, ns_vals = _vocab_arrays(snap, "ns", snap.ns_ids)
+    rel_keys, rel_vals = _vocab_arrays(snap, "rel", snap.rel_ids)
+    obj_keys, obj_vals = _vocab_arrays(snap, "obj", snap.obj_slots, True)
+    t_ns = _sorted_lookup(ns_keys, ns_vals, np.asarray(ns_l, dtype="U"))
+    t_rel = _sorted_lookup(rel_keys, rel_vals, np.asarray(rel_l, dtype="U"))
+    t_obj = _sorted_lookup(
+        obj_keys, obj_vals,
+        _compose_keys_like(obj_keys, t_ns, np.asarray(obj_l, dtype="U")),
+    )
+    valid = present & (t_ns != -1) & (t_rel != -1) & (t_obj != -1)
+    ov = view.overlay
+    if ov is not None:
+        for i in np.flatnonzero(present & ~valid):
+            i = int(i)
+            ns = int(t_ns[i])
+            if ns == -1:
+                ns = ov.ns_ids.get(ns_l[i], -1)
+            rel = int(t_rel[i])
+            if rel == -1:
+                rel = ov.rel_ids.get(rel_l[i], -1)
+            slot = int(t_obj[i])
+            if slot == -1 and ns != -1:
+                slot = ov.obj_slots.get((ns, obj_l[i]), -1)
+            if ns != -1 and rel != -1 and slot != -1:
+                t_obj[i], t_rel[i], valid[i] = slot, rel, True
+    return t_obj, t_rel, valid
+
+
+def encode_node_batch(view, triples, B: int):
+    """Vectorized (namespace, object, relation) -> (obj_slot, rel_id)
+    encoding for B node queries (the expand path's analog of
+    encode_query_batch: per-subject scalar ArrayMap lookups cost ~1 ms
+    each at 1e7 vocab). `triples[i]` is (ns, obj, rel) or None (row
+    stays invalid). Returns (q_obj, q_rel, q_valid)."""
+    n = len(triples)
+    ns_l = [""] * n
+    obj_l = [""] * n
+    rel_l = [""] * n
+    present = np.zeros(n, dtype=bool)
+    for i, tr in enumerate(triples):
+        if tr is None:
+            continue
+        ns_l[i], obj_l[i], rel_l[i] = tr
+        present[i] = True
+
+    t_obj, t_rel, valid = _encode_nodes(view, ns_l, obj_l, rel_l, present)
+    q_obj = np.zeros(B, dtype=np.int32)
+    q_rel = np.zeros(B, dtype=np.int32)
+    q_valid = np.zeros(B, dtype=bool)
+    q_obj[:n] = np.where(valid, t_obj, 0)
+    q_rel[:n] = np.where(valid, t_rel, 0)
+    q_valid[:n] = valid
+    return q_obj, q_rel, q_valid
+
+
 def encode_query_batch(view, tuples, B: int):
     """Vectorized batch query encoding against an ArrayMap-vocab
     snapshot: ONE composed-key searchsorted per column for the whole
@@ -1091,15 +1155,27 @@ def encode_query_batch(view, tuples, B: int):
             sobj_l[i] = t.subject_id or ""
 
     is_set = skind_l == 1
-    t_ns, t_rel, t_obj, s_ns, s_rel, s_slot, sid = _lookup_name_columns(
-        snap,
-        np.asarray(ns_l, dtype="U"), np.asarray(obj_l, dtype="U"),
-        np.asarray(rel_l, dtype="U"),
-        is_set, np.asarray(sns_l, "U"), np.asarray(sobj_l, dtype="U"),
-        np.asarray(srel_l, "U"),
+    # node half: shared vectorized base lookups + overlay node patch
+    node_obj, node_rel, node_valid = _encode_nodes(
+        view, ns_l, obj_l, rel_l, np.ones(n, dtype=bool)
     )
+    # subject half: base lookups over the subject columns
+    ns_keys, ns_vals = _vocab_arrays(snap, "ns", snap.ns_ids)
+    rel_keys, rel_vals = _vocab_arrays(snap, "rel", snap.rel_ids)
+    obj_keys, obj_vals = _vocab_arrays(snap, "obj", snap.obj_slots, True)
+    subj_keys, subj_vals = _vocab_arrays(snap, "subj", snap.subj_ids)
+    sobj_arr = np.asarray(sobj_l, dtype="U")
+    s_ns = np.where(
+        is_set, _sorted_lookup(ns_keys, ns_vals, np.asarray(sns_l, "U")), -1
+    )
+    s_rel = np.where(
+        is_set, _sorted_lookup(rel_keys, rel_vals, np.asarray(srel_l, "U")), -1
+    )
+    s_slot = _sorted_lookup(
+        obj_keys, obj_vals, _compose_keys_like(obj_keys, s_ns, sobj_arr)
+    )
+    sid = _sorted_lookup(subj_keys, subj_vals, _queries_like(subj_keys, sobj_arr))
 
-    valid = (t_ns != -1) & (t_rel != -1) & (t_obj != -1)
     set_ok = is_set & (s_slot != -1) & (s_rel != -1)
     plain_ok = ~is_set & (sid != -1)
 
@@ -1109,36 +1185,22 @@ def encode_query_batch(view, tuples, B: int):
     q_sa = np.full(B, -2, dtype=np.int32)  # sentinel: matches nothing
     q_sb = np.zeros(B, dtype=np.int32)
     q_valid = np.zeros(B, dtype=bool)
-    q_obj[:n] = np.where(valid, t_obj, 0)
-    q_rel[:n] = np.where(valid, t_rel, 0)
-    q_valid[:n] = valid
+    q_obj[:n] = np.where(node_valid, node_obj, 0)
+    q_rel[:n] = np.where(node_valid, node_rel, 0)
+    q_valid[:n] = node_valid
     q_skind[:n] = np.where(set_ok, 1, 0)
     q_sa[:n] = np.where(set_ok, s_slot, np.where(plain_ok, sid, -2))
     q_sb[:n] = np.where(set_ok, s_rel, 0)
 
     ov = view.overlay
     if ov is not None:
-        # patch base-unresolved rows from the SMALL overlay dicts only —
-        # the vectorized pass already gave the base verdict for every
-        # component, so no scalar big-vocab lookups happen here (an
-        # overlay-era namespace can only own overlay-era objects)
-        unresolved = np.flatnonzero(~valid | ~(set_ok | plain_ok))
+        # subject-only overlay patch (the node half was patched inside
+        # _encode_nodes): still SMALL-dict lookups only — the base
+        # verdict for every subject component is already known
+        unresolved = np.flatnonzero(node_valid & ~(set_ok | plain_ok))
         for i in unresolved:
             i = int(i)
             t = tuples[i]
-            ns = int(t_ns[i])
-            if ns == -1:
-                ns = ov.ns_ids.get(t.namespace, -1)
-            rel = int(t_rel[i])
-            if rel == -1:
-                rel = ov.rel_ids.get(t.relation, -1)
-            slot = int(t_obj[i])
-            if slot == -1 and ns != -1:
-                slot = ov.obj_slots.get((ns, t.object), -1)
-            if ns == -1 or rel == -1 or slot == -1:
-                q_valid[i] = False
-                continue
-            q_obj[i], q_rel[i], q_valid[i] = slot, rel, True
             if t.subject_set is not None:
                 s = t.subject_set
                 sns = int(s_ns[i])

@@ -612,22 +612,42 @@ class TPUCheckEngine:
                 )
             return out
 
-        q_obj = np.zeros(B, dtype=np.int32)
-        q_rel = np.zeros(B, dtype=np.int32)
-        q_valid = np.zeros(B, dtype=bool)
         host_idx: set[int] = set()
-        for i, sub in enumerate(subjects):
-            if not isinstance(sub, _SubjectSet):
-                host_idx.add(i)
-                continue
-            node = state.view.encode_node(sub.namespace, sub.object, sub.relation)
-            if node is None:
-                # unknown to graph+config: no tuples can match => nil tree,
-                # but keep exact host semantics for the verdict
-                host_idx.add(i)
-                continue
-            q_obj[i], q_rel[i] = node
-            q_valid[i] = True
+        if isinstance(state.snapshot.obj_slots, ArrayMap):
+            # big-vocab snapshots: vectorized node encoding (scalar
+            # ArrayMap lookups cost ~1 ms each at 1e7 vocab)
+            from .snapshot import encode_node_batch
+
+            triples = []
+            for i, sub in enumerate(subjects):
+                if isinstance(sub, _SubjectSet):
+                    triples.append((sub.namespace, sub.object, sub.relation))
+                else:
+                    triples.append(None)
+                    host_idx.add(i)
+            q_obj, q_rel, q_valid = encode_node_batch(state.view, triples, B)
+            for i in np.flatnonzero(~q_valid[: len(subjects)]):
+                # unknown to graph+config: no tuples can match => nil
+                # tree, but keep exact host semantics for the verdict
+                host_idx.add(int(i))
+        else:
+            q_obj = np.zeros(B, dtype=np.int32)
+            q_rel = np.zeros(B, dtype=np.int32)
+            q_valid = np.zeros(B, dtype=bool)
+            for i, sub in enumerate(subjects):
+                if not isinstance(sub, _SubjectSet):
+                    host_idx.add(i)
+                    continue
+                node = state.view.encode_node(
+                    sub.namespace, sub.object, sub.relation
+                )
+                if node is None:
+                    # unknown to graph+config: no tuples can match =>
+                    # nil tree, but keep exact host semantics
+                    host_idx.add(i)
+                    continue
+                q_obj[i], q_rel[i] = node
+                q_valid[i] = True
 
         if self.mesh is not None:
             from ..parallel.expand import sharded_expand_kernel

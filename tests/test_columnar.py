@@ -241,3 +241,22 @@ class TestVectorizedQueryEncoding:
         for q, g in zip(queries, got):
             want = e.reference.check_relation_tuple(q, 0)
             assert g.membership == want.membership, q.to_string()
+
+    def test_expand_overlay_era_node(self):
+        """Expanding a node written AFTER the base snapshot resolves
+        through encode_node_batch's overlay patch and must match the
+        exact host tree (the expand twin of the check-path matrix)."""
+        e = self._engine()
+        from keto_tpu.ketoapi import SubjectSet
+
+        for sub in (
+            SubjectSet("o", "w", "r"),    # overlay ns + overlay obj
+            SubjectSet("b", "z", "r"),    # base ns + overlay obj
+            SubjectSet("b", "x", "s"),    # base node w/ overlay-era child
+            SubjectSet("nope", "q", "r"),  # unknown entirely
+        ):
+            got = e.expand_batch([sub], 4)[0]
+            want = e.reference.expand(sub, 4)
+            got_d = got.to_dict() if got is not None else None
+            want_d = want.to_dict() if want is not None else None
+            assert got_d == want_d, sub
