@@ -2,7 +2,8 @@
 
 Parity with the reference's cobra tree (cmd/root.go:47-66):
 
-    keto_tpu {serve, migrate {up,down,status}, namespace validate,
+    keto_tpu {serve, migrate {up,down,status},
+              namespace {validate, migrate {up,down,status}},
               relation-tuple {parse, create, delete, delete-all, get},
               check, expand, status, version}
 
@@ -171,6 +172,107 @@ def cmd_migrate(args) -> int:
     p.migrate_down(args.steps)
     print(f"Rolled back {args.steps} migration(s).")
     return 0
+
+
+NAMESPACE_MIGRATE_DEPRECATION = (
+    "Note: per-namespace migrations are deprecated (the reference made "
+    "these commands no-ops, cmd/namespace/migrate_up.go:12); here they "
+    "drive the global strings->UUIDs data migration scoped to reporting "
+    "on one namespace."
+)
+
+
+def cmd_namespace_migrate(args) -> int:
+    """ref: cmd/namespace/migrate_{up,down,status}.go — same command
+    shape + --yes/format flags, wired to the real data migration
+    (the reference deprecated these to no-ops after moving the work
+    into the global migration box; so do we, but `status` still
+    reports per-namespace legacy rows and `up` runs the box)."""
+    from ..config import Config
+    from ..storage.sqlite import SQLitePersister
+
+    config = Config.from_file(args.config) if args.config else Config()
+    ns = next(
+        (n for n in config.namespace_manager().namespaces() if n.name == args.namespace),
+        None,
+    )
+    if ns is None:
+        raise CLIError(f"unknown namespace {args.namespace!r} (not in config)")
+    dsn = config.dsn
+    if not dsn.startswith("sqlite://"):
+        # same exit-0 contract as the global `migrate` command (and the
+        # reference's deprecated no-ops): nothing-to-migrate is success
+        _print_formatted(
+            args,
+            {"namespace": args.namespace, "migrated_rows": 0,
+             "detail": f"dsn {dsn!r} needs no migrations"},
+            f"dsn {dsn!r} needs no migrations",
+        )
+        return 0
+    p = SQLitePersister(
+        dsn.removeprefix("sqlite://"),
+        auto_migrate=False,
+        legacy_namespaces=config.legacy_namespace_ids(),
+    )
+    try:
+        box = dict(p.migration_status())
+        data_status = box.get("20220513200400_migrate_strings_to_uuids", "Pending")
+        # rows only count as pending while the data migration itself is:
+        # an already-migrated database may still hold the (copied) legacy
+        # table if the drop migration hasn't run — those rows are done
+        pending = (
+            p.legacy_row_count(ns.id)
+            if ns.id is not None and data_status == "Pending"
+            else 0
+        )
+        if args.action == "status":
+            _print_formatted(
+                args,
+                {
+                    "namespace": args.namespace,
+                    "legacy_namespace_id": ns.id,
+                    "data_migration": data_status,
+                    "legacy_rows_pending": pending,
+                },
+                f"{data_status:10s} strings->UUIDs data migration\n"
+                f"{pending} legacy row(s) pending for namespace {args.namespace!r}",
+            )
+            return 0
+        if args.action == "up":
+            if not args.yes:
+                print(NAMESPACE_MIGRATE_DEPRECATION)
+                print(
+                    f"About to migrate {pending} legacy row(s) of namespace "
+                    f"{args.namespace!r} (plus any other pending migrations)."
+                )
+                if input("Apply migrations? [y/N] ").strip().lower() != "y":
+                    return 1
+            p.migrate_up()
+            _print_formatted(
+                args,
+                {"namespace": args.namespace, "migrated_rows": pending},
+                f"Successfully migrated namespace {args.namespace!r} "
+                f"({pending} legacy row(s)).",
+            )
+            return 0
+        # down — the data migration has no down path (same as the
+        # reference post-#638: the command succeeds without applying
+        # anything, whatever <steps> says)
+        if args.steps < 0:
+            raise CLIError(f"invalid steps {args.steps}: must be >= 0")
+        if not args.yes:
+            print("Use --yes to confirm down-migration.")
+            return 1
+        _print_formatted(
+            args,
+            {"namespace": args.namespace, "migrated_rows": 0},
+            NAMESPACE_MIGRATE_DEPRECATION
+            + "\nThe strings->UUIDs data migration has no down path; "
+            "nothing to do.",
+        )
+        return 0
+    finally:
+        p.close()
 
 
 def cmd_namespace_validate(args) -> int:
@@ -398,6 +500,25 @@ def build_parser() -> argparse.ArgumentParser:
     np = nsub.add_parser("validate", help="validate namespace definition files")
     np.add_argument("files", nargs="+")
     np.set_defaults(fn=cmd_namespace_validate)
+
+    nm = nsub.add_parser("migrate", help="migrate a namespace (deprecated)")
+    nmsub = nm.add_subparsers(dest="nsm_command", required=True)
+    for action, help_text in (
+        ("up", "migrate a namespace up to the most recent migration"),
+        ("down", "migrate a namespace down (deprecated no-op; the UUID "
+                 "data migration has no down path, so <steps> is accepted "
+                 "for reference CLI parity but not acted on)"),
+        ("status", "get the current namespace migration status"),
+    ):
+        nmp = nmsub.add_parser(action, help=help_text)
+        nmp.add_argument("namespace", metavar="namespace-name")
+        if action == "down":
+            nmp.add_argument("steps", type=int)
+        if action != "status":
+            nmp.add_argument("--yes", action="store_true")
+        nmp.add_argument("--config", "-c", default=None)
+        _add_format_flag(nmp)
+        nmp.set_defaults(fn=cmd_namespace_migrate, action=action)
 
     p = sub.add_parser("relation-tuple", help="relation tuple commands")
     rsub = p.add_subparsers(dest="rt_command", required=True)

@@ -181,6 +181,14 @@ MIGRATIONS: list[tuple[str, list, list]] = [
         ["__migrate_strings_to_uuids__"],
         [],
     ),
+    (
+        # the reference drops the legacy table once its rows are moved
+        # (20220513200600000000_drop-old-non-uuid-table.up.sql); down
+        # restores the empty legacy schema like the reference's .down.sql
+        "20220513200600_drop_legacy_relation_tuples",
+        ["DROP TABLE IF EXISTS keto_relation_tuples"],
+        ["__recreate_legacy_relation_tuples__"],
+    ),
 ]
 
 
@@ -195,6 +203,11 @@ def _migrate_strings_to_uuids(persister) -> None:
     numeric ids); unknown ids fail the migration loudly, like the
     reference's namespaceIDtoName error."""
     conn = persister._conn
+    if not conn.execute(
+        "SELECT 1 FROM sqlite_master WHERE type='table'"
+        " AND name='keto_relation_tuples'"
+    ).fetchone():
+        return  # post-drop database: nothing left to migrate
     names = persister.legacy_namespaces or {}
     # composite keyset cursor: the legacy PK is (shard_id, nid), so two
     # networks may share a shard_id — paginating on shard_id alone would
@@ -248,8 +261,20 @@ def _migrate_strings_to_uuids(persister) -> None:
             persister.write_relation_tuples(ts, nid=nid)
 
 
+def _recreate_legacy_relation_tuples(persister) -> None:
+    """Down-path of the drop: restore the empty legacy schema (the
+    reference's drop-old-non-uuid-table.down.sql recreates the table)."""
+    ups = next(
+        u for v, u, _ in MIGRATIONS
+        if v == "20210623162417_create_legacy_relation_tuples"
+    )
+    for stmt in ups:
+        persister._conn.execute(stmt)
+
+
 _DATA_MIGRATIONS = {
     "__migrate_strings_to_uuids__": _migrate_strings_to_uuids,
+    "__recreate_legacy_relation_tuples__": _recreate_legacy_relation_tuples,
 }
 
 _SELECT = """
@@ -339,6 +364,28 @@ class SQLitePersister:
             (version, "Applied" if version in applied else "Pending")
             for version, _, _ in MIGRATIONS
         ]
+
+    def legacy_row_count(self, namespace_id: int | None = None) -> int:
+        """Rows still in the pre-UUID keto_relation_tuples table
+        (optionally for one deprecated numeric namespace id); 0 once the
+        drop-legacy migration has run or on a fresh database."""
+        with self._lock:
+            if not self._conn.execute(
+                "SELECT 1 FROM sqlite_master WHERE type='table'"
+                " AND name='keto_relation_tuples'"
+            ).fetchone():
+                return 0
+            if namespace_id is None:
+                (n,) = self._conn.execute(
+                    "SELECT COUNT(*) FROM keto_relation_tuples"
+                ).fetchone()
+            else:
+                (n,) = self._conn.execute(
+                    "SELECT COUNT(*) FROM keto_relation_tuples"
+                    " WHERE namespace_id = ?",
+                    (namespace_id,),
+                ).fetchone()
+            return n
 
     def migrate_up(self) -> None:
         with self._lock:

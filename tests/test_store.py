@@ -206,10 +206,12 @@ class TestMigrations:
         assert all(s == "Pending" for _, s in p.migration_status())
         p.migrate_up()
         assert all(s == "Applied" for _, s in p.migration_status())
-        # peel 4: the strings-to-uuids data migration, the uuid table,
-        # the change log, and the store-version table
-        p.migrate_down(4)
+        # peel 5: the legacy-table drop, the strings-to-uuids data
+        # migration, the uuid table, the change log, and the
+        # store-version table
+        p.migrate_down(5)
         status = dict(p.migration_status())
+        assert status["20220513200600_drop_legacy_relation_tuples"] == "Pending"
         assert status["20220513200400_migrate_strings_to_uuids"] == "Pending"
         assert status["20220513200302_create_store_version"] == "Pending"
         assert status["20220513200303_create_change_log"] == "Pending"

@@ -6,10 +6,10 @@ chip IS healthy every artifact must be captured in one sitting, most
 important first, each step in its OWN subprocess with a timeout so a
 mid-step wedge cannot take the rest of the session down:
 
-  1. bench.py            -> BENCH_TPU_r03.json   (the round's headline)
-  2. tpu_test_tier.py    -> TPU_TIER_r03.json    (hardware correctness)
-  3. profile_kernel.py   -> TPU_PROFILE_r03.json (per-phase steady state)
-  4. scale_bench 1e6     -> TPU_SCALE_r03.json   (table-size scaling on chip)
+  1. bench.py            -> BENCH_TPU_r04.json   (the round's headline)
+  2. tpu_test_tier.py    -> TPU_TIER_r04.json    (hardware correctness)
+  3. profile_kernel.py   -> TPU_PROFILE_r04.json (per-phase steady state)
+  4. scale_bench 1e6     -> TPU_SCALE_r04.json   (table-size scaling on chip)
 
 Usage:  python tools/tpu_session.py [--skip-scale]
 Prints one JSON status line per step; exits 0 iff step 1 succeeded.
@@ -91,18 +91,18 @@ def main() -> int:
 
     steps = [
         ("bench", [sys.executable, "bench.py", "--probe-timeout", "120"],
-         "BENCH_TPU_r03.json", 1800),
+         "BENCH_TPU_r04.json", 1800),
         ("tier", [sys.executable, "tools/tpu_test_tier.py"],
-         "TPU_TIER_r03.json", 1200),
+         "TPU_TIER_r04.json", 1200),
         ("profile", [sys.executable, "tools/profile_kernel.py"],
-         "TPU_PROFILE_r03.json", 1200),
+         "TPU_PROFILE_r04.json", 1200),
     ]
     if not args.skip_scale:
         steps.append((
             "scale-1e6",
             [sys.executable, "tools/scale_bench.py", "--tuples", "1000000",
              "--ref-samples", "8"],
-            "TPU_SCALE_r03.json", 2400,
+            "TPU_SCALE_r04.json", 2400,
         ))
 
     results = []
