@@ -1,7 +1,9 @@
 """Recursive-descent parser for the Ory Permission Language.
 
-Grammar and semantics per docs/ory_permission_language_spec.md in the
-reference, with behavior matching internal/schema/parser.go:
+Grammar and semantics per this repo's normative docs/opl_spec.md
+(source-compatible with the reference's
+docs/ory_permission_language_spec.md; behavior matches
+internal/schema/parser.go):
   - class X implements Namespace { related: {...} permits = {...} }
   - relation types: T[], (A | B)[], SubjectSet<NS, "rel">[]
   - permissions: name: (ctx [: Context]) [: boolean] => expr
