@@ -172,6 +172,22 @@ def build_spec(version: str = "", kind: str | None = None) -> dict:
                                   "as the status code)", "checkResponse"),
         },
     }
+    # POST check takes the subject tuple from the JSON body ONLY (the
+    # handler ignores subject query params on POST, like the reference's
+    # postCheck vs getCheck split, rest_server._check_tuple_from_request)
+    # — so the POST operations carry a required body and just max-depth
+    check_body = {
+        "required": True,
+        "content": {"application/json": {"schema": {
+            "$ref": "#/components/schemas/relationTuple"
+        }}},
+    }
+    check_op_post = {
+        **check_op, "requestBody": check_body, "parameters": [_MAX_DEPTH_PARAM],
+    }
+    check_bare_post = {
+        **check_bare, "requestBody": check_body, "parameters": [_MAX_DEPTH_PARAM],
+    }
     paths = {
         READ_ROUTE_BASE: {
             "get": {
@@ -189,8 +205,8 @@ def build_spec(version: str = "", kind: str | None = None) -> dict:
                 },
             }
         },
-        CHECK_ROUTE_BASE: {"get": check_bare, "post": check_bare},
-        CHECK_OPENAPI_ROUTE: {"get": check_op, "post": check_op},
+        CHECK_ROUTE_BASE: {"get": check_bare, "post": check_bare_post},
+        CHECK_OPENAPI_ROUTE: {"get": check_op, "post": check_op_post},
         EXPAND_ROUTE: {
             "get": {
                 "summary": "Expand a subject set into its membership tree",
@@ -258,6 +274,27 @@ def build_spec(version: str = "", kind: str | None = None) -> dict:
             "503": _json_response("not ready", "errorGeneric")}}},
         VERSION_PATH: {"get": {"responses": {
             "200": _json_response("build version", "version")}}},
+    }
+    op_ids = {
+        (READ_ROUTE_BASE, "get"): "listRelationTuples",
+        (CHECK_ROUTE_BASE, "get"): "getCheckMirrorStatus",
+        (CHECK_ROUTE_BASE, "post"): "postCheckMirrorStatus",
+        (CHECK_OPENAPI_ROUTE, "get"): "getCheck",
+        (CHECK_OPENAPI_ROUTE, "post"): "postCheck",
+        (EXPAND_ROUTE, "get"): "getExpand",
+        (WRITE_ROUTE_BASE, "put"): "createRelationTuple",
+        (WRITE_ROUTE_BASE, "delete"): "deleteRelationTuples",
+        (WRITE_ROUTE_BASE, "patch"): "patchRelationTuples",
+        (ALIVE_PATH, "get"): "isAlive",
+        (READY_PATH, "get"): "isReady",
+        (VERSION_PATH, "get"): "getVersion",
+    }
+    # the per-method dicts are shared between routes (check_op/check_bare),
+    # so operationIds go on per-use copies, keyed like the reference's
+    # swagger operationIds (httpclient-next method names derive from these)
+    paths = {
+        p: {m: {**op, "operationId": op_ids[(p, m)]} for m, op in ops.items()}
+        for p, ops in paths.items()
     }
     if kind in ("read", "write"):
         # ROUTE_KINDS[p] (not .get): a path missing from the ownership

@@ -1,4 +1,4 @@
-"""End-to-end matrix: one shared case suite through four client types.
+"""End-to-end matrix: one shared case suite through five client types.
 
 Port of the reference's e2e strategy (internal/e2e/full_suit_test.go +
 cases_test.go): a real in-process server (mux'd gRPC+REST ports, TPU
@@ -393,6 +393,110 @@ class SDKClientAdapter:
         self.write_ch.close()
 
 
+class OpenAPIGenClientAdapter:
+    """Client GENERATED from the live served OpenAPI documents (the
+    reference's httpclient-next leg, internal/e2e/sdk_client_test.go:
+    an openapi-generator product consuming spec/api.json; here
+    tools/openapi_client_gen.py consumes /.well-known/openapi.json).
+    Proves the served schemas are consumable by a generator, not just
+    structurally valid."""
+
+    def __init__(self, daemon, mods):
+        read_mod, write_mod = mods
+        self.read = read_mod.Client(f"http://127.0.0.1:{daemon.read_port}")
+        self.write = write_mod.Client(f"http://127.0.0.1:{daemon.write_port}")
+        self.ApiError = read_mod.ApiError
+
+    @staticmethod
+    def _qkw(q: RelationQuery) -> dict:
+        # wire name -> generated kwarg name ('subject_set.namespace' ->
+        # 'subject_set_namespace'), the generator's _pyname mapping
+        import re as _re
+
+        return {
+            _re.sub(r"[^0-9a-zA-Z_]", "_", k): v
+            for k, v in q.to_url_query().items()
+        }
+
+    def create(self, t: RelationTuple):
+        status, _ = self.write.create_relation_tuple(body=t.to_dict())
+        assert status == 201
+
+    def delete(self, t: RelationTuple):
+        status, _ = self.write.patch_relation_tuples(
+            body=[{"action": "delete", "relation_tuple": t.to_dict()}]
+        )
+        assert status == 204
+
+    def delete_all(self, q: RelationQuery):
+        status, _ = self.write.delete_relation_tuples(**self._qkw(q))
+        assert status == 204
+
+    def query(self, q: RelationQuery, page_size=0, page_token="") -> GetResponse:
+        kw = self._qkw(q)
+        if page_size:
+            kw["page_size"] = page_size
+        if page_token:
+            kw["page_token"] = page_token
+        _, body = self.read.list_relation_tuples(**kw)
+        return GetResponse(
+            relation_tuples=[
+                RelationTuple.from_dict(d) for d in body["relation_tuples"]
+            ],
+            next_page_token=body["next_page_token"],
+        )
+
+    def check(self, t: RelationTuple, max_depth=0) -> bool:
+        kw = {"max_depth": max_depth} if max_depth else {}
+        _, body = self.read.post_check(body=t.to_dict(), **kw)
+        return body["allowed"]
+
+    def expand(self, s: SubjectSet, max_depth=0) -> Tree:
+        kw = {"namespace": s.namespace, "object": s.object, "relation": s.relation}
+        if max_depth:
+            kw["max_depth"] = max_depth
+        _, body = self.read.get_expand(**kw)
+        return Tree.from_dict(body)
+
+    def query_unknown_namespace_error(self, q: RelationQuery):
+        with pytest.raises(self.ApiError) as exc:
+            self.read.list_relation_tuples(**self._qkw(q))
+        assert exc.value.status == 404
+
+    def close(self):
+        pass
+
+
+@pytest.fixture(scope="module")
+def generated_rest_modules(daemon, tmp_path_factory):
+    """Run the OpenAPI generator against the documents each port SERVES
+    (read and write carry different route subsets), import the two
+    generated modules, and hand them to the adapter."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    gen_path = os.path.join(repo, "tools", "openapi_client_gen.py")
+    spec = importlib.util.spec_from_file_location("openapi_client_gen", gen_path)
+    gen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gen)
+
+    out = tmp_path_factory.mktemp("openapigen")
+    mods = []
+    for name, port in (("read", daemon.read_port), ("write", daemon.write_port)):
+        url = f"http://127.0.0.1:{port}/.well-known/openapi.json"
+        code = gen.generate(gen.load_spec(url), source=url)
+        mod_path = out / f"{name}_client.py"
+        mod_path.write_text(code)
+        mspec = importlib.util.spec_from_file_location(
+            f"genclient_{name}", mod_path
+        )
+        mod = importlib.util.module_from_spec(mspec)
+        mspec.loader.exec_module(mod)
+        mods.append(mod)
+    # module_from_spec does not register in sys.modules, so no teardown
+    return tuple(mods)
+
+
 @pytest.fixture(scope="module")
 def generated_pb2(tmp_path_factory):
     """Generate message classes from the shipped proto with the SYSTEM
@@ -432,7 +536,7 @@ def generated_pb2(tmp_path_factory):
         _sys.modules.pop("keto_pb2", None)
 
 
-ADAPTERS = ["grpc", "rest", "cli", "sdk"]
+ADAPTERS = ["grpc", "rest", "cli", "sdk", "openapi-gen"]
 
 
 @pytest.fixture(params=ADAPTERS)
@@ -443,6 +547,10 @@ def client(request, daemon, capsys, tmp_path):
         c = RESTClientAdapter(daemon)
     elif request.param == "sdk":
         c = SDKClientAdapter(daemon, request.getfixturevalue("generated_pb2"))
+    elif request.param == "openapi-gen":
+        c = OpenAPIGenClientAdapter(
+            daemon, request.getfixturevalue("generated_rest_modules")
+        )
     else:
         c = CLIClientAdapter(daemon, capsys, tmp_path)
     yield c

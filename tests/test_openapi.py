@@ -106,3 +106,112 @@ class TestServedSpec:
         assert payload is not None
         schema = _schema_for(spec, "/relation-tuples", "get", 404)
         jsonschema.Draft7Validator(schema).validate(payload)
+
+
+class TestClientGenerator:
+    """tools/openapi_client_gen.py guarantees: bad documents fail
+    generation loudly; generated validators reject non-conforming
+    bodies (the properties the e2e openapi-gen leg relies on)."""
+
+    @staticmethod
+    def _gen():
+        import importlib.util
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "openapi_client_gen",
+            os.path.join(repo, "tools", "openapi_client_gen.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def _minimal_spec(self):
+        return {
+            "openapi": "3.0.3",
+            "info": {"title": "t", "version": "1"},
+            "paths": {
+                "/things": {
+                    "put": {
+                        "operationId": "createThing",
+                        "requestBody": {
+                            "required": True,
+                            "content": {"application/json": {"schema": {
+                                "$ref": "#/components/schemas/thing"
+                            }}},
+                        },
+                        "responses": {"201": {"description": "made"}},
+                    }
+                }
+            },
+            "components": {"schemas": {"thing": {
+                "type": "object",
+                "required": ["name"],
+                "properties": {
+                    "name": {"type": "string"},
+                    "kind": {"type": "string", "enum": ["a", "b"]},
+                },
+            }}},
+        }
+
+    def test_unresolvable_ref_fails_generation(self):
+        gen = self._gen()
+        spec = self._minimal_spec()
+        spec["paths"]["/things"]["put"]["requestBody"]["content"][
+            "application/json"]["schema"]["$ref"] = "#/components/schemas/ghost"
+        with pytest.raises(gen.GenerationError, match="ghost"):
+            gen.generate(spec)
+
+    def test_duplicate_operation_id_fails_generation(self):
+        gen = self._gen()
+        spec = self._minimal_spec()
+        spec["paths"]["/things"]["delete"] = {
+            "operationId": "createThing",
+            "responses": {"204": {"description": "gone"}},
+        }
+        with pytest.raises(gen.GenerationError, match="duplicate"):
+            gen.generate(spec)
+
+    def test_missing_operation_id_fails_generation(self):
+        gen = self._gen()
+        spec = self._minimal_spec()
+        del spec["paths"]["/things"]["put"]["operationId"]
+        with pytest.raises(gen.GenerationError, match="operationId"):
+            gen.generate(spec)
+
+    def test_generated_validator_rejects_bad_bodies(self):
+        import types
+
+        gen = self._gen()
+        code = gen.generate(self._minimal_spec())
+        mod = types.ModuleType("genclient_unit")
+        exec(code, mod.__dict__)
+        c = mod.Client("http://127.0.0.1:1")  # never reached: validation first
+        with pytest.raises(mod.ValidationError, match="missing required 'name'"):
+            c.create_thing(body={})
+        with pytest.raises(mod.ValidationError, match="expected object"):
+            c.create_thing(body=[1])
+        with pytest.raises(mod.ValidationError, match="not in"):
+            c.create_thing(body={"name": "x", "kind": "z"})
+
+    def test_range_status_keys_and_alias_cycles(self):
+        import types
+
+        gen = self._gen()
+        # 2XX range key accepted and honored
+        spec = self._minimal_spec()
+        spec["paths"]["/things"]["put"]["responses"] = {"2XX": {"description": "ok"}}
+        code = gen.generate(spec)
+        mod = types.ModuleType("genclient_range")
+        exec(code, mod.__dict__)
+        # junk status key rejected loudly
+        spec["paths"]["/things"]["put"]["responses"] = {"teapot": {"description": "?"}}
+        with pytest.raises(gen.GenerationError, match="status key"):
+            gen.generate(spec)
+        # top-level alias cycle rejected at generation time
+        spec2 = self._minimal_spec()
+        spec2["components"]["schemas"]["a"] = {"$ref": "#/components/schemas/b"}
+        spec2["components"]["schemas"]["b"] = {"$ref": "#/components/schemas/a"}
+        with pytest.raises(gen.GenerationError, match="cycle"):
+            gen.generate(spec2)
