@@ -293,9 +293,14 @@ def probe_phase(
     (insert adds the edge, tombstone masks a deleted one). `has_delta` is
     static: a clean mirror (the common serving state between writes)
     skips the overlay probe entirely — half the probe gathers."""
-    main_hit, _ = _edge_key_probe(
+    main_hit, main_val = _edge_key_probe(
         tables, "dh", obj, rel, skind, sa, sb, dh_probes
     )
+    # value-liveness: incremental compaction (engine/compact.py) deletes
+    # by zeroing the value in place (removing the key would break other
+    # keys' probe chains); freshly-built tables store val=1 everywhere,
+    # and the value lane rides the same packed-row gather — free
+    main_hit = main_hit & (main_val == 1)
     if has_delta:
         in_delta, dval = _edge_key_probe(
             tables, "dd", obj, rel, skind, sa, sb, DELTA_PROBES

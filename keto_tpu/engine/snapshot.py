@@ -350,6 +350,43 @@ class ArrayMap:
             v = int(self._values[i]) if self._values is not None else i
             yield self._decode(self._raw_to_str(k)), v
 
+    def merged_with(self, new_items: dict) -> "ArrayMap":
+        """New ArrayMap with `new_items` (decoded key -> id) inserted;
+        EXISTING ids are preserved, so the merged map must carry an
+        explicit value array (sorted position no longer equals id).
+        One O(n + k log k) sorted insert — the incremental-compaction
+        vocab path (engine/compact.py)."""
+        if not new_items:
+            return self
+        enc = [self._encode(k) for k in new_items]
+        if self._is_bytes:
+            new_keys = np.array([e.encode("utf-8") for e in enc], dtype="S")
+        else:
+            new_keys = np.array(enc, dtype="U")
+        new_vals = np.fromiter(
+            new_items.values(), dtype=np.int64, count=len(new_items)
+        )
+        order = np.argsort(new_keys)
+        new_keys, new_vals = new_keys[order], new_vals[order]
+        base_keys = self._keys
+        # np.insert silently truncates values longer than the array's
+        # fixed itemsize — widen first
+        if new_keys.dtype.itemsize > base_keys.dtype.itemsize:
+            base_keys = base_keys.astype(new_keys.dtype)
+        else:
+            new_keys = new_keys.astype(base_keys.dtype)
+        base_vals = (
+            np.arange(len(base_keys), dtype=np.int64)
+            if self._values is None
+            else np.asarray(self._values, dtype=np.int64)
+        )
+        pos = np.searchsorted(base_keys, new_keys)
+        keys = np.insert(base_keys, pos, new_keys)
+        vals = np.insert(base_vals, pos, new_vals)
+        return ArrayMap(
+            keys, encode=self._encode, decode=self._decode, values=vals
+        )
+
 
 def _encode_obj_key(key) -> str:
     ns_id, obj = key
@@ -476,6 +513,12 @@ class GraphSnapshot:
 
     version: int = 0
     n_tuples: int = 0
+
+    # edge-array slots orphaned by incremental-compaction row rewrites
+    # (engine/compact.py); past GARBAGE_FRACTION the engine rebuilds.
+    # Not persisted by checkpoints — a reloaded mirror undercounts, which
+    # only delays (never corrupts) the amortizing rebuild.
+    merge_garbage: int = 0
 
     # lazy per-snapshot cache of _map_sorted_arrays results (sorted key/
     # value arrays per vocab — rebuilt per batch they cost O(V log V)

@@ -273,7 +273,10 @@ class TPUCheckEngine:
             view = SnapshotView(state.snapshot, overlay)
             delta = build_delta_tables(view, ops)
         except DeltaOverflow:
-            return None
+            # oversized delta: merge the ops into a new base incrementally
+            # (only affected slots/rows) before paying the full O(edges)
+            # rebuild — the write-churn cliff fix (engine/compact.py)
+            return self._incremental_compact(state, store_version, ops)
 
         from .kernel import refresh_delta_tables
 
@@ -335,6 +338,46 @@ class TPUCheckEngine:
             new_state.fh_probes = state.fh_probes
             new_state.base_decoder = state.base_decoder
             new_state.decoder = state.base_decoder.extended(overlay)
+        return new_state
+
+    def _incremental_compact(
+        self, state: _EngineState, store_version: int, ops
+    ) -> Optional[_EngineState]:
+        """Delta overflow: fold `ops` into a NEW base snapshot by copying
+        + patching only the affected table slots/rows (engine/compact.py)
+        instead of the full store re-ingest. None => full rebuild (mesh
+        path, too-large op batch, load/garbage/probe gates). The merged
+        state drops the expand tables — they lazily rebuild from the
+        store on the next expand call; the check path (the write-churn
+        hot path) never pays the rebuild."""
+        if self.mesh is not None:
+            return None  # sharded tables merge per-shard; rebuild for now
+        from .checkpoint import stable_fingerprint
+        from .compact import merge_ops_into_snapshot
+
+        version = stable_fingerprint([store_version, state.config_fp])
+        with self.tracer.span("engine.incremental_compact") as sp:
+            merged = merge_ops_into_snapshot(state.snapshot, ops, version)
+            if merged is None:
+                return None
+            sp.set_attribute("ops", len(ops))
+        new_state = _EngineState(
+            snapshot=merged,
+            view=SnapshotView(merged),
+            sharded=None,
+            tables=snapshot_tables(merged),
+            delta_np=empty_delta_tables(),
+            base_version=store_version,
+            covered_version=store_version,
+            config_fp=state.config_fp,
+            has_delta=False,
+        )
+        self.stats["incremental_merges"] = (
+            self.stats.get("incremental_merges", 0) + 1
+        )
+        # scheduling only (the O(edges) compressed write runs on the
+        # timer thread) — safe under the engine lock
+        self._maybe_persist(merged)
         return new_state
 
     @staticmethod
