@@ -673,15 +673,7 @@ def finalize(
     return final.ctx_hit, needs_host, final.isl_parent, final.isl_pid, final.n_isl
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "K", "dh_probes", "rh_probes", "max_steps",
-        "wildcard_rel", "n_config_rels", "frontier_cap",
-        "n_island_cap", "has_delta",
-    ),
-)
-def check_kernel(
+def _check_kernel_impl(
     tables: dict,
     q_obj: jnp.ndarray,  # [B] seed object slots
     q_rel: jnp.ndarray,  # [B] seed relation ids
@@ -753,6 +745,86 @@ def check_kernel(
     init = seed_state(q_obj, q_rel, q_depth, q_valid, F, n_island_cap, K)
     final = jax.lax.while_loop(loop_cond(max_steps, B), step_fn, init)
     return finalize(final, max_steps, B)
+
+
+_KERNEL_STATICS = (
+    "K", "dh_probes", "rh_probes", "max_steps",
+    "wildcard_rel", "n_config_rels", "frontier_cap",
+    "n_island_cap", "has_delta",
+)
+
+check_kernel = functools.partial(
+    jax.jit, static_argnames=_KERNEL_STATICS
+)(_check_kernel_impl)
+
+
+@functools.partial(jax.jit, static_argnames=_KERNEL_STATICS)
+def check_kernel_packed(
+    tables: dict,
+    qpack: jnp.ndarray,
+    *,
+    K: int,
+    dh_probes: int,
+    rh_probes: int,
+    max_steps: int,
+    wildcard_rel: int,
+    n_config_rels: int,
+    frontier_cap: int,
+    n_island_cap: int = 0,
+    has_delta: bool = True,
+):
+    """check_kernel with single-buffer I/O: `qpack` is ONE [7, B] int32
+    array (obj, rel, depth, skind, sa, sb, valid) and the result is ONE
+    int32 vector [n_isl, ctx_hit(B + NI*K), needs_host(B), isl_parent(NI),
+    isl_pid(NI)].
+
+    Through the axon TPU tunnel every host<->device buffer transfer pays
+    its own round-trip (measured r04: a 4096-batch dispatch cost ~300 ms
+    while the r03 per-primitive microbenches showed ~µs compute — seven
+    query uploads + five result readbacks of per-call RTT, not kernel
+    time). One upload + one readback per batch is the transfer-count
+    floor. unpack/concat compile to free reshapes on device."""
+    ctx_hit, needs_host, isl_parent, isl_pid, n_isl = _check_kernel_impl(
+        tables,
+        qpack[0], qpack[1], qpack[2], qpack[3], qpack[4], qpack[5],
+        qpack[6].astype(bool),
+        K=K, dh_probes=dh_probes, rh_probes=rh_probes, max_steps=max_steps,
+        wildcard_rel=wildcard_rel, n_config_rels=n_config_rels,
+        frontier_cap=frontier_cap, n_island_cap=n_island_cap,
+        has_delta=has_delta,
+    )
+    return jnp.concatenate([
+        n_isl[None].astype(jnp.int32),
+        ctx_hit.astype(jnp.int32),
+        needs_host.astype(jnp.int32),
+        isl_parent.astype(jnp.int32),
+        isl_pid.astype(jnp.int32),
+    ])
+
+
+def pack_queries(
+    q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid
+) -> np.ndarray:
+    """Host-side twin of check_kernel_packed's input layout."""
+    import numpy as _np
+
+    return _np.stack([
+        q_obj, q_rel, q_depth, q_skind, q_sa, q_sb,
+        q_valid.astype(_np.int32),
+    ]).astype(_np.int32)
+
+
+def unpack_results(flat: np.ndarray, B: int, n_island_cap: int, K: int):
+    """Slice check_kernel_packed's result vector back into
+    (ctx_hit, needs_host, isl_parent, isl_pid, n_isl) numpy views."""
+    NI = max(n_island_cap, 1)
+    NC = B + n_island_cap * K
+    n_isl = int(flat[0])
+    ctx_hit = flat[1 : 1 + NC].astype(bool)
+    needs_host = flat[1 + NC : 1 + NC + B]
+    isl_parent = flat[1 + NC + B : 1 + NC + B + NI]
+    isl_pid = flat[1 + NC + B + NI : 1 + NC + B + 2 * NI]
+    return ctx_hit, needs_host, isl_parent, isl_pid, n_isl
 
 
 PASSTHROUGH_TABLE_KEYS = (

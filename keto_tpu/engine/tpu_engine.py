@@ -708,11 +708,25 @@ class TPUCheckEngine:
                 axis=self.mesh.axis_names[0],
             )
         else:
-            eb = expand_kernel(
+            from .expand_kernel import (
+                expand_kernel_packed,
+                unpack_expand_results,
+            )
+
+            # single-buffer I/O + device-side compaction: the raw edge
+            # buffers are [B*edge_cap] (~99% padding at real tree sizes);
+            # through the axon tunnel that readback, not kernel compute,
+            # was the 2.9 s/batch in the r04 first capture. Pool overflow
+            # flags needs_host — exact host replay, same contract as
+            # edge_cap overflow.
+            pool_cap = max(32 * B, 4096)
+            qpack = np.stack([
+                q_obj, q_rel, np.full(B, depth, dtype=np.int32),
+                q_valid.astype(np.int32),
+            ]).astype(np.int32)
+            flat = expand_kernel_packed(
                 state.expand_tables,
-                q_obj, q_rel,
-                np.full(B, depth, dtype=np.int32),
-                q_valid,
+                qpack,
                 fh_probes=state.fh_probes,
                 # static step budget keyed to the GLOBAL depth cap, not the
                 # per-call depth (avoids one recompile per requested depth);
@@ -720,11 +734,21 @@ class TPUCheckEngine:
                 max_steps=global_max + 2,
                 frontier_cap=max(frontier_cap, B),
                 edge_cap=edge_cap,
+                pool_cap=pool_cap,
             )
-        eb_pobj, eb_prel, eb_skind, eb_sa, eb_sb = (np.asarray(x) for x in eb[:5])
-        eb_count = np.asarray(eb[5])
-        root_has_children = np.asarray(eb[6])
-        needs_host = np.asarray(eb[7])
+            offs, root_has_children, needs_host, pool_cols = (
+                unpack_expand_results(np.asarray(flat), B, pool_cap)
+            )
+            eb = None
+        if eb is not None:
+            eb_pobj, eb_prel, eb_skind, eb_sa, eb_sb = (
+                np.asarray(x) for x in eb[:5]
+            )
+            eb_count = np.asarray(eb[5])
+            root_has_children = np.asarray(eb[6])
+            needs_host = np.asarray(eb[7])
+            offs = None
+            pool_cols = None
 
         results = []
         n_host_exp = 0
@@ -733,10 +757,15 @@ class TPUCheckEngine:
                 n_host_exp += 1
                 results.append(self.reference.expand(sub, max_depth, self.nid))
                 continue
-            adjacency = decode_edge_buffer(
-                eb_pobj, eb_prel, eb_skind, eb_sa, eb_sb,
-                int(eb_count[i]), i * edge_cap,
-            )
+            if offs is not None:
+                adjacency = decode_edge_buffer(
+                    *pool_cols, int(offs[i + 1] - offs[i]), int(offs[i]),
+                )
+            else:
+                adjacency = decode_edge_buffer(
+                    eb_pobj, eb_prel, eb_skind, eb_sa, eb_sb,
+                    int(eb_count[i]), i * edge_cap,
+                )
             results.append(
                 assemble_tree(
                     sub, int(q_obj[i]), int(q_rel[i]), depth,
@@ -788,10 +817,14 @@ class TPUCheckEngine:
             )
 
         q_depth = np.full(B, depth, dtype=np.int32)
-        if isinstance(state.snapshot.obj_slots, ArrayMap):
-            # big-vocab (columnar) snapshots: vectorized batch encoding —
-            # scalar ArrayMap lookups cost ~1 ms each at 1e7 vocab and
-            # dominated check_batch (988/s engine vs 77k/s kernel)
+        if isinstance(state.snapshot.obj_slots, ArrayMap) or n >= 16:
+            # vectorized batch encoding: mandatory for big (ArrayMap)
+            # vocabs — scalar lookups cost ~1 ms each at 1e7 vocab and
+            # dominated check_batch (988/s engine vs 77k/s kernel) — and
+            # cheaper than the per-tuple loop for any real batch on dict
+            # vocabs too. Tiny dict-vocab batches (the single-check serve
+            # path) keep the scalar loop: ~µs of dict gets beats the
+            # ~0.1 ms fixed numpy overhead of the vectorized pipeline.
             q_obj, q_rel, q_skind, q_sa, q_sb, q_valid = encode_query_batch(
                 state.view, tuples, B
             )
@@ -859,13 +892,23 @@ class TPUCheckEngine:
                     statics=statics, axis=self.mesh.axis_names[0],
                 )
             else:
+                from .kernel import check_kernel_packed, pack_queries
+
                 cfg = kernel_static_config(
                     state.snapshot, global_max, launch_cap,
                     n_island_cap=island_cap, has_delta=state.has_delta,
                 )
-                outputs = check_kernel(
+                # single-buffer I/O: ONE host->device upload (the packed
+                # query array) and ONE device->host readback at resolve.
+                # Through the axon tunnel every buffer transfer pays its
+                # own round-trip; seven uploads + five readbacks per
+                # batch, not kernel compute, dominated the r04 first
+                # capture (~300 ms/batch at ~µs-scale primitives).
+                outputs = check_kernel_packed(
                     state.tables,
-                    q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid,
+                    pack_queries(
+                        q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid
+                    ),
                     **cfg,
                 )
         # everything past the launch is deferred to resolve: touching the
@@ -880,6 +923,7 @@ class TPUCheckEngine:
                 "B": B,
                 "max_depth": max_depth,
                 "q_valid": q_valid,
+                "island_cap": island_cap if self.mesh is None else None,
             },
         )
 
@@ -895,10 +939,19 @@ class TPUCheckEngine:
         tuples = meta["tuples"]
         n, B, max_depth = meta["n"], meta["B"], meta["max_depth"]
         q_valid = meta["q_valid"]
-        ctx_hit, needs_host, isl_parent, isl_pid, n_isl = outputs
-        ctx_hit = np.asarray(ctx_hit).copy()
-        needs_host = np.asarray(needs_host)
-        n_isl = int(n_isl)
+        if meta.get("island_cap") is not None:
+            # packed single-device result: ONE device->host readback
+            from .kernel import unpack_results
+
+            ctx_hit, needs_host, isl_parent, isl_pid, n_isl = unpack_results(
+                np.asarray(outputs), B, meta["island_cap"], state.snapshot.K
+            )
+            ctx_hit = ctx_hit.copy()
+        else:
+            ctx_hit, needs_host, isl_parent, isl_pid, n_isl = outputs
+            ctx_hit = np.asarray(ctx_hit).copy()
+            needs_host = np.asarray(needs_host)
+            n_isl = int(n_isl)
         if n_isl:
             from .islands import combine_islands
 
