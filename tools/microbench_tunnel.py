@@ -1,0 +1,194 @@
+"""Tunnel transfer-cost microbench: validates the r04 dispatch model.
+
+BENCH_TPU_r04.json's first capture measured ~300 ms per 4096-check batch
+and 2.9 s per expand batch while the r03 per-primitive microbenches put
+every kernel phase at ~µs scale. Hypothesis: through the axon tunnel
+EVERY host<->device buffer transfer pays its own round-trip, so the old
+dispatch path's 7 query uploads + 5 result readbacks (and the expand
+path's 21 MB padded readback) were the latency, not the chip.
+
+Experiments (each bounded: in-flight window <= 16 — deep unbounded
+queues wedge the tunnel, ROUND3_NOTES.md):
+
+  1. rtt          — blocked round-trip of a trivial 1-element op
+  2. upload       — blocked device_put: one [7,4096] vs seven [4096]
+  3. readback     — blocked np.asarray: one [33k] vs five slices
+  4. kernel_old   — legacy check_kernel (7 uploads/5 readbacks), blocked
+                    + pipelined windows {1,2,4,8,16}
+  5. kernel_packed— check_kernel_packed (1 upload/1 readback), same grid
+  6. batch_scaling— packed kernel blocked latency at B in {1k,4k,16k}
+                    (RTT amortization headroom for bigger buckets)
+
+Usage: python tools/microbench_tunnel.py [--rounds 24]
+Prints one JSON line per experiment; safe to rerun.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _block(x):
+    import jax
+
+    jax.block_until_ready(x)
+
+
+def _bench_blocked(fn, n=10):
+    fn()  # warm
+    t = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        t.append(time.perf_counter() - t0)
+    a = np.array(t) * 1e3
+    return round(float(np.percentile(a, 50)), 2), round(float(a.min()), 2)
+
+
+def _bench_window(submit, resolve, window: int, rounds: int):
+    """Amortized per-call ms with `window` launches in flight."""
+    resolve(submit())  # warm
+    t0 = time.perf_counter()
+    pending = []
+    for _ in range(rounds):
+        pending.append(submit())
+        if len(pending) >= window:
+            resolve(pending.pop(0))
+    for h in pending:
+        resolve(h)
+    return round((time.perf_counter() - t0) / rounds * 1e3, 2)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=24)
+    ap.add_argument(
+        "--platform", default=None,
+        help="'cpu' for a sanity run (the container sitecustomize "
+        "force-selects the axon TPU backend, whose init blocks on a "
+        "wedged tunnel; the env var alone cannot override it)",
+    )
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    print(json.dumps({"exp": "device", "platform": dev.platform,
+                      "kind": str(dev.device_kind)}), flush=True)
+
+    # 1. trivial RTT
+    one = jnp.ones((8,), jnp.int32)
+    _block(one)
+    trivial = jax.jit(lambda x: x + 1)
+    p50, mn = _bench_blocked(lambda: _block(trivial(one)))
+    print(json.dumps({"exp": "rtt", "p50_ms": p50, "min_ms": mn}), flush=True)
+
+    # 2. upload: one packed array vs seven separate
+    seven = [np.zeros(4096, np.int32) for _ in range(7)]
+    packed = np.zeros((7, 4096), np.int32)
+    p50_1, mn_1 = _bench_blocked(lambda: _block(jax.device_put(packed)))
+    p50_7, mn_7 = _bench_blocked(
+        lambda: _block([jax.device_put(a) for a in seven])
+    )
+    print(json.dumps({"exp": "upload", "one_packed_p50_ms": p50_1,
+                      "seven_arrays_p50_ms": p50_7,
+                      "one_min_ms": mn_1, "seven_min_ms": mn_7}), flush=True)
+
+    # 3. readback: one vector vs five pieces
+    big = jax.device_put(np.zeros(33000, np.int32))
+    parts = [jax.device_put(np.zeros(6600, np.int32)) for _ in range(5)]
+    _block([big, parts])
+    p50_1, mn_1 = _bench_blocked(lambda: np.asarray(big))
+    p50_5, mn_5 = _bench_blocked(lambda: [np.asarray(p) for p in parts])
+    print(json.dumps({"exp": "readback", "one_p50_ms": p50_1,
+                      "five_p50_ms": p50_5, "one_min_ms": mn_1,
+                      "five_min_ms": mn_5}), flush=True)
+
+    # 4/5. the real check kernel both ways on the bench fixture
+    import bench as benchmod  # repo root is on sys.path (top of file)
+
+    namespaces, tuples, queries = benchmod.build_dataset()
+    from keto_tpu.config import Config
+    from keto_tpu.engine.kernel import (
+        check_kernel,
+        check_kernel_packed,
+        kernel_static_config,
+        pack_queries,
+    )
+    from keto_tpu.engine.snapshot import encode_query_batch
+    from keto_tpu.engine.tpu_engine import TPUCheckEngine
+    from keto_tpu.storage import MemoryManager
+
+    cfg = Config({"limit": {"max_read_depth": 5}})
+    cfg.set_namespaces(namespaces)
+    manager = MemoryManager()
+    manager.write_relation_tuples(tuples)
+    engine = TPUCheckEngine(manager, cfg, frontier_cap=2 * len(queries))
+    state = engine._ensure_state()
+    B = 4096
+    q = encode_query_batch(state.view, queries[:B], B)
+    q_obj, q_rel, q_skind, q_sa, q_sb, q_valid = q
+    q_depth = np.full(B, 5, dtype=np.int32)
+    statics = kernel_static_config(state.snapshot, 5, 2 * B, has_delta=False)
+    qp = pack_queries(q_obj, q_rel, q_depth, q_skind, q_sa, q_sb, q_valid)
+
+    def submit_old():
+        return check_kernel(
+            state.tables, q_obj, q_rel, q_depth, q_skind, q_sa, q_sb,
+            q_valid, **statics,
+        )
+
+    def resolve_old(out):
+        return [np.asarray(x) for x in out]
+
+    def submit_packed():
+        return check_kernel_packed(state.tables, qp, **statics)
+
+    for name, sub, res in (
+        ("kernel_old", submit_old, resolve_old),
+        ("kernel_packed", submit_packed, np.asarray),
+    ):
+        p50, mn = _bench_blocked(lambda: res(sub()), n=8)
+        row = {"exp": name, "blocked_p50_ms": p50, "blocked_min_ms": mn}
+        for w in (1, 2, 4, 8, 16):
+            per = _bench_window(sub, res, w, args.rounds)
+            row[f"w{w}_ms"] = per
+        row["best_qps"] = round(
+            B / (min(row[f"w{w}_ms"] for w in (1, 2, 4, 8, 16)) / 1e3), 1
+        )
+        print(json.dumps(row), flush=True)
+
+    # 6. batch scaling (RTT amortization headroom)
+    for bb in (1024, 4096, 16384):
+        qq = encode_query_batch(state.view, (queries * 8)[:bb], bb)
+        qpb = pack_queries(
+            qq[0], qq[1], np.full(bb, 5, np.int32), qq[2], qq[3], qq[4], qq[5]
+        )
+        st = kernel_static_config(state.snapshot, 5, 2 * bb, has_delta=False)
+
+        def sub_b():
+            return check_kernel_packed(state.tables, qpb, **st)
+
+        p50, mn = _bench_blocked(lambda: np.asarray(sub_b()), n=6)
+        w8 = _bench_window(sub_b, np.asarray, 8, max(args.rounds // 2, 8))
+        print(json.dumps({
+            "exp": "batch_scaling", "B": bb, "blocked_p50_ms": p50,
+            "w8_ms": w8, "w8_qps": round(bb / (w8 / 1e3), 1),
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

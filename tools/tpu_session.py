@@ -90,6 +90,10 @@ def main() -> int:
         return 0
 
     steps = [
+        # transfer-cost model first: cheap, and it decides how to read
+        # every number after it (docstring of microbench_tunnel.py)
+        ("tunnel", [sys.executable, "tools/microbench_tunnel.py"],
+         "TUNNEL_r04.json", 900),
         ("bench", [sys.executable, "bench.py", "--probe-timeout", "120"],
          "BENCH_TPU_r04.json", 1800),
         ("tier", [sys.executable, "tools/tpu_test_tier.py"],
@@ -116,7 +120,9 @@ def main() -> int:
                               "error": f"aborted after {name} wedge"}))
             break
 
-    bench_ok = any(r["step"] == "bench" and r["ok"] for r in results)
+    bench_ok = any(
+        r["step"] in ("bench", "tunnel") and r["ok"] for r in results
+    )
     print(json.dumps({"step": "session", "ok": bench_ok,
                       "steps_ok": sum(1 for r in results if r["ok"]),
                       "steps": len(results)}))
