@@ -630,6 +630,7 @@ class TPUCheckEngine:
         max_depth: int = 0,
         frontier_cap: int = 1024,
         edge_cap: int = 4096,
+        pool_cap: int = 0,
     ) -> list:
         """Batched expand: device BFS subgraph gather + exact host DFS
         assembly (engine/expand_kernel.py); SubjectIDs and overflowing /
@@ -651,7 +652,7 @@ class TPUCheckEngine:
             for i in range(0, n, step):
                 out.extend(
                     self.expand_batch(subjects[i : i + step], max_depth,
-                                      frontier_cap, edge_cap)
+                                      frontier_cap, edge_cap, pool_cap)
                 )
             return out
 
@@ -718,8 +719,10 @@ class TPUCheckEngine:
             # through the axon tunnel that readback, not kernel compute,
             # was the 2.9 s/batch in the r04 first capture. Pool overflow
             # flags needs_host — exact host replay, same contract as
-            # edge_cap overflow.
-            pool_cap = max(32 * B, 4096)
+            # edge_cap overflow. Callers expecting wide trees (the scale
+            # bench's RBAC fixtures) pass pool_cap explicitly; the
+            # default sizes for serve-path trees (~10 nodes avg).
+            pool_cap = pool_cap or max(32 * B, 4096)
             qpack = np.stack([
                 q_obj, q_rel, np.full(B, depth, dtype=np.int32),
                 q_valid.astype(np.int32),
