@@ -411,18 +411,51 @@ def _compose_keys_bytes(ns_ids_arr: np.ndarray, objs: np.ndarray) -> np.ndarray:
     memcmp-comparable — the sort/unique/searchsorted pipeline over 1e7+
     keys is string-compare bound (measured: np.unique over U keys was
     60% of the 1e7 sharded build). UTF-8 byte order equals code-point
-    order, so sorting/uniqueness match the U pipeline exactly."""
-    return np.char.add(
-        np.char.add(ns_ids_arr.astype("S11"), _SEP.encode()),
-        np.char.encode(objs.astype("U"), "utf-8"),
-    )
+    order, so sorting/uniqueness match the U pipeline exactly.
+
+    Byte-for-byte the same "%d\\x1fobj" keys np.char.add built, but
+    assembled by slice-assignment into one uint8 buffer, grouped by
+    DISTINCT ns_id (namespaces are few; np.char.add's per-element
+    _vec_string passes were ~35% of the 1e7 columnar build)."""
+    n = len(objs)
+    if n == 0:
+        return np.array([], dtype="S1")
+    obj_s = _encode_utf8(objs)
+    ow = obj_s.dtype.itemsize
+    ids = np.asarray(ns_ids_arr, dtype=np.int64)
+    uniq = np.unique(ids)
+    if len(uniq) > 256:  # pathological namespace count: one pass beats
+        return np.char.add(  # thousands of per-group slice assignments
+            np.char.add(ids.astype("S11"), _SEP.encode()), obj_s
+        )
+    prefixes = {int(u): f"{int(u)}{_SEP}".encode() for u in uniq}
+    total = max(len(p) for p in prefixes.values()) + ow
+    buf = np.zeros((n, total), dtype=np.uint8)
+    ob = np.ascontiguousarray(obj_s).view(np.uint8).reshape(n, ow)
+    for u, p in prefixes.items():
+        rows = np.flatnonzero(ids == u)
+        pw = len(p)
+        buf[rows, :pw] = np.frombuffer(p, dtype=np.uint8)
+        buf[rows, pw : pw + ow] = ob[rows]
+    return buf.view(f"S{total}").ravel()
 
 
 def _encode_utf8(arr: np.ndarray) -> np.ndarray:
-    # no astype on already-U input: that would materialize a redundant
-    # GB-scale temporary on the 1e7+ build path
+    """U -> S (utf-8). ASCII fast path: a U array is UCS-4, so for pure-
+    ASCII content the utf-8 bytes are just the low byte of each code
+    point — one vectorized narrowing cast instead of numpy's per-element
+    _vec_string encode (measured 0.29 s/1e6 keys; the cast is ~20x
+    faster, and real authorization-model names are overwhelmingly
+    ASCII). Trailing NULs match np.char.encode's S-padding semantics."""
     if arr.dtype.kind != "U":
         arr = arr.astype("U")
+    n = len(arr)
+    if n == 0:
+        return np.array([], dtype="S1")
+    w = arr.dtype.itemsize // 4
+    cp = np.ascontiguousarray(arr).view(np.uint32).reshape(n, w)
+    if cp.max(initial=0) < 128:
+        return np.ascontiguousarray(cp.astype(np.uint8)).view(f"S{w}").ravel()
     return np.char.encode(arr, "utf-8")
 
 

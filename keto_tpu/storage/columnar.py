@@ -54,17 +54,56 @@ def _identity_keys(cols: TupleColumns) -> np.ndarray:
     pagination ordering than numpy U, with identical ordering (UTF-8
     byte order == code-point order). str-side comparisons encode via
     _tuple_identity(...).encode()."""
-    sep = _SEP.encode()
+    from ..engine.snapshot import _encode_utf8
+
     parts = [
         cols.ns, cols.obj, cols.rel,
         cols.skind.astype("U1"), cols.sns, cols.sobj, cols.srel,
     ]
-    out = np.char.encode(parts[0].astype("U"), "utf-8")
-    for p in parts[1:]:
-        out = np.char.add(
-            np.char.add(out, sep), np.char.encode(p.astype("U"), "utf-8")
+    n = len(cols)
+    if n == 0:
+        return np.array([], dtype="S1")
+    # exact "\x1f".join(parts) concatenation, assembled by one masked
+    # flat scatter per column instead of np.char.add chains (12+
+    # per-element _vec_string passes; they were ~75% of a 1e7 bulk_load)
+    enc = []
+    lens = []
+    for p in parts:
+        b = _encode_utf8(np.asarray(p))
+        w = b.dtype.itemsize
+        m = np.ascontiguousarray(b).view(np.uint8).reshape(n, w)
+        enc.append(m)
+        # element byte length = position of the last non-NUL byte
+        # (numpy S semantics: trailing NULs are padding, interior NULs
+        # cannot occur in names)
+        lens.append(
+            np.max((m != 0) * np.arange(1, w + 1, dtype=np.int32), axis=1)
         )
-    return out
+    row_len = np.sum(lens, axis=0) + (len(parts) - 1)
+    total = int(row_len.max())
+    out = np.zeros((n, total), dtype=np.uint8)
+    flat = out.reshape(-1)
+    base = np.arange(n, dtype=np.int64) * total
+    off = np.zeros(n, dtype=np.int64)
+    sep_b = _SEP.encode()[0]
+    for k, (m, ln) in enumerate(zip(enc, lens)):
+        w = m.shape[1]
+        j = np.arange(w, dtype=np.int64)
+        mask = j[None, :] < ln[:, None]
+        dest = (base + off)[:, None] + j[None, :]
+        flat[dest[mask]] = m[mask]
+        off += ln
+        if k < len(parts) - 1:
+            flat[base + off] = sep_b
+            off += 1
+    return out.view(f"S{total}").ravel()
+
+
+def _concat_s(parts: list[np.ndarray]) -> np.ndarray:
+    """Concatenate S arrays, widening to the max itemsize first (numpy
+    would otherwise silently truncate the wider array's entries)."""
+    w = max(p.dtype.itemsize for p in parts)
+    return np.concatenate([p.astype(f"S{w}") for p in parts])
 
 
 def _encode_token(key: str) -> str:
@@ -113,6 +152,7 @@ class _ColumnarNetwork:
     def __init__(self):
         self.base = TupleColumns.empty()
         self.base_keys = np.array([], dtype="S1")  # sorted identity keys
+        self.base_ident = np.array([], dtype="S1")  # row-aligned (unsorted)
         self.base_order = np.array([], dtype=np.int64)  # key-sorted -> row
         self.alive = np.array([], dtype=bool)
         self.buffer: list[RelationTuple] = []
@@ -123,8 +163,13 @@ class _ColumnarNetwork:
 
     # -- base maintenance -------------------------------------------------
 
-    def rebuild_base_index(self) -> None:
-        keys = _identity_keys(self.base)
+    def rebuild_base_index(self, keys: Optional[np.ndarray] = None) -> None:
+        """`keys` (row-aligned identity keys) skips recomputing them for
+        rows whose keys the caller already holds — identity composition
+        was ~75% of a 1e7 bulk_load."""
+        if keys is None:
+            keys = _identity_keys(self.base)
+        self.base_ident = keys
         order = np.argsort(keys, kind="stable")
         self.base_keys = keys[order]
         self.base_order = order
@@ -144,12 +189,18 @@ class _ColumnarNetwork:
         if not self.buffer:
             return
         add = TupleColumns.from_tuples(self.buffer)
-        keep = self.alive
-        self.base = concat_columns([self.base.take(np.flatnonzero(keep)), add])
+        alive_idx = np.flatnonzero(self.alive)
+        self.base = concat_columns([self.base.take(alive_idx), add])
+        add_keys = _identity_keys(add)
+        all_keys = (
+            _concat_s([self.base_ident[alive_idx], add_keys])
+            if len(self.base_ident)
+            else add_keys
+        )
         self.alive = np.ones(len(self.base), dtype=bool)
         self.buffer = []
         self.buffer_keys = {}
-        self.rebuild_base_index()
+        self.rebuild_base_index(all_keys)
 
 
 class ColumnarStore:
@@ -182,9 +233,10 @@ class ColumnarStore:
             net.merge_buffer()
             keys = _identity_keys(cols)
             _, first = np.unique(keys, return_index=True)
-            cols = cols.take(np.sort(first))
+            take = np.sort(first)
+            cols = cols.take(take)
+            keys = keys[take]
             if len(net.base):
-                keys = _identity_keys(cols)
                 idx = np.clip(
                     np.searchsorted(net.base_keys, keys),
                     0, max(len(net.base_keys) - 1, 0),
@@ -196,14 +248,20 @@ class ColumnarStore:
                 )
                 # duplicates of DEAD rows resurrect: keep them
                 dup &= net.alive[net.base_order[idx]]
-                cols = cols.take(np.flatnonzero(~dup))
+                fresh = np.flatnonzero(~dup)
+                cols = cols.take(fresh)
+                keys = keys[fresh]
             if not len(cols):
                 return
-            net.base = concat_columns(
-                [net.base.take(np.flatnonzero(net.alive)), cols]
+            alive_idx = np.flatnonzero(net.alive)
+            net.base = concat_columns([net.base.take(alive_idx), cols])
+            all_keys = (
+                _concat_s([net.base_ident[alive_idx], keys])
+                if len(net.base_ident)
+                else keys
             )
             net.alive = np.ones(len(net.base), dtype=bool)
-            net.rebuild_base_index()
+            net.rebuild_base_index(all_keys)
             net.version += 1
             net.log.clear()
             net.log_floor = net.version
