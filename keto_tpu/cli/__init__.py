@@ -133,9 +133,13 @@ def cmd_serve(args) -> int:
     from ..config import Config
     from ..registry import Registry
     from ..api.daemon import Daemon
+    from ..profiling import profiled
 
     config = Config.from_file(args.config) if args.config else Config()
-    Daemon(Registry(config)).serve_forever()
+    # env/config-driven profiling around the whole serve lifetime
+    # (ref: profilex.Profile() in /root/reference/main.go:24)
+    with profiled(config.get("profiling")):
+        Daemon(Registry(config)).serve_forever()
     return 0
 
 
@@ -454,6 +458,14 @@ def cmd_status(args) -> int:
         time.sleep(1)
 
 
+def cmd_clidoc(args) -> int:
+    from .clidoc import generate
+
+    written = generate(args.output_dir)
+    print(f"All files have been generated and updated. ({len(written)} pages)")
+    return 0
+
+
 def cmd_version(args) -> int:
     print(__version__)
     return 0
@@ -586,6 +598,15 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("version", help="print version")
     p.set_defaults(fn=cmd_version)
+
+    p = sub.add_parser(
+        "clidoc",
+        help="generate one markdown reference page per CLI command",
+        description="Walks the command tree and writes one markdown page "
+        "per command plus an index (the reference's cmd/clidoc analog).",
+    )
+    p.add_argument("output_dir")
+    p.set_defaults(fn=cmd_clidoc)
 
     return root
 

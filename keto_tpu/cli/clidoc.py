@@ -1,0 +1,99 @@
+"""CLI reference-doc generator.
+
+The reference ships a standalone generator that walks the cobra command
+tree and writes one markdown page per command for the docs site
+(/root/reference/cmd/clidoc/main.go, ory/x clidoc.Generate). This is
+the argparse analog: it walks build_parser()'s subparser tree and emits
+one `keto_tpu_<command path>.md` per command plus an index, with the
+same page shape (description, usage block, options table, links to
+parent/children).
+
+Usage:  keto_tpu clidoc <output-dir>
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def _subparsers(parser: argparse.ArgumentParser):
+    for action in parser._actions:
+        if isinstance(action, argparse._SubParsersAction):
+            # choices maps name -> parser; dedupe aliases by id
+            seen = {}
+            for name, sub in action.choices.items():
+                seen.setdefault(id(sub), (name, sub))
+            return [v for _, v in sorted(seen.items(), key=lambda kv: kv[1][0])]
+    return []
+
+
+def _options_rows(parser: argparse.ArgumentParser):
+    rows = []
+    for action in parser._actions:
+        if isinstance(action, argparse._SubParsersAction):
+            continue
+        if not action.option_strings:
+            continue
+        flags = ", ".join(action.option_strings)
+        default = (
+            "" if action.default in (None, argparse.SUPPRESS)
+            else repr(action.default)
+        )
+        rows.append((flags, default, action.help or ""))
+    return rows
+
+
+def _page(path_parts, parser, children):
+    name = " ".join(path_parts)
+    lines = [f"# {name}", ""]
+    if parser.description:
+        lines += [parser.description, ""]
+    lines += ["```", parser.format_usage().strip(), "```", ""]
+    rows = _options_rows(parser)
+    if rows:
+        lines += ["## Options", "", "| Flag | Default | Description |",
+                  "|---|---|---|"]
+        lines += [f"| `{f}` | {d} | {h} |" for f, d, h in rows]
+        lines.append("")
+    if children:
+        lines += ["## Subcommands", ""]
+        for child_name, child in children:
+            slug = "_".join(path_parts + [child_name])
+            first_help = (child.description or "").split("\n")[0]
+            lines.append(f"- [{child_name}]({slug}.md) — {first_help}")
+        lines.append("")
+    if len(path_parts) > 1:
+        parent_slug = "_".join(path_parts[:-1])
+        lines += [f"See also: [{' '.join(path_parts[:-1])}]({parent_slug}.md)",
+                  ""]
+    return "\n".join(lines)
+
+
+def generate(out_dir: str) -> list[str]:
+    """Walk the live parser tree; returns the written file names."""
+    from . import build_parser
+
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+
+    def walk(parser, path_parts):
+        children = [(name, sub) for name, sub in _subparsers(parser)]
+        slug = "_".join(path_parts)
+        fname = f"{slug}.md"
+        with open(os.path.join(out_dir, fname), "w") as f:
+            f.write(_page(path_parts, parser, children))
+        written.append(fname)
+        for name, sub in children:
+            walk(sub, path_parts + [name])
+
+    walk(build_parser(), ["keto_tpu"])
+    index = sorted(written)
+    with open(os.path.join(out_dir, "README.md"), "w") as f:
+        f.write(
+            "# keto_tpu CLI reference\n\n"
+            + "\n".join(f"- [{n[:-3].replace('_', ' ')}]({n})" for n in index)
+            + "\n"
+        )
+    written.append("README.md")
+    return written

@@ -1,0 +1,57 @@
+"""Env/config-driven serve profiling.
+
+The reference wraps its entire process in `profilex.Profile()`
+(/root/reference/main.go:24): the PROFILING env var ("cpu" | "mem")
+turns on a profiler whose report is written when the process stops, so
+an operator can profile a production serve without code changes. The
+Python analog:
+
+  - "cpu": cProfile around the serve loop; a pstats dump is written on
+    stop (readable with `python -m pstats <file>`)
+  - "mem": tracemalloc; the top-25 allocation sites by size are written
+    as text on stop
+
+Source of truth: the `profiling` config key (embedx parity —
+config_schema.json) with the KETO_PROFILING env var taking precedence,
+mirroring profilex's env-only contract. Output path: KETO_PROFILE_PATH
+or ./keto_<mode>.pprof-like defaults.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+
+
+@contextmanager
+def profiled(mode: str | None, path: str | None = None):
+    """Context manager running the serve loop under the selected
+    profiler; no-op for falsy/unknown modes (same forgiving contract as
+    profilex: an operator typo must not stop the server)."""
+    mode = (os.environ.get("KETO_PROFILING") or mode or "").strip().lower()
+    if mode == "cpu":
+        import cProfile
+
+        out = path or os.environ.get("KETO_PROFILE_PATH") or "keto_cpu.pstats"
+        prof = cProfile.Profile()
+        prof.enable()
+        try:
+            yield
+        finally:
+            prof.disable()
+            prof.dump_stats(out)
+    elif mode == "mem":
+        import tracemalloc
+
+        out = path or os.environ.get("KETO_PROFILE_PATH") or "keto_mem.txt"
+        tracemalloc.start(25)
+        try:
+            yield
+        finally:
+            snap = tracemalloc.take_snapshot()
+            tracemalloc.stop()
+            stats = snap.statistics("lineno")[:25]
+            with open(out, "w") as f:
+                f.write("\n".join(str(s) for s in stats) + "\n")
+    else:
+        yield

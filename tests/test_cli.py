@@ -282,3 +282,65 @@ class TestNamespaceMigrateCLI:
         cfg, _ = legacy_db
         code, _, err = run(capsys, ["namespace", "migrate", "status", "nope", "-c", str(cfg)])
         assert code == 1 and "unknown namespace" in err
+
+
+class TestClidoc:
+    def test_generates_page_per_command(self, tmp_path, capsys):
+        out = tmp_path / "docs"
+        assert main(["clidoc", str(out)]) == 0
+        files = {p.name for p in out.iterdir()}
+        # root, nested command-group and leaf pages, plus the index
+        assert "keto_tpu.md" in files
+        assert "keto_tpu_namespace.md" in files
+        assert "keto_tpu_namespace_migrate_up.md" in files
+        assert "keto_tpu_relation-tuple_parse.md" in files
+        assert "README.md" in files
+        assert "generated and updated" in capsys.readouterr().out
+        root = (out / "keto_tpu.md").read_text()
+        assert "## Subcommands" in root
+        leaf = (out / "keto_tpu_check.md").read_text()
+        assert "## Options" in leaf
+        assert "keto_tpu_namespace.md" not in leaf  # parent link is slugged
+        nested = (out / "keto_tpu_namespace_migrate_up.md").read_text()
+        assert "keto_tpu_namespace_migrate.md" in nested  # see-also parent
+
+
+class TestProfiling:
+    def test_cpu_profile_written(self, tmp_path):
+        import pstats
+
+        from keto_tpu.profiling import profiled
+
+        out = tmp_path / "cpu.pstats"
+        with profiled("cpu", str(out)):
+            sum(range(1000))
+        stats = pstats.Stats(str(out))  # parseable pstats dump
+        assert stats.total_calls >= 1
+
+    def test_mem_profile_written(self, tmp_path):
+        from keto_tpu.profiling import profiled
+
+        out = tmp_path / "mem.txt"
+        with profiled("mem", str(out)):
+            _ = [b"x" * 1024 for _ in range(100)]
+        assert out.read_text().strip()
+
+    def test_env_overrides_config(self, tmp_path, monkeypatch):
+        from keto_tpu.profiling import profiled
+
+        out = tmp_path / "cpu.pstats"
+        monkeypatch.setenv("KETO_PROFILING", "cpu")
+        with profiled("", str(out)):  # config says off; env wins
+            pass
+        assert out.exists()
+
+    def test_unknown_mode_is_noop(self, tmp_path):
+        from keto_tpu.profiling import profiled
+
+        with profiled("bogus", str(tmp_path / "x")):
+            pass
+        assert not (tmp_path / "x").exists()
+
+    def test_profiling_config_key_validates(self):
+        cfg = Config({"profiling": "cpu", "version": "v0.11.1"})
+        assert cfg.get("profiling") == "cpu"
