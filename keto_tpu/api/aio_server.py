@@ -1,0 +1,257 @@
+"""asyncio read-path gRPC server: the no-handoff serving plane.
+
+Measured on the 1-core bench host (ROUND4_NOTES.md §4): the threaded
+serving stack's structural ceiling is ~68% of the raw gRPC echo
+ceiling, because every request pays a cross-thread handoff — the gRPC
+worker thread enqueues, a collector thread batches, a pool thread
+resolves, and a per-request Future wakes the worker back up. The
+reference doesn't have this problem (goroutines are cheap and its
+checkgroup fans out per request, internal/check/checkgroup); a Python
+batching server on one core needs the asyncio shape instead:
+
+  - grpc.aio serves every RPC as a coroutine on ONE loop thread —
+    request parsing, batch assembly, and result fan-out all happen
+    in-loop with no thread wakeups
+  - only the device work (check_batch_submit / _resolve — blocking jax
+    dispatch + readback) runs in a small thread executor, bounded by
+    the same in-flight semaphore discipline as the sync batcher (a
+    deep dispatch queue can wedge the axon TPU tunnel)
+  - asyncio futures resolve in-loop: one callback per request instead
+    of one lock/notify/context-switch per request
+
+The sync daemon (api/daemon.py) remains the composition root and the
+wire-parity muxed listener; this server backs the DIRECT read-gRPC
+listener when `serve.read.grpc.aio` is true. Handlers delegate to the
+same `_Services` request/response logic (grpc_server.py) so both
+planes share one behavior surface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import grpc
+import grpc.aio
+
+from .descriptors import CHECK_SERVICE, pb
+from .grpc_server import _grpc_code, _Services
+from ..errors import KetoError
+
+
+class AioCheckBatcher:
+    """Event-loop-native micro-batcher: same contract as api/batcher.py
+    (coalesce concurrent checks into device batches, bounded in-flight
+    split-phase dispatch) with zero cross-thread handoffs on the
+    request path."""
+
+    def __init__(
+        self,
+        engine_resolver,
+        max_batch: int = 1024,
+        window_s: float = 0.002,
+        pipeline_depth: int = 4,
+    ):
+        self._resolve_engine = engine_resolver
+        self.max_batch = max_batch
+        self.window_s = window_s
+        self._queue: asyncio.Queue = asyncio.Queue()
+        # device dispatch is blocking (jax launch + readback): a small
+        # executor keeps it off the loop; in-flight launches are bounded
+        # (wedge discipline, see api/batcher.py)
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(pipeline_depth, 2),
+            thread_name_prefix="keto-aio-dispatch",
+        )
+        self._inflight = asyncio.Semaphore(max(2 * pipeline_depth, 4))
+        self._collector: asyncio.Task | None = None
+        self._closed = False
+
+    def start(self) -> None:
+        self._collector = asyncio.get_running_loop().create_task(self._run())
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._collector is not None:
+            await self._queue.put(None)
+            await self._collector
+        self._executor.shutdown(wait=True)
+
+    async def check(self, tuple, max_depth: int = 0, nid=None):
+        if self._closed:
+            raise RuntimeError("AioCheckBatcher is closed")
+        fut = asyncio.get_running_loop().create_future()
+        self._queue.put_nowait((tuple, max_depth, nid, fut))
+        return await fut
+
+    async def _drain(self, first) -> list:
+        batch = [first]
+        loop = asyncio.get_running_loop()
+        end = loop.time() + self.window_s
+        while len(batch) < self.max_batch:
+            timeout = end - loop.time()
+            if timeout <= 0:
+                try:
+                    item = self._queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+            else:
+                try:
+                    item = await asyncio.wait_for(self._queue.get(), timeout)
+                except asyncio.TimeoutError:
+                    break
+            if item is None:
+                await self._queue.put(None)
+                break
+            batch.append(item)
+        return batch
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            item = await self._queue.get()
+            if item is None:
+                return
+            batch = await self._drain(item)
+            by_key: dict = {}
+            for p in batch:
+                by_key.setdefault((p[1], p[2]), []).append(p)
+            for (depth, nid), group in by_key.items():
+                await self._inflight.acquire()
+                try:
+                    engine = self._resolve_engine(nid)
+                    handle = await loop.run_in_executor(
+                        self._executor,
+                        engine.check_batch_submit,
+                        [p[0] for p in group],
+                        depth,
+                    )
+                except Exception as e:
+                    self._inflight.release()
+                    for p in group:
+                        if not p[3].done():
+                            p[3].set_exception(e)
+                    continue
+                # resolve concurrently: the collector goes back to
+                # draining while the device round-trip completes
+                loop.create_task(self._finish(engine, handle, group))
+
+    async def _finish(self, engine, handle, group) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            results = await loop.run_in_executor(
+                self._executor, engine.check_batch_resolve, handle
+            )
+        except Exception as e:
+            for p in group:
+                if not p[3].done():
+                    p[3].set_exception(e)
+            return
+        finally:
+            self._inflight.release()
+        for p, res in zip(group, results):
+            if not p[3].done():
+                p[3].set_result(res)
+
+
+class _AioCheckService:
+    """Check over grpc.aio; request/response logic shared with the
+    threaded plane via _Services helpers."""
+
+    def __init__(self, services: _Services, batcher: AioCheckBatcher):
+        self._svc = services
+        self._batcher = batcher
+
+    async def check(self, req, context):
+        try:
+            t = self._svc._check_tuple(req)
+            self._svc.registry.validate_namespaces(t)
+            nid = self._svc._nid(context)
+            res = await self._batcher.check(t, int(req.max_depth), nid=nid)
+            if res.error is not None:
+                raise res.error
+            return pb.CheckResponse(
+                allowed=res.allowed, snaptoken="not yet implemented"
+            )
+        except KetoError as e:
+            await context.abort(_grpc_code(e), e.message)
+
+
+def _aio_handlers(service: _AioCheckService):
+    return grpc.method_handlers_generic_handler(
+        CHECK_SERVICE,
+        {
+            "Check": grpc.unary_unary_rpc_method_handler(
+                service.check,
+                request_deserializer=pb.CheckRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+        },
+    )
+
+
+class AioReadServer:
+    """Own-thread event loop hosting the aio gRPC read listener. The
+    sync daemon composes it like any other listener: start() binds and
+    returns the port, stop() drains."""
+
+    def __init__(self, registry, host: str, port: int,
+                 pipeline_depth: int = 4, window_s: float = 0.002):
+        self.registry = registry
+        self.host = host
+        self.port = port
+        self.bound_port: int | None = None
+        self._pipeline_depth = pipeline_depth
+        self._window_s = window_s
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._started = threading.Event()
+        self._server = None
+        self.batcher: AioCheckBatcher | None = None
+
+    def start(self) -> int:
+        self._thread = threading.Thread(
+            target=self._run, name="keto-aio-read", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=30) or self.bound_port is None:
+            raise RuntimeError("aio read server failed to start")
+        return self.bound_port
+
+    def _run(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_until_complete(self._serve())
+
+    async def _serve(self) -> None:
+        services = _Services(self.registry)
+        self.batcher = AioCheckBatcher(
+            self.registry.check_engine,
+            pipeline_depth=self._pipeline_depth,
+            window_s=self._window_s,
+        )
+        self.batcher.start()
+        server = grpc.aio.server()
+        server.add_generic_rpc_handlers(
+            (_aio_handlers(_AioCheckService(services, self.batcher)),)
+        )
+        self.bound_port = server.add_insecure_port(f"{self.host}:{self.port}")
+        await server.start()
+        self._server = server
+        self._started.set()
+        await server.wait_for_termination()
+
+    def stop(self, grace: float = 2.0) -> None:
+        if self._loop is None or self._server is None:
+            return
+
+        async def _shutdown():
+            await self._server.stop(grace)
+            await self.batcher.close()
+
+        fut = asyncio.run_coroutine_threadsafe(_shutdown(), self._loop)
+        fut.result(timeout=grace + 10)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -131,13 +131,24 @@ def _build_hash_table(
             if max_probes > 64:
                 break  # extremely clustered: grow and retry
             slots = (h1[pending] + probe[pending] * h2[pending]) & mask
-            occupied = table_vals[slots] != EMPTY
-            free = ~occupied
-            # among pending rows probing the same free slot, lowest index wins
+            if max_probes == 1:
+                free = np.ones(len(pending), dtype=bool)  # empty table
+            else:
+                free = table_vals[slots] == EMPTY
+            # among pending rows probing the same free slot, lowest index
+            # wins: one stable sort by slot, then first-of-run — NOT
+            # np.unique, which would re-sort the already-sorted slots
+            # (the double sort was ~25% of the 5e7 per-shard builds)
             order = np.argsort(slots[free], kind="stable")
             free_idx = pending[free][order]
             free_slots = slots[free][order]
-            uniq_slots, first = np.unique(free_slots, return_index=True)
+            if len(free_slots):
+                first = np.concatenate(
+                    [[0], np.flatnonzero(free_slots[1:] != free_slots[:-1]) + 1]
+                )
+            else:
+                first = np.array([], dtype=np.int64)
+            uniq_slots = free_slots[first]
             winners = free_idx[first]
             table_vals[uniq_slots] = values[winners]
             for col, key in zip(table_keys, keys):
