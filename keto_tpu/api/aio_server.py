@@ -155,16 +155,35 @@ class AioCheckBatcher:
                 p[3].set_result(res)
 
 
-class _AioCheckService:
-    """Check over grpc.aio; request/response logic shared with the
-    threaded plane via _Services helpers."""
+class _AioReadServices:
+    """The full read surface over grpc.aio. Check rides the in-loop
+    batcher; Expand/List (blocking device/store work) delegate to the
+    shared _Services bodies on a small executor; Version/Health answer
+    in-loop. One behavior surface with the threaded plane."""
 
     def __init__(self, services: _Services, batcher: AioCheckBatcher):
         self._svc = services
         self._batcher = batcher
+        self._blocking = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="keto-aio-blocking"
+        )
+        # health watchers park a thread in ready.wait_change for up to
+        # 5 s per wake; pool sized to the sync plane's 16-watcher cap
+        self._watch_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="keto-aio-watch"
+        )
+
+    async def _observed(self, method, coro_fn, req, context):
+        with self._svc.metrics.observe_request("grpc", method) as outcome:
+            try:
+                with self._svc.registry.tracer().span(f"grpc.{method}"):
+                    return await coro_fn(req, context)
+            except KetoError as e:
+                outcome["code"] = _grpc_code(e).name
+                await context.abort(_grpc_code(e), e.message)
 
     async def check(self, req, context):
-        try:
+        async def body(req, context):
             t = self._svc._check_tuple(req)
             self._svc.registry.validate_namespaces(t)
             nid = self._svc._nid(context)
@@ -174,21 +193,101 @@ class _AioCheckService:
             return pb.CheckResponse(
                 allowed=res.allowed, snaptoken="not yet implemented"
             )
-        except KetoError as e:
-            await context.abort(_grpc_code(e), e.message)
 
+        return await self._observed("Check", body, req, context)
 
-def _aio_handlers(service: _AioCheckService):
-    return grpc.method_handlers_generic_handler(
-        CHECK_SERVICE,
-        {
-            "Check": grpc.unary_unary_rpc_method_handler(
-                service.check,
-                request_deserializer=pb.CheckRequest.FromString,
-                response_serializer=lambda m: m.SerializeToString(),
+    def _delegated(self, name, sync_fn):
+        async def body(req, context):
+            loop = asyncio.get_running_loop()
+            return await loop.run_in_executor(
+                self._blocking, sync_fn, req, context
             )
-        },
+
+        async def handler(req, context):
+            return await self._observed(name, body, req, context)
+
+        return handler
+
+    async def get_version(self, req, context):
+        return self._svc.get_version(req, context)
+
+    async def health_check(self, req, context):
+        return self._svc.health_check(req, context)
+
+    async def health_watch(self, req, context):
+        """Async twin of _Services.health_watch: same event-driven
+        contract and watcher cap; only the wait parks on an executor."""
+        if not self._svc._watch_slots.acquire(blocking=False):
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                "too many concurrent health watchers",
+            )
+        loop = asyncio.get_running_loop()
+        ready = self._svc.registry.ready
+        try:
+            flag, gen = ready.state()
+            last = None
+            while not context.cancelled():
+                current = 1 if flag else 2
+                if current != last:
+                    last = current
+                    yield pb.HealthCheckResponse(status=current)
+                flag, gen = await loop.run_in_executor(
+                    self._watch_pool, ready.wait_change, gen, 5.0
+                )
+        finally:
+            self._svc._watch_slots.release()
+
+    def close(self) -> None:
+        self._blocking.shutdown(wait=False)
+        self._watch_pool.shutdown(wait=False)
+
+
+def _aio_handlers(service: _AioReadServices):
+    from .descriptors import (
+        EXPAND_SERVICE,
+        HEALTH_SERVICE,
+        READ_SERVICE,
+        VERSION_SERVICE,
     )
+
+    def unary(fn, req_cls):
+        return grpc.unary_unary_rpc_method_handler(
+            fn,
+            request_deserializer=req_cls.FromString,
+            response_serializer=lambda m: m.SerializeToString(),
+        )
+
+    svc = service._svc
+    return [
+        grpc.method_handlers_generic_handler(CHECK_SERVICE, {
+            "Check": unary(service.check, pb.CheckRequest),
+        }),
+        grpc.method_handlers_generic_handler(EXPAND_SERVICE, {
+            "Expand": unary(
+                service._delegated("Expand", svc.expand), pb.ExpandRequest
+            ),
+        }),
+        grpc.method_handlers_generic_handler(READ_SERVICE, {
+            "ListRelationTuples": unary(
+                service._delegated(
+                    "ListRelationTuples", svc.list_relation_tuples
+                ),
+                pb.ListRelationTuplesRequest,
+            ),
+        }),
+        grpc.method_handlers_generic_handler(VERSION_SERVICE, {
+            "GetVersion": unary(service.get_version, pb.GetVersionRequest),
+        }),
+        grpc.method_handlers_generic_handler(HEALTH_SERVICE, {
+            "Check": unary(service.health_check, pb.HealthCheckRequest),
+            "Watch": grpc.unary_stream_rpc_method_handler(
+                service.health_watch,
+                request_deserializer=pb.HealthCheckRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            ),
+        }),
+    ]
 
 
 class AioReadServer:
@@ -208,6 +307,7 @@ class AioReadServer:
         self._thread: threading.Thread | None = None
         self._started = threading.Event()
         self._server = None
+        self._services = None
         self.batcher: AioCheckBatcher | None = None
 
     def start(self) -> int:
@@ -232,10 +332,9 @@ class AioReadServer:
             window_s=self._window_s,
         )
         self.batcher.start()
+        self._services = _AioReadServices(services, self.batcher)
         server = grpc.aio.server()
-        server.add_generic_rpc_handlers(
-            (_aio_handlers(_AioCheckService(services, self.batcher)),)
-        )
+        server.add_generic_rpc_handlers(tuple(_aio_handlers(self._services)))
         self.bound_port = server.add_insecure_port(f"{self.host}:{self.port}")
         await server.start()
         self._server = server
@@ -249,9 +348,13 @@ class AioReadServer:
         async def _shutdown():
             await self._server.stop(grace)
             await self.batcher.close()
+            if self._services is not None:
+                self._services.close()
 
-        fut = asyncio.run_coroutine_threadsafe(_shutdown(), self._loop)
-        fut.result(timeout=grace + 10)
-        self._loop.call_soon_threadsafe(self._loop.stop)
+        try:
+            fut = asyncio.run_coroutine_threadsafe(_shutdown(), self._loop)
+            fut.result(timeout=grace + 10)
+        except TimeoutError:
+            pass  # daemon shutdown must not hang on a stuck stream
         if self._thread is not None:
             self._thread.join(timeout=5)

@@ -240,7 +240,24 @@ class Daemon:
         # on a 1-core host the splice alone costs ~1/3 of the serve
         # ceiling. The muxed port stays for reference wire parity (one
         # port, both protocols); this is the high-throughput side door.
-        self.read_grpc_port = self._add_direct_grpc("read", self._grpc_read)
+        cfg0 = reg.config
+        if cfg0.get("serve.read.grpc") and cfg0.get("serve.read.grpc.aio"):
+            # asyncio read plane for the direct listener: all RPCs run as
+            # coroutines on one loop thread — no per-request cross-thread
+            # handoff (api/aio_server.py); the muxed port stays threaded
+            # for wire parity
+            from .aio_server import AioReadServer
+
+            g = cfg0.get("serve.read.grpc")
+            self._aio_read = AioReadServer(
+                reg, g.get("host", "127.0.0.1"), int(g.get("port", 0)),
+                pipeline_depth=int(cfg0.get("check.pipeline_depth", 2)),
+                window_s=float(cfg0.get("check.batch_window_ms", 2.0)) / 1e3,
+            )
+            self.read_grpc_port = self._aio_read.start()
+        else:
+            self._aio_read = None
+            self.read_grpc_port = self._add_direct_grpc("read", self._grpc_read)
         self.write_grpc_port = self._add_direct_grpc("write", self._grpc_write)
         self._grpc_read.start()
         self._grpc_write.start()
@@ -337,6 +354,8 @@ class Daemon:
         self.registry.ready.clear()
         for m in self._muxes.values():
             m.stop()
+        if getattr(self, "_aio_read", None) is not None:
+            self._aio_read.stop(grace)
         if self._grpc_read is not None:
             self._grpc_read.stop(grace).wait(grace)
         if self._grpc_write is not None:

@@ -1,0 +1,149 @@
+"""asyncio read plane (api/aio_server.py): behavior parity with the
+threaded gRPC read surface, exercised through the SAME ReadClient the
+sync-plane tests use — the wire contract is identical, only the server
+architecture differs (every RPC a coroutine, in-loop batching)."""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+from keto_tpu.api import ReadClient, WriteClient, open_channel
+from keto_tpu.api.daemon import Daemon
+from keto_tpu.config import Config
+from keto_tpu.ketoapi import RelationQuery, RelationTuple, SubjectSet
+from keto_tpu.registry import Registry
+
+NAMESPACES = [
+    {
+        "name": "videos",
+        "relations": [
+            {"name": "owner"},
+            {
+                "name": "view",
+                "rewrite": {
+                    "operation": "or",
+                    "children": [
+                        {"type": "computed_subject_set", "relation": "owner"}
+                    ],
+                },
+            },
+        ],
+    },
+]
+
+
+@pytest.fixture(scope="module")
+def daemon():
+    cfg = Config(
+        {
+            "dsn": "memory",
+            "check": {"engine": "tpu"},
+            "serve": {
+                "read": {
+                    "host": "127.0.0.1", "port": 0,
+                    "grpc": {"host": "127.0.0.1", "port": 0, "aio": True},
+                },
+                "write": {"host": "127.0.0.1", "port": 0},
+                "metrics": {"host": "127.0.0.1", "port": 0},
+            },
+            "namespaces": NAMESPACES,
+        }
+    )
+    d = Daemon(Registry(cfg))
+    d.start()
+    yield d
+    d.stop()
+
+
+@pytest.fixture(scope="module")
+def clients(daemon):
+    rc = ReadClient(open_channel(f"127.0.0.1:{daemon.read_grpc_port}"))
+    wc = WriteClient(open_channel(f"127.0.0.1:{daemon.write_port}"))
+    yield rc, wc
+    rc.close()
+    wc.close()
+
+
+def t(s: str) -> RelationTuple:
+    return RelationTuple.from_string(s)
+
+
+class TestAioReadPlane:
+    def test_check_and_rewrite(self, clients):
+        rc, wc = clients
+        wc.transact(insert=[t("videos:/a#owner@alice")])
+        assert rc.check(t("videos:/a#owner@alice"))
+        assert rc.check(t("videos:/a#view@alice"))  # computed rewrite
+        assert not rc.check(t("videos:/a#owner@bob"))
+
+    def test_concurrent_checks_batch(self, clients):
+        rc, wc = clients
+        wc.transact(insert=[t(f"videos:/c{i}#owner@u{i}") for i in range(16)])
+        results = {}
+        addr_clients = []
+
+        def worker(i):
+            results[i] = rc.check(t(f"videos:/c{i}#owner@u{i}"))
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(16)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert all(results[i] for i in range(16))
+        for c in addr_clients:
+            c.close()
+
+    def test_expand(self, clients):
+        rc, wc = clients
+        wc.transact(insert=[t("videos:/e#owner@erin")])
+        tree = rc.expand(SubjectSet("videos", "/e", "owner"))
+        assert tree is not None
+
+    def test_list_relation_tuples(self, clients):
+        rc, wc = clients
+        wc.transact(insert=[t("videos:/l#owner@lee")])
+        resp = rc.list_relation_tuples(
+            RelationQuery(namespace="videos", object="/l")
+        )
+        assert any(
+            x.subject_id == "lee" for x in resp.relation_tuples
+        )
+
+    def test_version_and_health(self, clients):
+        rc, _ = clients
+        assert rc.get_version()
+        assert rc.health() == "SERVING"
+
+    def test_unknown_namespace_is_grpc_error(self, clients):
+        rc, _ = clients
+        with pytest.raises(grpc.RpcError) as err:
+            rc.check(t("nope:/x#owner@alice"))
+        assert err.value.code() in (
+            grpc.StatusCode.INVALID_ARGUMENT, grpc.StatusCode.NOT_FOUND
+        )
+
+    def test_health_watch_stream(self, daemon):
+        from keto_tpu.api.descriptors import HEALTH_SERVICE, pb
+
+        chan = open_channel(f"127.0.0.1:{daemon.read_grpc_port}")
+        watch = chan.unary_stream(
+            f"/{HEALTH_SERVICE}/Watch",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.HealthCheckResponse.FromString,
+        )
+        stream = watch(pb.HealthCheckRequest(), timeout=10)
+        first = next(stream)
+        assert first.status == 1  # SERVING
+        stream.cancel()
+        chan.close()
+
+    def test_read_your_writes(self, clients):
+        rc, wc = clients
+        for i in range(3):
+            wc.transact(insert=[t(f"videos:/w{i}#owner@w{i}")])
+            assert rc.check(t(f"videos:/w{i}#owner@w{i}"))
