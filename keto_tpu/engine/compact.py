@@ -193,7 +193,9 @@ def _host_row_lookup(
     h1 = hash_combine(o, r)
     h2 = mix32(h1 ^ _GOLDEN) | np.uint32(1)
     for p in range(probes):
-        slot = int((h1[0] + np.uint32(p) * h2[0]) & mask)
+        # array (not scalar) arithmetic: uint32 wraparound is the point,
+        # and numpy only warns about it on the scalar path
+        slot = int(((h1 + np.uint32(p) * h2) & mask)[0])
         if rh_obj[slot] == obj and rh_rel[slot] == rel:
             return int(rh_row[slot])
         if rh_obj[slot] == EMPTY:
@@ -392,19 +394,27 @@ def merge_ops_into_snapshot(
     snapshot: GraphSnapshot,
     ops: Sequence[tuple[str, RelationTuple]],
     version: int,
-) -> Optional[GraphSnapshot]:
+    with_encoded: bool = False,
+):
     """The merge driver: a NEW GraphSnapshot with `ops` folded in, or
     None when a full rebuild is the better (or only correct) move.
-    The input snapshot is never mutated — concurrent readers hold it."""
+    The input snapshot is never mutated — concurrent readers hold it.
+    `with_encoded` additionally returns the deduped encoded ops
+    (snapshot, enc_u [n,5] int32, ins_u bool) so the engine can patch
+    the expand full-CSR with the same op set."""
+
+    def _ret(snap, enc_u=None, ins_u=None):
+        return (snap, enc_u, ins_u) if with_encoded else snap
+
     n_ops = len(ops)
     if n_ops == 0:
-        return None
+        return _ret(None)
     if n_ops > max(MIN_OPS_CAP, snapshot.n_tuples // MAX_OPS_FRACTION):
-        return None
+        return _ret(None)
     try:
         enc, is_insert, overlay = encode_ops(snapshot, ops)
     except (KeyError, TypeError):
-        return None  # inconsistent op stream — rebuild from the store
+        return _ret(None)  # inconsistent op stream — rebuild from the store
 
     # last-op-wins per exact edge key (same contract as the delta overlay)
     rev = np.arange(n_ops - 1, -1, -1)
@@ -465,7 +475,7 @@ def merge_ops_into_snapshot(
                 )
             )
         except MergeFallback:
-            return None
+            return _ret(None)
     else:
         rh_obj, rh_rel, rh_row = snapshot.rh_obj, snapshot.rh_rel, snapshot.rh_row
         rh_probes = snapshot.rh_probes
@@ -474,14 +484,14 @@ def merge_ops_into_snapshot(
 
     total_garbage = snapshot.merge_garbage + garbage
     if total_garbage > max(GARBAGE_FLOOR, GARBAGE_FRACTION * len(e_obj)):
-        return None
+        return _ret(None)
 
     # live-edge delta: inserts that were absent minus deletes that were live
     # (approximated from op counts; exactness only matters for the load
     # gate above, which measures occupancy directly)
     n_tuples = snapshot.n_tuples + int(ins_u.sum()) - int((~ins_u).sum())
 
-    return GraphSnapshot(
+    return _ret(GraphSnapshot(
         ns_ids=_merged_vocab(snapshot.ns_ids, overlay.ns_ids),
         rel_ids=_merged_vocab(snapshot.rel_ids, overlay.rel_ids),
         obj_slots=_merged_vocab(snapshot.obj_slots, overlay.obj_slots, True),
@@ -502,4 +512,4 @@ def merge_ops_into_snapshot(
         version=version,
         n_tuples=max(n_tuples, 0),
         merge_garbage=total_garbage,
-    )
+    ), enc_u, ins_u)

@@ -84,6 +84,12 @@ class _EngineState:
     fh_probes: Optional[int] = None
     base_decoder: object = None  # reverse vocab of the base snapshot only
     decoder: object = None  # base_decoder extended with the overlay
+    # host mirror of the single-device full CSR (fh_* / f_*): retained so
+    # an incremental compaction can PATCH the expand state (affected rows
+    # only) instead of dropping it — a lazy full-CSR rebuild costs ~212 s
+    # at 1e7 (SCALE_1e7_r04). ~1 GB extra host RAM at 1e7; "garbage"
+    # counts tail-rewritten slots for the amortizing rebuild
+    expand_np: Optional[dict] = None
 
 
 class TPUCheckEngine:
@@ -338,6 +344,7 @@ class TPUCheckEngine:
             new_state.fh_probes = state.fh_probes
             new_state.base_decoder = state.base_decoder
             new_state.decoder = state.base_decoder.extended(overlay)
+            new_state.expand_np = state.expand_np
         return new_state
 
     def _incremental_compact(
@@ -357,7 +364,9 @@ class TPUCheckEngine:
 
         version = stable_fingerprint([store_version, state.config_fp])
         with self.tracer.span("engine.incremental_compact") as sp:
-            merged = merge_ops_into_snapshot(state.snapshot, ops, version)
+            merged, enc_u, ins_u = merge_ops_into_snapshot(
+                state.snapshot, ops, version, with_encoded=True
+            )
             if merged is None:
                 return None
             sp.set_attribute("ops", len(ops))
@@ -372,6 +381,21 @@ class TPUCheckEngine:
             config_fp=state.config_fp,
             has_delta=False,
         )
+        # patch the retained expand full-CSR mirror with the same op set
+        # (None falls back to the lazy rebuild on next expand)
+        expand_np, device_csr, fh_probes = self._patched_expand_state(
+            state, enc_u, ins_u
+        )
+        if expand_np is not None:
+            from .expand_kernel import ExpandDecoder
+
+            new_state.expand_np = expand_np
+            new_state.fh_probes = fh_probes
+            new_state.base_decoder = ExpandDecoder(merged)
+            new_state.decoder = new_state.base_decoder.extended(None)
+            new_state.expand_tables = self._merge_expand_dirty(
+                device_csr, new_state.delta_np
+            )
         self.stats["incremental_merges"] = (
             self.stats.get("incremental_merges", 0) + 1
         )
@@ -379,6 +403,67 @@ class TPUCheckEngine:
         # timer thread) — safe under the engine lock
         self._maybe_persist(merged)
         return new_state
+
+    @staticmethod
+    def _pack_expand_csr(csr: dict) -> dict:
+        """Host full-CSR arrays -> the expand kernel's device table dict."""
+        import jax.numpy as jnp
+
+        from .kernel import pack_pair_table
+
+        return {
+            "fh_pack": jnp.asarray(pack_pair_table(
+                csr["fh_obj"], csr["fh_rel"], csr["fh_row"]
+            )),
+            "f_row_ptr": jnp.asarray(csr["f_row_ptr"]),
+            "f_skind": jnp.asarray(csr["f_skind"]),
+            "f_sa": jnp.asarray(csr["f_sa"]),
+            "f_sb": jnp.asarray(csr["f_sb"]),
+        }
+
+    def _patched_expand_state(self, state: _EngineState, enc_u, ins_u):
+        """Patch the retained host full-CSR mirror with the merged ops
+        (affected rows only) and return (expand_np, device_csr,
+        fh_probes), or (None, None, None) to fall back to the lazy
+        rebuild (no mirror retained, or garbage past the amortization
+        threshold)."""
+        import numpy as np
+
+        from .compact import GARBAGE_FLOOR, GARBAGE_FRACTION, patch_csr
+
+        src = state.expand_np
+        if src is None:
+            return None, None, None
+        per_row: dict = {}
+        for (obj, rel, sk, sa, sb), ins in zip(enc_u.tolist(), ins_u.tolist()):
+            ch = per_row.setdefault((obj, rel), {"ins": [], "del": set()})
+            if ins:
+                ch["ins"].append((sk, sa, sb))
+                ch["del"].discard((sk, sa, sb))
+            else:
+                ch["del"].add((sk, sa, sb))
+                ch["ins"] = [t for t in ch["ins"] if t != (sk, sa, sb)]
+        (fh_obj, fh_rel, fh_row), fh_probes, f_row_ptr, payloads, garbage = (
+            patch_csr(
+                (src["fh_obj"], src["fh_rel"], src["fh_row"]),
+                src["fh_probes"],
+                src["f_row_ptr"],
+                (src["f_skind"], src["f_sa"], src["f_sb"]),
+                per_row,
+            )
+        )
+        total_garbage = src["garbage"] + garbage
+        if total_garbage > max(
+            GARBAGE_FLOOR, GARBAGE_FRACTION * len(payloads[0])
+        ):
+            return None, None, None
+        expand_np = {
+            "fh_obj": fh_obj, "fh_rel": fh_rel, "fh_row": fh_row,
+            "fh_probes": fh_probes, "f_row_ptr": f_row_ptr,
+            "f_skind": payloads[0], "f_sa": payloads[1], "f_sb": payloads[2],
+            "garbage": total_garbage,
+        }
+        return expand_np, self._pack_expand_csr(expand_np), fh_probes
 
     @staticmethod
     def _merge_expand_dirty(base_csr: dict, delta_np: dict) -> dict:
@@ -582,18 +667,9 @@ class TPUCheckEngine:
                     state.snapshot, view=state.view,
                 )
             fh_probes = csr.pop("fh_probes")
-            from .kernel import pack_pair_table
-
-            device_csr = {
-                "fh_pack": jnp.asarray(pack_pair_table(
-                    csr["fh_obj"], csr["fh_rel"], csr["fh_row"]
-                )),
-                "f_row_ptr": jnp.asarray(csr["f_row_ptr"]),
-                "f_skind": jnp.asarray(csr["f_skind"]),
-                "f_sa": jnp.asarray(csr["f_sa"]),
-                "f_sb": jnp.asarray(csr["f_sb"]),
-            }
+            device_csr = self._pack_expand_csr(csr)
             state.fh_probes = fh_probes
+            state.expand_np = {**csr, "fh_probes": fh_probes, "garbage": 0}
             state.base_decoder = ExpandDecoder(state.snapshot)
             state.decoder = state.base_decoder.extended(state.view.overlay)
             # expand_tables is the readiness signal: set it last

@@ -300,3 +300,54 @@ class TestCheckpointCompat:
         assert loaded.n_tuples == snap.n_tuples
         # tombstoned values survive the roundtrip
         assert (loaded.dh_val == np.asarray(snap.dh_val)).all()
+
+
+class TestExpandStateMerge:
+    def test_expand_state_survives_merge(self):
+        """The retained full-CSR mirror is PATCHED by the merge (affected
+        rows only) — no lazy expand rebuild, and the merged edges are
+        served from the device path."""
+        from keto_tpu.ketoapi import SubjectSet
+
+        eng = make_engine(tuples=base_tuples())
+        tree = eng.expand(SubjectSet("f", "dir", "member"), 3)
+        assert {c.tuple.subject_id for c in tree.children} == {"bob"}
+        assert eng.stats.get("device_expands", 0) == 1
+
+        writes = overflow_writes() + ts("f:dir#member@zoe")
+        eng.manager.write_relation_tuples(writes)
+        eng.manager.delete_relation_tuples(ts("f:dir#member@bob"))
+        eng.check_batch([t("f:bulk0#member@ubulk0")], max_depth=6)
+        assert eng.stats.get("incremental_merges", 0) == 1
+        # the merged state still carries a ready expand mirror
+        assert eng._state.expand_tables is not None
+        assert eng._state.expand_np is not None
+
+        tree2 = eng.expand(SubjectSet("f", "dir", "member"), 3)
+        assert {c.tuple.subject_id for c in tree2.children} == {"zoe"}
+        # a merged-in row expands on device too (new CSR row at the tail)
+        tree3 = eng.expand(SubjectSet("f", "bulk3", "member"), 3)
+        assert {c.tuple.subject_id for c in tree3.children} == {"ubulk3"}
+        assert eng.stats.get("host_expands", 0) == 0
+        assert eng.stats["snapshot_builds"] == 1
+
+    def test_expand_differential_after_merge(self):
+        from keto_tpu.ketoapi import SubjectSet
+
+        eng = make_engine(tuples=base_tuples())
+        eng.expand(SubjectSet("f", "dir", "member"), 3)
+        eng.manager.write_relation_tuples(
+            overflow_writes() + ts("f:doc#parent@(f:team#member)",
+                                   "f:team#member@tariq")
+        )
+        eng.check_batch([t("f:bulk0#member@ubulk0")], max_depth=6)
+        assert eng.stats.get("incremental_merges", 0) == 1
+        ref = ReferenceEngine(eng.manager, eng.config)
+        for sub in (SubjectSet("f", "doc", "parent"),
+                    SubjectSet("f", "team", "member"),
+                    SubjectSet("f", "keep", "member")):
+            got = eng.expand(sub, 4)
+            want = ref.expand(sub, 4)
+            g = {str(c.tuple) for c in (got.children if got else ())}
+            w = {str(c.tuple) for c in (want.children if want else ())}
+            assert g == w, sub
