@@ -553,6 +553,49 @@ class SQLitePersister:
             ).fetchall()
         return [self._row_to_tuple(r) for r in rows]
 
+    def all_tuple_columns(self, nid: str = DEFAULT_NETWORK):
+        """Columnar ingest surface: the SQL store's rows as TupleColumns,
+        so a SQLite-backed deployment rides the same vectorized snapshot
+        builders as the in-memory columnar tier (no per-tuple Python
+        objects between the DB and the device mirror). One fetchall +
+        seven np.array transpositions; the reference's closest analog is
+        its paginated full scan feeding the in-memory check graph
+        (internal/check/engine.go re-querying SQL per check)."""
+        import numpy as np
+
+        from .columns import TupleColumns
+
+        with self._lock:
+            rows = self._conn.execute(
+                _SELECT + " WHERE t.nid = ? ORDER BY t.shard_id", (nid,)
+            ).fetchall()
+        n = len(rows)
+        if n == 0:
+            return TupleColumns.empty()
+        cols = list(zip(*rows))
+        sid = cols[3]
+        is_set = np.array([s is None for s in sid], dtype=bool)
+        return TupleColumns(
+            ns=np.array(cols[0], dtype="U"),
+            obj=np.array(cols[1], dtype="U"),
+            rel=np.array(cols[2], dtype="U"),
+            skind=is_set.astype(np.int8),
+            sns=np.array(
+                [c if c is not None else "" for c in cols[4]], dtype="U"
+            ),
+            # plain subjects carry the subject id in sobj (columns.py)
+            sobj=np.array(
+                [
+                    (cols[5][i] if is_set[i] else (sid[i] or ""))
+                    for i in range(n)
+                ],
+                dtype="U",
+            ),
+            srel=np.array(
+                [c if c is not None else "" for c in cols[6]], dtype="U"
+            ),
+        )
+
     def version(self, nid: str = DEFAULT_NETWORK) -> int:
         """Durable per-nid write counter (device-mirror staleness signal);
         survives reopen, unaffected by other tenants' writes."""

@@ -389,3 +389,54 @@ class TestMigrationKeysetBoundary:
         p.migrate_up()
         assert len(p.all_relation_tuples(nid="net-a")) == 120
         assert len(p.all_relation_tuples(nid="net-b")) == 120
+
+
+class TestSQLiteColumnarSurface:
+    def test_all_tuple_columns_matches_tuples(self):
+        from keto_tpu.storage.sqlite import SQLitePersister
+
+        p = SQLitePersister("memory")
+        tuples = [
+            RelationTuple.from_string("files:a#owner@alice"),
+            RelationTuple.from_string("files:b#viewer@(files:a#owner)"),
+            RelationTuple.from_string("files:c#owner@bob"),
+        ]
+        p.write_relation_tuples(tuples)
+        cols = p.all_tuple_columns()
+        assert len(cols) == 3
+        got = set()
+        for i in range(len(cols)):
+            if int(cols.skind[i]) == 1:
+                got.add(
+                    f"{cols.ns[i]}:{cols.obj[i]}#{cols.rel[i]}"
+                    f"@({cols.sns[i]}:{cols.sobj[i]}#{cols.srel[i]})"
+                )
+            else:
+                got.add(f"{cols.ns[i]}:{cols.obj[i]}#{cols.rel[i]}@{cols.sobj[i]}")
+        assert got == {str(t) for t in tuples}
+
+    def test_engine_columnar_path_over_sqlite(self):
+        from keto_tpu.config import Config
+        from keto_tpu.engine.snapshot import ArrayMap
+        from keto_tpu.engine.tpu_engine import TPUCheckEngine
+        from keto_tpu.namespace import Namespace
+        from keto_tpu.namespace.ast import Relation
+        from keto_tpu.storage.sqlite import SQLitePersister
+
+        cfg = Config({"limit": {"max_read_depth": 5}})
+        cfg.set_namespaces(
+            [Namespace(name="files", relations=[Relation(name="owner")])]
+        )
+        p = SQLitePersister("memory")
+        p.write_relation_tuples(
+            [RelationTuple.from_string(f"files:f{i}#owner@u{i}")
+             for i in range(50)]
+        )
+        eng = TPUCheckEngine(p, cfg)
+        r = eng.check_batch(
+            [RelationTuple.from_string("files:f7#owner@u7"),
+             RelationTuple.from_string("files:f7#owner@u8")]
+        )
+        assert [x.allowed for x in r] == [True, False]
+        # the columnar builder ran: big vocabs are ArrayMaps
+        assert isinstance(eng._state.snapshot.obj_slots, ArrayMap)
