@@ -45,7 +45,11 @@ NORTH_STAR_QPS = 1_000_000.0
 N_FOLDERS = 64
 FILES_PER_FOLDER = 120
 N_USERS = 512
-BATCH = 4096
+# KETO_BENCH_BATCH: RTT-amortization knob for tunneled devices — with
+# per-dispatch round-trips dominating (TUNNEL_r04 model), a bigger batch
+# spreads the fixed cost over more checks (device step cost scales with
+# the frontier, so this trades latency for throughput explicitly)
+BATCH = int(os.environ.get("KETO_BENCH_BATCH", 4096))
 ROUNDS = 20
 
 SERVE_THREADS = 32

@@ -59,7 +59,7 @@ from .snapshot import (
     encode_query_batch,
 )
 
-_BUCKETS = (16, 64, 256, 1024, 4096)
+_BUCKETS = (16, 64, 256, 1024, 4096, 16384)
 
 
 @dataclass

@@ -98,9 +98,20 @@ def main() -> int:
          "BENCH_TPU_r04.json", 1800),
         ("tier", [sys.executable, "tools/tpu_test_tier.py"],
          "TPU_TIER_r04.json", 1200),
+    ]
+    # one 1e8-scale shard onto real HBM (VERDICT item 2), if the
+    # shard-streamed build's artifacts are on disk
+    if os.path.exists("/tmp/keto_1e8_shards/statics.json"):
+        steps.append((
+            "scale-1e8-tpu",
+            [sys.executable, "tools/scale_1e8_shard.py", "--phase", "tpu",
+             "--out", "/tmp/keto_1e8_shards"],
+            "SCALE_1e8_TPU_r04.json", 1800,
+        ))
+    steps.append(
         ("profile", [sys.executable, "tools/profile_kernel.py"],
          "TPU_PROFILE_r04.json", 1200),
-    ]
+    )
     if not args.skip_scale:
         steps.append((
             "scale-1e6",
