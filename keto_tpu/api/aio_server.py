@@ -121,11 +121,17 @@ class AioCheckBatcher:
                 await self._inflight.acquire()
                 try:
                     engine = self._resolve_engine(nid)
+                    submit = getattr(engine, "check_batch_submit", None)
+                    if submit is None:
+                        # host-engine fallback: no split-phase surface —
+                        # evaluate the whole batch on the executor (same
+                        # contract as the threaded batcher's _evaluate)
+                        loop.create_task(
+                            self._evaluate(engine, group, depth)
+                        )
+                        continue
                     handle = await loop.run_in_executor(
-                        self._executor,
-                        engine.check_batch_submit,
-                        [p[0] for p in group],
-                        depth,
+                        self._executor, submit, [p[0] for p in group], depth
                     )
                 except Exception as e:
                     self._inflight.release()
@@ -136,6 +142,26 @@ class AioCheckBatcher:
                 # resolve concurrently: the collector goes back to
                 # draining while the device round-trip completes
                 loop.create_task(self._finish(engine, handle, group))
+
+    async def _evaluate(self, engine, group, depth) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            results = await loop.run_in_executor(
+                self._executor,
+                engine.check_batch,
+                [p[0] for p in group],
+                depth,
+            )
+        except Exception as e:
+            for p in group:
+                if not p[3].done():
+                    p[3].set_exception(e)
+            return
+        finally:
+            self._inflight.release()
+        for p, res in zip(group, results):
+            if not p[3].done():
+                p[3].set_result(res)
 
     async def _finish(self, engine, handle, group) -> None:
         loop = asyncio.get_running_loop()
@@ -181,6 +207,12 @@ class _AioReadServices:
             except KetoError as e:
                 outcome["code"] = _grpc_code(e).name
                 await context.abort(_grpc_code(e), e.message)
+            except grpc.aio.AbortError:
+                raise  # context.abort signalling, already coded
+            except Exception as e:  # noqa: BLE001 — RPC boundary; same
+                # generic->INTERNAL mapping as the threaded plane
+                outcome["code"] = "INTERNAL"
+                await context.abort(grpc.StatusCode.INTERNAL, str(e))
 
     async def check(self, req, context):
         async def body(req, context):
@@ -322,9 +354,17 @@ class AioReadServer:
     def _run(self) -> None:
         self._loop = asyncio.new_event_loop()
         asyncio.set_event_loop(self._loop)
-        self._loop.run_until_complete(self._serve())
+        # run_forever (not run_until_complete of a serve coroutine): the
+        # loop must outlive wait_for_termination so stop()'s _shutdown
+        # coroutine can finish closing the batcher/executors — ending the
+        # loop the moment the server stops raced exactly that and burned
+        # the full stop timeout on every shutdown
+        self._loop.run_until_complete(self._start_server())
+        self._loop.run_forever()
+        self._loop.run_until_complete(self._loop.shutdown_asyncgens())
+        self._loop.close()
 
-    async def _serve(self) -> None:
+    async def _start_server(self) -> None:
         services = _Services(self.registry)
         self.batcher = AioCheckBatcher(
             self.registry.check_engine,
@@ -339,7 +379,6 @@ class AioReadServer:
         await server.start()
         self._server = server
         self._started.set()
-        await server.wait_for_termination()
 
     def stop(self, grace: float = 2.0) -> None:
         if self._loop is None or self._server is None:
@@ -356,5 +395,6 @@ class AioReadServer:
             fut.result(timeout=grace + 10)
         except TimeoutError:
             pass  # daemon shutdown must not hang on a stuck stream
+        self._loop.call_soon_threadsafe(self._loop.stop)
         if self._thread is not None:
             self._thread.join(timeout=5)

@@ -258,13 +258,11 @@ def patch_csr(
         tail.append(cols)
         pos += len(cols[0])
         ends.append(pos)
-        if row < 0:
-            new_row_keys.append((obj, rel))
-            new_row_ids.append(next_row)
-        else:
-            # repoint the existing hash entry at the rewritten row
-            new_row_keys.append((obj, rel))
-            new_row_ids.append(next_row)
+        # uniform for new and rewritten rows: the hash upsert below
+        # either inserts the key or repoints the existing entry at the
+        # tail row — last-write-wins on the value either way
+        new_row_keys.append((obj, rel))
+        new_row_ids.append(next_row)
         next_row += 1
 
     new_payloads = tuple(

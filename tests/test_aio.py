@@ -147,3 +147,51 @@ class TestAioReadPlane:
         for i in range(3):
             wc.transact(insert=[t(f"videos:/w{i}#owner@w{i}")])
             assert rc.check(t(f"videos:/w{i}#owner@w{i}"))
+
+
+def _make_daemon(engine: str):
+    cfg = Config(
+        {
+            "dsn": "memory",
+            "check": {"engine": engine},
+            "serve": {
+                "read": {
+                    "host": "127.0.0.1", "port": 0,
+                    "grpc": {"host": "127.0.0.1", "port": 0, "aio": True},
+                },
+                "write": {"host": "127.0.0.1", "port": 0},
+                "metrics": {"host": "127.0.0.1", "port": 0},
+            },
+            "namespaces": NAMESPACES,
+        }
+    )
+    d = Daemon(Registry(cfg))
+    d.start()
+    return d
+
+
+class TestAioLifecycle:
+    def test_host_engine_fallback(self):
+        """check.engine=host has no split-phase surface; the aio batcher
+        must fall back to whole-batch evaluation (the threaded batcher's
+        getattr guard, mirrored)."""
+        d = _make_daemon("host")
+        try:
+            rc = ReadClient(open_channel(f"127.0.0.1:{d.read_grpc_port}"))
+            wc = WriteClient(open_channel(f"127.0.0.1:{d.write_port}"))
+            wc.transact(insert=[t("videos:/h#owner@hana")])
+            assert rc.check(t("videos:/h#owner@hana"))
+            assert not rc.check(t("videos:/h#owner@hugo"))
+            rc.close(); wc.close()
+        finally:
+            d.stop()
+
+    def test_stop_is_prompt(self):
+        """Shutdown must complete within the grace budget — the loop has
+        to outlive the server so the batcher/executors actually close
+        (the run_until_complete(serve) shape raced this and burned the
+        full stop timeout on every shutdown)."""
+        d = _make_daemon("tpu")
+        t0 = time.monotonic()
+        d.stop()
+        assert time.monotonic() - t0 < 8.0
