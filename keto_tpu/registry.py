@@ -91,6 +91,30 @@ class Registry:
         self.nid = nid
         self.mesh = mesh
         self.version = __version__
+        # operator platform pin: the container's sitecustomize can
+        # force-select a remote TPU backend whose init BLOCKS while the
+        # device/tunnel is unhealthy; `check.platform: cpu` keeps a
+        # degraded deployment serving (exact host fallbacks either way)
+        platform = self.config.get("check.platform")
+        if platform:
+            import jax
+
+            try:  # the pin is a silent no-op once a backend exists —
+                # surface that instead of letting the operator believe
+                # the unhealthy backend was avoided
+                from jax._src import xla_bridge
+
+                if xla_bridge.backends_are_initialized():
+                    import logging
+
+                    logging.getLogger("keto_tpu").warning(
+                        "check.platform=%r set after a JAX backend "
+                        "initialized; the pin has no effect in this "
+                        "process", platform,
+                    )
+            except ImportError:
+                pass
+            jax.config.update("jax_platforms", platform)
         self._lock = threading.RLock()
         self._manager = None
         self._engine = None

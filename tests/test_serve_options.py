@@ -277,3 +277,31 @@ class TestSubmitResolvePipeline:
         res = e.check_batch_resolve(h)
         assert len(res) == 130
         assert all(r.membership == Membership.IS_MEMBER for r in res)
+
+
+class TestPlatformPin:
+    def test_check_platform_updates_jax_config(self):
+        import jax
+
+        from keto_tpu.config import Config
+        from keto_tpu.registry import Registry
+
+        # a value DISTINCT from the conftest ambient ('cpu'), otherwise
+        # the assertion would pass with the pin code deleted; jax accepts
+        # arbitrary platform strings at the config level
+        before = jax.config.jax_platforms
+        try:
+            Registry(Config({"check": {"platform": "cpu,tpu_fake"}}))
+            assert jax.config.jax_platforms == "cpu,tpu_fake"
+        finally:
+            jax.config.update("jax_platforms", before)
+
+    def test_unset_leaves_environment_default(self):
+        import jax
+
+        from keto_tpu.config import Config
+        from keto_tpu.registry import Registry
+
+        before = jax.config.jax_platforms
+        Registry(Config({}))
+        assert jax.config.jax_platforms == before
