@@ -528,33 +528,40 @@ def bench_served(namespaces, tuples, queries) -> dict:
     from keto_tpu.config import Config
     from keto_tpu.registry import Registry
 
-    cfg = Config(
-        {
-            "dsn": "memory",
-            # pipeline depth 8: on a tunneled TPU the ~70 ms round-trip
-            # dwarfs batch compute, so served throughput scales with
-            # launched-but-unresolved batches in flight
-            "check": {"engine": "tpu", "pipeline_depth": 8},
-            "limit": {"max_read_depth": 5},
-            "serve": {
-                "read": {"host": "127.0.0.1", "port": 0,
-                         "grpc": {"host": "127.0.0.1", "port": 0}},
-                "write": {"host": "127.0.0.1", "port": 0},
-                "metrics": {"host": "127.0.0.1", "port": 0},
-            },
-        }
-    )
-    cfg.set_namespaces(namespaces)
-    registry = Registry(cfg)
-    registry.relation_tuple_manager().write_relation_tuples(tuples)
-    daemon = Daemon(registry)
-    daemon.start()
+    def make_daemon(aio: bool) -> Daemon:
+        grpc_cfg = {"host": "127.0.0.1", "port": 0}
+        if aio:
+            grpc_cfg["aio"] = True
+        cfg = Config(
+            {
+                "dsn": "memory",
+                # pipeline depth 8: on a tunneled TPU the ~70 ms round-
+                # trip dwarfs batch compute, so served throughput scales
+                # with launched-but-unresolved batches in flight
+                "check": {"engine": "tpu", "pipeline_depth": 8},
+                "limit": {"max_read_depth": 5},
+                "serve": {
+                    "read": {"host": "127.0.0.1", "port": 0,
+                             "grpc": grpc_cfg},
+                    "write": {"host": "127.0.0.1", "port": 0},
+                    "metrics": {"host": "127.0.0.1", "port": 0},
+                },
+            }
+        )
+        cfg.set_namespaces(namespaces)
+        registry = Registry(cfg)
+        registry.relation_tuple_manager().write_relation_tuples(tuples)
+        d = Daemon(registry)
+        d.start()
+        return d
+
+    daemon = make_daemon(aio=False)
     try:
         addr = f"127.0.0.1:{daemon.read_grpc_port}"
         # warm every bucket size the load phase can hit (single checks ride
         # the smallest padded bucket; batcher-coalesced groups the next one
         # up) so XLA compiles land before the timed window, not inside it
-        engine = registry.check_engine()
+        engine = daemon.registry.check_engine()
         engine.check_batch(queries[:1])
         engine.check_batch(queries[: min(SERVE_THREADS + 1, len(queries))])
         warm = ReadClient(open_channel(addr))
@@ -628,6 +635,25 @@ def bench_served(namespaces, tuples, queries) -> dict:
     finally:
         daemon.stop()
 
+    # asyncio plane (serve.read.grpc.aio): same workload, the no-handoff
+    # server architecture — recorded beside the threaded number
+    aio = None
+    try:
+        daemon = make_daemon(aio=True)
+        try:
+            addr = f"127.0.0.1:{daemon.read_grpc_port}"
+            engine = daemon.registry.check_engine()
+            engine.check_batch(queries[:1])
+            engine.check_batch(queries[: min(SERVE_THREADS + 1, len(queries))])
+            warm = ReadClient(open_channel(addr))
+            warm.check(queries[0], timeout=300)
+            warm.close()
+            aio = load_phase(SERVE_THREADS, SERVE_SECONDS / 2)
+        finally:
+            daemon.stop()
+    except Exception as e:  # the aio leg must never sink the bench line
+        aio = {"error": f"{type(e).__name__}: {e}"}
+
     out = {"host_cores": len(_os.sched_getaffinity(0))}
     # each phase reports independently: a wedge between phases must not
     # discard the completed phase's measurement
@@ -648,6 +674,12 @@ def bench_served(namespaces, tuples, queries) -> dict:
         "served_p99_ms": high["p99_ms"],
         "served_errors": high["errors"],
     })
+    if aio is not None:
+        if "error" in aio:
+            out["served_aio_error"] = aio["error"]
+        else:
+            out["served_aio_qps"] = aio["qps"]
+            out["served_aio_p95_ms"] = aio["p95_ms"]
     out.update(bench_grpc_echo_ceiling())
     if out.get("echo_ceiling_qps"):
         out["served_vs_echo_ceiling"] = round(
