@@ -41,6 +41,15 @@ The merge returns None (caller falls back to full rebuild) when the ops
 batch is too large a fraction of the graph, the hash tables would pass
 MAX_LOAD occupancy, probing would exceed MAX_PROBES, or accumulated CSR
 garbage passes GARBAGE_FRACTION.
+
+Future work (remote devices): after a merge the engine re-uploads the
+full table set; the deltas are actually tiny (op slots in the hash
+tables + the CSR tail), so a jitted device-side scatter
+(`dh_pack.at[slots].set(rows)`) could cut the post-merge upload from
+O(tables) to O(ops) — it needs headroom-padded edge arrays so the CSR
+tail append keeps shapes static, and slot tracking through
+_hash_insert. Worth it once write-churn-under-tunnel shows up in a
+profile; the host-side merge (this module) is the part that was minutes.
 """
 
 from __future__ import annotations
