@@ -45,10 +45,17 @@ NORTH_STAR_QPS = 1_000_000.0
 N_FOLDERS = 64
 FILES_PER_FOLDER = 120
 N_USERS = 512
-# KETO_BENCH_BATCH: RTT-amortization knob for tunneled devices — with
-# per-dispatch round-trips dominating (TUNNEL_r04 model), a bigger batch
-# spreads the fixed cost over more checks (device step cost scales with
-# the frontier, so this trades latency for throughput explicitly)
+# KETO_BENCH_BATCH: launch-amortization knob for tunneled devices — the
+# TUNNEL_r04 model puts ~70-80ms of FIXED cost on every kernel launch
+# through the axon tunnel regardless of batch size (B=1024 and B=16384
+# both ~80ms pipelined), so a bigger batch spreads that cost over more
+# checks. Measured sweep on the real chip: 4096 -> 52.7k/s, 16384 ->
+# 155.7k/s, 65536 -> 144.6k/s (compute starts to dominate past ~16k).
+# Unset, bench.main() picks the batch per platform: 16384 on tpu, 4096
+# on cpu (where there is no launch cost to amortize and big batches only
+# add latency). Importers that never run main() (microbench_tunnel,
+# profile_kernel) see the 4096 default.
+_BATCH_FROM_ENV = "KETO_BENCH_BATCH" in os.environ
 BATCH = int(os.environ.get("KETO_BENCH_BATCH", 4096))
 ROUNDS = 20
 
@@ -700,6 +707,20 @@ def main() -> int:
     ap.add_argument("--skip-serve", action="store_true")
     args = ap.parse_args()
 
+    platform = args.platform
+    tpu_error = None
+    if platform == "auto":
+        ok, diag = probe_tpu(args.probe_timeout, args.probe_attempts)
+        if ok:
+            platform = "tpu"
+        else:
+            platform = "cpu"
+            tpu_error = diag
+
+    global BATCH
+    if not _BATCH_FROM_ENV and platform == "tpu":
+        BATCH = 16384
+
     record: dict = {
         "metric": "batched_check_qps",
         "value": 0.0,
@@ -707,15 +728,8 @@ def main() -> int:
         "vs_baseline": 0.0,
         "batch": BATCH,
     }
-
-    platform = args.platform
-    if platform == "auto":
-        ok, diag = probe_tpu(args.probe_timeout, args.probe_attempts)
-        if ok:
-            platform = "tpu"
-        else:
-            platform = "cpu"
-            record["tpu_error"] = diag
+    if tpu_error is not None:
+        record["tpu_error"] = tpu_error
     try:
         if platform == "cpu":
             # the container sitecustomize force-selects the axon TPU plugin

@@ -11,7 +11,9 @@ mid-step wedge cannot take the rest of the session down:
   3. profile_kernel.py   -> TPU_PROFILE_r04.json (per-phase steady state)
   4. scale_bench 1e6     -> TPU_SCALE_r04.json   (table-size scaling on chip)
 
-Usage:  python tools/tpu_session.py [--skip-scale]
+Usage:  python tools/tpu_session.py [--skip-scale] [--skip-profile]
+(--skip-profile drops step 3 — the one step that has wedged the tunnel
+before — so fragile-window sessions can bank steps 1-2 first)
 Prints one JSON status line per step; exits 0 iff step 1 succeeded.
 """
 
@@ -63,6 +65,13 @@ def run_step(name: str, argv: list[str], out_path: str | None,
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--skip-scale", action="store_true")
+    ap.add_argument(
+        "--skip-profile", action="store_true",
+        help="skip profile_kernel.py (the one step that has wedged the "
+        "tunnel before); re-run the session without this flag — or "
+        "tools/profile_kernel.py directly — once the higher-value "
+        "artifacts are safely captured",
+    )
     ap.add_argument("--probe-timeout", type=float, default=120.0)
     ap.add_argument(
         "--probe-only", action="store_true",
@@ -108,10 +117,11 @@ def main() -> int:
              "--out", "/tmp/keto_1e8_shards"],
             "SCALE_1e8_TPU_r04.json", 1800,
         ))
-    steps.append(
-        ("profile", [sys.executable, "tools/profile_kernel.py"],
-         "TPU_PROFILE_r04.json", 1200),
-    )
+    if not args.skip_profile:
+        steps.append(
+            ("profile", [sys.executable, "tools/profile_kernel.py"],
+             "TPU_PROFILE_r04.json", 1200),
+        )
     if not args.skip_scale:
         steps.append((
             "scale-1e6",
