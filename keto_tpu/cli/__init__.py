@@ -143,20 +143,39 @@ def cmd_serve(args) -> int:
     return 0
 
 
-def cmd_migrate(args) -> int:
-    from ..config import Config
-    from ..storage.sqlite import SQLitePersister
+def _migration_persister(config):
+    """The store behind the migration box for this config's DSN, or None
+    for the ephemeral stores (memory / columnar) that have none. Any SQL
+    DSN — sqlite path or postgres/cockroach/mysql URL — routes through
+    the dialect layer; a missing network driver fails loudly with the
+    driver named (storage/dialect.py)."""
+    from ..storage.sqlite import SQLPersister
 
-    config = Config.from_file(args.config) if args.config else Config()
     dsn = config.dsn
-    if not dsn.startswith("sqlite://"):
-        print(f"dsn {dsn!r} needs no migrations")
-        return 0
-    p = SQLitePersister(
-        dsn.removeprefix("sqlite://"),
+    if dsn in ("memory", ":memory:", "columnar"):
+        return None
+    if dsn.startswith("sqlite://"):
+        dsn = dsn.removeprefix("sqlite://")
+    elif "://" not in dsn:
+        # same contract as the registry: a bare string is a typo
+        # ('Memory') — raising beats creating and migrating a stray
+        # sqlite file the serve command will then refuse to open
+        raise CLIError(f"unsupported DSN: {dsn!r}")
+    return SQLPersister(
+        dsn,
         auto_migrate=False,
         legacy_namespaces=config.legacy_namespace_ids(),
     )
+
+
+def cmd_migrate(args) -> int:
+    from ..config import Config
+
+    config = Config.from_file(args.config) if args.config else Config()
+    p = _migration_persister(config)
+    if p is None:
+        print(f"dsn {config.dsn!r} needs no migrations")
+        return 0
     if args.action == "status":
         for name, status in p.migration_status():
             print(f"{status:10s} {name}")
@@ -193,7 +212,6 @@ def cmd_namespace_migrate(args) -> int:
     into the global migration box; so do we, but `status` still
     reports per-namespace legacy rows and `up` runs the box)."""
     from ..config import Config
-    from ..storage.sqlite import SQLitePersister
 
     config = Config.from_file(args.config) if args.config else Config()
     ns = next(
@@ -202,22 +220,17 @@ def cmd_namespace_migrate(args) -> int:
     )
     if ns is None:
         raise CLIError(f"unknown namespace {args.namespace!r} (not in config)")
-    dsn = config.dsn
-    if not dsn.startswith("sqlite://"):
+    p = _migration_persister(config)
+    if p is None:
         # same exit-0 contract as the global `migrate` command (and the
         # reference's deprecated no-ops): nothing-to-migrate is success
         _print_formatted(
             args,
             {"namespace": args.namespace, "migrated_rows": 0,
-             "detail": f"dsn {dsn!r} needs no migrations"},
-            f"dsn {dsn!r} needs no migrations",
+             "detail": f"dsn {config.dsn!r} needs no migrations"},
+            f"dsn {config.dsn!r} needs no migrations",
         )
         return 0
-    p = SQLitePersister(
-        dsn.removeprefix("sqlite://"),
-        auto_migrate=False,
-        legacy_namespaces=config.legacy_namespace_ids(),
-    )
     try:
         box = dict(p.migration_status())
         data_status = box.get("20220513200400_migrate_strings_to_uuids", "Pending")

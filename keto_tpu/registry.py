@@ -155,7 +155,20 @@ class Registry:
                         dsn.removeprefix("sqlite://"),
                         legacy_namespaces=self.config.legacy_namespace_ids(),
                     )
+                elif "://" in dsn:
+                    # postgres:// | cockroach:// | mysql:// route through
+                    # the dialect layer (storage/dialect.py); an unknown
+                    # scheme or a missing driver raises with the reason
+                    from .storage.sqlite import SQLPersister
+
+                    self._manager = SQLPersister(
+                        dsn,
+                        legacy_namespaces=self.config.legacy_namespace_ids(),
+                    )
                 else:
+                    # a bare string here is a typo ('Memory', 'colummnar')
+                    # — failing startup beats silently serving an empty
+                    # store out of a freshly created sqlite file
                     raise ValueError(f"unsupported DSN: {dsn!r}")
                 # span-per-store-op when tracing (ref: otel spans in every
                 # persister method, relationtuples.go:203-205)

@@ -1,13 +1,20 @@
 from .definitions import Manager, DEFAULT_PAGE_SIZE
+from .dialect import DIALECTS, Dialect, StoreDriverMissing, dialect_for_dsn
 from .memory import MemoryManager
-from .sqlite import SQLitePersister
+from .sqlite import SQLPersister, SQLitePersister, render_migrations
 from .mapping import UUIDMappingManager, Mapper
 
 __all__ = [
     "Manager",
     "MemoryManager",
+    "SQLPersister",
     "SQLitePersister",
     "UUIDMappingManager",
     "Mapper",
     "DEFAULT_PAGE_SIZE",
+    "DIALECTS",
+    "Dialect",
+    "StoreDriverMissing",
+    "dialect_for_dsn",
+    "render_migrations",
 ]

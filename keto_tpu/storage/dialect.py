@@ -1,0 +1,395 @@
+"""SQL dialect layer: one logical schema, four renderings.
+
+The reference ships every migration four times — hand-written
+`*.{sqlite3,postgres,mysql,cockroach}.{up,down}.sql` files
+(internal/persistence/sql/migrations/sql/) — and routes a DSN to a
+driver + dialect pair in internal/x/dbx/dsn_testutils.go:106-151. Here
+the schema is written ONCE as templates (storage/sqlite.py MIGRATION
+_TEMPLATES) and each `Dialect` renders the DDL and the handful of
+non-portable runtime statements (insert-or-ignore, version upsert,
+aliased delete, table-exists probe, autoincrement, epoch defaults,
+partial indexes) for its engine. Differences mirror the reference's own
+per-dialect files, e.g. the mysql rendering drops partial-index WHERE
+clauses and uses CHAR(36)/VARCHAR types exactly like
+20220513200300000000_create-intermediary-uuid-table.mysql.up.sql
+("mysql has no partial indexes so we can only use the full one").
+
+Only the sqlite dialect can be driven live in this environment (the
+postgres/mysql drivers are not installed); the other three are covered
+by golden SQL-shape tests (tests/test_dialect.py) and fail loudly at
+connect() time with the missing driver named. The TPU framing is
+unchanged: whichever dialect persists the tuples, the device snapshot is
+built from the same columnar ingest surface.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Sequence
+
+__all__ = [
+    "Dialect",
+    "SQLiteDialect",
+    "PostgresDialect",
+    "CockroachDialect",
+    "MySQLDialect",
+    "DIALECTS",
+    "dialect_for_dsn",
+    "StoreDriverMissing",
+]
+
+
+class StoreDriverMissing(RuntimeError):
+    """A DSN named an engine whose Python driver is not installed."""
+
+
+# {partial:WHERE ...} — kept verbatim by dialects with partial-index
+# support, dropped by the ones without (mysql), like the reference's
+# divergent index DDL per dialect
+_PARTIAL_RE = re.compile(r"\{partial:([^{}]*)\}", re.S)
+
+
+class Dialect:
+    """Fragments + statement shapes one SQL engine needs. Subclasses
+    override only what diverges; the canonical statement text in the
+    persister is written in qmark style and `prep()`ed per driver."""
+
+    name = "sqlite3"
+    #: DB-API placeholder the driver expects ("?" qmark / "%s" format)
+    placeholder = "?"
+    supports_partial_indexes = True
+    #: template fragments (see storage/sqlite.py MIGRATION_TEMPLATES)
+    fragments = {
+        "uuid_t": "TEXT",        # uuid-encoded columns (object, subject_id …)
+        "nid_t": "TEXT",         # network ids: arbitrary strings ("default")
+        "ns_t": "TEXT",          # namespace names (reference: VARCHAR(200))
+        "rel_t": "TEXT",         # relation names (reference: VARCHAR(64))
+        "obj_t": "TEXT",         # legacy-table string objects
+        "op_t": "TEXT",          # change-log op tags ('insert' / 'delete')
+        "ver_t": "TEXT",         # migration version keys
+        "text_t": "TEXT",        # unbounded strings (mapping values, log rows)
+        "float_t": "REAL",
+        "epoch_default": "DEFAULT (strftime('%s','now'))",
+        "autoinc_pk": "INTEGER PRIMARY KEY AUTOINCREMENT",
+    }
+
+    # -- statement rendering ---------------------------------------------------
+
+    def render(self, template: str) -> str:
+        """Render one migration-template statement for this engine."""
+        sql = _PARTIAL_RE.sub(
+            (lambda m: m.group(1)) if self.supports_partial_indexes
+            else (lambda m: ""),
+            template,
+        )
+        return sql.format(**self.fragments)
+
+    def prep(self, sql: str) -> str:
+        """Canonical qmark statement -> this driver's paramstyle. (No
+        statement in the persister carries a literal '?' or '%'.)"""
+        if self.placeholder == "?":
+            return sql
+        return sql.replace("?", self.placeholder)
+
+    def insert_ignore(self, table: str, cols: Sequence[str]) -> str:
+        """Idempotent insert: duplicate-key rows are silently skipped
+        (uuid_mapping.go:31-66 relies on this for mapping writes)."""
+        ph = ", ".join("?" * len(cols))
+        return (
+            f"INSERT INTO {table} ({', '.join(cols)}) VALUES ({ph})"
+            " ON CONFLICT DO NOTHING"
+        )
+
+    def version_upsert(self, table: str = "keto_store_version") -> str:
+        """Insert-or-increment of the per-nid write counter."""
+        return (
+            f"INSERT INTO {table} (nid, version) VALUES (?, 1)"
+            " ON CONFLICT(nid) DO UPDATE SET version = version + 1"
+        )
+
+    def delete_aliased(self, table: str, alias: str, where: str) -> str:
+        """DELETE with an alias usable inside `where` (the query builder
+        qualifies every column with the alias)."""
+        return f"DELETE FROM {table} AS {alias} WHERE {where}"
+
+    def table_exists_sql(self) -> str:
+        """One-param probe: does a table with this name exist?"""
+        return (
+            "SELECT 1 FROM sqlite_master WHERE type='table' AND name = ?"
+        )
+
+    # -- connection ------------------------------------------------------------
+
+    #: When set, connections run in driver autocommit mode and the
+    #: persister's write transactions are bracketed with this explicit
+    #: BEGIN (committed/rolled back with COMMIT/ROLLBACK statements).
+    #: Keeps read-only statements from pinning a server transaction
+    #: open — a postgres replica that only ever SELECTs must not sit
+    #: "idle in transaction" blocking VACUUM/DDL. None = the driver's
+    #: native transaction handling (sqlite).
+    txn_begin: str | None = "BEGIN"
+
+    def connect(self, dsn: str):
+        raise NotImplementedError
+
+    def on_connect(self, conn) -> None:
+        """Per-connection session setup (pragmas / session vars)."""
+
+    def is_transient(self, err: Exception) -> bool:
+        """Should the connect backoff retry this error?"""
+        msg = str(err).lower()
+        return "locked" in msg or "busy" in msg
+
+
+class SQLiteDialect(Dialect):
+    txn_begin = None  # sqlite3's native deferred transactions
+
+    def insert_ignore(self, table: str, cols: Sequence[str]) -> str:
+        # sqlite's ON CONFLICT DO NOTHING exists but OR IGNORE also
+        # covers CHECK-constraint races and predates it; keep the
+        # battle-tested spelling
+        ph = ", ".join("?" * len(cols))
+        return f"INSERT OR IGNORE INTO {table} ({', '.join(cols)}) VALUES ({ph})"
+
+    def connect(self, dsn: str):
+        import sqlite3
+
+        path = ":memory:" if dsn in ("memory", ":memory:") else dsn
+        conn = sqlite3.connect(path, check_same_thread=False)
+        try:
+            # probe like the reference's conn.Open + ping: a locked or
+            # corrupt file fails here, not at first use
+            conn.execute("SELECT 1").fetchone()
+        except Exception:
+            conn.close()
+            raise
+        return conn
+
+    def on_connect(self, conn) -> None:
+        conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("PRAGMA foreign_keys=ON")
+
+
+class PostgresDialect(Dialect):
+    name = "postgres"
+    placeholder = "%s"
+    fragments = {
+        **Dialect.fragments,
+        "uuid_t": "UUID",
+        "nid_t": "VARCHAR(64)",
+        "ns_t": "VARCHAR(200)",
+        "rel_t": "VARCHAR(64)",
+        "obj_t": "VARCHAR(255)",
+        "op_t": "VARCHAR(16)",
+        "ver_t": "VARCHAR(255)",
+        "float_t": "DOUBLE PRECISION",
+        "epoch_default": "DEFAULT (extract(epoch from now()))",
+        "autoinc_pk": "BIGSERIAL PRIMARY KEY",
+    }
+
+    def version_upsert(self, table: str = "keto_store_version") -> str:
+        # postgres resolves the bare column to the excluded row inside
+        # DO UPDATE, so the increment must name the table
+        return (
+            f"INSERT INTO {table} (nid, version) VALUES (?, 1)"
+            f" ON CONFLICT(nid) DO UPDATE SET version = {table}.version + 1"
+        )
+
+    def table_exists_sql(self) -> str:
+        return (
+            "SELECT 1 FROM information_schema.tables"
+            " WHERE table_schema = current_schema() AND table_name = ?"
+        )
+
+    def connect(self, dsn: str):
+        try:
+            import psycopg2
+        except ImportError as e:
+            raise StoreDriverMissing(
+                f"DSN {dsn!r} needs the 'psycopg2' driver, which is not"
+                " installed in this environment; use a sqlite:// or"
+                " memory DSN, or install the driver"
+            ) from e
+        return psycopg2.connect(dsn)
+
+    def on_connect(self, conn) -> None:
+        # autocommit + explicit BEGIN (txn_begin): reads must not pin a
+        # server transaction open (idle-in-transaction blocks VACUUM)
+        conn.autocommit = True
+
+    def is_transient(self, err: Exception) -> bool:
+        msg = str(err).lower()
+        # libpq >= 14 prefixes EVERY connect failure with "connection to
+        # server at … failed: <cause>", including permanent ones —
+        # classify by cause, permanent first (retrying a bad password
+        # for 60s hammers auth and can trip server-side lockout)
+        if (
+            "password authentication failed" in msg
+            or "no pg_hba.conf entry" in msg
+            or "does not exist" in msg  # unknown database / role
+        ):
+            return False
+        return (
+            "could not connect" in msg  # libpq < 14 wording
+            or "connection refused" in msg
+            or "timeout expired" in msg
+            or "starting up" in msg  # recovery mode during failover
+            or "too many clients" in msg
+        )
+
+
+class CockroachDialect(PostgresDialect):
+    """CockroachDB speaks the postgres wire protocol + SQL surface; the
+    reference's cockroach migration files differ from postgres only in
+    type spellings that cockroach also accepts. SERIAL maps to
+    unique_rowid() ids, which our change-log consumer only requires to
+    be monotone per insert batch — the same property the reference's
+    cockroach rendering relies on."""
+
+    name = "cockroach"
+    fragments = {
+        **PostgresDialect.fragments,
+        "autoinc_pk": "SERIAL PRIMARY KEY",
+    }
+
+    def connect(self, dsn: str):
+        # cockroach:// is a routing scheme, not a wire scheme
+        return super().connect(
+            re.sub(r"^cockroach(db)?://", "postgres://", dsn)
+        )
+
+
+class MySQLDialect(Dialect):
+    name = "mysql"
+    placeholder = "%s"
+    supports_partial_indexes = False  # the reference's mysql DDL comment
+    fragments = {
+        **Dialect.fragments,
+        # TEXT cannot be a MySQL PK/index key without a prefix length,
+        # so every indexed column gets a bounded type (the reference's
+        # mysql DDL makes the same choice: CHAR(36)/VARCHAR columns);
+        # mapping values and log payloads stay TEXT (never indexed)
+        "uuid_t": "CHAR(36)",
+        "nid_t": "VARCHAR(64)",
+        "ns_t": "VARCHAR(200)",
+        "rel_t": "VARCHAR(64)",
+        "obj_t": "VARCHAR(255)",
+        "op_t": "VARCHAR(16)",
+        "ver_t": "VARCHAR(255)",
+        "float_t": "DOUBLE",
+        "epoch_default": "DEFAULT (unix_timestamp())",
+        "autoinc_pk": "BIGINT NOT NULL AUTO_INCREMENT PRIMARY KEY",
+    }
+
+    def render(self, template: str) -> str:
+        # MySQL (unlike MariaDB/Postgres/SQLite) rejects IF NOT EXISTS
+        # on CREATE INDEX (syntax error 1064). Strip it; index creation
+        # idempotency then rests on the migration box's version guard —
+        # only a crash BETWEEN an index statement and the version row
+        # re-runs one, and that re-run fails loudly (1061) instead of
+        # corrupting anything.
+        sql = super().render(template)
+        return re.sub(r"(CREATE INDEX)\s+IF NOT EXISTS", r"\1", sql)
+
+    def insert_ignore(self, table: str, cols: Sequence[str]) -> str:
+        ph = ", ".join("?" * len(cols))
+        return f"INSERT IGNORE INTO {table} ({', '.join(cols)}) VALUES ({ph})"
+
+    def version_upsert(self, table: str = "keto_store_version") -> str:
+        return (
+            f"INSERT INTO {table} (nid, version) VALUES (?, 1)"
+            " ON DUPLICATE KEY UPDATE version = version + 1"
+        )
+
+    def delete_aliased(self, table: str, alias: str, where: str) -> str:
+        # mysql's multi-table DELETE form is the only one that accepts
+        # an alias: DELETE t FROM tbl AS t WHERE …
+        return f"DELETE {alias} FROM {table} AS {alias} WHERE {where}"
+
+    def table_exists_sql(self) -> str:
+        return (
+            "SELECT 1 FROM information_schema.tables"
+            " WHERE table_schema = database() AND table_name = ?"
+        )
+
+    #: DSN query keys forwarded to pymysql.connect — anything else is a
+    #: loud error, never a silently-dropped option (an ignored ssl=true
+    #: would downgrade the connection without a trace)
+    _QUERY_KEYS = {
+        "charset": str,
+        "connect_timeout": int,
+        "read_timeout": int,
+        "write_timeout": int,
+    }
+
+    def connect(self, dsn: str):
+        try:
+            import pymysql
+        except ImportError as e:
+            raise StoreDriverMissing(
+                f"DSN {dsn!r} needs the 'pymysql' driver, which is not"
+                " installed in this environment; use a sqlite:// or"
+                " memory DSN, or install the driver"
+            ) from e
+        from urllib.parse import parse_qsl, unquote, urlparse
+
+        u = urlparse(dsn)
+        kwargs: dict = {}
+        for key, value in parse_qsl(u.query):
+            if key in ("ssl", "tls"):
+                kwargs["ssl"] = (
+                    {} if value.lower() in ("true", "1", "on") else None
+                )
+            elif key in self._QUERY_KEYS:
+                kwargs[key] = self._QUERY_KEYS[key](value)
+            else:
+                raise ValueError(
+                    f"unsupported mysql DSN option {key!r} in {dsn!r}"
+                )
+        # urlparse does NOT percent-decode userinfo; a password holding
+        # '@' / ':' / '/' can only be written percent-encoded in a DSN
+        # (psycopg2 decodes its own DSNs — here we parse, so we decode)
+        conn = pymysql.connect(
+            host=u.hostname or "localhost",
+            port=u.port or 3306,
+            user=unquote(u.username or ""),
+            password=unquote(u.password or ""),
+            database=unquote(u.path.lstrip("/")),
+            **kwargs,
+        )
+        return conn
+
+    def on_connect(self, conn) -> None:
+        conn.autocommit(True)  # see Dialect.txn_begin
+
+    def is_transient(self, err: Exception) -> bool:
+        msg = str(err).lower()
+        return "can't connect" in msg or "too many connections" in msg
+
+
+DIALECTS: dict[str, Dialect] = {
+    "sqlite": SQLiteDialect(),
+    "postgres": PostgresDialect(),
+    "postgresql": PostgresDialect(),
+    "cockroach": CockroachDialect(),
+    "cockroachdb": CockroachDialect(),
+    "mysql": MySQLDialect(),
+}
+
+
+def dialect_for_dsn(dsn: str) -> tuple[Dialect, str]:
+    """DSN -> (dialect, driver-facing dsn). Mirrors the reference's
+    scheme routing (dbx.GetDriverName): sqlite:// strips to a path,
+    memory routes to in-process sqlite, network engines keep the full
+    URL for their driver."""
+    if dsn in ("memory", ":memory:"):
+        return DIALECTS["sqlite"], ":memory:"
+    scheme, sep, rest = dsn.partition("://")
+    if not sep:  # bare filesystem path
+        return DIALECTS["sqlite"], dsn
+    d = DIALECTS.get(scheme)
+    if d is None:
+        raise ValueError(f"unsupported DSN scheme: {dsn!r}")
+    if isinstance(d, SQLiteDialect):
+        return d, rest
+    return d, dsn

@@ -18,12 +18,20 @@ popx (internal/driver/registry_default.go:194-217, cmd/migrate).
 The persister speaks the public string Manager protocol; UUID encoding is
 internal, with JOINs against the mapping table on read — the same
 traffic shape as the reference's Mapper-wrapped SQL store.
+
+The schema below is written ONCE as dialect templates (the reference
+hand-writes each migration four times, one per SQL engine — see
+storage/dialect.py); `MIGRATIONS` is the sqlite rendering, and
+`render_migrations(dialect)` produces the postgres / cockroach / mysql
+DDL. `SQLPersister` runs against any of the four dialects; only sqlite
+has a live driver in this environment, so `SQLitePersister` is the
+live-tested configuration and the rest are golden-SQL-tested
+(tests/test_dialect.py).
 """
 
 from __future__ import annotations
 
 import json
-import sqlite3
 import threading
 import uuid
 from typing import Iterable, Sequence
@@ -36,15 +44,17 @@ from .definitions import (
     shard_id,
     validate_page_token,
 )
+from .dialect import Dialect, SQLiteDialect, dialect_for_dsn
 from .mapping import map_string_to_uuid
 
 # each migration is (version, up_steps, down_steps); every step is
 # IDEMPOTENT (IF [NOT] EXISTS / idempotent inserts) so a run interrupted
 # mid-version converges on retry; a step is either a
-# SQL string or the registered name of a Python data migration — the
-# reference's popx.WithGoMigrations data migrations
+# SQL *template* (rendered per dialect — storage/dialect.py) or the
+# registered name of a Python data migration — the reference's
+# popx.WithGoMigrations data migrations
 # (internal/persistence/sql/migrations/uuidmapping/uuid_mapping_migrator.go)
-MIGRATIONS: list[tuple[str, list, list]] = [
+MIGRATION_TEMPLATES: list[tuple[str, list, list]] = [
     (
         "20210623162417_create_legacy_relation_tuples",
         [
@@ -53,16 +63,16 @@ MIGRATIONS: list[tuple[str, list, list]] = [
             # — kept so pre-UUID databases can data-migrate forward
             """
             CREATE TABLE IF NOT EXISTS keto_relation_tuples (
-                shard_id TEXT NOT NULL,
-                nid TEXT NOT NULL,
+                shard_id {uuid_t} NOT NULL,
+                nid {nid_t} NOT NULL,
                 namespace_id INTEGER NOT NULL,
-                object TEXT NOT NULL,
-                relation TEXT NOT NULL,
-                subject_id TEXT NULL,
+                object {obj_t} NOT NULL,
+                relation {rel_t} NOT NULL,
+                subject_id {obj_t} NULL,
                 subject_set_namespace_id INTEGER NULL,
-                subject_set_object TEXT NULL,
-                subject_set_relation TEXT NULL,
-                commit_time REAL NOT NULL DEFAULT (strftime('%s','now')),
+                subject_set_object {obj_t} NULL,
+                subject_set_relation {rel_t} NULL,
+                commit_time {float_t} NOT NULL {epoch_default},
                 PRIMARY KEY (shard_id, nid),
                 CONSTRAINT chk_keto_rt_subject_type CHECK
                     ((subject_id IS NULL AND subject_set_namespace_id IS NOT NULL
@@ -87,9 +97,9 @@ MIGRATIONS: list[tuple[str, list, list]] = [
             # string disclosure.
             """
             CREATE TABLE IF NOT EXISTS keto_uuid_mappings (
-                id TEXT NOT NULL,
-                nid TEXT NOT NULL,
-                string_representation TEXT NOT NULL,
+                id {uuid_t} NOT NULL,
+                nid {nid_t} NOT NULL,
+                string_representation {text_t} NOT NULL,
                 PRIMARY KEY (id, nid)
             )
             """
@@ -101,7 +111,7 @@ MIGRATIONS: list[tuple[str, list, list]] = [
         [
             """
             CREATE TABLE IF NOT EXISTS keto_store_version (
-                nid TEXT PRIMARY KEY,
+                nid {nid_t} PRIMARY KEY,
                 version INTEGER NOT NULL DEFAULT 0
             )
             """
@@ -116,11 +126,11 @@ MIGRATIONS: list[tuple[str, list, list]] = [
             # equivalent — Keto replicas re-read SQL on every query
             """
             CREATE TABLE IF NOT EXISTS keto_change_log (
-                seq INTEGER PRIMARY KEY AUTOINCREMENT,
-                nid TEXT NOT NULL,
+                seq {autoinc_pk},
+                nid {nid_t} NOT NULL,
                 version INTEGER NOT NULL,
-                op TEXT NOT NULL,
-                tuple TEXT NOT NULL
+                op {op_t} NOT NULL,
+                tuple {text_t} NOT NULL
             )
             """,
             """
@@ -135,16 +145,16 @@ MIGRATIONS: list[tuple[str, list, list]] = [
         [
             """
             CREATE TABLE IF NOT EXISTS keto_relation_tuples_uuid (
-                shard_id TEXT NOT NULL,
-                nid TEXT NOT NULL,
-                namespace TEXT NOT NULL,
-                object TEXT NOT NULL,
-                relation TEXT NOT NULL,
-                subject_id TEXT NULL,
-                subject_set_namespace TEXT NULL,
-                subject_set_object TEXT NULL,
-                subject_set_relation TEXT NULL,
-                commit_time REAL NOT NULL DEFAULT (strftime('%s','now')),
+                shard_id {uuid_t} NOT NULL,
+                nid {nid_t} NOT NULL,
+                namespace {ns_t} NOT NULL,
+                object {uuid_t} NOT NULL,
+                relation {rel_t} NOT NULL,
+                subject_id {uuid_t} NULL,
+                subject_set_namespace {ns_t} NULL,
+                subject_set_object {uuid_t} NULL,
+                subject_set_relation {rel_t} NULL,
+                commit_time {float_t} NOT NULL {epoch_default},
                 PRIMARY KEY (shard_id, nid),
                 CHECK (
                     (subject_id IS NOT NULL AND subject_set_namespace IS NULL
@@ -162,13 +172,13 @@ MIGRATIONS: list[tuple[str, list, list]] = [
             """
             CREATE INDEX IF NOT EXISTS keto_relation_tuples_uuid_reverse_subject_ids_idx
                 ON keto_relation_tuples_uuid (nid, subject_id, relation, namespace)
-                WHERE subject_id IS NOT NULL
+                {partial:WHERE subject_id IS NOT NULL}
             """,
             """
             CREATE INDEX IF NOT EXISTS keto_relation_tuples_uuid_reverse_subject_sets_idx
                 ON keto_relation_tuples_uuid
                    (nid, subject_set_namespace, subject_set_object, subject_set_relation)
-                WHERE subject_set_namespace IS NOT NULL
+                {partial:WHERE subject_set_namespace IS NOT NULL}
             """,
         ],
         ["DROP TABLE IF EXISTS keto_relation_tuples_uuid"],
@@ -192,6 +202,25 @@ MIGRATIONS: list[tuple[str, list, list]] = [
 ]
 
 
+def render_migrations(dialect: Dialect) -> list[tuple[str, list, list]]:
+    """The migration box rendered for one SQL engine — the analog of the
+    reference's four hand-written per-dialect migration files
+    (internal/persistence/sql/migrations/sql/*.{sqlite3,postgres,mysql,
+    cockroach}.*.sql), generated from one set of templates instead.
+    Data-migration markers (``__…__``) pass through unrendered."""
+    def r(steps: list) -> list:
+        return [
+            s if s.startswith("__") else dialect.render(s) for s in steps
+        ]
+
+    return [(v, r(ups), r(downs)) for v, ups, downs in MIGRATION_TEMPLATES]
+
+
+# the live (sqlite) rendering — what this environment executes; tests and
+# the migration box run these statements directly
+MIGRATIONS: list[tuple[str, list, list]] = render_migrations(SQLiteDialect())
+
+
 def _migrate_strings_to_uuids(persister) -> None:
     """Data migration: legacy keto_relation_tuples (string object, numeric
     namespace_id) -> keto_relation_tuples_uuid + keto_uuid_mappings.
@@ -203,10 +232,7 @@ def _migrate_strings_to_uuids(persister) -> None:
     numeric ids); unknown ids fail the migration loudly, like the
     reference's namespaceIDtoName error."""
     conn = persister._conn
-    if not conn.execute(
-        "SELECT 1 FROM sqlite_master WHERE type='table'"
-        " AND name='keto_relation_tuples'"
-    ).fetchone():
+    if not persister._table_exists("keto_relation_tuples"):
         return  # post-drop database: nothing left to migrate
     names = persister.legacy_namespaces or {}
     # composite keyset cursor: the legacy PK is (shard_id, nid), so two
@@ -265,7 +291,7 @@ def _recreate_legacy_relation_tuples(persister) -> None:
     """Down-path of the drop: restore the empty legacy schema (the
     reference's drop-old-non-uuid-table.down.sql recreates the table)."""
     ups = next(
-        u for v, u, _ in MIGRATIONS
+        u for v, u, _ in persister._migrations
         if v == "20210623162417_create_legacy_relation_tuples"
     )
     for stmt in ups:
@@ -288,13 +314,72 @@ SELECT t.namespace, mo.string_representation, t.relation,
 """
 
 
-class SQLitePersister:
-    """dsn: a filesystem path, or 'memory' / ':memory:' for in-process."""
+class _PrepConn:
+    """Thin DB-API connection shim: converts the persister's canonical
+    qmark statements to the driver's paramstyle on the way through
+    (identity for sqlite), runs everything through an explicit cursor
+    (sqlite3's conn.execute shortcut is non-standard), and provides a
+    portable transaction context manager (pymysql's connection CM does
+    not commit; psycopg2's does — this one always commit-or-rollbacks)."""
+
+    __slots__ = ("raw", "_d")
+
+    def __init__(self, raw, dialect: Dialect):
+        self.raw = raw
+        self._d = dialect
+
+    def execute(self, sql: str, params: Sequence = ()):
+        cur = self.raw.cursor()
+        cur.execute(self._d.prep(sql), params)
+        return cur
+
+    def executemany(self, sql: str, rows: Sequence):
+        cur = self.raw.cursor()
+        cur.executemany(self._d.prep(sql), rows)
+        return cur
+
+    def commit(self) -> None:
+        self.raw.commit()
+
+    def close(self) -> None:
+        self.raw.close()
+
+    def __enter__(self):
+        # network dialects run autocommit + explicit BEGIN so read-only
+        # statements never pin a server transaction (Dialect.txn_begin);
+        # sqlite keeps its native deferred transactions
+        if self._d.txn_begin is not None:
+            self.raw.cursor().execute(self._d.txn_begin)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._d.txn_begin is not None:
+            # driver commit()/rollback() are no-ops in autocommit mode;
+            # end the explicit transaction with real statements
+            self.raw.cursor().execute(
+                "COMMIT" if exc_type is None else "ROLLBACK"
+            )
+        elif exc_type is None:
+            self.raw.commit()
+        else:
+            self.raw.rollback()
+        return False
+
+
+class SQLPersister:
+    """Dialect-generic durable persister.
+
+    dsn: 'memory' / a filesystem path / sqlite://path (sqlite), or a
+    postgres:// | cockroach:// | mysql:// URL routed to the matching
+    dialect (storage/dialect.py), like the reference's popx DSN routing
+    (internal/x/dbx). Every statement below is canonical qmark SQL or a
+    dialect hook; the schema comes from render_migrations(dialect)."""
 
     # connect backoff mirrors the reference's DB connector resilience
     # (internal/driver/pop_connection.go:40-66: exponential retry, capped
     # total wait): a file DB briefly locked by a sibling process (WAL
-    # checkpoint, backup) must not fail startup
+    # checkpoint, backup) — or a network DB mid-failover — must not fail
+    # startup
     CONNECT_MAX_WAIT = 60.0
     CONNECT_BASE_DELAY = 0.1
 
@@ -303,11 +388,15 @@ class SQLitePersister:
         dsn: str = "memory",
         auto_migrate: bool = True,
         legacy_namespaces: dict | None = None,
+        dialect: Dialect | None = None,
     ):
-        path = ":memory:" if dsn in ("memory", ":memory:") else dsn
-        self._conn = self._connect_with_backoff(path)
-        self._conn.execute("PRAGMA journal_mode=WAL")
-        self._conn.execute("PRAGMA foreign_keys=ON")
+        if dialect is None:
+            dialect, dsn = dialect_for_dsn(dsn)
+        self._d = dialect
+        self._migrations = render_migrations(dialect)
+        raw = self._connect_with_backoff(dsn)
+        dialect.on_connect(raw)
+        self._conn = _PrepConn(raw, dialect)
         self._lock = threading.RLock()
         # numeric namespace-id -> name map for the strings-to-uuids data
         # migration (the reference resolves via namespace.Manager configs)
@@ -315,41 +404,41 @@ class SQLitePersister:
         if auto_migrate:
             self.migrate_up()
 
-    @classmethod
-    def _connect_with_backoff(cls, path: str) -> sqlite3.Connection:
+    def _connect_with_backoff(self, dsn: str):
         import time as _time
 
-        delay = cls.CONNECT_BASE_DELAY
-        deadline = _time.monotonic() + cls.CONNECT_MAX_WAIT
+        delay = self.CONNECT_BASE_DELAY
+        deadline = _time.monotonic() + self.CONNECT_MAX_WAIT
         while True:
-            conn = None
             try:
-                conn = sqlite3.connect(path, check_same_thread=False)
-                # probe the connection like the reference's conn.Open +
-                # ping: a locked/corrupt file fails here, not at first use
-                conn.execute("SELECT 1").fetchone()
-                return conn
-            except sqlite3.OperationalError as err:
-                if conn is not None:
-                    conn.close()
+                return self._d.connect(dsn)
+            except Exception as err:
                 # only TRANSIENT contention retries; a permanent error
-                # (missing directory, permissions) fails startup now
-                msg = str(err).lower()
-                if "locked" not in msg and "busy" not in msg:
+                # (missing directory, permissions, absent driver) fails
+                # startup now
+                if not self._d.is_transient(err):
                     raise
                 if _time.monotonic() + delay > deadline:
                     raise
                 _time.sleep(delay)
                 delay = min(delay * 2, 5.0)
 
+    def _table_exists(self, name: str) -> bool:
+        return (
+            self._conn.execute(self._d.table_exists_sql(), (name,)).fetchone()
+            is not None
+        )
+
     # -- migration box (popx stand-in) ----------------------------------------
 
     def _ensure_migration_table(self) -> None:
         self._conn.execute(
-            """CREATE TABLE IF NOT EXISTS keto_migrations (
-                   version TEXT PRIMARY KEY,
-                   applied_at REAL NOT NULL DEFAULT (strftime('%s','now'))
-               )"""
+            self._d.render(
+                """CREATE TABLE IF NOT EXISTS keto_migrations (
+                       version {ver_t} PRIMARY KEY,
+                       applied_at {float_t} NOT NULL {epoch_default}
+                   )"""
+            )
         )
 
     def migration_status(self) -> list[tuple[str, str]]:
@@ -362,7 +451,7 @@ class SQLitePersister:
             }
         return [
             (version, "Applied" if version in applied else "Pending")
-            for version, _, _ in MIGRATIONS
+            for version, _, _ in self._migrations
         ]
 
     def legacy_row_count(self, namespace_id: int | None = None) -> int:
@@ -370,10 +459,7 @@ class SQLitePersister:
         (optionally for one deprecated numeric namespace id); 0 once the
         drop-legacy migration has run or on a fresh database."""
         with self._lock:
-            if not self._conn.execute(
-                "SELECT 1 FROM sqlite_master WHERE type='table'"
-                " AND name='keto_relation_tuples'"
-            ).fetchone():
+            if not self._table_exists("keto_relation_tuples"):
                 return 0
             if namespace_id is None:
                 (n,) = self._conn.execute(
@@ -394,7 +480,7 @@ class SQLitePersister:
                 row[0]
                 for row in self._conn.execute("SELECT version FROM keto_migrations")
             }
-            for version, ups, _ in MIGRATIONS:
+            for version, ups, _ in self._migrations:
                 if version in applied:
                     continue
                 for stmt in ups:
@@ -417,7 +503,7 @@ class SQLitePersister:
                     "SELECT version FROM keto_migrations ORDER BY version"
                 )
             ]
-            by_version = {v: downs for v, _, downs in MIGRATIONS}
+            by_version = {v: downs for v, _, downs in self._migrations}
             for version in reversed(applied[-steps:] if steps > 0 else []):
                 for stmt in by_version.get(version, []):
                     runner = _DATA_MIGRATIONS.get(stmt)
@@ -441,8 +527,9 @@ class SQLitePersister:
             out[s] = u
             rows.append((u, nid, s))
         self._conn.executemany(
-            "INSERT OR IGNORE INTO keto_uuid_mappings (id, nid, string_representation)"
-            " VALUES (?, ?, ?)",
+            self._d.insert_ignore(
+                "keto_uuid_mappings", ("id", "nid", "string_representation")
+            ),
             rows,
         )
         return out
@@ -606,11 +693,7 @@ class SQLitePersister:
         return row[0] if row else 0
 
     def _bump_version(self, nid: str) -> None:
-        self._conn.execute(
-            "INSERT INTO keto_store_version (nid, version) VALUES (?, 1) "
-            "ON CONFLICT(nid) DO UPDATE SET version = version + 1",
-            (nid,),
-        )
+        self._conn.execute(self._d.version_upsert(), (nid,))
 
     def write_relation_tuples(
         self, tuples: Sequence[RelationTuple], nid: str = DEFAULT_NETWORK
@@ -636,7 +719,8 @@ class SQLitePersister:
                 ).fetchall()
             ]
             cur = self._conn.execute(
-                f"DELETE FROM keto_relation_tuples_uuid AS t WHERE {where}", params
+                self._d.delete_aliased("keto_relation_tuples_uuid", "t", where),
+                params,
             )
             if cur.rowcount:
                 self._bump_version(nid)
@@ -669,19 +753,26 @@ class SQLitePersister:
                 if sid in present:
                     ops.append(("delete", t))
                     present.discard(sid)
-            before = self._conn.total_changes
             self._conn.executemany(
-                "INSERT OR IGNORE INTO keto_relation_tuples_uuid "
-                "(shard_id, nid, namespace, object, relation, subject_id, "
-                " subject_set_namespace, subject_set_object, subject_set_relation) "
-                "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)",
+                self._d.insert_ignore(
+                    "keto_relation_tuples_uuid",
+                    ("shard_id", "nid", "namespace", "object", "relation",
+                     "subject_id", "subject_set_namespace",
+                     "subject_set_object", "subject_set_relation"),
+                ),
                 [self._tuple_row(nid, t, m) for t in insert],
             )
             self._conn.executemany(
                 "DELETE FROM keto_relation_tuples_uuid WHERE shard_id = ? AND nid = ?",
                 [(shard_id(nid, t), nid) for t in delete],
             )
-            if self._conn.total_changes != before:
+            # `ops` — computed above from the pre-probe under the same
+            # lock + transaction — is exactly the set of rows this
+            # transaction really changes, so it is the change signal.
+            # (Driver rowcounts are NOT portable here: psycopg2's
+            # executemany reports only the LAST statement's count, and
+            # sqlite3's total_changes is connection-global.)
+            if ops:
                 self._bump_version(nid)
                 self._log_changes(nid, ops)
 
@@ -713,11 +804,15 @@ class SQLitePersister:
             "INSERT INTO keto_change_log (nid, version, op, tuple) VALUES (?, ?, ?, ?)",
             [(nid, version, op, json.dumps(t.to_dict())) for op, t in ops],
         )
-        # bounded: prune the oldest rows beyond the cap
+        # bounded: prune the oldest rows beyond the cap. The cutoff
+        # subquery is wrapped in a derived table because MySQL rejects a
+        # DELETE whose subquery reads the target table directly (error
+        # 1093); the wrapped form is valid on all four dialects.
         self._conn.execute(
             "DELETE FROM keto_change_log WHERE nid = ? AND seq <= ("
-            "  SELECT seq FROM keto_change_log WHERE nid = ?"
-            "  ORDER BY seq DESC LIMIT 1 OFFSET ?)",
+            "  SELECT cutoff FROM ("
+            "    SELECT seq AS cutoff FROM keto_change_log WHERE nid = ?"
+            "    ORDER BY seq DESC LIMIT 1 OFFSET ?) AS boundary)",
             (nid, nid, self.CHANGE_LOG_CAP),
         )
 
@@ -782,3 +877,27 @@ class SQLitePersister:
 
     def close(self) -> None:
         self._conn.close()
+
+
+class SQLitePersister(SQLPersister):
+    """The live-tested configuration: SQLPersister over the sqlite
+    dialect (dsn: a filesystem path, or 'memory' / ':memory:' for
+    in-process). Kept as its own name because it is the only dialect
+    whose driver ships in this environment, and because callers that
+    mean 'embedded file database' shouldn't depend on DSN routing."""
+
+    def __init__(
+        self,
+        dsn: str = "memory",
+        auto_migrate: bool = True,
+        legacy_namespaces: dict | None = None,
+    ):
+        # 'memory' / ':memory:' normalization lives in
+        # SQLiteDialect.connect, the funnel every sqlite connection
+        # passes through
+        super().__init__(
+            dsn,
+            auto_migrate=auto_migrate,
+            legacy_namespaces=legacy_namespaces,
+            dialect=SQLiteDialect(),
+        )
