@@ -59,7 +59,12 @@ _BATCH_FROM_ENV = "KETO_BENCH_BATCH" in os.environ
 BATCH = int(os.environ.get("KETO_BENCH_BATCH", 4096))
 ROUNDS = 20
 
-SERVE_THREADS = 32
+# KETO_BENCH_SERVE_CLIENTS: concurrent closed-loop clients in the
+# served phase. On a tunneled TPU the served ceiling is in-flight
+# clients / launch-latency (32 clients / 66ms ≈ 480 QPS no matter how
+# well the batcher coalesces), so showing batch amortization there
+# needs more offered load than the 32-client default used on CPU.
+SERVE_THREADS = int(os.environ.get("KETO_BENCH_SERVE_CLIENTS", 32))
 SERVE_SECONDS = 8.0
 
 _PROBE_SCRIPT = (

@@ -154,18 +154,18 @@ def _migration_persister(config):
     dsn = config.dsn
     if dsn in ("memory", ":memory:", "columnar"):
         return None
-    if dsn.startswith("sqlite://"):
-        dsn = dsn.removeprefix("sqlite://")
-    elif "://" not in dsn:
-        # same contract as the registry: a bare string is a typo
-        # ('Memory') — raising beats creating and migrating a stray
-        # sqlite file the serve command will then refuse to open
-        raise CLIError(f"unsupported DSN: {dsn!r}")
-    return SQLPersister(
-        dsn,
-        auto_migrate=False,
-        legacy_namespaces=config.legacy_namespace_ids(),
-    )
+    try:
+        # the strict dialect router classifies the DSN (storage/
+        # dialect.py): sqlite:// paths, network URLs, loud rejection of
+        # bare-string typos ('Memory') — raising beats creating and
+        # migrating a stray sqlite file serve will then refuse to open
+        return SQLPersister(
+            dsn,
+            auto_migrate=False,
+            legacy_namespaces=config.legacy_namespace_ids(),
+        )
+    except ValueError as e:
+        raise CLIError(str(e))
 
 
 def cmd_migrate(args) -> int:

@@ -28,7 +28,6 @@ from .errors import NamespaceNotFoundError
 from .ketoapi import RelationQuery, RelationTuple
 from .storage.definitions import DEFAULT_NETWORK
 from .storage.memory import MemoryManager
-from .storage.sqlite import SQLitePersister
 
 logger = logging.getLogger("keto_tpu")
 
@@ -150,26 +149,19 @@ class Registry:
                     from .storage.columnar import ColumnarStore
 
                     self._manager = ColumnarStore()
-                elif dsn.startswith("sqlite://"):
-                    self._manager = SQLitePersister(
-                        dsn.removeprefix("sqlite://"),
-                        legacy_namespaces=self.config.legacy_namespace_ids(),
-                    )
-                elif "://" in dsn:
-                    # postgres:// | cockroach:// | mysql:// route through
-                    # the dialect layer (storage/dialect.py); an unknown
-                    # scheme or a missing driver raises with the reason
+                else:
+                    # sqlite:// | postgres:// | cockroach:// | mysql://
+                    # route through the STRICT dialect layer
+                    # (storage/dialect.py): an unknown scheme, a missing
+                    # driver, or a bare-string typo ('Memory') raises
+                    # with the reason — failing startup beats silently
+                    # serving an empty store from a fresh sqlite file
                     from .storage.sqlite import SQLPersister
 
                     self._manager = SQLPersister(
                         dsn,
                         legacy_namespaces=self.config.legacy_namespace_ids(),
                     )
-                else:
-                    # a bare string here is a typo ('Memory', 'colummnar')
-                    # — failing startup beats silently serving an empty
-                    # store out of a freshly created sqlite file
-                    raise ValueError(f"unsupported DSN: {dsn!r}")
                 # span-per-store-op when tracing (ref: otel spans in every
                 # persister method, relationtuples.go:203-205)
                 if self.config.get("tracing.enabled", False):

@@ -381,12 +381,19 @@ def dialect_for_dsn(dsn: str) -> tuple[Dialect, str]:
     """DSN -> (dialect, driver-facing dsn). Mirrors the reference's
     scheme routing (dbx.GetDriverName): sqlite:// strips to a path,
     memory routes to in-process sqlite, network engines keep the full
-    URL for their driver."""
+    URL for their driver.
+
+    STRICT — the one place DSN strings are classified (registry and CLI
+    both route through it): a bare string that is not memory/:memory: is
+    rejected as a probable typo ('Memory', 'colummnar') rather than
+    silently treated as a fresh sqlite file path. Callers that mean
+    'embedded file database' say so: sqlite://<path>, or
+    SQLitePersister(path) which binds the dialect explicitly."""
     if dsn in ("memory", ":memory:"):
         return DIALECTS["sqlite"], ":memory:"
     scheme, sep, rest = dsn.partition("://")
-    if not sep:  # bare filesystem path
-        return DIALECTS["sqlite"], dsn
+    if not sep:
+        raise ValueError(f"unsupported DSN: {dsn!r}")
     d = DIALECTS.get(scheme)
     if d is None:
         raise ValueError(f"unsupported DSN scheme: {dsn!r}")

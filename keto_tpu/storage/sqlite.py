@@ -834,7 +834,10 @@ class SQLPersister:
                 return None
             rows = self._conn.execute(
                 "SELECT op, tuple FROM keto_change_log"
-                " WHERE nid = ? AND version > ? ORDER BY seq",
+                # version first: cockroach's SERIAL seq (unique_rowid)
+                # is only monotone within a transaction, and replay must
+                # follow commit order; seq breaks ties inside one version
+                " WHERE nid = ? AND version > ? ORDER BY version, seq",
                 (nid, version),
             ).fetchall()
         return [

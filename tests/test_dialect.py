@@ -187,15 +187,22 @@ class TestStatements:
 
 
 class TestRouting:
-    def test_memory_and_paths_route_to_sqlite(self):
+    def test_memory_and_sqlite_urls_route_to_sqlite(self):
         for dsn, want in [
             ("memory", ":memory:"),
             (":memory:", ":memory:"),
-            ("/tmp/db.sqlite", "/tmp/db.sqlite"),
             ("sqlite:///tmp/db.sqlite", "/tmp/db.sqlite"),
         ]:
             d, out = dialect_for_dsn(dsn)
             assert isinstance(d, SQLiteDialect) and out == want
+
+    def test_bare_strings_rejected_as_typos(self):
+        # 'Memory' / a bare path must not silently become a fresh sqlite
+        # file; file databases are spelled sqlite://<path> (or use
+        # SQLitePersister, which binds the dialect explicitly)
+        for dsn in ("Memory", "colummnar", "/tmp/db.sqlite"):
+            with pytest.raises(ValueError, match="unsupported DSN"):
+                dialect_for_dsn(dsn)
 
     def test_network_schemes_route_and_keep_url(self):
         for scheme, cls in [

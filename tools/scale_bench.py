@@ -197,7 +197,14 @@ def main() -> int:
         # default_mesh truncates to the devices that exist — record and
         # index by the ACTUAL shard count, not the requested one
         record["mesh_devices"] = int(mesh.devices.size)
-    engine = TPUCheckEngine(store, cfg, mesh=mesh)
+    # frontier sized to the query batch like bench.py: the BFS frontier
+    # routinely exceeds B (TTU fan-out), and an overflow silently turns
+    # the whole batch into host-oracle replays — at --batch 16384 the
+    # engine's 1<<14 default measured the HOST, not the chip. The floor
+    # keeps small --batch runs at least at the engine default.
+    engine = TPUCheckEngine(
+        store, cfg, mesh=mesh, frontier_cap=max(1 << 14, 2 * args.batch)
+    )
 
     # snapshot build (timed separately from XLA compile: run a 1-query
     # warm-up AFTER grabbing the build time via _ensure_state)
