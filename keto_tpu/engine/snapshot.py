@@ -417,6 +417,20 @@ def _compose_keys(ns_ids_arr: np.ndarray, objs: np.ndarray) -> np.ndarray:
     )
 
 
+def _sorted_unique_encode(
+    keys: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(sorted uniques, first-occurrence indices, per-row sorted ranks)
+    of a fixed-width S-dtype key array — np.unique + searchsorted
+    semantics, computed by the native hash-dedupe path when available
+    (keto_tpu/native: O(n) dedupe + sort of the uniques only; ~5x over
+    np.unique's whole-array comparison sort, the dominant cost of the
+    1e8 encode phase)."""
+    from ..native import sorted_unique_encode
+
+    return sorted_unique_encode(keys)
+
+
 def _compose_keys_bytes(ns_ids_arr: np.ndarray, objs: np.ndarray) -> np.ndarray:
     """UTF-8 bytes (S dtype) composite keys: 4x smaller than U and
     memcmp-comparable — the sort/unique/searchsorted pipeline over 1e7+
@@ -929,21 +943,26 @@ def columnar_encode(
     is_set = cols.skind == 1
     n_t = len(cols)
 
-    # data namespaces/relations join the small dicts in sorted order
-    for name in np.unique(np.concatenate([cols.ns, cols.sns[is_set]])):
-        ns_ids.setdefault(str(name), len(ns_ids))
-    for name in np.unique(np.concatenate([cols.rel, cols.srel[is_set]])):
-        rel_ids.setdefault(str(name), len(rel_ids))
+    # data namespaces/relations join the small dicts in sorted order,
+    # and every row is factorized in the same pass: ONE sorted-unique
+    # encode per name family replaces the np.unique over the full
+    # columns plus four per-row sorted lookups (the names are few; the
+    # rows are 1e7+ — rank->id is then a tiny int-array gather)
+    def factorize(d: dict, own: np.ndarray, sub: np.ndarray):
+        all_names = _encode_utf8(np.concatenate([own, sub[is_set]]))
+        uniq, _, codes = _sorted_unique_encode(all_names)
+        for name in uniq:
+            d.setdefault(name.decode("utf-8"), len(d))
+        rank_to_id = np.array(
+            [d[name.decode("utf-8")] for name in uniq], dtype=np.int32
+        )
+        own_ids = rank_to_id[codes[: len(own)]]
+        sub_ids = np.zeros(len(sub), dtype=np.int32)
+        sub_ids[is_set] = rank_to_id[codes[len(own):]]
+        return own_ids, sub_ids
 
-    def small_lookup(d: dict, queries: np.ndarray) -> np.ndarray:
-        keys = np.array(sorted(d.keys()), dtype="U")
-        vals = np.array([d[str(k)] for k in keys], dtype=np.int32)
-        return _sorted_lookup(keys, vals, queries.astype("U"))
-
-    t_ns = small_lookup(ns_ids, cols.ns)
-    t_rel = small_lookup(rel_ids, cols.rel)
-    s_ns = np.where(is_set, small_lookup(ns_ids, cols.sns), 0)
-    s_rel = np.where(is_set, small_lookup(rel_ids, cols.srel), 0)
+    t_ns, s_ns = factorize(ns_ids, cols.ns, cols.sns)
+    t_rel, s_rel = factorize(rel_ids, cols.rel, cols.srel)
 
     # object slots: sorted-unique composite (ns_id, object) keys; the
     # slot id IS the sorted position, so encoding = one searchsorted.
@@ -953,25 +972,26 @@ def columnar_encode(
     set_keys = _compose_keys_bytes(s_ns[is_set], cols.sobj[is_set])
     all_keys = np.concatenate([own_keys, set_keys])
     all_ns = np.concatenate([t_ns, s_ns[is_set]])
-    uniq_keys, first_idx = (
-        np.unique(all_keys, return_index=True)
-        if len(all_keys)
-        else (np.array([], dtype="S1"), np.array([], dtype=np.int64))
-    )
+    if len(all_keys):
+        uniq_keys, first_idx, all_codes = _sorted_unique_encode(all_keys)
+    else:
+        uniq_keys, first_idx, all_codes = (
+            np.array([], dtype="S1"), np.array([], dtype=np.int64),
+            np.array([], dtype=np.int32),
+        )
     obj_slots = ArrayMap(uniq_keys, encode=_encode_obj_key, decode=_decode_obj_key)
-    t_obj = np.searchsorted(uniq_keys, own_keys).astype(np.int32)
-    sa_set = np.searchsorted(uniq_keys, set_keys).astype(np.int32)
+    t_obj = all_codes[: len(own_keys)]
+    sa_set = all_codes[len(own_keys):]
 
     plain = ~is_set
-    subj_keys = (
-        np.unique(_encode_utf8(cols.sobj[plain]))
-        if plain.any()
-        else np.array([], "S1")
-    )
+    if plain.any():
+        subj_keys, _, sa_plain = _sorted_unique_encode(
+            _encode_utf8(cols.sobj[plain])
+        )
+    else:
+        subj_keys = np.array([], "S1")
+        sa_plain = np.array([], dtype=np.int32)
     subj_ids = ArrayMap(subj_keys)
-    sa_plain = np.searchsorted(
-        subj_keys, _encode_utf8(cols.sobj[plain])
-    ).astype(np.int32)
 
     t_skind = cols.skind.astype(np.int32)
     t_sa = np.zeros(n_t, dtype=np.int32)

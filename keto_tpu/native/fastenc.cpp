@@ -1,0 +1,163 @@
+// Native ingest accelerator: sorted-unique encoding of fixed-width
+// byte keys.
+//
+// The columnar ingest's dominant cost at 1e8 scale is
+// np.unique(S-array) — a comparison sort over every row
+// (n log n memcmps; measured ~500 s of the 1e8 build's 634 s encode
+// phase, SCALE_1e8_BUILD_r04.json). The contract the engine needs is
+// narrower than a full sort: dense ids in SORTED-unique order
+// (ArrayMap's searchsorted lookups require sorted keys) plus
+// first-occurrence indices. That is O(n) hash work + a sort of only
+// the UNIQUES:
+//
+//   1. one open-addressing pass dedupes n rows into u slots
+//      (FNV-1a over the row bytes; first-comer claims the slot and
+//      tracks the minimum original index for the first-occurrence
+//      contract),
+//   2. std::sort of the u unique rows (u << n in every real dataset:
+//      objects/subjects repeat across tuples),
+//   3. one pass maps every row's slot to its sorted rank.
+//
+// Exposed as a plain C ABI for ctypes (this image has no pybind11);
+// keto_tpu/native/__init__.py compiles it on demand with g++ and falls
+// back to the numpy path when no compiler is available. Single
+// threaded on purpose: the bench hosts are 1-core, and correctness
+// must not depend on thread count.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// Chunked 8-bytes-at-a-time hash (memcpy keeps unaligned row starts
+// legal; trailing bytes zero-padded into the final chunk — harmless
+// because fixed-width rows already embed their \x00 padding in the
+// compared bytes). Every chunk goes through a murmur3-style fmix64:
+// a plain chunked FNV (one multiply per chunk) does NOT diffuse
+// middle-byte differences into the table-mask bits and probe chains
+// explode — measured 2.5x slower end-to-end than the byte-wise
+// version before this mixer.
+inline uint64_t fmix64(uint64_t x) {
+    x ^= x >> 33;
+    x *= 0xff51afd7ed558ccdull;
+    x ^= x >> 33;
+    x *= 0xc4ceb9fe1a85ec53ull;
+    x ^= x >> 33;
+    return x;
+}
+
+inline uint64_t hash_row(const uint8_t* p, int64_t w) {
+    uint64_t h = 0x9e3779b97f4a7c15ull ^ static_cast<uint64_t>(w);
+    int64_t i = 0;
+    for (; i + 8 <= w; i += 8) {
+        uint64_t c;
+        std::memcpy(&c, p + i, 8);
+        h = fmix64(h ^ c) + 0x165667b19e3779f9ull;
+    }
+    if (i < w) {
+        uint64_t c = 0;
+        std::memcpy(&c, p + i, static_cast<size_t>(w - i));
+        h = fmix64(h ^ c) + 0x165667b19e3779f9ull;
+    }
+    return fmix64(h);
+}
+
+struct Slot {
+    uint64_t h;    // full hash: probe mismatches resolve WITHOUT
+                   // touching the representative row (second random
+                   // access); equality still memcmp-confirms, so a
+                   // 64-bit collision can never merge distinct keys
+    int32_t rep;   // representative row index, -1 = empty (int32: n is
+                   // guarded <= INT32_MAX, and the field is half the
+                   // per-slot footprint at 1e8-row calls)
+};
+
+}  // namespace
+
+extern "C" {
+
+// keys: n rows of w bytes, contiguous.
+// out_first_idx: int64[n] (filled for the first n_uniq entries with the
+//   minimal original row index of each unique key, in sorted key
+//   order — so keys[out_first_idx[:n_uniq]] IS the sorted unique set).
+// out_codes: int32[n] (sorted-unique rank of every input row; identical
+//   to np.searchsorted(sorted_uniques, keys)).
+// Returns n_uniq, or -1 when n would overflow the int32 row/slot
+// fields (slot ids reach 2n rounded up to a power of two, so n is
+// capped at 2^30 ≈ 1.07e9 rows — beyond every supported table size;
+// callers fall back to numpy).
+int64_t keto_unique_encode(const uint8_t* keys, int64_t n, int64_t w,
+                           int64_t* out_first_idx, int32_t* out_codes) {
+    if (n == 0) return 0;
+    if (n > (int64_t{1} << 30)) return -1;
+    // power-of-two capacity at load <= 0.5
+    uint64_t cap = 1;
+    while (cap < static_cast<uint64_t>(2 * n)) cap <<= 1;
+    const uint64_t mask = cap - 1;
+    std::vector<Slot> slots(cap, Slot{0, -1});
+    std::vector<int32_t> row_slot(n);
+
+    // software-pipelined probe: hash a block, prefetch its home slots,
+    // then probe — the random slot read is the dominant stall, and the
+    // block gives the prefetches time to land
+    constexpr int64_t BLK = 32;
+    uint64_t hs[BLK];
+    for (int64_t b = 0; b < n; b += BLK) {
+        const int64_t e = std::min(b + BLK, n);
+        for (int64_t i = b; i < e; ++i) {
+            hs[i - b] = hash_row(keys + i * w, w);
+            __builtin_prefetch(&slots[hs[i - b] & mask], 1, 1);
+        }
+        for (int64_t i = b; i < e; ++i) {
+            const uint8_t* row = keys + i * w;
+            const uint64_t h = hs[i - b];
+            uint64_t s = h & mask;
+            for (;;) {
+                Slot& sl = slots[s];
+                if (sl.rep < 0) {
+                    sl.h = h;
+                    // ascending i: rep IS the first occurrence
+                    sl.rep = static_cast<int32_t>(i);
+                    break;
+                }
+                if (sl.h == h
+                    && std::memcmp(keys + static_cast<int64_t>(sl.rep) * w,
+                                   row, w) == 0) {
+                    break;
+                }
+                s = (s + 1) & mask;  // linear probe
+            }
+            row_slot[i] = static_cast<int32_t>(s);
+        }
+    }
+
+    // collect occupied slots, sort their representative rows bytewise
+    std::vector<int64_t> occupied;
+    occupied.reserve(static_cast<size_t>(n));
+    for (uint64_t s = 0; s < cap; ++s) {
+        if (slots[s].rep >= 0) occupied.push_back(static_cast<int64_t>(s));
+    }
+    const int64_t n_uniq = static_cast<int64_t>(occupied.size());
+    if (n_uniq > INT32_MAX) return -1;
+    std::sort(occupied.begin(), occupied.end(),
+              [keys, w, &slots](int64_t a, int64_t b) {
+                  return std::memcmp(keys + slots[a].rep * w,
+                                     keys + slots[b].rep * w, w) < 0;
+              });
+
+    // sorted rank per slot, first-occurrence per rank
+    std::vector<int32_t> slot_rank(cap);
+    for (int64_t r = 0; r < n_uniq; ++r) {
+        const int64_t s = occupied[static_cast<size_t>(r)];
+        slot_rank[static_cast<size_t>(s)] = static_cast<int32_t>(r);
+        out_first_idx[r] = slots[static_cast<size_t>(s)].rep;
+    }
+    for (int64_t i = 0; i < n; ++i) {
+        out_codes[i] = slot_rank[static_cast<size_t>(row_slot[i])];
+    }
+    return n_uniq;
+}
+
+}  // extern "C"

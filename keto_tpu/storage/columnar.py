@@ -232,7 +232,17 @@ class ColumnarStore:
             net = self._net(nid)
             net.merge_buffer()
             keys = _identity_keys(cols)
-            _, first = np.unique(keys, return_index=True)
+            # native hash-dedupe when available (keto_tpu/native) — the
+            # np.unique sort was the bulk-load hot spot at 1e7+. Only
+            # first-occurrence indices are needed here, so the numpy
+            # fallback stays the bare np.unique (no wasted codes pass).
+            from ..native import unique_encode
+
+            got = unique_encode(keys)
+            if got is not None:
+                first = got[1]
+            else:
+                _, first = np.unique(keys, return_index=True)
             take = np.sort(first)
             cols = cols.take(take)
             keys = keys[take]

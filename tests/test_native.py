@@ -1,0 +1,104 @@
+"""Native ingest accelerator (keto_tpu/native): exact-equivalence tests.
+
+The C++ `unique_encode` must be bit-identical to the numpy expressions
+it replaces (np.unique + return_index + searchsorted) — the snapshot
+compiler's vocabulary ids and ArrayMap ordering depend on it. Also
+exercises the fallback contract: with KETO_NATIVE=0 every caller takes
+the numpy path and produces the same snapshot.
+"""
+
+import numpy as np
+import pytest
+
+from keto_tpu.native import sorted_unique_encode, unique_encode
+
+
+def _numpy_triple(keys):
+    uniq, first = np.unique(keys, return_index=True)
+    return uniq, first, np.searchsorted(uniq, keys).astype(np.int32)
+
+
+def _assert_matches(keys):
+    want = _numpy_triple(keys)
+    got = sorted_unique_encode(keys)
+    for g, w in zip(got, want):
+        assert g.dtype.kind == w.dtype.kind
+        assert np.array_equal(g, w)
+
+
+class TestUniqueEncode:
+    def test_empty_single_and_all_dupes(self):
+        _assert_matches(np.array([], dtype="S8"))
+        _assert_matches(np.array([b"a"], dtype="S4"))
+        _assert_matches(np.array([b"x"] * 17, dtype="S2"))
+
+    def test_random_mixed_widths(self):
+        rng = np.random.default_rng(5)
+        for w in (1, 7, 24, 36, 64):
+            base = np.array(
+                [f"k{i}".encode().ljust(w, b"\x00")[:w] for i in range(257)],
+                dtype=f"S{w}",
+            )
+            keys = base[rng.integers(0, len(base), 4096)]
+            _assert_matches(keys)
+
+    def test_embedded_nuls_and_high_bytes(self):
+        # composite keys embed ns ids as raw bytes incl. \x00 and >0x7f
+        keys = np.array(
+            [b"\x00\x01abc", b"\xff\xfe\x00x", b"\x00\x01abc", b"\x7f" * 6],
+            dtype="S6",
+        )
+        _assert_matches(keys)
+
+    def test_first_occurrence_contract(self):
+        keys = np.array([b"b", b"a", b"b", b"a", b"c"], dtype="S1")
+        got = sorted_unique_encode(keys)
+        assert np.array_equal(got[0], np.array([b"a", b"b", b"c"], "S1"))
+        assert np.array_equal(got[1], [1, 0, 4])  # first occurrences
+        assert np.array_equal(got[2], [1, 0, 1, 0, 2])
+
+    def test_disabled_falls_back(self, monkeypatch):
+        import keto_tpu.native as native
+
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_lib_tried", True)
+        keys = np.array([b"q", b"p", b"q"], dtype="S1")
+        assert unique_encode(keys) is None
+        _assert_matches(keys)  # sorted_unique_encode numpy path
+
+    def test_snapshot_identical_with_and_without_native(self, monkeypatch):
+        # the vocabulary ids the engine derives must not depend on which
+        # implementation ran
+        from keto_tpu.engine.snapshot import columnar_encode
+        from keto_tpu.namespace.definitions import Namespace, Relation
+        from keto_tpu.storage.columns import TupleColumns
+        import keto_tpu.native as native
+
+        rng = np.random.default_rng(9)
+        n = 2000
+        ns = np.array(["videos"] * n, dtype="U")
+        obj = np.array([f"/f{rng.integers(0, 97)}" for _ in range(n)], "U")
+        rel = np.array(["view"] * n, dtype="U")
+        skind = (rng.random(n) < 0.3).astype(np.int8)
+        sns = np.where(skind == 1, "videos", "")
+        sobj = np.array([f"u{rng.integers(0, 53)}" for _ in range(n)], "U")
+        srel = np.where(skind == 1, "owner", "")
+        cols = TupleColumns(ns=ns, obj=obj, rel=rel, skind=skind,
+                            sns=sns.astype("U"), sobj=sobj,
+                            srel=srel.astype("U"))
+        nss = [Namespace(name="videos",
+                         relations=[Relation(name="owner"),
+                                    Relation(name="view")])]
+
+        if native._load() is None:
+            pytest.skip("no compiler: native path unavailable")
+        snap_native, enc_native = columnar_encode(cols, nss)
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_lib_tried", True)
+        snap_numpy, enc_numpy = columnar_encode(cols, nss)
+        for a, b in zip(enc_native, enc_numpy):
+            assert np.array_equal(a, b)
+        assert np.array_equal(
+            snap_native.obj_slots.keys_by_id_array(),
+            snap_numpy.obj_slots.keys_by_id_array(),
+        )
