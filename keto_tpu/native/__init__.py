@@ -3,10 +3,13 @@
 The compute path of this framework is JAX/XLA on the TPU; the runtime
 around it is Python — EXCEPT where a host-side loop is the measured
 bottleneck and numpy's primitive isn't the right algorithm. First (and
-so far only) member: `unique_encode`, the sorted-unique dictionary
+so far) members — both in fastenc.cpp, both bit-identical to the numpy
+expressions they replace: `unique_encode`, the sorted-unique dictionary
 encoding of fixed-width byte keys that dominates columnar ingest at
 1e8 scale (np.unique comparison-sorts every row; the native version
-hash-dedupes in O(n) and sorts only the uniques — see fastenc.cpp).
+hash-dedupes in O(n) and sorts only the uniques), and
+`build_probe_table`, round-based open-addressing construction without
+the numpy builder's per-round argsort.
 
 Build story: compiled on first use with g++ (baked into this image)
 into __pycache__/; no pybind11 dependency — plain C ABI + ctypes. When
@@ -80,6 +83,14 @@ def _load() -> ctypes.CDLL | None:
                 ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
                 ctypes.c_void_p, ctypes.c_void_p,
             ]
+            bt = lib.keto_build_probe_table
+            bt.restype = ctypes.c_int64
+            bt.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int32,
+            ]
             _lib = lib
         except Exception as e:  # no compiler / failed build: numpy path
             logger.info("native fastenc unavailable (%s); using numpy", e)
@@ -118,6 +129,45 @@ def unique_encode(
         return None
     first_idx = first_idx[:n_uniq]
     return keys[first_idx], first_idx, codes
+
+
+def build_probe_table(
+    h1: np.ndarray,
+    h2: np.ndarray,
+    keys: tuple[np.ndarray, ...],
+    values: np.ndarray,
+    cap: int,
+    empty: int,
+) -> tuple[list[np.ndarray], np.ndarray, int] | None:
+    """Round-based open-addressing construction, bit-identical to the
+    numpy rounds in engine/snapshot._build_hash_table (lowest index
+    wins each contended slot; losers advance one probe round) without
+    the per-round argsort. Returns ([key col arrays], values array,
+    max_probes), max_probes == -1 when a key needs > 64 rounds (caller
+    grows cap and retries, same as numpy), or None when the native
+    library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = len(values)
+    if n > (1 << 30):
+        return None
+    key_block = np.stack(keys)  # already contiguous; avoid a re-copy
+    if key_block.dtype != np.int32:
+        key_block = key_block.astype(np.int32)
+    out_cols = np.full((len(keys), cap), empty, dtype=np.int32)
+    out_vals = np.full(cap, empty, dtype=np.int32)
+    h1 = np.ascontiguousarray(h1, dtype=np.uint32)
+    h2 = np.ascontiguousarray(h2, dtype=np.uint32)
+    values = np.ascontiguousarray(values, dtype=np.int32)
+    rc = lib.keto_build_probe_table(
+        h1.ctypes.data, h2.ctypes.data, n, key_block.ctypes.data,
+        len(keys), values.ctypes.data, out_cols.ctypes.data,
+        out_vals.ctypes.data, cap, empty,
+    )
+    if rc == -2:
+        return None
+    return [out_cols[c] for c in range(len(keys))], out_vals, int(rc)
 
 
 def sorted_unique_encode(

@@ -160,4 +160,57 @@ int64_t keto_unique_encode(const uint8_t* keys, int64_t n, int64_t w,
     return n_uniq;
 }
 
+// Round-based open-addressing table construction, bit-identical to the
+// numpy builder in engine/snapshot.py (_build_hash_table): all pending
+// keys probe slot (h1 + r*h2) & mask at round r; among this round's
+// contenders for a slot that was free at round start, the LOWEST index
+// wins; losers advance one round. Iterating pending in ascending index
+// order and claiming on first-empty reproduces that rule exactly —
+// the lowest-index contender reaches each slot first — without the
+// per-round argsort that dominates the numpy builder at 1e7+ keys
+// (the 5e7 build notes measured the sort at ~25% of per-shard build).
+//
+// No key comparisons happen at all (duplicate keys each take a slot,
+// exactly like the numpy rounds); the caller computes h1/h2 with its
+// vectorized hash and pre-fills the output arrays with EMPTY.
+//
+// key_cols: [n_cols][n] int32, out_cols: [n_cols][cap] int32.
+// Returns max_probes (>= 1), or -1 when any key needs > 64 rounds
+// (pathological clustering: the caller doubles cap and retries, same
+// as the numpy path).
+int64_t keto_build_probe_table(const uint32_t* h1, const uint32_t* h2,
+                               int64_t n, const int32_t* key_cols,
+                               int64_t n_cols, const int32_t* values,
+                               int32_t* out_cols, int32_t* out_vals,
+                               int64_t cap, int32_t empty) {
+    if (n == 0) return 1;
+    if (n > (int64_t{1} << 30)) return -2;  // int32 pending indices
+    const uint32_t mask = static_cast<uint32_t>(cap - 1);
+    std::vector<int32_t> pending(static_cast<size_t>(n));
+    for (int64_t i = 0; i < n; ++i) pending[static_cast<size_t>(i)] =
+        static_cast<int32_t>(i);
+    std::vector<int32_t> lost;
+    lost.reserve(pending.size());
+    int64_t round = 0;
+    while (!pending.empty()) {
+        if (round >= 64) return -1;  // numpy path: max 64 probe rounds
+        const uint32_t r = static_cast<uint32_t>(round);
+        lost.clear();
+        for (int32_t i : pending) {
+            const uint32_t s = (h1[i] + r * h2[i]) & mask;
+            if (out_vals[s] == empty) {
+                out_vals[s] = values[i];
+                for (int64_t c = 0; c < n_cols; ++c) {
+                    out_cols[c * cap + s] = key_cols[c * n + i];
+                }
+            } else {
+                lost.push_back(i);
+            }
+        }
+        pending.swap(lost);
+        ++round;
+    }
+    return round;
+}
+
 }  // extern "C"

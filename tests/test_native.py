@@ -66,6 +66,70 @@ class TestUniqueEncode:
         assert unique_encode(keys) is None
         _assert_matches(keys)  # sorted_unique_encode numpy path
 
+    def test_build_probe_table_bit_identical(self, monkeypatch):
+        # the native round-based builder must produce byte-identical
+        # table arrays to the numpy rounds — checkpoints and the kernel
+        # probe the same layout
+        import keto_tpu.native as native
+        from keto_tpu.engine import snapshot as snap_mod
+
+        if native._load() is None:
+            pytest.skip("no compiler: native path unavailable")
+        rng = np.random.default_rng(2)
+        for trial in range(6):
+            n = int(rng.integers(0, 5000))
+            ka = rng.integers(0, max(n, 1), max(n, 1)).astype(np.int32)[:n]
+            kb = rng.integers(0, 60, max(n, 1)).astype(np.int32)[:n]
+            vals = np.arange(n, dtype=np.int32)
+            got = snap_mod._build_hash_table((ka, kb), vals)
+            monkeypatch.setattr(native, "_lib", None)
+            monkeypatch.setattr(native, "_lib_tried", True)
+            want = snap_mod._build_hash_table((ka, kb), vals)
+            monkeypatch.undo()
+            assert got[-1] == want[-1]  # probe limit
+            for g, w in zip(got[:-1], want[:-1]):
+                assert np.array_equal(g, w)
+
+    def test_build_probe_table_overflow_signal(self):
+        # a table too small for its keys must hit the 64-round limit
+        # and report -1 (the retry signal), never return a partial table
+        import keto_tpu.native as native
+        from keto_tpu.engine.snapshot import _GOLDEN, hash_combine, mix32
+
+        if native._load() is None:
+            pytest.skip("no compiler: native path unavailable")
+        n = 600
+        ka = np.zeros(n, dtype=np.int32)
+        kb = np.arange(n, dtype=np.int32)
+        h1 = hash_combine(ka, kb)
+        h2 = mix32(h1 ^ _GOLDEN) | np.uint32(1)
+        out = native.build_probe_table(
+            h1, h2, (ka, kb), np.arange(n, dtype=np.int32), 64, -1
+        )
+        assert out is not None and out[2] == -1
+
+    def test_build_probe_table_grow_path(self, monkeypatch):
+        # force the grow/retry branch (snapshot.py: cap *= 2 on rc -1)
+        # to actually run: start from a capacity far too small for the
+        # keys, let the loop double until the build fits
+        import keto_tpu.native as native
+        from keto_tpu.engine import snapshot as snap_mod
+
+        if native._load() is None:
+            pytest.skip("no compiler: native path unavailable")
+        monkeypatch.setattr(snap_mod, "hash_table_capacity",
+                            lambda n, min_capacity=64: 64)
+        n = 600
+        ka = np.zeros(n, dtype=np.int32)
+        kb = np.arange(n, dtype=np.int32)
+        out = snap_mod._build_hash_table(
+            (ka, kb), np.arange(n, dtype=np.int32)
+        )
+        assert 1 <= out[-1] <= 64
+        vals = out[-2]
+        present = vals[vals != snap_mod.EMPTY]  # EMPTY == -1
+        assert sorted(present.tolist()) == list(range(n))
+
     def test_snapshot_identical_with_and_without_native(self, monkeypatch):
         # the vocabulary ids the engine derives must not depend on which
         # implementation ran
