@@ -57,6 +57,11 @@ N_USERS = 512
 # profile_kernel) see the 4096 default.
 _BATCH_FROM_ENV = "KETO_BENCH_BATCH" in os.environ
 BATCH = int(os.environ.get("KETO_BENCH_BATCH", 4096))
+# expand leg batch: same per-platform logic (launch amortization on
+# tpu; measured sweep: 256 -> 2.97k trees/s, 1024 -> 6.3k, 4096 flat),
+# resolved in main() beside BATCH
+_EXPAND_FROM_ENV = "KETO_BENCH_EXPAND_BATCH" in os.environ
+EXPAND_BATCH = int(os.environ.get("KETO_BENCH_EXPAND_BATCH", 256))
 ROUNDS = 20
 
 # KETO_BENCH_SERVE_CLIENTS: concurrent closed-loop clients in the
@@ -373,7 +378,7 @@ def bench_config3_expand() -> dict:
     m = MemoryManager()
     m.write_relation_tuples(tuples)
     engine = TPUCheckEngine(m, cfg)
-    exp_batch = 256
+    exp_batch = EXPAND_BATCH
     # expand the role member sets: real tuple fanout (direct members +
     # nested roles), the "who holds this role" question — expand follows
     # STORED subject-set edges, not rewrites (engine.go:35-104), so doc
@@ -383,7 +388,17 @@ def bench_config3_expand() -> dict:
                    relation="member")
         for _ in range(exp_batch)
     ]
-    trees = engine.expand_batch(subjects, 6)  # warm-up/compile
+    # frontier/edge caps scale with the batch: the fixed defaults
+    # (frontier 1024, edges 4096) fit ~256 of these trees, and an
+    # overflow silently turns the excess into host replays (the leg
+    # then measures the host). pool_cap stays at the engine's auto
+    # default, which already scales with the batch (32x the bucket —
+    # larger than any explicit value we'd pass here).
+    ecaps = dict(
+        frontier_cap=max(1024, 4 * exp_batch),
+        edge_cap=max(4096, 16 * exp_batch),
+    )
+    trees = engine.expand_batch(subjects, 6, **ecaps)  # warm-up/compile
     n_nodes = sum(_tree_size(t) for t in trees if t is not None)
     host_after_warmup = engine.stats.get("host_expands", 0)
     rounds = 5
@@ -391,7 +406,7 @@ def bench_config3_expand() -> dict:
     t0 = time.perf_counter()
     for _ in range(rounds):
         s = time.perf_counter()
-        engine.expand_batch(subjects, 6)
+        engine.expand_batch(subjects, 6, **ecaps)
         lat.append(time.perf_counter() - s)
     wall = time.perf_counter() - t0
     return {
@@ -722,9 +737,11 @@ def main() -> int:
             platform = "cpu"
             tpu_error = diag
 
-    global BATCH
+    global BATCH, EXPAND_BATCH
     if not _BATCH_FROM_ENV and platform == "tpu":
         BATCH = 16384
+    if not _EXPAND_FROM_ENV and platform == "tpu":
+        EXPAND_BATCH = 1024
 
     record: dict = {
         "metric": "batched_check_qps",
