@@ -57,6 +57,42 @@ class TestUniqueEncode:
         assert np.array_equal(got[1], [1, 0, 4])  # first occurrences
         assert np.array_equal(got[2], [1, 0, 1, 0, 2])
 
+    def test_concurrent_calls_are_isolated(self):
+        # ctypes releases the GIL during the foreign call; concurrent
+        # encodes (serving-plane bulk loads racing a snapshot build)
+        # must not corrupt each other's outputs — all state is per-call
+        import threading
+
+        import keto_tpu.native as native
+
+        if native._load() is None:
+            pytest.skip("no compiler: native path unavailable")
+
+        rng = np.random.default_rng(7)
+        base = np.array(
+            [f"k{i}".encode().ljust(16, b"\x00") for i in range(500)],
+            dtype="S16",
+        )
+        arrays = [base[rng.integers(0, 500, 50_000)] for _ in range(4)]
+        wants = [_numpy_triple(a) for a in arrays]
+        errs = []
+
+        def run(idx):
+            try:
+                for _ in range(5):
+                    got = sorted_unique_encode(arrays[idx])
+                    for g, w in zip(got, wants[idx]):
+                        assert np.array_equal(g, w)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+
     def test_disabled_falls_back(self, monkeypatch):
         import keto_tpu.native as native
 
