@@ -125,13 +125,25 @@ def _isolate(x: jnp.ndarray) -> jnp.ndarray:
     return x
 
 
-def _edge_key_probe(tables, prefix, obj, rel, skind, sa, sb, probes: int):
+def _edge_key_probe(tables, prefix, obj, rel, skind, sa, sb, probes: int,
+                    key=None):
     """Probe a 5-key edge hash table stored as PACKED rows
     `{prefix}_pack[cap, 8]` = (obj, rel, skind, sa, sb, val, pad, pad):
     ONE [F, P, 8] row-gather replaces six per-column gathers — on v5e a
     row-gather moves its whole row for the cost of one element
     (~15ns/row, tools/microbench2.py probe_rowgather vs probe_6col).
-    Returns (found[F], value[F])."""
+
+    Matching compares WHOLE rows against a [F, 8] key matrix (lanes >= 5
+    auto-pass; the value rides lane 5 of the same masked reduce), which
+    keeps the match+value computation in fused elementwise+reduce form
+    instead of per-column minor-dim slices of the gathered block. Note
+    the measured round-5 cost model (tools/ablate_step.py +
+    microbench_gather_layout.py): the step is GATHER-VOLUME bound
+    (~constant cost per gathered row, independent of row width 32-256 B);
+    compare/slice form is a secondary effect, so the real lever is the
+    probe count P multiplying the [F, P, 8] gather's row count.
+    `key` lets a caller probing two tables with the same key (main +
+    delta overlay) build the matrix once. Returns (found[F], value[F])."""
     h1 = _hash_combine(obj, rel, skind, sa, sb)
     h2 = _mix32(h1 ^ _GOLDEN) | jnp.uint32(1)
     pack = tables[f"{prefix}_pack"]
@@ -139,48 +151,59 @@ def _edge_key_probe(tables, prefix, obj, rel, skind, sa, sb, probes: int):
     j = jnp.arange(probes, dtype=jnp.uint32)
     slots = ((h1[:, None] + j * h2[:, None]) & cap_mask).astype(jnp.int32)
     rows = _isolate(pack[slots])  # [F, P, 8]
-    match = (
-        (rows[..., 0] == obj[:, None])
-        & (rows[..., 1] == rel[:, None])
-        & (rows[..., 2] == skind[:, None])
-        & (rows[..., 3] == sa[:, None])
-        & (rows[..., 4] == sb[:, None])
-    )
+    if key is None:
+        key = edge_probe_key(obj, rel, skind, sa, sb)
+    lane = jnp.arange(8, dtype=jnp.int32)
+    match = jnp.all((rows == key[:, None, :]) | (lane >= 5), axis=-1)
     found = jnp.any(match, axis=-1)
-    val = jnp.max(jnp.where(match, rows[..., 5], EMPTY), axis=-1)
+    # lane-5 extraction rides the same fused reduce (EMPTY = -1 < values)
+    val = jnp.max(
+        jnp.where(match[:, :, None] & (lane == 5), rows, EMPTY), axis=(1, 2)
+    )
     return found, val
 
 
-def _multi_pair_key_probe(tables, prefix, obj, rels_cols, probes: int):
+def edge_probe_key(obj, rel, skind, sa, sb) -> jnp.ndarray:
+    """[F, 8] whole-row key matrix for _edge_key_probe (pad lanes 0)."""
+    z = jnp.zeros_like(obj)
+    return jnp.stack([obj, rel, skind, sa, sb, z, z, z], axis=-1)
+
+
+def _multi_pair_key_probe(tables, prefix, obj, rels, probes: int):
     """Probe a (obj, rel)-keyed packed table `{prefix}_pack[cap, 4]` =
     (obj, rel, val, pad) for MANY relations per task at once: all S*P
-    probe slots ride ONE [F, S*P, 4] row-gather. Returns [F]-value
-    arrays, one per rel."""
-    F = obj.shape[0]
+    probe slots ride ONE [F, S*P, 4] row-gather. `rels` is a [F, S]
+    relation matrix; returns the [F, S] value matrix (EMPTY = miss).
+
+    Like _edge_key_probe, matching is a whole-row compare with the value
+    extracted through the same masked reduce; the dominant cost is the
+    S*P gathered rows themselves (gather-volume model, ablate_step.py),
+    so S and P are the terms worth shrinking."""
+    F, S = rels.shape
     P = probes
-    rel_flat = jnp.concatenate(
-        [jnp.broadcast_to(r[:, None], (F, P)) for r in rels_cols], axis=1
-    )
-    obj_flat = obj[:, None]
-    h1 = _hash_combine(obj_flat, rel_flat)  # [F, S*P]
+    rel_flat = jnp.broadcast_to(rels[:, :, None], (F, S, P)).reshape(F, S * P)
+    h1 = _hash_combine(obj[:, None], rel_flat)  # [F, S*P]
     h2 = _mix32(h1 ^ _GOLDEN) | jnp.uint32(1)
-    p_flat = jnp.tile(jnp.arange(P, dtype=jnp.uint32), len(rels_cols))
+    p_flat = jnp.tile(jnp.arange(P, dtype=jnp.uint32), S)
     pack = tables[f"{prefix}_pack"]
     cap_mask = jnp.uint32(pack.shape[0] - 1)
     slots = ((h1 + p_flat * h2) & cap_mask).astype(jnp.int32)
     rows = _isolate(pack[slots])  # [F, S*P, 4]
-    match = (rows[..., 0] == obj_flat) & (rows[..., 1] == rel_flat)
-    cand = jnp.where(match, rows[..., 2], EMPTY)
-    # per-slot max over its P probes: 2-D slices, no 3-D relayout
-    return [
-        jnp.max(cand[:, s * P : (s + 1) * P], axis=1)
-        for s in range(len(rels_cols))
-    ]
+    z = jnp.zeros_like(rel_flat)
+    key = jnp.stack([jnp.broadcast_to(obj[:, None], rel_flat.shape),
+                     rel_flat, z, z], axis=-1)  # [F, S*P, 4]
+    lane = jnp.arange(4, dtype=jnp.int32)
+    match = jnp.all((rows == key) | (lane >= 2), axis=-1)  # [F, S*P]
+    cand = jnp.max(
+        jnp.where(match[:, :, None] & (lane == 2), rows, EMPTY), axis=-1
+    )  # [F, S*P]
+    # per-slot max over its P probes: minor-dim split is layout-free
+    return jnp.max(cand.reshape(F, S, P), axis=-1)
 
 
 def _pair_key_probe(tables, prefix, obj, rel, probes: int):
     """Single-relation probe of a (obj, rel)-keyed table -> value or EMPTY."""
-    return _multi_pair_key_probe(tables, prefix, obj, [rel], probes)[0]
+    return _multi_pair_key_probe(tables, prefix, obj, rel[:, None], probes)[:, 0]
 
 
 def dirty_lookup(tables, obj, rel):
@@ -255,8 +278,22 @@ class Expansion(NamedTuple):
     valid: jnp.ndarray
 
 
+def program_lookup(tables, obj, rel, live, *, n_config_rels: int):
+    """Shared (ns, has_prog, pid, flags) lookup used by flag_phase and
+    expand_phase: the two phases need the identical gathers (objslot_ns,
+    prog_flags x2 before this factoring), and the step cost is
+    gather-volume bound (tools/ablate_step.py), so recomputing them per
+    phase was pure overhead. Pure function of replicated tables."""
+    ns = tables["objslot_ns"][jnp.clip(obj, 0, None)]
+    has_prog = (rel < n_config_rels) & live
+    pid = jnp.where(has_prog, ns * n_config_rels + rel, 0)
+    flags = jnp.where(has_prog, tables["prog_flags"][pid], 0)
+    return ns, has_prog, pid, flags
+
+
 def flag_phase(
     tables, obj, rel, live, *, n_config_rels: int, island_is_host: bool = False,
+    prog=None,
 ):
     """Per-task host-replay CAUSE codes (0 = stay on device); pure
     function of replicated tables, so every shard computes the identical
@@ -268,10 +305,9 @@ def flag_phase(
     mutually exclusive by construction (a program compiles to exactly one
     of HOST_ONLY / ISLAND / plain; CONFIG_MISSING programs are never
     compiled), so one int code loses nothing vs a bitmask."""
-    ns = tables["objslot_ns"][jnp.clip(obj, 0, None)]
-    has_prog = (rel < n_config_rels) & live
-    pid = jnp.where(has_prog, ns * n_config_rels + rel, 0)
-    flags = jnp.where(has_prog, tables["prog_flags"][pid], 0)
+    if prog is None:
+        prog = program_lookup(tables, obj, rel, live, n_config_rels=n_config_rels)
+    ns, has_prog, pid, flags = prog
     code = jnp.where((flags & FLAG_HOST_ONLY) != 0, CAUSE_REWRITE_CAP, 0)
     code = jnp.where((flags & FLAG_CONFIG_MISSING) != 0, CAUSE_CONFIG_MISSING, code)
     if island_is_host:
@@ -293,8 +329,9 @@ def probe_phase(
     (insert adds the edge, tombstone masks a deleted one). `has_delta` is
     static: a clean mirror (the common serving state between writes)
     skips the overlay probe entirely — half the probe gathers."""
+    key = edge_probe_key(obj, rel, skind, sa, sb)
     main_hit, main_val = _edge_key_probe(
-        tables, "dh", obj, rel, skind, sa, sb, dh_probes
+        tables, "dh", obj, rel, skind, sa, sb, dh_probes, key=key
     )
     # value-liveness: incremental compaction (engine/compact.py) deletes
     # by zeroing the value in place (removing the key would break other
@@ -303,7 +340,7 @@ def probe_phase(
     main_hit = main_hit & (main_val == 1)
     if has_delta:
         in_delta, dval = _edge_key_probe(
-            tables, "dd", obj, rel, skind, sa, sb, DELTA_PROBES
+            tables, "dd", obj, rel, skind, sa, sb, DELTA_PROBES, key=key
         )
         main_hit = jnp.where(in_delta, dval == 1, main_hit)
     return main_hit & live & (depth >= 1)
@@ -326,6 +363,7 @@ def expand_phase(
     n_queries: int,
     n_island_cap: int,
     has_delta: bool = True,
+    prog=None,
 ) -> tuple[Expansion, jnp.ndarray, tuple]:
     """Expand every live task through its CSR row + rewrite instructions.
 
@@ -354,9 +392,9 @@ def expand_phase(
     n_edges = tables["e_obj"].shape[0]
     n_rows = tables["row_ptr"].shape[0] - 1
 
-    ns = tables["objslot_ns"][jnp.clip(obj, 0, None)]
-    has_prog = (rel < n_config_rels) & live
-    pid = jnp.where(has_prog, ns * n_config_rels + rel, 0)
+    if prog is None:
+        prog = program_lookup(tables, obj, rel, live, n_config_rels=n_config_rels)
+    ns, has_prog, pid, prog_flags = prog
 
     # instruction load: 3 gathers with [F, K] outputs
     mask_prog = has_prog[:, None]
@@ -366,11 +404,10 @@ def expand_phase(
 
     # relation per expansion slot: slot 0 = the task's own relation
     # (subject-set row), slots 1..K = the instruction relation
-    rels_cols = [rel] + [ir[:, k] for k in range(K)]
+    rels = jnp.concatenate([rel[:, None], ir], axis=1)  # [F, S]
 
     # row lookup for every (obj, slot-relation): ONE packed row-gather
-    rows_cols = _multi_pair_key_probe(tables, "rh", obj, rels_cols, rh_probes)
-    rows = jnp.stack(rows_cols, axis=1)  # [F, S]
+    rows = _multi_pair_key_probe(tables, "rh", obj, rels, rh_probes)  # [F, S]
     rows_c = jnp.clip(rows, 0, n_rows)
     starts = tables["row_ptr"][rows_c]  # [F, S]
     ends = tables["row_ptr"][jnp.minimum(rows_c + 1, n_rows)]
@@ -394,13 +431,10 @@ def expand_phase(
 
     # delta-dirty rows (stale CSR contents): slot-0 expansion or TTU rows
     if has_delta:
-        dirty_cols = _multi_pair_key_probe(
-            tables, "dirty", obj, rels_cols, DELTA_PROBES
+        dirty_vals = _multi_pair_key_probe(
+            tables, "dirty", obj, rels, DELTA_PROBES
         )
-        row_dirty = jnp.stack(
-            [(jnp.maximum(d, 0) & DIRTY_FOR_CHECK) != 0 for d in dirty_cols],
-            axis=1,
-        )  # [F, S]
+        row_dirty = (jnp.maximum(dirty_vals, 0) & DIRTY_FOR_CHECK) != 0  # [F, S]
         dirty = (can_expand & row_dirty[:, 0]) | jnp.any(
             is_ttu & row_dirty[:, 1:], axis=1
         )
@@ -412,8 +446,7 @@ def expand_phase(
     # AND/NOT; its instruction slots seed leaf ctxs B + idx*K + (k-1)
     isl_parent, isl_pid, n_isl = isl_state
     if NI > 0:
-        flags = jnp.where(has_prog, tables["prog_flags"][pid], 0)
-        is_island = ((flags & FLAG_ISLAND) != 0) & live
+        is_island = ((prog_flags & FLAG_ISLAND) != 0) & live
         inc = is_island.astype(jnp.int32)
         rank = jnp.cumsum(inc) - inc  # exclusive rank among island tasks
         idx = n_isl + rank
@@ -479,9 +512,20 @@ def expand_phase(
     )
 
     # build candidate children by segmented gather; all per-(task, slot)
-    # source columns flatten to [F*S] 1-D arrays (no small-lane layouts)
+    # source columns flatten to [F*S] 1-D arrays (no small-lane layouts).
+    # The covering segment per output position comes from ONE scatter of
+    # segment-start markers + a running max, not a binary search: a
+    # searchsorted over [F*S] offsets is ~17 sequential gather rounds of
+    # F random rows each, and the step cost is gather-volume bound
+    # (~constant per gathered row, tools/ablate_step.py), while nonempty
+    # segments have strictly increasing starts so cummax(marks)
+    # reconstructs the same mapping with one scatter + one cheap scan.
     j = jnp.arange(F, dtype=jnp.int32)
-    seg = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32) - 1
+    startpos = jnp.where(flat_counts > 0, offsets, F)  # empty segs drop
+    marks = jnp.zeros(F, jnp.int32).at[startpos].max(
+        jnp.arange(1, F * S + 1, dtype=jnp.int32), mode="drop"
+    )
+    seg = jax.lax.cummax(marks) - 1  # -1 before the first segment
     seg = jnp.clip(seg, 0, F * S - 1)
     within = j - offsets[seg]
     in_range = j < jnp.minimum(total, F)
@@ -577,12 +621,16 @@ def dedupe_phase(
     ).astype(jnp.int32)
 
     won = children.valid & (winner_idx == idx)
-    # same-key losers are duplicates; different-key losers survive
-    same_key = (
-        (children.ctx[winner_idx] == children.ctx)
-        & (children.obj[winner_idx] == children.obj)
-        & (children.rel[winner_idx] == children.rel)
-    )
+    # same-key losers are duplicates; different-key losers survive.
+    # ONE packed [G, 4] row-gather of the winners' keys instead of three
+    # column gathers: a row-gather costs the same as a one-column gather
+    # (gather-volume model, tools/microbench_gather_layout.py), so this
+    # is 3 gathered-row sets -> 1
+    keys = jnp.stack(
+        [children.ctx, children.obj, children.rel,
+         jnp.zeros_like(children.ctx)], axis=-1
+    )  # [G, 4]
+    same_key = jnp.all(keys[winner_idx] == keys, axis=-1)
     keep = children.valid & (won | ~same_key)
 
     pos = jnp.cumsum(keep) - 1
@@ -710,9 +758,11 @@ def _check_kernel_impl(
         live = (idx < st.n_tasks) & ~root_done[q] & ~st.ctx_hit[ctx]
         obj, rel, depth = st.t_obj, st.t_rel, st.t_depth
 
+        prog = program_lookup(tables, obj, rel, live, n_config_rels=n_config_rels)
         flagged = flag_phase(
             tables, obj, rel, live,
             n_config_rels=n_config_rels, island_is_host=(n_island_cap == 0),
+            prog=prog,
         )
         hit = probe_phase(
             tables, obj, rel, q_skind[q], q_sa[q], q_sb[q], depth, live,
@@ -729,7 +779,7 @@ def _check_kernel_impl(
             (st.isl_parent, st.isl_pid, st.n_isl),
             K=K, rh_probes=rh_probes, n_config_rels=n_config_rels,
             wildcard_rel=wildcard_rel, n_queries=B,
-            n_island_cap=n_island_cap, has_delta=has_delta,
+            n_island_cap=n_island_cap, has_delta=has_delta, prog=prog,
         )
         needs_host = jnp.maximum(needs_host, overflow_q)
 

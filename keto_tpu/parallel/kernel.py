@@ -40,6 +40,7 @@ from ..engine.kernel import (
     kernel_static_config,
     loop_cond,
     probe_phase,
+    program_lookup,
     seed_state,
 )
 from .sharding import (
@@ -80,10 +81,14 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
             obj, rel, depth = st.t_obj, st.t_rel, st.t_depth
 
             # flags depend only on replicated tables: identical everywhere
+            prog = program_lookup(
+                tables, obj, rel, live, n_config_rels=n_config_rels
+            )
             flagged = flag_phase(
                 tables, obj, rel, live,
                 n_config_rels=n_config_rels,
                 island_is_host=(n_island_cap == 0),
+                prog=prog,
             )
             hit_local = probe_phase(
                 tables, obj, rel, q_skind[q], q_sa[q], q_sb[q], depth, live,
@@ -104,7 +109,7 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
                 (st.isl_parent, st.isl_pid, st.n_isl),
                 K=K, rh_probes=rh_probes, n_config_rels=n_config_rels,
                 wildcard_rel=wildcard_rel, n_queries=B,
-                n_island_cap=n_island_cap, has_delta=has_delta,
+                n_island_cap=n_island_cap, has_delta=has_delta, prog=prog,
             )
             # per-shard expansions differ (CSR rows are shard-local), so
             # the cause codes merge with pmax — same priority semantics

@@ -1,0 +1,117 @@
+"""Gather-layout microbench: what does a random row fetch really cost?
+
+The round-5 ablation (tools/ablate_step.py) showed the BFS step is
+gather-volume bound: ~16 ns per RANDOM gathered row, so the P-probe
+chains (5-6 scattered rows per key per table) dominate the step. This
+bench measures, in one fori_loop launch per variant (launch cost
+amortized, data-dependent feedback defeats DCE/hoisting):
+
+  scattered_P5   [F,5,8] rows at h1 + j*h2 (today's double hashing)
+  adjacent_P5    [F,5,8] rows at h1 + j    (linear probing) — do
+                 adjacent rows coalesce into ~one fetch?
+  wide_row64     [F,64] single gather from a [cap/8,64] bucket table —
+                 the bucket-of-8 layout's one-fetch-per-bucket claim
+  single_row8    [F,8] one row per task (the floor)
+  pack_rows8     [F,8] row-gather from an [F*3,8] source (the packed
+                 child-construction gather)
+
+    python tools/microbench_gather_layout.py [--frontier 16384]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--frontier", type=int, default=16384)
+    ap.add_argument("--iters", type=int, default=50)
+    ap.add_argument("--cap", type=int, default=65536)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    F, N, CAP = args.frontier, args.iters, args.cap
+    rng = np.random.default_rng(0)
+    tab = jnp.asarray(rng.integers(0, 1 << 20, (CAP, 8), dtype=np.int32))
+    tab64 = jnp.asarray(
+        rng.integers(0, 1 << 20, (CAP // 8, 64), dtype=np.int32)
+    )
+    small = jnp.asarray(rng.integers(0, 1 << 20, (3 * F, 8), dtype=np.int32))
+    h1 = jnp.asarray(rng.integers(0, CAP, F, dtype=np.int32))
+    h2 = jnp.asarray((rng.integers(0, CAP, F, dtype=np.int32) | 1))
+
+    def iso(x):
+        (x,) = jax.lax.optimization_barrier((x,))
+        return x
+
+    def dep(sink):
+        # 0 at runtime: every body folds its contribution to one bit so
+        # the sink stays bounded (int32 overflow would flip it negative
+        # and perturb the benchmarked indices); never provably 0
+        return (sink >> jnp.int32(31)).astype(jnp.int32)
+
+    def loopify(body):
+        def run(n):
+            def it(i, st):
+                o, sink = st
+                return (o + dep(sink), body(o + dep(sink), sink))
+
+            return jax.lax.fori_loop(0, n, it, (h1, jnp.int32(0)))[1]
+
+        return jax.jit(run, static_argnums=0)
+
+    j5 = jnp.arange(5, dtype=jnp.int32)
+
+    variants = {
+        "scattered_P5": loopify(
+            lambda o, s: s
+            + (iso(tab[(o[:, None] + j5 * h2[:, None]) & (CAP - 1)]).sum(
+                dtype=jnp.int32
+            ) & 1)
+        ),
+        "adjacent_P5": loopify(
+            lambda o, s: s
+            + (iso(tab[(o[:, None] + j5) & (CAP - 1)]).sum(dtype=jnp.int32) & 1)
+        ),
+        "wide_row64": loopify(
+            lambda o, s: s
+            + (iso(tab64[o & (CAP // 8 - 1)]).sum(dtype=jnp.int32) & 1)
+        ),
+        "single_row8": loopify(
+            lambda o, s: s + (iso(tab[o & (CAP - 1)]).sum(dtype=jnp.int32) & 1)
+        ),
+        "pack_rows8": loopify(
+            lambda o, s: s + (iso(small[o % (3 * F)]).sum(dtype=jnp.int32) & 1)
+        ),
+    }
+
+    print(json.dumps({
+        "device": str(jax.devices()[0]), "F": F, "cap": CAP, "iters": N,
+    }), flush=True)
+    for name, fn in variants.items():
+        jax.block_until_ready(fn(1))
+        jax.block_until_ready(fn(N))
+        t1, tN = [], []
+        for _ in range(3):
+            t = time.perf_counter(); jax.block_until_ready(fn(1))
+            t1.append(time.perf_counter() - t)
+            t = time.perf_counter(); jax.block_until_ready(fn(N))
+            tN.append(time.perf_counter() - t)
+        per = (min(tN) - min(t1)) / (N - 1) * 1e3
+        print(json.dumps({"variant": name, "per_iter_ms": round(per, 4)}),
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
