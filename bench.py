@@ -71,6 +71,12 @@ ROUNDS = 20
 # needs more offered load than the 32-client default used on CPU.
 SERVE_THREADS = int(os.environ.get("KETO_BENCH_SERVE_CLIENTS", 32))
 SERVE_SECONDS = 8.0
+# batch-check RPC leg (keto_tpu extension surface): few clients, big
+# batches — the serving-plane shape that can actually feed the device
+# engine (one check per RPC caps offered load at clients/RTT; a batch
+# RPC carries thousands per round-trip)
+SERVE_BATCH_SIZE = int(os.environ.get("KETO_BENCH_SERVE_BATCH", 2048))
+SERVE_BATCH_CLIENTS = int(os.environ.get("KETO_BENCH_SERVE_BATCH_CLIENTS", 4))
 
 _PROBE_SCRIPT = (
     "import jax, jax.numpy as jnp; d = jax.devices();"
@@ -654,11 +660,83 @@ def bench_served(namespaces, tuples, queries) -> dict:
                 "errors": errors[0],
             }
 
+        def batch_load_phase(n_threads: int, batch: int, seconds: float) -> dict:
+            """Batch-RPC load: every request carries `batch` checks
+            (BatchCheckService), so a handful of closed-loop clients
+            offer n_threads * batch checks per round-trip — the serving
+            shape that can saturate the device engine (a single-check
+            client fleet is offered-load-starved: clients/launch-RTT)."""
+            stop_at = time.monotonic() + seconds
+            lock = threading.Lock()
+            rpc_lat: list[float] = []
+            checks = [0]
+            last_done: list[float] = []
+            errors = [0]
+
+            def worker(seed: int) -> None:
+                rng = random.Random(seed)
+                client = ReadClient(open_channel(addr))
+                lat: list[float] = []
+                n_checks = 0
+                n_err = 0
+                done = 0.0
+                # pre-slice a rotation of query windows so the client
+                # side isn't building fresh lists per RPC
+                qn = len(queries)
+                try:
+                    while time.monotonic() < stop_at:
+                        start = rng.randrange(qn)
+                        qs = [
+                            queries[(start + j) % qn] for j in range(batch)
+                        ]
+                        s = time.perf_counter()
+                        try:
+                            client.check_batch(qs, timeout=60)
+                        except Exception:
+                            n_err += 1
+                            continue
+                        done = time.perf_counter()
+                        lat.append(done - s)
+                        n_checks += batch
+                finally:
+                    client.close()
+                    with lock:
+                        rpc_lat.extend(lat)
+                        checks[0] += n_checks
+                        errors[0] += n_err
+                        if done:
+                            last_done.append(done)
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=worker, args=(i,), daemon=True)
+                for i in range(n_threads)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if not rpc_lat:
+                return {"error": "no successful batch RPCs"}
+            wall = max(last_done) - t0
+            lat_ms = np.array(rpc_lat) * 1e3
+            return {
+                "qps": round(checks[0] / wall, 1),
+                "rpc_p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+                "rpc_p95_ms": round(float(np.percentile(lat_ms, 95)), 2),
+                "errors": errors[0],
+            }
+
         # low-concurrency phase first: the latency-respecting operating
         # point (p95 < 10 ms on the 1-core host); then the throughput
         # phase at full closed-loop concurrency
         low = load_phase(8, SERVE_SECONDS / 2)
         high = load_phase(SERVE_THREADS, SERVE_SECONDS)
+        # batch-RPC phase: warm the batch bucket first
+        engine.check_batch(queries[:SERVE_BATCH_SIZE])
+        batch_phase = batch_load_phase(
+            SERVE_BATCH_CLIENTS, SERVE_BATCH_SIZE, SERVE_SECONDS
+        )
     finally:
         daemon.stop()
 
@@ -701,6 +779,17 @@ def bench_served(namespaces, tuples, queries) -> dict:
         "served_p99_ms": high["p99_ms"],
         "served_errors": high["errors"],
     })
+    if "error" in batch_phase:
+        out["served_batch_error"] = batch_phase["error"]
+    else:
+        out.update({
+            "served_batch_qps": batch_phase["qps"],
+            "served_batch_size": SERVE_BATCH_SIZE,
+            "served_batch_clients": SERVE_BATCH_CLIENTS,
+            "served_batch_rpc_p50_ms": batch_phase["rpc_p50_ms"],
+            "served_batch_rpc_p95_ms": batch_phase["rpc_p95_ms"],
+            "served_batch_errors": batch_phase["errors"],
+        })
     if aio is not None:
         if "error" in aio:
             out["served_aio_error"] = aio["error"]

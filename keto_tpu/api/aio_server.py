@@ -277,6 +277,7 @@ class _AioReadServices:
 
 def _aio_handlers(service: _AioReadServices):
     from .descriptors import (
+        BATCH_CHECK_SERVICE,
         EXPAND_SERVICE,
         HEALTH_SERVICE,
         READ_SERVICE,
@@ -294,6 +295,16 @@ def _aio_handlers(service: _AioReadServices):
     return [
         grpc.method_handlers_generic_handler(CHECK_SERVICE, {
             "Check": unary(service.check, pb.CheckRequest),
+        }),
+        # batch extension: a whole batch per RPC is blocking device work
+        # (engine.check_batch), so it delegates like Expand/List — the
+        # in-loop batcher exists to coalesce SINGLE checks, which a
+        # batch request has already done client-side
+        grpc.method_handlers_generic_handler(BATCH_CHECK_SERVICE, {
+            "BatchCheck": unary(
+                service._delegated("BatchCheck", svc.batch_check),
+                pb.BatchCheckRequest,
+            ),
         }),
         grpc.method_handlers_generic_handler(EXPAND_SERVICE, {
             "Expand": unary(

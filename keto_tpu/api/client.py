@@ -16,6 +16,7 @@ import grpc
 
 from ..ketoapi import GetResponse, RelationQuery, RelationTuple, Subject, Tree
 from .descriptors import (
+    BATCH_CHECK_SERVICE,
     CHECK_SERVICE,
     EXPAND_SERVICE,
     HEALTH_SERVICE,
@@ -106,6 +107,26 @@ class ReadClient(_BaseClient):
         req.tuple.CopyFrom(tuple_to_proto(t))
         resp = self._rpc(CHECK_SERVICE, "Check", req, pb.CheckResponse, timeout)
         return resp.allowed
+
+    def check_batch(
+        self,
+        tuples: Iterable[RelationTuple],
+        max_depth: int = 0,
+        timeout=None,
+    ) -> list[tuple[bool, str]]:
+        """keto_tpu batch extension (BatchCheckService): one RPC per
+        batch. Returns [(allowed, error_message)] in request order,
+        error_message == "" for clean verdicts. Only this framework's
+        server implements the service; against a stock Keto deployment
+        it raises UNIMPLEMENTED."""
+        req = pb.BatchCheckRequest(max_depth=max_depth)
+        for t in tuples:
+            req.tuples.add().CopyFrom(tuple_to_proto(t))
+        resp = self._rpc(
+            BATCH_CHECK_SERVICE, "BatchCheck", req,
+            pb.BatchCheckResponse, timeout,
+        )
+        return [(r.allowed, r.error) for r in resp.results]
 
     def expand(
         self, subject: Subject, max_depth: int = 0, timeout=None

@@ -8,7 +8,7 @@ repo free of generated *_pb2.py code and independent of the protoc/protobuf
 gencode version treadmill. Regenerate with:
 
     protoc --include_imports --descriptor_set_out=keto_descriptors.binpb \
-        -I keto_tpu/api/protos keto.proto health.proto
+        -I keto_tpu/api/protos keto.proto health.proto keto_tpu_batch.proto
 """
 
 from __future__ import annotations
@@ -59,6 +59,10 @@ pb = SimpleNamespace(
     GetVersionResponse=_keto("GetVersionResponse"),
     HealthCheckRequest=_msg("grpc.health.v1.HealthCheckRequest"),
     HealthCheckResponse=_msg("grpc.health.v1.HealthCheckResponse"),
+    # keto_tpu extension surface (additive; not in the reference API)
+    BatchCheckRequest=_msg("keto_tpu.batch.v1.BatchCheckRequest"),
+    BatchCheckResult=_msg("keto_tpu.batch.v1.BatchCheckResult"),
+    BatchCheckResponse=_msg("keto_tpu.batch.v1.BatchCheckResponse"),
 )
 
 NODE_TYPE = _pool.FindEnumTypeByName(f"{_PKG}.NodeType")
@@ -73,3 +77,5 @@ READ_SERVICE = f"{_PKG}.ReadService"
 WRITE_SERVICE = f"{_PKG}.WriteService"
 VERSION_SERVICE = f"{_PKG}.VersionService"
 HEALTH_SERVICE = "grpc.health.v1.Health"
+# extension (keto_tpu_batch.proto): batched Check beside the parity API
+BATCH_CHECK_SERVICE = "keto_tpu.batch.v1.BatchCheckService"

@@ -30,6 +30,7 @@ import grpc
 from ..errors import KetoError
 from ..ketoapi import RelationQuery, RelationTuple, SubjectSet
 from .descriptors import (
+    BATCH_CHECK_SERVICE,
     CHECK_SERVICE,
     EXPAND_SERVICE,
     HEALTH_SERVICE,
@@ -138,6 +139,47 @@ class _Services:
         return pb.CheckResponse(
             allowed=res.allowed, snaptoken=NOT_IMPLEMENTED_SNAPTOKEN
         )
+
+    def batch_check(self, req, context):
+        """keto_tpu extension (keto_tpu_batch.proto): one RPC carries a
+        whole batch straight into engine.check_batch — the reference's
+        API resolves one check per RPC and its server-side checkgroup
+        fan-out cannot feed a device kernel
+        (check_service.proto:18-21). Per-item failures (nil subject,
+        engine errors, unknown names via host replay) come back as
+        per-result error strings; one bad item never fails the batch."""
+        nid = self._nid(context)
+        idx: list[int] = []
+        tuples: list[RelationTuple] = []
+        out = [None] * len(req.tuples)
+        for i, pt in enumerate(req.tuples):
+            sub = subject_from_proto(pt.subject)
+            if sub is None:
+                out[i] = pb.BatchCheckResult(
+                    allowed=False, error="subject is not allowed to be nil"
+                )
+                continue
+            t = RelationTuple.make(pt.namespace, pt.object, pt.relation, sub)
+            try:
+                # same per-tuple namespace semantics as the single-check
+                # gRPC plane (an ERROR, not a silent deny) — but scoped
+                # to the item
+                self.registry.validate_namespaces(t)
+            except KetoError as e:
+                out[i] = pb.BatchCheckResult(allowed=False, error=e.message)
+                continue
+            idx.append(i)
+            tuples.append(t)
+        engine = self.registry.check_engine(nid)
+        results = engine.check_batch(tuples, int(req.max_depth))
+        for i, r in zip(idx, results):
+            if r.error is not None:
+                out[i] = pb.BatchCheckResult(allowed=False, error=str(r.error))
+            else:
+                out[i] = pb.BatchCheckResult(allowed=r.allowed)
+        resp = pb.BatchCheckResponse()
+        resp.results.extend(out)
+        return resp
 
     # -- ExpandService --------------------------------------------------------
 
@@ -297,6 +339,15 @@ def _service_handlers(services: _Services, write: bool):
                 grpc.method_handlers_generic_handler(
                     CHECK_SERVICE,
                     {"Check": _unary(s, "Check", s.check, pb.CheckRequest)},
+                ),
+                grpc.method_handlers_generic_handler(
+                    BATCH_CHECK_SERVICE,
+                    {
+                        "BatchCheck": _unary(
+                            s, "BatchCheck", s.batch_check,
+                            pb.BatchCheckRequest,
+                        )
+                    },
                 ),
                 grpc.method_handlers_generic_handler(
                     EXPAND_SERVICE,

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from .rest_server import (
     ALIVE_PATH,
+    CHECK_BATCH_ROUTE,
     CHECK_OPENAPI_ROUTE,
     CHECK_ROUTE_BASE,
     EXPAND_ROUTE,
@@ -77,6 +78,34 @@ def _schemas() -> dict:
             "type": "object",
             "required": ["allowed"],
             "properties": {"allowed": {"type": "boolean"}},
+        },
+        "batchCheckRequest": {
+            "type": "object",
+            "required": ["tuples"],
+            "properties": {
+                "tuples": {
+                    "type": "array",
+                    "items": {"$ref": "#/components/schemas/relationTuple"},
+                },
+                "max_depth": {"type": "integer"},
+            },
+        },
+        "batchCheckResponse": {
+            "type": "object",
+            "required": ["results"],
+            "properties": {
+                "results": {
+                    "type": "array",
+                    "items": {
+                        "type": "object",
+                        "required": ["allowed"],
+                        "properties": {
+                            "allowed": {"type": "boolean"},
+                            "error": {"type": "string"},
+                        },
+                    },
+                },
+            },
         },
         "getResponse": {
             "type": "object",
@@ -207,6 +236,26 @@ def build_spec(version: str = "", kind: str | None = None) -> dict:
         },
         CHECK_ROUTE_BASE: {"get": check_bare, "post": check_bare_post},
         CHECK_OPENAPI_ROUTE: {"get": check_op, "post": check_op_post},
+        CHECK_BATCH_ROUTE: {
+            "post": {
+                "summary": "Check a batch of relation tuples in one "
+                           "round-trip (keto_tpu extension)",
+                "parameters": [_MAX_DEPTH_PARAM],
+                "requestBody": {
+                    "required": True,
+                    "content": {"application/json": {"schema": {
+                        "$ref": "#/components/schemas/batchCheckRequest"
+                    }}},
+                },
+                "responses": {
+                    "200": _json_response(
+                        "per-tuple verdicts in request order",
+                        "batchCheckResponse",
+                    ),
+                    "400": _json_response("malformed input", "errorGeneric"),
+                },
+            }
+        },
         EXPAND_ROUTE: {
             "get": {
                 "summary": "Expand a subject set into its membership tree",
@@ -281,6 +330,7 @@ def build_spec(version: str = "", kind: str | None = None) -> dict:
         (CHECK_ROUTE_BASE, "post"): "postCheckMirrorStatus",
         (CHECK_OPENAPI_ROUTE, "get"): "getCheck",
         (CHECK_OPENAPI_ROUTE, "post"): "postCheck",
+        (CHECK_BATCH_ROUTE, "post"): "postBatchCheck",
         (EXPAND_ROUTE, "get"): "getExpand",
         (WRITE_ROUTE_BASE, "put"): "createRelationTuple",
         (WRITE_ROUTE_BASE, "delete"): "deleteRelationTuples",

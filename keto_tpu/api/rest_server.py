@@ -38,6 +38,10 @@ from ..ketoapi import (
 READ_ROUTE_BASE = "/relation-tuples"
 CHECK_ROUTE_BASE = "/relation-tuples/check"
 CHECK_OPENAPI_ROUTE = "/relation-tuples/check/openapi"
+# keto_tpu extension beside the parity surface: POST an ARRAY of tuples,
+# get per-item verdicts in one round-trip (the reference has no batch
+# check API — check/handler.go resolves one tuple per request)
+CHECK_BATCH_ROUTE = "/relation-tuples/check/batch"
 EXPAND_ROUTE = "/relation-tuples/expand"
 WRITE_ROUTE_BASE = "/admin/relation-tuples"
 ALIVE_PATH = "/health/alive"
@@ -53,6 +57,7 @@ ROUTE_KINDS = {
     READ_ROUTE_BASE: "read",
     CHECK_ROUTE_BASE: "read",
     CHECK_OPENAPI_ROUTE: "read",
+    CHECK_BATCH_ROUTE: "read",
     EXPAND_ROUTE: "read",
     WRITE_ROUTE_BASE: "write",
     ALIVE_PATH: "shared",
@@ -239,6 +244,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return CHECK_OPENAPI_ROUTE, lambda: self._check(
                     method, mirror_status=False
                 )
+            if path == CHECK_BATCH_ROUTE and method == "POST":
+                return CHECK_BATCH_ROUTE, self._check_batch
             if method == "GET" and path == EXPAND_ROUTE:
                 return EXPAND_ROUTE, self._expand
             return None
@@ -302,6 +309,56 @@ class _Handler(BaseHTTPRequestHandler):
             raise res.error
         code = 403 if (mirror_status and not res.allowed) else 200
         self._json(code, {"allowed": res.allowed})
+
+    def _check_batch(self) -> None:
+        """keto_tpu extension: POST {"tuples": [...], "max_depth"?} (or a
+        bare array) -> {"results": [{"allowed": bool} | {"allowed":
+        false, "error": str}, ...]} in request order. The whole batch
+        rides ONE engine.check_batch launch; per-item problems (bad
+        subject, unknown names via host replay) never fail the batch."""
+        params = self._params()
+        body = self._body_json()
+        if isinstance(body, dict):
+            raw = body.get("tuples")
+            try:
+                max_depth = int(body.get("max_depth") or 0)
+            except (TypeError, ValueError):
+                raise MalformedInputError("max_depth must be an integer")
+            max_depth = max_depth or _get_max_depth(params)
+        else:
+            raw = body
+            max_depth = _get_max_depth(params)
+        if not isinstance(raw, list):
+            raise MalformedInputError(
+                "could not unmarshal json: expected array of relation tuples"
+            )
+        idx: list[int] = []
+        tuples: list[RelationTuple] = []
+        out: list[dict] = [None] * len(raw)  # type: ignore[list-item]
+        for i, d in enumerate(raw):
+            try:
+                if not isinstance(d, dict):
+                    raise MalformedInputError(
+                        "could not unmarshal json: expected object"
+                    )
+                t = RelationTuple.from_dict(d)
+                # unlike the single-check REST route (which swallows
+                # unknown namespaces to allowed=false for parity), the
+                # batch extension reports them per item — strictly more
+                # information, and consistent with the gRPC batch plane
+                self.registry.validate_namespaces(t)
+            except KetoError as e:
+                out[i] = {"allowed": False, "error": e.message}
+                continue
+            idx.append(i)
+            tuples.append(t)
+        engine = self.registry.check_engine(self._nid())
+        for i, res in zip(idx, engine.check_batch(tuples, max_depth)):
+            if res.error is not None:
+                out[i] = {"allowed": False, "error": str(res.error)}
+            else:
+                out[i] = {"allowed": res.allowed}
+        self._json(200, {"results": out})
 
     def _expand(self) -> None:
         """ref: expand/handler.go:43-107 (GET, subject-set params)."""

@@ -27,7 +27,10 @@ import numpy as np
 
 from .snapshot import GraphSnapshot
 
-FORMAT_VERSION = 2  # v2: island circuits (AND/NOT device programs)
+FORMAT_VERSION = 3  # v3: bucketized probe sequence (snapshot.probe_slot)
+# — v2 files hold tables built with the old (h1 + j*h2) slot layout and
+# would mis-probe; a version mismatch just triggers a rebuild.
+# v2: island circuits (AND/NOT device programs)
 
 # vocabularies larger than this reload as ArrayMaps, not Python dicts
 _ARRAY_VOCAB_THRESHOLD = 200_000

@@ -68,14 +68,18 @@ from .snapshot import (
     _lookup_name_columns,
     hash_combine,
     mix32,
+    probe_slot,
+    slots_per_bucket,
 )
 
 # merge only while the ops batch is a small fraction of the graph — past
 # this a rebuild costs comparably and resets load/garbage for free
 MAX_OPS_FRACTION = 8  # ops <= n_tuples / MAX_OPS_FRACTION
 MIN_OPS_CAP = 65536  # floor so small graphs still merge
-MAX_PROBES = 16  # probe-limit ceiling after insertion (multiplies every
-# kernel probe gather's width — past this, rebuild at proper capacity)
+MAX_PROBES = 32  # probe-limit ceiling after insertion; under the
+# bucketized sequence (snapshot.probe_slot) the kernel pays one gathered
+# bucket row per slots_per_bucket slots, so chains up to one-two buckets
+# are cheap — past this, rebuild at proper capacity
 MAX_LOAD = 0.40  # occupancy ceiling (tables build at 0.25; tombstones
 # and merged inserts erode sparseness, which probe limits pay for)
 GARBAGE_FRACTION = 0.25  # rewritten-row garbage that forces a rebuild
@@ -104,7 +108,6 @@ def _hash_insert(
     if n == 0:
         return base_probes
     cap = len(val_col)
-    mask = np.uint32(cap - 1)
     h1 = hash_combine(*new_keys)
     h2 = mix32(h1 ^ _GOLDEN) | np.uint32(1)
     pending = np.arange(n)
@@ -114,9 +117,10 @@ def _hash_insert(
         depth = int(probe[pending].min()) + 1
         if depth > MAX_PROBES:
             raise MergeFallback("probe limit exceeded on merge insert")
-        slots = ((h1[pending] + probe[pending] * h2[pending]) & mask).astype(
-            np.int64
-        )
+        slots = probe_slot(
+            h1[pending], h2[pending], probe[pending], cap,
+            slots_per_bucket(len(new_keys)),
+        ).astype(np.int64)
         match = np.ones(len(pending), dtype=bool)
         for col, k in zip(key_cols, new_keys):
             match &= col[slots] == k[pending]
@@ -196,7 +200,6 @@ def _host_row_lookup(
     """Scalar host-side probe of the (obj, rel) -> row hash table
     (the numpy twin of kernel._pair_key_probe). -1 when absent."""
     cap = len(rh_obj)
-    mask = np.uint32(cap - 1)
     o = np.asarray([obj], dtype=np.int32)
     r = np.asarray([rel], dtype=np.int32)
     h1 = hash_combine(o, r)
@@ -204,7 +207,7 @@ def _host_row_lookup(
     for p in range(probes):
         # array (not scalar) arithmetic: uint32 wraparound is the point,
         # and numpy only warns about it on the scalar path
-        slot = int(((h1 + np.uint32(p) * h2) & mask)[0])
+        slot = int(probe_slot(h1, h2, np.uint32(p), cap, slots_per_bucket(2))[0])
         if rh_obj[slot] == obj and rh_rel[slot] == rel:
             return int(rh_row[slot])
         if rh_obj[slot] == EMPTY:

@@ -125,32 +125,55 @@ def _isolate(x: jnp.ndarray) -> jnp.ndarray:
     return x
 
 
+def _bucket_rows(pack: jnp.ndarray, h1: jnp.ndarray, h2: jnp.ndarray,
+                 probes: int) -> jnp.ndarray:
+    """Gather every table row a probe chain of `probes` slots can touch,
+    as BUCKET rows: the device twin of snapshot.probe_slot's bucketized
+    sequence. `pack` is [cap, w]; slots j = 0..probes-1 live in buckets
+    (h1 + (j//spb)*h2) mod (cap/spb), spb consecutive slots each, so
+    PB = ceil(probes/spb) bucket-row gathers of 64 ints (256 B) cover
+    the chain. Returns [..., PB*spb, w] slot rows (leading dims = h1's
+    shape).
+
+    This is the gather-volume lever (tools/microbench_gather_layout.py:
+    a gathered row costs ~the same at any width 32-256 B, and adjacent
+    rows do NOT coalesce): one spb-slot bucket row per spb probe slots
+    instead of one slot row per probe — the dominant per-step cost
+    divides by ~min(probes, spb)."""
+    cap, w = pack.shape
+    # snapshot.slots_per_bucket's device twin: every bucket is one
+    # 256-byte row — 8-int edge entries pack 8 per bucket, 4-int pair
+    # entries 16
+    spb = 8 if w == 8 else 16
+    nb = cap // spb
+    PB = (probes + spb - 1) // spb
+    jb = jnp.arange(PB, dtype=jnp.uint32)
+    bidx = ((h1[..., None] + jb * h2[..., None]) & jnp.uint32(nb - 1)).astype(
+        jnp.int32
+    )  # [..., PB]
+    rows = _isolate(pack.reshape(nb, spb * w)[bidx])  # [..., PB, spb*w]
+    return rows.reshape(*h1.shape, PB * spb, w)
+
+
 def _edge_key_probe(tables, prefix, obj, rel, skind, sa, sb, probes: int,
                     key=None):
     """Probe a 5-key edge hash table stored as PACKED rows
-    `{prefix}_pack[cap, 8]` = (obj, rel, skind, sa, sb, val, pad, pad):
-    ONE [F, P, 8] row-gather replaces six per-column gathers — on v5e a
-    row-gather moves its whole row for the cost of one element
-    (~15ns/row, tools/microbench2.py probe_rowgather vs probe_6col).
+    `{prefix}_pack[cap, 8]` = (obj, rel, skind, sa, sb, val, pad, pad),
+    fetched as [F, PB, 64] bucket rows (_bucket_rows) — ONE gathered row
+    per 8 slots of probe depth, the measured round-5 cost lever.
 
     Matching compares WHOLE rows against a [F, 8] key matrix (lanes >= 5
     auto-pass; the value rides lane 5 of the same masked reduce), which
-    keeps the match+value computation in fused elementwise+reduce form
-    instead of per-column minor-dim slices of the gathered block. Note
-    the measured round-5 cost model (tools/ablate_step.py +
-    microbench_gather_layout.py): the step is GATHER-VOLUME bound
-    (~constant cost per gathered row, independent of row width 32-256 B);
-    compare/slice form is a secondary effect, so the real lever is the
-    probe count P multiplying the [F, P, 8] gather's row count.
+    keeps the match+value computation in fused elementwise+reduce form.
+    Comparing the full bucket (up to PB*8 slots, possibly beyond the
+    exact probe limit) is safe: a slot either holds a different full key
+    (never matches) or OUR key placed by the builder inside its own
+    chain — extra compared slots can only confirm true membership.
     `key` lets a caller probing two tables with the same key (main +
     delta overlay) build the matrix once. Returns (found[F], value[F])."""
     h1 = _hash_combine(obj, rel, skind, sa, sb)
     h2 = _mix32(h1 ^ _GOLDEN) | jnp.uint32(1)
-    pack = tables[f"{prefix}_pack"]
-    cap_mask = jnp.uint32(pack.shape[0] - 1)
-    j = jnp.arange(probes, dtype=jnp.uint32)
-    slots = ((h1[:, None] + j * h2[:, None]) & cap_mask).astype(jnp.int32)
-    rows = _isolate(pack[slots])  # [F, P, 8]
+    rows = _bucket_rows(tables[f"{prefix}_pack"], h1, h2, probes)  # [F,PB*8,8]
     if key is None:
         key = edge_probe_key(obj, rel, skind, sa, sb)
     lane = jnp.arange(8, dtype=jnp.int32)
@@ -169,36 +192,37 @@ def edge_probe_key(obj, rel, skind, sa, sb) -> jnp.ndarray:
     return jnp.stack([obj, rel, skind, sa, sb, z, z, z], axis=-1)
 
 
-def _multi_pair_key_probe(tables, prefix, obj, rels, probes: int):
+def _multi_pair_key_probe(tables, prefix, obj, rels, probes: int,
+                          n_vals: int = 1):
     """Probe a (obj, rel)-keyed packed table `{prefix}_pack[cap, 4]` =
-    (obj, rel, val, pad) for MANY relations per task at once: all S*P
-    probe slots ride ONE [F, S*P, 4] row-gather. `rels` is a [F, S]
-    relation matrix; returns the [F, S] value matrix (EMPTY = miss).
-
-    Like _edge_key_probe, matching is a whole-row compare with the value
-    extracted through the same masked reduce; the dominant cost is the
-    S*P gathered rows themselves (gather-volume model, ablate_step.py),
-    so S and P are the terms worth shrinking."""
+    (obj, rel, val, val2/pad) for MANY relations per task at once.
+    `rels` is a [F, S] relation matrix; returns the [F, S] value matrix
+    (EMPTY = miss), or with `n_vals=2` a [F, S, 2] matrix carrying BOTH
+    value lanes (the rh span table stores (row_start, row_end) so the
+    CSR row lookup needs zero extra gathers — both extractions reduce
+    over the SAME gathered bucket rows). Each (task, slot) chain rides
+    PB = ceil(probes/8) bucket-row gathers ([F, S, PB, 32] via
+    _bucket_rows) — the gather count is S*PB rows per task, the
+    dominant per-step cost (ablate_step.py)."""
     F, S = rels.shape
-    P = probes
-    rel_flat = jnp.broadcast_to(rels[:, :, None], (F, S, P)).reshape(F, S * P)
-    h1 = _hash_combine(obj[:, None], rel_flat)  # [F, S*P]
+    h1 = _hash_combine(obj[:, None], rels)  # [F, S]
     h2 = _mix32(h1 ^ _GOLDEN) | jnp.uint32(1)
-    p_flat = jnp.tile(jnp.arange(P, dtype=jnp.uint32), S)
-    pack = tables[f"{prefix}_pack"]
-    cap_mask = jnp.uint32(pack.shape[0] - 1)
-    slots = ((h1 + p_flat * h2) & cap_mask).astype(jnp.int32)
-    rows = _isolate(pack[slots])  # [F, S*P, 4]
-    z = jnp.zeros_like(rel_flat)
-    key = jnp.stack([jnp.broadcast_to(obj[:, None], rel_flat.shape),
-                     rel_flat, z, z], axis=-1)  # [F, S*P, 4]
+    rows = _bucket_rows(tables[f"{prefix}_pack"], h1, h2, probes)
+    # rows: [F, S, PB*8, 4]
+    z = jnp.zeros_like(rels)
+    key = jnp.stack(
+        [jnp.broadcast_to(obj[:, None], rels.shape), rels, z, z], axis=-1
+    )  # [F, S, 4]
     lane = jnp.arange(4, dtype=jnp.int32)
-    match = jnp.all((rows == key) | (lane >= 2), axis=-1)  # [F, S*P]
-    cand = jnp.max(
-        jnp.where(match[:, :, None] & (lane == 2), rows, EMPTY), axis=-1
-    )  # [F, S*P]
-    # per-slot max over its P probes: minor-dim split is layout-free
-    return jnp.max(cand.reshape(F, S, P), axis=-1)
+    match = jnp.all((rows == key[:, :, None, :]) | (lane >= 2), axis=-1)
+    # value extraction through the same masked reduce (EMPTY = -1 floor)
+    masked = jnp.where(match[..., None], rows, EMPTY)  # [F, S, PB*8, 4]
+    if n_vals == 1:
+        return jnp.max(
+            jnp.where(lane == 2, masked, EMPTY), axis=(-1, -2)
+        )  # [F, S]
+    vals = jnp.max(masked, axis=-2)  # [F, S, 4] per-lane winners
+    return vals[..., 2 : 2 + n_vals]  # [F, S, n_vals]
 
 
 def _pair_key_probe(tables, prefix, obj, rel, probes: int):
@@ -233,6 +257,42 @@ def pack_pair_table(obj, rel, val) -> np.ndarray:
     for i, col in enumerate((obj, rel, val)):
         out[:, i] = col
     return out
+
+
+def pack_rh_span_table(rh_obj, rh_rel, rh_row, row_ptr) -> np.ndarray:
+    """(obj, rel) -> CSR span packed as [cap, 4] rows
+    (obj, rel, row_start, row_end): resolving row_ptr at PACK time means
+    the kernel's row lookup needs zero extra gathers — the span rides
+    the probe's own bucket-row fetch (EMPTY rows pack (-1, -1))."""
+    import numpy as _np
+
+    cap = rh_obj.shape[0]
+    out = _np.zeros((cap, 4), dtype=_np.int32)
+    out[:, 0] = rh_obj
+    out[:, 1] = rh_rel
+    valid = rh_row != EMPTY
+    if row_ptr.shape[0] >= 2:
+        rc = _np.clip(rh_row, 0, row_ptr.shape[0] - 2)
+        out[:, 2] = _np.where(valid, row_ptr[rc], EMPTY)
+        out[:, 3] = _np.where(valid, row_ptr[rc + 1], EMPTY)
+    else:
+        out[:, 2] = EMPTY
+        out[:, 3] = EMPTY
+    return out
+
+
+def pack_instr_table(instr_kind, instr_rel, instr_rel2) -> np.ndarray:
+    """Interleave the K-slot instruction columns into [NP, K*4] rows of
+    (kind, rel, rel2, pad) lanes — one row-gather per task instead of
+    three [F, K] gathers."""
+    import numpy as _np
+
+    NP, K = instr_kind.shape
+    out = _np.zeros((NP, K, 4), dtype=_np.int32)
+    out[..., 0] = instr_kind
+    out[..., 1] = instr_rel
+    out[..., 2] = instr_rel2
+    return out.reshape(NP, K * 4)
 
 
 def pack_delta_tables(delta: dict) -> dict:
@@ -389,29 +449,32 @@ def expand_phase(
     F = q.shape[0]
     S = K + 1  # expansion slots per task: CSR row + K instructions
     NI = n_island_cap
-    n_edges = tables["e_obj"].shape[0]
-    n_rows = tables["row_ptr"].shape[0] - 1
+    n_edges = tables["e_pack"].shape[0]
 
     if prog is None:
         prog = program_lookup(tables, obj, rel, live, n_config_rels=n_config_rels)
     ns, has_prog, pid, prog_flags = prog
 
-    # instruction load: 3 gathers with [F, K] outputs
+    # instruction load: ONE [F, K*4] row-gather of the packed
+    # (kind, rel, rel2, pad) lanes instead of three [F, K] gathers
     mask_prog = has_prog[:, None]
-    ik = jnp.where(mask_prog, tables["instr_kind"][pid], INSTR_NONE)  # [F, K]
-    ir = jnp.where(mask_prog, tables["instr_rel"][pid], 0)
-    ir2 = jnp.where(mask_prog, tables["instr_rel2"][pid], 0)
+    ipack = _isolate(tables["instr_pack"][pid]).reshape(F, K, 4)
+    ik = jnp.where(mask_prog, ipack[..., 0], INSTR_NONE)  # [F, K]
+    ir = jnp.where(mask_prog, ipack[..., 1], 0)
+    ir2 = jnp.where(mask_prog, ipack[..., 2], 0)
 
     # relation per expansion slot: slot 0 = the task's own relation
     # (subject-set row), slots 1..K = the instruction relation
     rels = jnp.concatenate([rel[:, None], ir], axis=1)  # [F, S]
 
-    # row lookup for every (obj, slot-relation): ONE packed row-gather
-    rows = _multi_pair_key_probe(tables, "rh", obj, rels, rh_probes)  # [F, S]
-    rows_c = jnp.clip(rows, 0, n_rows)
-    starts = tables["row_ptr"][rows_c]  # [F, S]
-    ends = tables["row_ptr"][jnp.minimum(rows_c + 1, n_rows)]
-    row_len = jnp.where(rows == EMPTY, 0, ends - starts)
+    # row lookup for every (obj, slot-relation): the rh span table
+    # stores (row_start, row_end) in its two value lanes, so the CSR
+    # span arrives with the probe — no row_ptr gathers at all
+    spans = _multi_pair_key_probe(
+        tables, "rh", obj, rels, rh_probes, n_vals=2
+    )  # [F, S, 2]
+    starts = spans[..., 0]
+    row_len = jnp.where(starts < 0, 0, spans[..., 1] - starts)
 
     can_expand = live & (depth >= 1)
     is_comp = (ik == INSTR_COMPUTED) & live[:, None]
@@ -527,27 +590,45 @@ def expand_phase(
     )
     seg = jax.lax.cummax(marks) - 1  # -1 before the first segment
     seg = jnp.clip(seg, 0, F * S - 1)
-    within = j - offsets[seg]
+    # within rides srcmat lane 7 (offsets[seg]) — no standalone gather
     in_range = j < jnp.minimum(total, F)
 
-    ti = seg // S  # source task (1-D)
-    sk = seg % S  # slot
-
-    src_q = q[ti]
-    src_ctx = slot_ctx.reshape(-1)[seg]
-    src_obj = obj[ti]
-    src_depth = depth[ti]
-    src_start = starts.reshape(-1)[seg]
-    src_slot0 = sk == 0
-    src_comp = jnp.concatenate(
-        [jnp.zeros((F, 1), bool), is_comp], axis=1
-    ).reshape(-1)[seg]
-    src_crel = crel.reshape(-1)[seg]
+    # ONE [F, 8] row-gather of a stacked per-(task, slot) source matrix
+    # replaces seven separate [F]-sized gathers (q[ti], slot_ctx[seg],
+    # obj[ti], depth[ti], starts[seg], comp[seg], crel[seg]) — the
+    # gather-volume model again: a row costs the same as an element
+    srcmat = jnp.stack(
+        [
+            jnp.broadcast_to(q[:, None], (F, S)),
+            slot_ctx,
+            jnp.broadcast_to(obj[:, None], (F, S)),
+            jnp.broadcast_to(depth[:, None], (F, S)),
+            starts,
+            jnp.concatenate(
+                [jnp.zeros((F, 1), jnp.int32), is_comp.astype(jnp.int32)],
+                axis=1,
+            ),
+            crel,
+            offsets.reshape(F, S),  # lane 7: within = j - offsets[seg]
+        ],
+        axis=-1,
+    ).reshape(F * S, 8)
+    src = _isolate(srcmat[seg])  # [F, 8]
+    src_q = src[:, 0]
+    src_ctx = src[:, 1]
+    src_obj = src[:, 2]
+    src_depth = src[:, 3]
+    src_start = src[:, 4]
+    src_comp = src[:, 5].astype(bool)
+    src_crel = src[:, 6]
+    within = j - src[:, 7]
+    src_slot0 = (seg % S) == 0
 
     e = jnp.clip(src_start + within, 0, max(n_edges - 1, 0))
     if n_edges:
-        edge_obj = tables["e_obj"][e]
-        edge_rel = tables["e_rel"][e]
+        ep = _isolate(tables["e_pack"][e])  # [F, 2] = (obj, rel)
+        edge_obj = ep[:, 0]
+        edge_rel = ep[:, 1]
     else:
         edge_obj = jnp.zeros(F, jnp.int32)
         edge_rel = jnp.zeros(F, jnp.int32)
@@ -701,6 +782,27 @@ def loop_cond(max_steps: int, n_queries: int):
     return cond_fn
 
 
+def run_bfs_loop(step_fn, init, max_steps: int, n_queries: int):
+    """Drive step_fn to fixpoint: a COUNTED fori_loop whose body is
+    cond-gated, NOT a lax.while_loop.
+
+    Measured round 5 (axon-tunneled v5e): every while_loop ITERATION
+    costs ~3.8 ms of backend overhead regardless of body — a while loop
+    with a trivial body over this state costs the same ~49 ms as the
+    full r04 check kernel, while a fori_loop's iterations are free. The
+    entire r04 'op-overhead-bound step' was while-iteration overhead.
+    A counted loop has no data-dependent trip decision for the backend
+    to evaluate; the early-exit becomes a lax.cond inside the body
+    (XLA conditional executes only the taken branch, so resolved
+    batches pay a state pass-through, not a step)."""
+    cond_fn = loop_cond(max_steps, n_queries)
+
+    def body(i, st):
+        return jax.lax.cond(cond_fn(st), step_fn, lambda s: s, st)
+
+    return jax.lax.fori_loop(0, max_steps, body, init)
+
+
 def finalize(
     final: _State, max_steps: int, n_queries: int
 ) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -746,6 +848,11 @@ def _check_kernel_impl(
     combine (a no-op for monotone-only configs, where n_island_cap=0)."""
     B = q_obj.shape[0]
     F = frontier_cap
+    # packed per-query subject key: ONE [F, 4] row-gather per step
+    # instead of three [F] gathers (q_skind/q_sa/q_sb share the index q)
+    qsub = jnp.stack(
+        [q_skind, q_sa, q_sb, jnp.zeros_like(q_skind)], axis=-1
+    )  # [B, 4]
 
     def step_fn(st: _State) -> _State:
         idx = jnp.arange(F, dtype=jnp.int32)
@@ -764,8 +871,9 @@ def _check_kernel_impl(
             n_config_rels=n_config_rels, island_is_host=(n_island_cap == 0),
             prog=prog,
         )
+        sub = _isolate(qsub[q])  # [F, 4]
         hit = probe_phase(
-            tables, obj, rel, q_skind[q], q_sa[q], q_sb[q], depth, live,
+            tables, obj, rel, sub[:, 0], sub[:, 1], sub[:, 2], depth, live,
             dh_probes=dh_probes, has_delta=has_delta,
         )
         ctx_hit = st.ctx_hit.at[ctx].max(hit)
@@ -793,7 +901,7 @@ def _check_kernel_impl(
         )
 
     init = seed_state(q_obj, q_rel, q_depth, q_valid, F, n_island_cap, K)
-    final = jax.lax.while_loop(loop_cond(max_steps, B), step_fn, init)
+    final = run_bfs_loop(step_fn, init, max_steps, B)
     return finalize(final, max_steps, B)
 
 
@@ -878,20 +986,33 @@ def unpack_results(flat: np.ndarray, B: int, n_island_cap: int, K: int):
 
 
 PASSTHROUGH_TABLE_KEYS = (
-    "objslot_ns", "ns_has_config", "row_ptr", "e_obj", "e_rel",
-    "instr_kind", "instr_rel", "instr_rel2", "prog_flags",
+    "objslot_ns", "ns_has_config", "prog_flags",
 )
 
 
 def pack_raw_tables(raw: dict) -> dict:
     """Interleave the 1-D column arrays into the packed device layout
-    (host-side numpy; GraphSnapshot / checkpoint formats stay columnar)."""
+    (host-side numpy; GraphSnapshot / checkpoint formats stay columnar).
+    Everything hot rides packed row layouts: dh/rh bucket tables, the
+    (obj, rel) edge pack, and the per-program instruction lanes —
+    row_ptr is resolved into the rh span lanes at pack time and never
+    uploaded."""
+    import numpy as _np
+
     out = {k: raw[k] for k in PASSTHROUGH_TABLE_KEYS if k in raw}
     out["dh_pack"] = pack_edge_table(
         raw["dh_obj"], raw["dh_rel"], raw["dh_skind"],
         raw["dh_sa"], raw["dh_sb"], raw["dh_val"],
     )
-    out["rh_pack"] = pack_pair_table(raw["rh_obj"], raw["rh_rel"], raw["rh_row"])
+    out["rh_pack"] = pack_rh_span_table(
+        raw["rh_obj"], raw["rh_rel"], raw["rh_row"], raw["row_ptr"]
+    )
+    out["e_pack"] = _np.stack(
+        [_np.asarray(raw["e_obj"]), _np.asarray(raw["e_rel"])], axis=-1
+    ).astype(_np.int32)
+    out["instr_pack"] = pack_instr_table(
+        raw["instr_kind"], raw["instr_rel"], raw["instr_rel2"]
+    )
     if "dd_obj" in raw:
         out.update(pack_delta_tables(raw))
     return out

@@ -82,6 +82,43 @@ def hash_combine(*parts: np.ndarray) -> np.ndarray:
     return h
 
 
+def slots_per_bucket(n_key_cols: int) -> int:
+    """Open-addressing bucket size by table kind: every bucket is one
+    256-byte gather row (64 int32 lanes — the measured cost of a random
+    row-gather is constant in row width up to at least 256 B,
+    tools/microbench_gather_layout.py), so 2-key pair tables (4-int
+    packed entries) hold 16 slots per bucket and 5-key edge tables
+    (8-int entries) hold 8. The deeper pair buckets matter: at the
+    build load factor a bucket holds ~2 keys on average and the MAX
+    occupancy (which is the probe limit under the bucketized sequence)
+    reaches 9-14 on real tables — 16 slots keep that inside ONE gathered
+    bucket row."""
+    return 16 if n_key_cols <= 2 else 8
+
+
+def probe_slot(h1, h2, j, cap: int, spb: int = 8):
+    """Slot index for probe number `j` (0-based, slot units) of a key
+    with hashes (h1, h2) in a power-of-two table of `cap` >= spb slots,
+    with `spb` slots per bucket (see slots_per_bucket).
+
+    THE open-addressing probe sequence — builders (numpy + native C++),
+    host-side probes (engine/compact.py) and the device kernel
+    (engine/kernel.py) must all agree on it. Bucketized: probes fill the
+    spb consecutive slots of bucket (h1 + (j//spb)*h2) before double-
+    hash-stepping to the next bucket, so the device kernel fetches ONE
+    256-byte bucket row per spb slots of probe depth — the gather-volume
+    cost model (tools/microbench_gather_layout.py: row cost is constant
+    in row width 32-256 B, so a bucket row costs the same as one slot
+    row and cuts probe gathers ~P-fold).
+
+    Vectorized over numpy uint32 arrays (h1/h2/j broadcast)."""
+    sh = np.uint32(spb.bit_length() - 1)  # log2(spb); spb is 8 or 16
+    bmask = np.uint32(cap // spb - 1)
+    jb = np.asarray(j, dtype=np.uint32) >> sh
+    js = np.asarray(j, dtype=np.uint32) & np.uint32(spb - 1)
+    return ((h1 + jb * h2) & bmask) * np.uint32(spb) + js
+
+
 def pad_headroom(n: int, quantum: int = 1024) -> int:
     """Array length for n entries plus delta headroom. Vocab-dependent
     device arrays (objslot_ns, ns_has_config) are sized to a quantum
@@ -100,7 +137,9 @@ def hash_table_capacity(n: int, min_capacity: int = 64) -> int:
     limits 8/12; at 0.25 they drop to 5/6 and batched check QPS rises
     29% (CPU, measured round 3) for 2x table bytes. A further doubling
     gains ~2% — 0.25 is the knee."""
-    cap = max(min_capacity, 1)
+    # floor 64: the bucketized probe sequence (probe_slot) needs at
+    # least BUCKET slots and a power-of-two bucket count
+    cap = max(min_capacity, 64)
     while cap < 4 * n:
         cap *= 2
     return cap
@@ -125,8 +164,9 @@ def _build_hash_table(
         # ~25% of 5e7 per-shard builds)
         from ..native import build_probe_table
 
+        spb = slots_per_bucket(len(keys))
         native = build_probe_table(
-            h1_all, h2_all, keys, values, cap, int(EMPTY)
+            h1_all, h2_all, keys, values, cap, int(EMPTY), spb
         )
         if native is not None:
             n_cols, n_vals, max_probes = native
@@ -146,7 +186,9 @@ def _build_hash_table(
             max_probes += 1
             if max_probes > 64:
                 break  # extremely clustered: grow and retry
-            slots = (h1[pending] + probe[pending] * h2[pending]) & mask
+            slots = probe_slot(
+                h1[pending], h2[pending], probe[pending], cap, spb
+            )
             if max_probes == 1:
                 free = np.ones(len(pending), dtype=bool)  # empty table
             else:

@@ -89,7 +89,7 @@ def _load() -> ctypes.CDLL | None:
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
-                ctypes.c_int32,
+                ctypes.c_int32, ctypes.c_int64,
             ]
             _lib = lib
         except Exception as e:  # no compiler / failed build: numpy path
@@ -138,11 +138,13 @@ def build_probe_table(
     values: np.ndarray,
     cap: int,
     empty: int,
+    spb: int = 8,
 ) -> tuple[list[np.ndarray], np.ndarray, int] | None:
     """Round-based open-addressing construction, bit-identical to the
     numpy rounds in engine/snapshot._build_hash_table (lowest index
-    wins each contended slot; losers advance one probe round) without
-    the per-round argsort. Returns ([key col arrays], values array,
+    wins each contended slot; losers advance one probe round; the slot
+    sequence is snapshot.probe_slot's bucketized one with `spb` slots
+    per bucket) without the per-round argsort. Returns ([key col arrays], values array,
     max_probes), max_probes == -1 when a key needs > 64 rounds (caller
     grows cap and retries, same as numpy), or None when the native
     library is unavailable."""
@@ -163,7 +165,7 @@ def build_probe_table(
     rc = lib.keto_build_probe_table(
         h1.ctypes.data, h2.ctypes.data, n, key_block.ctypes.data,
         len(keys), values.ctypes.data, out_cols.ctypes.data,
-        out_vals.ctypes.data, cap, empty,
+        out_vals.ctypes.data, cap, empty, spb,
     )
     if rc == -2:
         return None

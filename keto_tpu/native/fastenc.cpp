@@ -162,13 +162,17 @@ int64_t keto_unique_encode(const uint8_t* keys, int64_t n, int64_t w,
 
 // Round-based open-addressing table construction, bit-identical to the
 // numpy builder in engine/snapshot.py (_build_hash_table): all pending
-// keys probe slot (h1 + r*h2) & mask at round r; among this round's
-// contenders for a slot that was free at round start, the LOWEST index
-// wins; losers advance one round. Iterating pending in ascending index
-// order and claiming on first-empty reproduces that rule exactly —
-// the lowest-index contender reaches each slot first — without the
-// per-round argsort that dominates the numpy builder at 1e7+ keys
-// (the 5e7 build notes measured the sort at ~25% of per-shard build).
+// keys probe the slot given by snapshot.probe_slot at round r — the
+// BUCKETIZED sequence ((h1 + (r/8)*h2) mod cap/8)*8 + r%8, filling the
+// 8 consecutive slots of a bucket before double-hash-stepping to the
+// next bucket (the device kernel fetches whole bucket rows; see
+// engine/kernel._bucket_rows). Among a round's contenders for a slot
+// that was free at round start, the LOWEST index wins; losers advance
+// one round. Iterating pending in ascending index order and claiming
+// on first-empty reproduces that rule exactly — the lowest-index
+// contender reaches each slot first — without the per-round argsort
+// that dominates the numpy builder at 1e7+ keys (the 5e7 build notes
+// measured the sort at ~25% of per-shard build).
 //
 // No key comparisons happen at all (duplicate keys each take a slot,
 // exactly like the numpy rounds); the caller computes h1/h2 with its
@@ -182,10 +186,16 @@ int64_t keto_build_probe_table(const uint32_t* h1, const uint32_t* h2,
                                int64_t n, const int32_t* key_cols,
                                int64_t n_cols, const int32_t* values,
                                int32_t* out_cols, int32_t* out_vals,
-                               int64_t cap, int32_t empty) {
+                               int64_t cap, int32_t empty, int64_t spb) {
     if (n == 0) return 1;
     if (n > (int64_t{1} << 30)) return -2;  // int32 pending indices
-    const uint32_t mask = static_cast<uint32_t>(cap - 1);
+    // spb = slots per bucket (snapshot.slots_per_bucket: 8 for edge
+    // tables, 16 for pair tables); must be a power of two <= cap
+    if (spb < 1 || (spb & (spb - 1)) != 0 || cap < spb) return -2;
+    const uint32_t sh = static_cast<uint32_t>(__builtin_ctzll(
+        static_cast<uint64_t>(spb)));
+    const uint32_t smask = static_cast<uint32_t>(spb - 1);
+    const uint32_t bmask = static_cast<uint32_t>(cap / spb - 1);
     std::vector<int32_t> pending(static_cast<size_t>(n));
     for (int64_t i = 0; i < n; ++i) pending[static_cast<size_t>(i)] =
         static_cast<int32_t>(i);
@@ -197,7 +207,9 @@ int64_t keto_build_probe_table(const uint32_t* h1, const uint32_t* h2,
         const uint32_t r = static_cast<uint32_t>(round);
         lost.clear();
         for (int32_t i : pending) {
-            const uint32_t s = (h1[i] + r * h2[i]) & mask;
+            const uint32_t s =
+                ((h1[i] + (r >> sh) * h2[i]) & bmask) * (smask + 1u)
+                + (r & smask);
             if (out_vals[s] == empty) {
                 out_vals[s] = values[i];
                 for (int64_t c = 0; c < n_cols; ++c) {

@@ -208,7 +208,13 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
         def cond_fn(st: _ExpandState):
             return (st.step < max_steps) & (st.n_tasks > 0)
 
-        final = jax.lax.while_loop(cond_fn, step_fn, init)
+        # counted loop + cond-gated body (engine/kernel.run_bfs_loop
+        # rationale); the predicate is replicated, so all shards branch
+        # together and step_fn's collectives stay aligned
+        def body_fn(i, st):
+            return jax.lax.cond(cond_fn(st), step_fn, lambda s: s, st)
+
+        final = jax.lax.fori_loop(0, max_steps, body_fn, init)
         # single merge: each slot was written (value+1) by its owner only
         merged = [
             jax.lax.psum(a, axis) - 1

@@ -42,11 +42,12 @@ _SHARDED_KEYS = (
     "dh_obj", "dh_rel", "dh_skind", "dh_sa", "dh_sb", "dh_val",
     "rh_obj", "rh_rel", "rh_row", "row_ptr", "e_obj", "e_rel",
 )
-# device-side keys after packing
-_SHARDED_DEVICE_KEYS = ("dh_pack", "rh_pack", "row_ptr", "e_obj", "e_rel")
+# device-side keys after packing (kernel.pack_raw_tables layouts: the rh
+# span pack absorbs row_ptr, e_pack interleaves (e_obj, e_rel), and the
+# instruction lanes pack into one replicated row table)
+_SHARDED_DEVICE_KEYS = ("dh_pack", "rh_pack", "e_pack")
 _REPLICATED_KEYS = (
-    "objslot_ns", "ns_has_config",
-    "instr_kind", "instr_rel", "instr_rel2", "prog_flags",
+    "objslot_ns", "ns_has_config", "instr_pack", "prog_flags",
 )
 # delta-overlay tables (engine/delta.py): small + fixed-shape, replicated
 _DELTA_DEVICE_KEYS = ("dd_pack", "dirty_pack")
@@ -157,10 +158,19 @@ def _stack_sharded_edge_tables(
 
 
 def _replicated_tables(base: GraphSnapshot) -> dict[str, np.ndarray]:
-    replicated = {k: base.device_arrays()[k] for k in _REPLICATED_KEYS}
+    """Replicated arrays in DEVICE format: the instruction columns pack
+    into instr_pack rows (kernel.pack_instr_table) like the single-chip
+    upload path."""
     from ..engine.delta import empty_delta_tables
-    from ..engine.kernel import pack_delta_tables
+    from ..engine.kernel import pack_delta_tables, pack_instr_table
 
+    raw = base.device_arrays()
+    replicated = {
+        k: raw[k] for k in _REPLICATED_KEYS if k != "instr_pack"
+    }
+    replicated["instr_pack"] = pack_instr_table(
+        raw["instr_kind"], raw["instr_rel"], raw["instr_rel2"]
+    )
     replicated.update(pack_delta_tables(empty_delta_tables()))
     return replicated
 

@@ -98,6 +98,18 @@ class TestAioReadPlane:
         for c in addr_clients:
             c.close()
 
+    def test_batch_check_rpc(self, clients):
+        """The batch extension rides the aio plane too (delegated to the
+        blocking executor like Expand — the batch already did the
+        coalescing client-side)."""
+        rc, wc = clients
+        wc.transact(insert=[t("videos:/b#owner@alice")])
+        results = rc.check_batch(
+            [t("videos:/b#owner@alice"), t("videos:/b#owner@bob")]
+        )
+        assert [r[0] for r in results] == [True, False]
+        assert all(r[1] == "" for r in results)
+
     def test_expand(self, clients):
         rc, wc = clients
         wc.transact(insert=[t("videos:/e#owner@erin")])

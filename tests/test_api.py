@@ -195,6 +195,46 @@ class TestGRPC:
         got = rc.list_relation_tuples(RelationQuery(namespace="videos"))
         assert len(got.relation_tuples) == 2 and got.next_page_token == ""
 
+    def test_batch_check(self, clients):
+        """keto_tpu extension: one BatchCheck RPC resolves a whole batch,
+        per-item errors don't fail the batch (keto_tpu_batch.proto)."""
+        rc, wc = clients
+        wc.transact(
+            insert=[
+                RelationTuple.from_string("videos:v1#owner@alice"),
+                RelationTuple.from_string("videos:v1#view@(groups:g#member)"),
+                RelationTuple.from_string("groups:g#member@bob"),
+            ]
+        )
+        results = rc.check_batch(
+            [
+                RelationTuple.from_string("videos:v1#view@alice"),
+                RelationTuple.from_string("videos:v1#view@bob"),
+                RelationTuple.from_string("videos:v1#view@eve"),
+                # unknown namespace: per-item error string, batch survives
+                RelationTuple.from_string("nope:v1#view@alice"),
+            ],
+            max_depth=5,
+        )
+        assert [r[0] for r in results] == [True, True, False, False]
+        assert results[0][1] == "" and results[1][1] == ""
+        assert results[3][1] != ""
+
+    def test_batch_check_nil_subject_item(self, clients):
+        rc, _ = clients
+        req = pb.BatchCheckRequest()
+        m = req.tuples.add()
+        m.namespace, m.object, m.relation = "videos", "v1", "view"
+        # no subject set on the item -> per-item error
+        call = rc.channel.unary_unary(
+            "/keto_tpu.batch.v1.BatchCheckService/BatchCheck",
+            request_serializer=lambda x: x.SerializeToString(),
+            response_deserializer=pb.BatchCheckResponse.FromString,
+        )
+        resp = call(req)
+        assert not resp.results[0].allowed
+        assert "subject" in resp.results[0].error
+
     def test_list_pagination(self, clients):
         rc, wc = clients
         wc.transact(
@@ -344,6 +384,36 @@ class TestREST:
             "POST", daemon.read_port, "/relation-tuples/check/openapi", deny
         )
         assert (code, body) == (200, {"allowed": False})
+
+    def test_check_batch_route(self, daemon, clients):
+        """keto_tpu extension: POST an array of tuples, per-item verdicts
+        in order; bad items carry error strings without failing the
+        batch (rest_server.CHECK_BATCH_ROUTE)."""
+        _, wc = clients
+        wc.transact(insert=[RelationTuple.from_string("videos:v1#owner@alice")])
+        ok = {"namespace": "videos", "object": "v1", "relation": "owner",
+              "subject_id": "alice"}
+        code, body, _ = http(
+            "POST", daemon.read_port, "/relation-tuples/check/batch",
+            {"tuples": [ok, dict(ok, subject_id="eve"),
+                        dict(ok, namespace="nope")]},
+        )
+        assert code == 200
+        res = body["results"]
+        assert res[0] == {"allowed": True}
+        assert res[1] == {"allowed": False}
+        assert res[2]["allowed"] is False and res[2]["error"]
+        # bare-array body form
+        code, body, _ = http(
+            "POST", daemon.read_port, "/relation-tuples/check/batch", [ok]
+        )
+        assert code == 200 and body["results"] == [{"allowed": True}]
+        # non-array body is malformed
+        code, _, _ = http(
+            "POST", daemon.read_port, "/relation-tuples/check/batch",
+            {"tuples": "x"},
+        )
+        assert code == 400
 
     def test_check_get_url_query(self, daemon, clients):
         _, wc = clients

@@ -100,7 +100,7 @@ def main() -> int:
                 "max_steps": statics["max_steps"],
                 "dh_cap": int(tables["dh_pack"].shape[0]),
                 "rh_cap": int(tables["rh_pack"].shape[0]),
-                "n_edges": int(tables["e_obj"].shape[0]),
+                "n_edges": int(tables["e_pack"].shape[0]),
                 "device": str(jax.devices()[0]),
             }
         )
