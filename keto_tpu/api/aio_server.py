@@ -216,14 +216,20 @@ class _AioReadServices:
 
     async def check(self, req, context):
         async def body(req, context):
+            from ..engine.snaptoken import encode_snaptoken
+
             t = self._svc._check_tuple(req)
             self._svc.registry.validate_namespaces(t)
             nid = self._svc._nid(context)
+            # store-version read + token enforcement are dict/counter
+            # reads — fine in-loop (no device or SQL round-trip on the
+            # memory manager; sqlite's counter SELECT is ~10 us)
+            version = self._svc._enforce_snaptoken(req.snaptoken, nid)
             res = await self._batcher.check(t, int(req.max_depth), nid=nid)
             if res.error is not None:
                 raise res.error
             return pb.CheckResponse(
-                allowed=res.allowed, snaptoken="not yet implemented"
+                allowed=res.allowed, snaptoken=encode_snaptoken(version, nid)
             )
 
         return await self._observed("Check", body, req, context)

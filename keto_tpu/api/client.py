@@ -101,25 +101,39 @@ class ReadClient(_BaseClient):
     """CheckService + ExpandService + ReadService client."""
 
     def check(
-        self, t: RelationTuple, max_depth: int = 0, timeout=None
+        self, t: RelationTuple, max_depth: int = 0, timeout=None,
+        snaptoken: str = "",
     ) -> bool:
-        req = pb.CheckRequest(max_depth=max_depth)
+        return self.check_with_token(
+            t, max_depth, timeout=timeout, snaptoken=snaptoken
+        )[0]
+
+    def check_with_token(
+        self, t: RelationTuple, max_depth: int = 0, timeout=None,
+        snaptoken: str = "",
+    ) -> tuple[bool, str]:
+        """(allowed, response snaptoken): the token pins this read to at
+        least the snapshot it encodes (read-your-writes against a token
+        from WriteClient.transact); the returned token chains further
+        bounded-staleness reads."""
+        req = pb.CheckRequest(max_depth=max_depth, snaptoken=snaptoken)
         req.tuple.CopyFrom(tuple_to_proto(t))
         resp = self._rpc(CHECK_SERVICE, "Check", req, pb.CheckResponse, timeout)
-        return resp.allowed
+        return resp.allowed, resp.snaptoken
 
     def check_batch(
         self,
         tuples: Iterable[RelationTuple],
         max_depth: int = 0,
         timeout=None,
+        snaptoken: str = "",
     ) -> list[tuple[bool, str]]:
         """keto_tpu batch extension (BatchCheckService): one RPC per
         batch. Returns [(allowed, error_message)] in request order,
         error_message == "" for clean verdicts. Only this framework's
         server implements the service; against a stock Keto deployment
         it raises UNIMPLEMENTED."""
-        req = pb.BatchCheckRequest(max_depth=max_depth)
+        req = pb.BatchCheckRequest(max_depth=max_depth, snaptoken=snaptoken)
         for t in tuples:
             req.tuples.add().CopyFrom(tuple_to_proto(t))
         resp = self._rpc(
@@ -165,7 +179,10 @@ class WriteClient(_BaseClient):
         insert: Iterable[RelationTuple] = (),
         delete: Iterable[RelationTuple] = (),
         timeout=None,
-    ) -> None:
+    ) -> list[str]:
+        """Applies the deltas; returns the per-insert snaptokens (REAL
+        post-write version tokens on this framework's server — present
+        them to ReadClient.check/check_batch for read-your-writes)."""
         req = pb.TransactRelationTuplesRequest()
         for t in insert:
             d = req.relation_tuple_deltas.add()
@@ -175,10 +192,11 @@ class WriteClient(_BaseClient):
             d = req.relation_tuple_deltas.add()
             d.action = 2
             d.relation_tuple.CopyFrom(tuple_to_proto(t))
-        self._rpc(
+        resp = self._rpc(
             WRITE_SERVICE, "TransactRelationTuples", req,
             pb.TransactRelationTuplesResponse, timeout,
         )
+        return list(resp.snaptokens)
 
     def delete_all(self, query: RelationQuery, timeout=None) -> None:
         req = pb.DeleteRelationTuplesRequest()

@@ -8,13 +8,17 @@ classes from descriptors.py, so no generated service stubs are needed.
 Behavioral parity:
   - Check: `tuple` field preferred over the deprecated flat fields
     (check/handler.go:248-256); unknown namespace is an ERROR here (only
-    REST swallows it to allowed=false); snaptoken answered with
-    "not yet implemented" (handler.go:273)
+    REST swallows it to allowed=false); snaptokens are REAL (the
+    reference answers "not yet implemented", handler.go:273 — see
+    engine/snaptoken.py): requests may pin a minimum snapshot version,
+    responses carry the evaluated version's token
   - Expand: SubjectID short-circuits to a leaf carrying only the
     deprecated subject field (expand/handler.go:110-118)
   - List/Delete: `relation_query` preferred, deprecated `query` accepted,
     neither -> InvalidArgument (read_server.go:65-75, transact_server.go:62-75)
-  - Transact: one snaptoken stub per INSERT delta (transact_server.go:54-58)
+  - Transact: one REAL snaptoken per INSERT delta carrying the
+    post-write store version (the reference stubs these,
+    transact_server.go:54-58)
   - errors map through the KetoError HTTP status the way the herodot
     unwrap interceptor does (daemon.go:351-360)
 
@@ -49,12 +53,18 @@ from .messages import (
     tuple_to_proto,
 )
 
+# kept for compatibility: the literal the REFERENCE answers from its
+# stubbed snaptoken surfaces; parse_snaptoken accepts it as "no
+# constraint" so clients that echo it back keep working. This framework
+# returns REAL tokens (engine/snaptoken.py) — one of the places it
+# exceeds the reference rather than matching it.
 NOT_IMPLEMENTED_SNAPTOKEN = "not yet implemented"
 
 _CODE_BY_STATUS = {
     400: grpc.StatusCode.INVALID_ARGUMENT,
     403: grpc.StatusCode.PERMISSION_DENIED,
     404: grpc.StatusCode.NOT_FOUND,
+    409: grpc.StatusCode.FAILED_PRECONDITION,  # unsatisfiable snaptoken
     500: grpc.StatusCode.INTERNAL,
     501: grpc.StatusCode.UNIMPLEMENTED,
 }
@@ -122,12 +132,22 @@ class _Services:
 
         raise MalformedInputError("you must provide a query")
 
+    # -- snaptokens -----------------------------------------------------------
+
+    def _enforce_snaptoken(self, token: str, nid: str) -> int:
+        from ..engine.snaptoken import enforce_snaptoken
+
+        return enforce_snaptoken(self.registry, token, nid)
+
     # -- CheckService ---------------------------------------------------------
 
     def check(self, req, context):
+        from ..engine.snaptoken import encode_snaptoken
+
         t = self._check_tuple(req)
         self.registry.validate_namespaces(t)
         nid = self._nid(context)
+        version = self._enforce_snaptoken(req.snaptoken, nid)
         if self.batcher is not None:
             res = self.batcher.check(t, int(req.max_depth), nid=nid)
         else:
@@ -137,7 +157,7 @@ class _Services:
         if res.error is not None:
             raise res.error
         return pb.CheckResponse(
-            allowed=res.allowed, snaptoken=NOT_IMPLEMENTED_SNAPTOKEN
+            allowed=res.allowed, snaptoken=encode_snaptoken(version, nid)
         )
 
     def batch_check(self, req, context):
@@ -148,7 +168,10 @@ class _Services:
         (check_service.proto:18-21). Per-item failures (nil subject,
         engine errors, unknown names via host replay) come back as
         per-result error strings; one bad item never fails the batch."""
+        from ..engine.snaptoken import encode_snaptoken
+
         nid = self._nid(context)
+        version = self._enforce_snaptoken(req.snaptoken, nid)
         idx: list[int] = []
         tuples: list[RelationTuple] = []
         out = [None] * len(req.tuples)
@@ -177,13 +200,14 @@ class _Services:
                 out[i] = pb.BatchCheckResult(allowed=False, error=str(r.error))
             else:
                 out[i] = pb.BatchCheckResult(allowed=r.allowed)
-        resp = pb.BatchCheckResponse()
+        resp = pb.BatchCheckResponse(snaptoken=encode_snaptoken(version, nid))
         resp.results.extend(out)
         return resp
 
     # -- ExpandService --------------------------------------------------------
 
     def expand(self, req, context):
+        self._enforce_snaptoken(req.snaptoken, self._nid(context))
         sub = subject_from_proto(req.subject)
         if not isinstance(sub, SubjectSet):
             resp = pb.ExpandResponse()
@@ -204,6 +228,7 @@ class _Services:
     # -- ReadService ----------------------------------------------------------
 
     def list_relation_tuples(self, req, context):
+        self._enforce_snaptoken(req.snaptoken, self._nid(context))
         q = self._query_from(req)
         self.registry.validate_namespaces(q)
         manager = self.registry.relation_tuple_manager()
@@ -231,11 +256,18 @@ class _Services:
                 deletes.append(tuple_from_proto(d.relation_tuple))
             # ACTION_UNSPECIFIED deltas are ignored (transact_server.go:20-31)
         self.registry.validate_namespaces(*inserts, *deletes)
-        self.registry.relation_tuple_manager().transact_relation_tuples(
-            inserts, deletes, nid=self._nid(context)
-        )
+        from ..engine.snaptoken import encode_snaptoken
+
+        nid = self._nid(context)
+        manager = self.registry.relation_tuple_manager()
+        manager.transact_relation_tuples(inserts, deletes, nid=nid)
+        # REAL tokens (the reference stubs these, transact_server.go:
+        # 55-58): one per INSERT delta, all carrying the post-write
+        # version — a Check presenting this token is guaranteed to see
+        # the write (read-your-writes)
+        token = encode_snaptoken(manager.version(nid=nid), nid)
         return pb.TransactRelationTuplesResponse(
-            snaptokens=[NOT_IMPLEMENTED_SNAPTOKEN] * len(inserts)
+            snaptokens=[token] * len(inserts)
         )
 
     def delete_relation_tuples(self, req, context):

@@ -88,12 +88,14 @@ def _schemas() -> dict:
                     "items": {"$ref": "#/components/schemas/relationTuple"},
                 },
                 "max_depth": {"type": "integer"},
+                "snaptoken": {"type": "string"},
             },
         },
         "batchCheckResponse": {
             "type": "object",
             "required": ["results"],
             "properties": {
+                "snaptoken": {"type": "string"},
                 "results": {
                     "type": "array",
                     "items": {
@@ -186,11 +188,31 @@ def build_spec(version: str = "", kind: str | None = None) -> dict:
     route→port ownership come from rest_server (ROUTE_KINDS), so `kind`
     ("read" | "write" | None) filters to the paths THAT router answers —
     each port's served spec must not advertise routes the port 404s."""
+    snaptoken_param = {
+        "name": "snaptoken", "in": "query",
+        "schema": {"type": "string"},
+        "description": "pin the read to at least this snapshot "
+                       "(keto_tpu extension; from a write response)",
+    }
+    snaptoken_header = {
+        "X-Keto-Snaptoken": {
+            "schema": {"type": "string"},
+            "description": "token of the snapshot this response was "
+                           "evaluated against (keto_tpu extension)",
+        }
+    }
     check_op = {
-        "parameters": _SUBJECT_QUERY_PARAMS + [_MAX_DEPTH_PARAM],
+        "parameters": _SUBJECT_QUERY_PARAMS + [_MAX_DEPTH_PARAM,
+                                               snaptoken_param],
         "responses": {
-            "200": _json_response("membership verdict", "checkResponse"),
+            "200": {
+                **_json_response("membership verdict", "checkResponse"),
+                "headers": snaptoken_header,
+            },
             "400": _json_response("malformed input", "errorGeneric"),
+            "409": _json_response(
+                "snaptoken demands a newer snapshot", "errorGeneric"
+            ),
         },
     }
     check_bare = {
@@ -212,10 +234,12 @@ def build_spec(version: str = "", kind: str | None = None) -> dict:
         }}},
     }
     check_op_post = {
-        **check_op, "requestBody": check_body, "parameters": [_MAX_DEPTH_PARAM],
+        **check_op, "requestBody": check_body,
+        "parameters": [_MAX_DEPTH_PARAM, snaptoken_param],
     }
     check_bare_post = {
-        **check_bare, "requestBody": check_body, "parameters": [_MAX_DEPTH_PARAM],
+        **check_bare, "requestBody": check_body,
+        "parameters": [_MAX_DEPTH_PARAM, snaptoken_param],
     }
     paths = {
         READ_ROUTE_BASE: {

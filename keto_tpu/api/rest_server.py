@@ -122,22 +122,32 @@ class _Handler(BaseHTTPRequestHandler):
             ("Vary", "Origin"),
         ]
 
-    def _write(self, code: int, body: bytes, content_type="application/json") -> None:
+    def _write(
+        self, code: int, body: bytes, content_type="application/json",
+        extra_headers: list[tuple[str, str]] | None = None,
+    ) -> None:
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in extra_headers or ():
+            self.send_header(k, v)
         for k, v in self._cors_headers():
             self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
 
-    def _json(self, code: int, obj, location: str | None = None) -> None:
+    def _json(
+        self, code: int, obj, location: str | None = None,
+        extra_headers: list[tuple[str, str]] | None = None,
+    ) -> None:
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         if location is not None:
             self.send_header("Location", location)
         self.send_header("Content-Length", str(len(body)))
+        for k, v in extra_headers or ():
+            self.send_header(k, v)
         for k, v in self._cors_headers():
             self.send_header(k, v)
         self.end_headers()
@@ -289,18 +299,33 @@ class _Handler(BaseHTTPRequestHandler):
             raise MalformedInputError("could not unmarshal json: expected object")
         return RelationTuple.from_dict(body)
 
+    def _enforce_snaptoken(self, token: str, nid: str) -> int:
+        from ..engine.snaptoken import enforce_snaptoken
+
+        return enforce_snaptoken(self.registry, token, nid)
+
     def _check(self, method: str, mirror_status: bool) -> None:
-        """ref: check/handler.go getCheck/postCheck + 403 mirroring."""
-        max_depth = _get_max_depth(self._params())
+        """ref: check/handler.go getCheck/postCheck + 403 mirroring.
+        Snaptokens (keto_tpu extension; the reference REST check has no
+        token surface at all): a `snaptoken` query param pins the read,
+        and the response carries the evaluated version's token in the
+        X-Keto-Snaptoken header — a header, so the parity JSON body
+        stays byte-identical to the reference's {"allowed": ...}."""
+        from ..engine.snaptoken import encode_snaptoken
+
+        params = self._params()
+        max_depth = _get_max_depth(params)
         t = self._check_tuple_from_request(method)
+        nid = self._nid()
+        version = self._enforce_snaptoken(params.get("snaptoken", ""), nid)
+        token_hdr = [("X-Keto-Snaptoken", encode_snaptoken(version, nid))]
         try:
             self.registry.validate_namespaces(t)
         except NamespaceNotFoundError:
             # unknown namespace => allowed=false, not 404 (handler.go:156-161)
             code = 403 if mirror_status else 200
-            self._json(code, {"allowed": False})
+            self._json(code, {"allowed": False}, extra_headers=token_hdr)
             return
-        nid = self._nid()
         if self.batcher is not None:
             res = self.batcher.check(t, max_depth, nid=nid)
         else:
@@ -308,7 +333,7 @@ class _Handler(BaseHTTPRequestHandler):
         if res.error is not None:
             raise res.error
         code = 403 if (mirror_status and not res.allowed) else 200
-        self._json(code, {"allowed": res.allowed})
+        self._json(code, {"allowed": res.allowed}, extra_headers=token_hdr)
 
     def _check_batch(self) -> None:
         """keto_tpu extension: POST {"tuples": [...], "max_depth"?} (or a
@@ -332,6 +357,13 @@ class _Handler(BaseHTTPRequestHandler):
             raise MalformedInputError(
                 "could not unmarshal json: expected array of relation tuples"
             )
+        from ..engine.snaptoken import encode_snaptoken
+
+        nid = self._nid()
+        req_token = params.get("snaptoken", "")
+        if isinstance(body, dict):
+            req_token = body.get("snaptoken") or req_token
+        version = self._enforce_snaptoken(req_token, nid)
         idx: list[int] = []
         tuples: list[RelationTuple] = []
         out: list[dict] = [None] * len(raw)  # type: ignore[list-item]
@@ -352,13 +384,16 @@ class _Handler(BaseHTTPRequestHandler):
                 continue
             idx.append(i)
             tuples.append(t)
-        engine = self.registry.check_engine(self._nid())
+        engine = self.registry.check_engine(nid)
         for i, res in zip(idx, engine.check_batch(tuples, max_depth)):
             if res.error is not None:
                 out[i] = {"allowed": False, "error": str(res.error)}
             else:
                 out[i] = {"allowed": res.allowed}
-        self._json(200, {"results": out})
+        self._json(
+            200,
+            {"results": out, "snaptoken": encode_snaptoken(version, nid)},
+        )
 
     def _expand(self) -> None:
         """ref: expand/handler.go:43-107 (GET, subject-set params)."""
@@ -392,11 +427,21 @@ class _Handler(BaseHTTPRequestHandler):
             raise MalformedInputError("could not unmarshal json: expected object")
         t = RelationTuple.from_dict(body)
         self.registry.validate_namespaces(t)
-        self.registry.relation_tuple_manager().write_relation_tuples(
-            [t], nid=self._nid()
-        )
+        from ..engine.snaptoken import encode_snaptoken
+
+        nid = self._nid()
+        manager = self.registry.relation_tuple_manager()
+        manager.write_relation_tuples([t], nid=nid)
         location = READ_ROUTE_BASE + "?" + urllib.parse.urlencode(t.to_url_query())
-        self._json(201, t.to_dict(), location=location)
+        # post-write token in a header: the parity body stays the echoed
+        # tuple exactly as the reference returns it
+        self._json(
+            201, t.to_dict(), location=location,
+            extra_headers=[(
+                "X-Keto-Snaptoken",
+                encode_snaptoken(manager.version(nid=nid), nid),
+            )],
+        )
 
     def _delete_relations(self) -> None:
         """ref: transact_server.go:152-181 (by URL query, 204)."""
@@ -416,10 +461,20 @@ class _Handler(BaseHTTPRequestHandler):
         inserts = [d.relation_tuple for d in deltas if d.action.value == "insert"]
         deletes = [d.relation_tuple for d in deltas if d.action.value == "delete"]
         self.registry.validate_namespaces(*inserts, *deletes)
-        self.registry.relation_tuple_manager().transact_relation_tuples(
-            inserts, deletes, nid=self._nid()
+        from ..engine.snaptoken import encode_snaptoken
+
+        nid = self._nid()
+        manager = self.registry.relation_tuple_manager()
+        manager.transact_relation_tuples(inserts, deletes, nid=nid)
+        # 204 has no body to carry a token; the header does (the parity
+        # status/body stay exactly the reference's)
+        self._write(
+            204, b"",
+            extra_headers=[(
+                "X-Keto-Snaptoken",
+                encode_snaptoken(manager.version(nid=nid), nid),
+            )],
         )
-        self._write(204, b"", content_type="application/json")
 
     # -- HTTP verbs -----------------------------------------------------------
 

@@ -149,6 +149,7 @@ def _migration_persister(config):
     DSN — sqlite path or postgres/cockroach/mysql URL — routes through
     the dialect layer; a missing network driver fails loudly with the
     driver named (storage/dialect.py)."""
+    from ..storage.dialect import StoreDriverMissing
     from ..storage.sqlite import SQLPersister
 
     dsn = config.dsn
@@ -164,7 +165,10 @@ def _migration_persister(config):
             auto_migrate=False,
             legacy_namespaces=config.legacy_namespace_ids(),
         )
-    except ValueError as e:
+    except (ValueError, StoreDriverMissing) as e:
+        # StoreDriverMissing (a RuntimeError: postgres/mysql DSN without
+        # its driver installed) surfaces as the clean CLI error the
+        # docstring promises, not a traceback
         raise CLIError(str(e))
 
 
@@ -415,7 +419,11 @@ def cmd_relation_tuple_get(args) -> int:
 
 
 def cmd_check(args) -> int:
-    """ref: cmd/check/root.go — subject is a plain subject id."""
+    """ref: cmd/check/root.go — subject is a plain subject id.
+    --snaptoken pins the read to at least that snapshot (keto_tpu
+    extension; the reference CLI has no token surface) and
+    --print-snaptoken emits the evaluated snapshot's token for
+    chaining."""
     t = RelationTuple(
         namespace=args.namespace,
         object=args.object,
@@ -424,10 +432,19 @@ def cmd_check(args) -> int:
     )
     client = _read_client(args)
     try:
-        allowed = client.check(t, max_depth=args.max_depth)
+        allowed, token = client.check_with_token(
+            t, max_depth=args.max_depth, snaptoken=args.snaptoken or ""
+        )
     finally:
         client.close()
-    _print_formatted(args, {"allowed": allowed}, "Allowed" if allowed else "Denied")
+    verdict = "Allowed" if allowed else "Denied"
+    if getattr(args, "print_snaptoken", False):
+        _print_formatted(
+            args, {"allowed": allowed, "snaptoken": token},
+            f"{verdict}\n{token}",
+        )
+    else:
+        _print_formatted(args, {"allowed": allowed}, verdict)
     return 0
 
 
@@ -590,6 +607,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("namespace")
     p.add_argument("object")
     p.add_argument("--max-depth", "-d", type=int, default=0)
+    p.add_argument(
+        "--snaptoken", default=None,
+        help="pin the read to at least this snapshot (keto_tpu extension)",
+    )
+    p.add_argument(
+        "--print-snaptoken", action="store_true",
+        help="also print the evaluated snapshot's token",
+    )
     _add_remote_flags(p, read=True)
     _add_format_flag(p)
     p.set_defaults(fn=cmd_check)

@@ -1,0 +1,107 @@
+"""Snapshot tokens: read-your-writes / bounded-staleness handles.
+
+The reference STUBS snaptokens — every surface answers the literal
+string "not yet implemented" (proto/ory/keto/relation_tuples/v1alpha2/
+check_service.proto:42-81, internal/relationtuple/transact_server.go:
+55-58) — but this engine already maintains exactly the machinery they
+need: each write bumps a per-nid store version counter and every engine
+state records the version range it covers
+(tpu_engine._EngineState.base_version/covered_version). A token is an
+encoding of (nid, store_version):
+
+  Transact  -> returns the post-write version: "whatever this token
+               holds happened-before any state that satisfies it"
+  Check/Expand/List <- accept a token; evaluation is pinned to a state
+               with covered_version >= the token's version. The engine
+               syncs to the latest store version on every call, so a
+               token from this store is always satisfiable; a token
+               AHEAD of the store (another deployment, a restored
+               backup, a forged value) fails loudly with 409 instead of
+               silently answering from the past.
+  Check     -> returns the evaluated state's token, so clients can
+               chain bounded-staleness reads without writing.
+
+Format: "ktv1_<nid-fnv1a-8hex>_<version>". Opaque to clients; the nid
+digest catches tokens crossing tenant boundaries (a full nid would leak
+tenant identifiers into client-held strings).
+"""
+
+from __future__ import annotations
+
+from ..errors import KetoError
+
+_PREFIX = "ktv1"
+# the reference's stub literal: accepted (and ignored) for compatibility
+# with clients that echo back what the stubbed API returned them
+_LEGACY_STUB = "not yet implemented"
+
+
+class SnaptokenMalformedError(KetoError):
+    status = 400
+    code = "bad_request"
+    default_message = "malformed snaptoken"
+
+
+class SnaptokenUnsatisfiableError(KetoError):
+    # 409: the token demands a snapshot this deployment has not reached
+    # (gRPC FAILED_PRECONDITION) — retrying against the same store will
+    # not help unless the missing writes arrive
+    status = 409
+    code = "conflict"
+    default_message = (
+        "snaptoken requires a newer snapshot than this store has"
+    )
+
+
+def _nid_digest(nid: str) -> str:
+    h = 0x811C9DC5
+    for b in nid.encode("utf-8"):
+        h = ((h ^ b) * 0x01000193) & 0xFFFFFFFF
+    return f"{h:08x}"
+
+
+def encode_snaptoken(version: int, nid: str) -> str:
+    return f"{_PREFIX}_{_nid_digest(nid)}_{int(version)}"
+
+
+def parse_snaptoken(token: str, nid: str) -> int | None:
+    """Minimum store version the token demands; None for empty/legacy
+    stub tokens (no constraint). Raises SnaptokenMalformedError on
+    garbage or a token minted for a different nid."""
+    if not token or token == _LEGACY_STUB:
+        return None
+    parts = token.split("_")
+    if len(parts) != 3 or parts[0] != _PREFIX:
+        raise SnaptokenMalformedError(debug=f"bad format: {token!r}")
+    if parts[1] != _nid_digest(nid):
+        raise SnaptokenMalformedError(
+            debug="snaptoken was issued for a different network"
+        )
+    try:
+        v = int(parts[2])
+    except ValueError:
+        raise SnaptokenMalformedError(debug=f"bad version: {parts[2]!r}")
+    if v < 0:
+        raise SnaptokenMalformedError(debug="negative version")
+    return v
+
+
+def require_version(covered: int, min_version: int | None) -> None:
+    """Raise unless the evaluated snapshot satisfies the token."""
+    if min_version is not None and covered < min_version:
+        raise SnaptokenUnsatisfiableError(
+            debug=f"snapshot covers v{covered}, token demands v{min_version}"
+        )
+
+
+def enforce_snaptoken(registry, token: str, nid: str) -> int:
+    """Parse + enforce a request snaptoken against the CURRENT store
+    version; returns that version (the response token's value). Shared
+    by the gRPC and REST planes: the engine evaluates at >= the version
+    returned here (its state sync reads the same monotone counter after
+    this check), so verifying the store has reached the token's version
+    pins read-your-writes without threading versions through engines."""
+    min_v = parse_snaptoken(token, nid)
+    current = registry.relation_tuple_manager().version(nid=nid)
+    require_version(current, min_v)
+    return current

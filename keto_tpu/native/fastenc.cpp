@@ -89,7 +89,13 @@ extern "C" {
 // capped at 2^30 ≈ 1.07e9 rows — beyond every supported table size;
 // callers fall back to numpy).
 int64_t keto_unique_encode(const uint8_t* keys, int64_t n, int64_t w,
-                           int64_t* out_first_idx, int32_t* out_codes) {
+                           int64_t* out_first_idx, int32_t* out_codes)
+// a C++ exception escaping an extern "C" ctypes entry point calls
+// std::terminate and kills the whole process; std::bad_alloc from the
+// std::vector allocations (cap can reach 2n slots) must instead return
+// the error sentinel so the Python wrapper falls back to numpy (which
+// raises a catchable MemoryError if the host is truly out)
+try {
     if (n == 0) return 0;
     if (n > (int64_t{1} << 30)) return -1;
     // power-of-two capacity at load <= 0.5
@@ -158,6 +164,8 @@ int64_t keto_unique_encode(const uint8_t* keys, int64_t n, int64_t w,
         out_codes[i] = slot_rank[static_cast<size_t>(row_slot[i])];
     }
     return n_uniq;
+} catch (...) {
+    return -1;  // numpy fallback
 }
 
 // Round-based open-addressing table construction, bit-identical to the
@@ -186,7 +194,8 @@ int64_t keto_build_probe_table(const uint32_t* h1, const uint32_t* h2,
                                int64_t n, const int32_t* key_cols,
                                int64_t n_cols, const int32_t* values,
                                int32_t* out_cols, int32_t* out_vals,
-                               int64_t cap, int32_t empty, int64_t spb) {
+                               int64_t cap, int32_t empty, int64_t spb)
+try {
     if (n == 0) return 1;
     if (n > (int64_t{1} << 30)) return -2;  // int32 pending indices
     // spb = slots per bucket (snapshot.slots_per_bucket: 8 for edge
@@ -223,6 +232,8 @@ int64_t keto_build_probe_table(const uint32_t* h1, const uint32_t* h2,
         ++round;
     }
     return round;
+} catch (...) {
+    return -2;  // numpy fallback (see keto_unique_encode's rationale)
 }
 
 }  // extern "C"

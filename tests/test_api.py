@@ -235,6 +235,50 @@ class TestGRPC:
         assert not resp.results[0].allowed
         assert "subject" in resp.results[0].error
 
+    def test_snaptoken_read_your_writes(self, clients, daemon):
+        """Transact returns a REAL post-write token; a Check presenting
+        it is pinned to a snapshot containing the write. The reference
+        stubs this entire surface (transact_server.go:55-58)."""
+        rc, wc = clients
+        t = RelationTuple.from_string("videos:vs#owner@alice")
+        tokens = wc.transact(insert=[t])
+        assert len(tokens) == 1 and tokens[0].startswith("ktv1_")
+        allowed, resp_token = rc.check_with_token(t, snaptoken=tokens[0])
+        assert allowed
+        # the response token chains: it satisfies itself
+        from keto_tpu.engine.snaptoken import parse_snaptoken
+
+        nid = daemon.registry.nid
+        assert parse_snaptoken(resp_token, nid) >= parse_snaptoken(
+            tokens[0], nid
+        )
+        # legacy stub literal = no constraint (clients that echo what a
+        # stock Keto once returned keep working)
+        assert rc.check(t, snaptoken="not yet implemented")
+
+    def test_snaptoken_unsatisfiable_and_malformed(self, clients, daemon):
+        rc, wc = clients
+        t = RelationTuple.from_string("videos:vs2#owner@alice")
+        wc.transact(insert=[t])
+        from keto_tpu.engine.snaptoken import encode_snaptoken
+
+        nid = daemon.registry.nid
+        future = encode_snaptoken(10**9, nid)
+        with pytest.raises(grpc.RpcError) as e:
+            rc.check(t, snaptoken=future)
+        assert e.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        with pytest.raises(grpc.RpcError) as e:
+            rc.check(t, snaptoken="garbage-token")
+        assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        # a token minted for ANOTHER tenant is malformed here
+        other = encode_snaptoken(1, "other-network")
+        with pytest.raises(grpc.RpcError) as e:
+            rc.check(t, snaptoken=other)
+        assert e.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+        # batch RPC enforces + returns tokens too
+        results = rc.check_batch([t], snaptoken=wc.transact(insert=[t])[0])
+        assert results[0][0] is True
+
     def test_list_pagination(self, clients):
         rc, wc = clients
         wc.transact(
@@ -310,7 +354,13 @@ class TestGRPC:
         req = pb.CheckRequest(namespace="videos", object="v1", relation="owner")
         req.subject.id = "alice"
         resp = call(req)
-        assert resp.allowed and resp.snaptoken == "not yet implemented"
+        # REAL snaptoken (the reference answers "not yet implemented"
+        # here, handler.go:273; this framework returns the evaluated
+        # snapshot's token — engine/snaptoken.py)
+        from keto_tpu.engine.snaptoken import parse_snaptoken
+
+        assert resp.allowed
+        assert parse_snaptoken(resp.snaptoken, daemon.registry.nid) >= 1
         chan.close()
 
     def test_expand_subject_id_leaf(self, daemon):
@@ -424,6 +474,59 @@ class TestREST:
             "/relation-tuples/check?namespace=videos&object=v1&relation=owner&subject_id=alice",
         )
         assert (code, body) == (200, {"allowed": True})
+
+    def test_rest_snaptoken_flow(self, daemon):
+        """REST plane: writes answer X-Keto-Snaptoken; check accepts a
+        `snaptoken` query param and answers the header; the parity JSON
+        bodies stay exactly the reference's."""
+        code, _, headers = http(
+            "PUT", daemon.write_port, "/admin/relation-tuples",
+            {"namespace": "videos", "object": "vr", "relation": "owner",
+             "subject_id": "rex"},
+        )
+        assert code == 201
+        token = headers["X-Keto-Snaptoken"]
+        assert token.startswith("ktv1_")
+        code, body, hdrs = http(
+            "GET", daemon.read_port,
+            "/relation-tuples/check?namespace=videos&object=vr"
+            f"&relation=owner&subject_id=rex&snaptoken={token}",
+        )
+        assert (code, body) == (200, {"allowed": True})  # parity body
+        assert hdrs["X-Keto-Snaptoken"].startswith("ktv1_")
+        # unsatisfiable -> 409; malformed -> 400
+        from keto_tpu.engine.snaptoken import encode_snaptoken
+
+        future = encode_snaptoken(10**9, daemon.registry.nid)
+        code, _, _ = http(
+            "GET", daemon.read_port,
+            "/relation-tuples/check?namespace=videos&object=vr"
+            f"&relation=owner&subject_id=rex&snaptoken={future}",
+        )
+        assert code == 409
+        code, _, _ = http(
+            "GET", daemon.read_port,
+            "/relation-tuples/check?namespace=videos&object=vr"
+            "&relation=owner&subject_id=rex&snaptoken=junk",
+        )
+        assert code == 400
+        # PATCH answers the token header; batch accepts + returns tokens
+        code, _, headers = http(
+            "PATCH", daemon.write_port, "/admin/relation-tuples",
+            [{"action": "insert", "relation_tuple": {
+                "namespace": "videos", "object": "vr2",
+                "relation": "owner", "subject_id": "rex"}}],
+        )
+        assert code == 204
+        tok2 = headers["X-Keto-Snaptoken"]
+        code, body, _ = http(
+            "POST", daemon.read_port, "/relation-tuples/check/batch",
+            {"tuples": [{"namespace": "videos", "object": "vr2",
+                         "relation": "owner", "subject_id": "rex"}],
+             "snaptoken": tok2},
+        )
+        assert code == 200 and body["results"] == [{"allowed": True}]
+        assert body["snaptoken"].startswith("ktv1_")
 
     def test_check_unknown_namespace_allowed_false(self, daemon):
         # REST swallows unknown namespaces (check/handler.go:156-161)

@@ -136,6 +136,23 @@ def test_create_check_get_expand_delete_all(capsys, tmp_path, remotes):
     )
     assert json.loads(out) == {"allowed": True}
 
+    # snaptoken flow (keto_tpu extension): print the evaluated token,
+    # then present it back to pin the next read
+    code, out, _ = run(
+        capsys,
+        ["check", "alice", "view", "videos", "v1", "--print-snaptoken",
+         "--format", "json", *remotes],
+    )
+    assert code == 0
+    token = json.loads(out)["snaptoken"]
+    assert token.startswith("ktv1_")
+    code, out, _ = run(
+        capsys,
+        ["check", "alice", "view", "videos", "v1",
+         "--snaptoken", token, *remotes],
+    )
+    assert code == 0 and out.strip() == "Allowed"
+
     code, out, _ = run(
         capsys, ["relation-tuple", "get", "--namespace", "videos", "--format", "json", *remotes]
     )

@@ -1,0 +1,54 @@
+"""Unit tests for engine/snaptoken.py (the reference stubs this entire
+surface — check_service.proto:42-81, transact_server.go:55-58 — so these
+semantics are keto_tpu-original: format round-trip, tenant binding,
+legacy-stub compatibility, version enforcement)."""
+
+import pytest
+
+from keto_tpu.engine.snaptoken import (
+    SnaptokenMalformedError,
+    SnaptokenUnsatisfiableError,
+    encode_snaptoken,
+    parse_snaptoken,
+    require_version,
+)
+
+
+def test_round_trip():
+    tok = encode_snaptoken(42, "default")
+    assert parse_snaptoken(tok, "default") == 42
+
+
+def test_empty_and_legacy_stub_mean_no_constraint():
+    assert parse_snaptoken("", "default") is None
+    assert parse_snaptoken("not yet implemented", "default") is None
+
+
+def test_cross_tenant_token_rejected():
+    tok = encode_snaptoken(7, "tenant-a")
+    with pytest.raises(SnaptokenMalformedError):
+        parse_snaptoken(tok, "tenant-b")
+
+
+@pytest.mark.parametrize("bad", [
+    "junk", "ktv1_zz", "ktv1_deadbeef_notanint", "ktv2_00000000_5",
+    "ktv1_00000000_-3",
+])
+def test_malformed_tokens(bad):
+    with pytest.raises(SnaptokenMalformedError):
+        parse_snaptoken(bad, "default")
+
+
+def test_require_version():
+    require_version(5, None)
+    require_version(5, 5)
+    require_version(5, 3)
+    with pytest.raises(SnaptokenUnsatisfiableError):
+        require_version(5, 6)
+
+
+def test_tokens_are_monotonic_within_nid():
+    # lexical format detail doesn't matter; parsed versions must order
+    a = parse_snaptoken(encode_snaptoken(1, "n"), "n")
+    b = parse_snaptoken(encode_snaptoken(2, "n"), "n")
+    assert b > a
