@@ -1010,9 +1010,12 @@ def pack_raw_tables(raw: dict) -> dict:
     out["e_pack"] = _np.stack(
         [_np.asarray(raw["e_obj"]), _np.asarray(raw["e_rel"])], axis=-1
     ).astype(_np.int32)
-    out["instr_pack"] = pack_instr_table(
-        raw["instr_kind"], raw["instr_rel"], raw["instr_rel2"]
-    )
+    if "instr_kind" in raw:
+        # edge-table-only dicts (per-shard builds: the instruction
+        # tables are replicated, packed once by the caller) skip this
+        out["instr_pack"] = pack_instr_table(
+            raw["instr_kind"], raw["instr_rel"], raw["instr_rel2"]
+        )
     if "dd_obj" in raw:
         out.update(pack_delta_tables(raw))
     return out

@@ -84,19 +84,26 @@ class Dialect:
         )
         return sql.format(**self.fragments)
 
+    #: a complete SQL string literal, including '' escapes ('it''s ok')
+    _SQL_LITERAL_RE = re.compile(r"'(?:[^']|'')*'")
+
     def prep(self, sql: str) -> str:
         """Canonical qmark statement -> this driver's paramstyle.
-        Quote-aware: only '?' OUTSIDE single-quoted string literals are
-        placeholders, so a future statement containing a literal '?'
-        (or the existing type='table' probe growing one) can never be
-        silently corrupted on %s dialects."""
+        Literal-aware: only '?' OUTSIDE single-quoted string literals
+        are placeholders (the regex consumes whole literals including
+        SQL's '' escape, so quote parity can't flip mid-statement), so a
+        statement containing a literal '?' can never be silently
+        corrupted on %s dialects."""
         if self.placeholder == "?":
             return sql
-        parts = sql.split("'")
-        return "'".join(
-            p.replace("?", self.placeholder) if i % 2 == 0 else p
-            for i, p in enumerate(parts)
-        )
+        out = []
+        last = 0
+        for m in self._SQL_LITERAL_RE.finditer(sql):
+            out.append(sql[last:m.start()].replace("?", self.placeholder))
+            out.append(m.group(0))
+            last = m.end()
+        out.append(sql[last:].replace("?", self.placeholder))
+        return "".join(out)
 
     def insert_ignore(self, table: str, cols: Sequence[str]) -> str:
         """Idempotent insert: duplicate-key rows are silently skipped

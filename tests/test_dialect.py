@@ -337,3 +337,11 @@ class TestPrepQuoteAwareness:
             "SELECT 1 FROM t WHERE note = 'why?' AND name = ?"
         )
         assert got == "SELECT 1 FROM t WHERE note = 'why?' AND name = %s"
+
+    def test_escaped_quote_does_not_flip_parity(self):
+        from keto_tpu.storage.dialect import PostgresDialect
+
+        got = PostgresDialect().prep(
+            "SELECT 1 FROM t WHERE note = 'it''s ok?' AND name = ?"
+        )
+        assert got == "SELECT 1 FROM t WHERE note = 'it''s ok?' AND name = %s"

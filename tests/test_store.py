@@ -53,8 +53,13 @@ def store(request):
 
         yield ColumnarStore()
     elif request.param.startswith("live-"):
+        from keto_tpu.storage.sqlite import SQLPersister
+
         env = dict(_LIVE_DSNS)[request.param[len("live-"):]]
-        p = SQLitePersister(os.environ[env])
+        # SQLPersister routes the DSN through the dialect layer
+        # (postgres:// -> PostgresDialect etc.); SQLitePersister would
+        # pin sqlite and try to open the URL as a file path
+        p = SQLPersister(os.environ[env])
         yield p
         # live servers persist between test runs: drop this run's rows
         p.delete_all_relation_tuples(RelationQuery())

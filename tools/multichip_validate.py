@@ -159,7 +159,7 @@ def main() -> int:
     snap = state.snapshot
     cross = same = 0
     for c in range(1, min(n_docs, 20000)):
-        a = snap.obj_slots.get((0, f"d{c}")) if not hasattr(snap.obj_slots, "get") else snap.obj_slots.get((0, f"d{c}"))
+        a = snap.obj_slots.get((0, f"d{c}"))
         b = snap.obj_slots.get((0, f"d{c // 3}"))
         if a is None or b is None:
             continue

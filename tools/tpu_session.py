@@ -36,12 +36,16 @@ PROBE = (
 
 
 def run_step(name: str, argv: list[str], out_path: str | None,
-             timeout_s: float) -> dict:
+             timeout_s: float, env_extra: dict | None = None) -> dict:
     t0 = time.monotonic()
+    env = None
+    if env_extra:
+        env = dict(os.environ)
+        env.update(env_extra)
     try:
         r = subprocess.run(
             argv, capture_output=True, text=True, timeout=timeout_s,
-            cwd=REPO,
+            cwd=REPO, env=env,
         )
     except subprocess.TimeoutExpired:
         return {"step": name, "ok": False,
@@ -99,40 +103,52 @@ def main() -> int:
         return 0
 
     steps = [
-        # transfer-cost model first: cheap, and it decides how to read
-        # every number after it (docstring of microbench_tunnel.py)
-        ("tunnel", [sys.executable, "tools/microbench_tunnel.py"],
-         "TUNNEL_r04.json", 900),
+        # headline first: the fragile window must bank the round's
+        # comparable artifact before anything riskier runs (r04 ordering
+        # put the tunnel model first; r05 has TUNNEL_r04 to read against
+        # and the loop-fix makes bench itself the thing to protect)
         ("bench", [sys.executable, "bench.py", "--probe-timeout", "120"],
-         "BENCH_TPU_r04.json", 1800),
+         "BENCH_TPU_r05.json", 1800),
         ("tier", [sys.executable, "tools/tpu_test_tier.py"],
-         "TPU_TIER_r04.json", 1200),
+         "TPU_TIER_r05.json", 1200),
+        # batch-size sweep: the fori-loop fix moves the amortization
+        # sweet spot; 32768 was compute-bound before, may win now
+        ("bench-b32768",
+         [sys.executable, "bench.py", "--probe-timeout", "120",
+          "--skip-serve"],
+         "BENCH_TPU_r05_b32768.json", 1200, {"KETO_BENCH_BATCH": "32768"}),
+        # phase ablation: the per-step cost decomposition on the new
+        # kernel (fori-amortized, trustworthy through the tunnel)
+        ("ablate", [sys.executable, "tools/ablate_step.py"],
+         "TPU_ABLATE_r05.json", 1200),
     ]
-    # one 1e8-scale shard onto real HBM (VERDICT item 2), if the
-    # shard-streamed build's artifacts are on disk
+    # one 1e8-scale shard onto real HBM, if the shard-streamed build's
+    # artifacts are on disk (r05: measures the droop fix — gather diet
+    # cuts the cold-HBM gather volume the r04 droop is attributed to)
     if os.path.exists("/tmp/keto_1e8_shards/statics.json"):
         steps.append((
             "scale-1e8-tpu",
             [sys.executable, "tools/scale_1e8_shard.py", "--phase", "tpu",
              "--out", "/tmp/keto_1e8_shards"],
-            "SCALE_1e8_TPU_r04.json", 1800,
+            "SCALE_1e8_TPU_r05.json", 1800,
         ))
     if not args.skip_profile:
         steps.append(
             ("profile", [sys.executable, "tools/profile_kernel.py"],
-             "TPU_PROFILE_r04.json", 1200),
+             "TPU_PROFILE_r05.json", 1200),
         )
     if not args.skip_scale:
         steps.append((
             "scale-1e6",
             [sys.executable, "tools/scale_bench.py", "--tuples", "1000000",
              "--ref-samples", "8"],
-            "TPU_SCALE_r04.json", 2400,
+            "TPU_SCALE_r05.json", 2400,
         ))
 
     results = []
-    for name, argv, out_path, timeout_s in steps:
-        res = run_step(name, argv, out_path, timeout_s)
+    for name, argv, out_path, timeout_s, *rest in steps:
+        res = run_step(name, argv, out_path, timeout_s,
+                       rest[0] if rest else None)
         results.append(res)
         print(json.dumps(res), flush=True)
         if not res["ok"] and "timeout" in str(res.get("error", "")):
