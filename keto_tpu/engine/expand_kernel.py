@@ -318,13 +318,12 @@ def expand_kernel(
     def cond_fn(st: _ExpandState):
         return (st.step < max_steps) & (st.n_tasks > 0)
 
-    # counted loop + cond-gated body: a lax.while_loop iteration costs
-    # ~3.8 ms of backend overhead through the axon tunnel regardless of
-    # body (see engine/kernel.run_bfs_loop); fori iterations are free
-    def body_fn(i, st):
-        return jax.lax.cond(cond_fn(st), step_fn, lambda s: s, st)
+    # loop construct per backend: engine/kernel.bounded_loop (fori+cond
+    # on TPU-class backends — while iterations cost ~3.8 ms through the
+    # axon tunnel — early-exiting while_loop on CPU)
+    from .kernel import bounded_loop
 
-    final = jax.lax.fori_loop(0, max_steps, body_fn, init)
+    final = bounded_loop(cond_fn, step_fn, init, max_steps)
     return (
         final.eb_pobj, final.eb_prel, final.eb_skind, final.eb_sa, final.eb_sb,
         final.eb_count, root_has_children, final.needs_host,

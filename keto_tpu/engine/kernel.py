@@ -576,19 +576,23 @@ def expand_phase(
 
     # build candidate children by segmented gather; all per-(task, slot)
     # source columns flatten to [F*S] 1-D arrays (no small-lane layouts).
-    # The covering segment per output position comes from ONE scatter of
-    # segment-start markers + a running max, not a binary search: a
+    # The covering-segment map is backend-picked: on TPU-class backends
+    # ONE scatter of segment-start markers + a running max (a
     # searchsorted over [F*S] offsets is ~17 sequential gather rounds of
-    # F random rows each, and the step cost is gather-volume bound
-    # (~constant per gathered row, tools/ablate_step.py), while nonempty
-    # segments have strictly increasing starts so cummax(marks)
-    # reconstructs the same mapping with one scatter + one cheap scan.
+    # F random rows each, and the step cost there is gather-volume
+    # bound); on CPU the scan is the expensive op (lax.cummax measured
+    # 0.8 ms per call vs cheap binary-search gathers), so searchsorted
+    # stays. Nonempty segments have strictly increasing starts, so both
+    # reconstruct the identical mapping.
     j = jnp.arange(F, dtype=jnp.int32)
-    startpos = jnp.where(flat_counts > 0, offsets, F)  # empty segs drop
-    marks = jnp.zeros(F, jnp.int32).at[startpos].max(
-        jnp.arange(1, F * S + 1, dtype=jnp.int32), mode="drop"
-    )
-    seg = jax.lax.cummax(marks) - 1  # -1 before the first segment
+    if counted_loop_backend():
+        startpos = jnp.where(flat_counts > 0, offsets, F)  # empty segs drop
+        marks = jnp.zeros(F, jnp.int32).at[startpos].max(
+            jnp.arange(1, F * S + 1, dtype=jnp.int32), mode="drop"
+        )
+        seg = jax.lax.cummax(marks) - 1  # -1 before the first segment
+    else:
+        seg = jnp.searchsorted(offsets, j, side="right").astype(jnp.int32) - 1
     seg = jnp.clip(seg, 0, F * S - 1)
     # within rides srcmat lane 7 (offsets[seg]) — no standalone gather
     in_range = j < jnp.minimum(total, F)
@@ -782,25 +786,59 @@ def loop_cond(max_steps: int, n_queries: int):
     return cond_fn
 
 
-def run_bfs_loop(step_fn, init, max_steps: int, n_queries: int):
-    """Drive step_fn to fixpoint: a COUNTED fori_loop whose body is
-    cond-gated, NOT a lax.while_loop.
+def tpu_class_backend() -> bool:
+    """Is the default backend TPU-class (TPU / the axon tunnel)? The
+    round-5 cost measurements split two backend-dependent choices off
+    this: the loop construct (counted_loop_backend) and expand_phase's
+    covering-segment algorithm (scan_seg_map_backend). Each has its own
+    predicate so one can be varied (debugging, a future GPU case)
+    without silently flipping the other."""
+    return jax.default_backend() not in ("cpu",)
 
-    Measured round 5 (axon-tunneled v5e): every while_loop ITERATION
-    costs ~3.8 ms of backend overhead regardless of body — a while loop
-    with a trivial body over this state costs the same ~49 ms as the
-    full r04 check kernel, while a fori_loop's iterations are free. The
-    entire r04 'op-overhead-bound step' was while-iteration overhead.
-    A counted loop has no data-dependent trip decision for the backend
-    to evaluate; the early-exit becomes a lax.cond inside the body
-    (XLA conditional executes only the taken branch, so resolved
-    batches pay a state pass-through, not a step)."""
-    cond_fn = loop_cond(max_steps, n_queries)
+
+def counted_loop_backend() -> bool:
+    """Should BFS loops run as counted fori+cond instead of while_loop?
+
+    Measured round 5, BOTH ways:
+    - axon-tunneled v5e: every while_loop ITERATION costs ~3.8 ms of
+      backend overhead regardless of body (a trivial-body while over
+      this state costs the same ~49 ms as the full r04 kernel; a
+      max_steps=1 kernel costs the same as max_steps=26) — the counted
+      loop removes it and resolved batches pay a cond pass-through.
+    - CPU: while_loop iterations are cheap and the loop EXITS EARLY
+      (the bench workload resolves in ~4 of 13 budgeted steps); a
+      counted loop runs all max_steps bodies-or-conds and measured
+      2.2x SLOWER end to end (134.7k -> 62.4k checks/s, this round).
+
+    So the choice keys off the backend at trace time. Semantics are
+    identical either way (loop_cond gates both)."""
+    return tpu_class_backend()
+
+
+def bounded_loop(cond_fn, step_fn, init, max_steps: int):
+    """Drive step_fn while cond_fn holds, never past max_steps; ONE
+    construct-selection site for every BFS loop (check, sharded check,
+    both expand kernels) per counted_loop_backend."""
+    if not counted_loop_backend():
+        return jax.lax.while_loop(cond_fn, step_fn, init)
 
     def body(i, st):
         return jax.lax.cond(cond_fn(st), step_fn, lambda s: s, st)
 
     return jax.lax.fori_loop(0, max_steps, body, init)
+
+
+def scan_seg_map_backend() -> bool:
+    """Should expand_phase build its covering-segment map with
+    scatter+cummax (TPU-class: binary search = 17 rounds of F random
+    gathers) instead of searchsorted (CPU: the scan is the expensive
+    op)? See tpu_class_backend."""
+    return tpu_class_backend()
+
+
+def run_bfs_loop(step_fn, init, max_steps: int, n_queries: int):
+    """bounded_loop under the check kernels' standard predicate."""
+    return bounded_loop(loop_cond(max_steps, n_queries), step_fn, init, max_steps)
 
 
 def finalize(

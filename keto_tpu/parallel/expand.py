@@ -35,7 +35,13 @@ except ImportError:  # pragma: no cover
 
 from ..engine.delta import DIRTY_FOR_EXPAND
 from ..engine.expand_kernel import _ExpandState
-from ..engine.kernel import Expansion, _pair_key_probe, dedupe_phase, dirty_lookup
+from ..engine.kernel import (
+    Expansion,
+    _pair_key_probe,
+    bounded_loop,
+    dedupe_phase,
+    dirty_lookup,
+)
 from ..engine.snapshot import EMPTY
 from .sharding import _EXPAND_SHARDED_KEYS
 
@@ -208,13 +214,10 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
         def cond_fn(st: _ExpandState):
             return (st.step < max_steps) & (st.n_tasks > 0)
 
-        # counted loop + cond-gated body (engine/kernel.run_bfs_loop
-        # rationale); the predicate is replicated, so all shards branch
-        # together and step_fn's collectives stay aligned
-        def body_fn(i, st):
-            return jax.lax.cond(cond_fn(st), step_fn, lambda s: s, st)
-
-        final = jax.lax.fori_loop(0, max_steps, body_fn, init)
+        # loop construct per backend (engine/kernel.bounded_loop); the
+        # predicate is replicated, so all shards branch together and
+        # step_fn's collectives stay aligned either way
+        final = bounded_loop(cond_fn, step_fn, init, max_steps)
         # single merge: each slot was written (value+1) by its owner only
         merged = [
             jax.lax.psum(a, axis) - 1

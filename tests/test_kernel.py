@@ -696,3 +696,93 @@ class TestHostFallbackCauses:
         e.check_batch([RelationTuple.from_string("w:o#wide@u")] * 2)
         text = metrics.export().decode()
         assert 'keto_tpu_host_fallback_total{cause="rewrite_cap"} 2.0' in text
+
+
+class TestCountedLoopBranch:
+    """bounded_loop picks fori+cond on TPU-class backends and while_loop
+    on CPU (engine/kernel.counted_loop_backend). CPU test runs would
+    otherwise never execute the counted branch — force it and pin the
+    differential so the on-chip construct stays covered off-chip.
+
+    Forcing requires clearing jit caches: earlier tests pre-warm traces
+    for the same (shapes, statics), and a cached executable would bypass
+    the patched selector entirely — each test asserts the selector
+    actually RAN during tracing (review r5 finding: the unasserted
+    version was vacuous)."""
+
+    @pytest.fixture(autouse=True)
+    def _cache_hygiene(self):
+        """Forced-branch executables must not leak into the global jit
+        cache (a later same-shape test would silently run the wrong
+        construct), and stale pre-force caches must not swallow the
+        forced trace — clear on both edges."""
+        import jax
+
+        jax.clear_caches()
+        yield
+        jax.clear_caches()
+
+    def _force_counted(self, monkeypatch):
+        import jax
+
+        from keto_tpu.engine import kernel as kmod
+
+        calls = {"n": 0}
+
+        def forced():
+            calls["n"] += 1
+            return True
+
+        # both TPU-class choices flip together: the point is covering
+        # the on-chip configuration (counted loop + scan seg map) on CPU
+        monkeypatch.setattr(kmod, "counted_loop_backend", forced)
+        monkeypatch.setattr(kmod, "scan_seg_map_backend", forced)
+        jax.clear_caches()
+        return calls
+
+    def test_counted_branch_matches_reference(self, monkeypatch):
+        calls = self._force_counted(monkeypatch)
+        e = make_tpu_engine(REWRITE_NAMESPACES, REWRITE_TUPLES, max_depth=100)
+        for query, expected in REWRITE_CASES:
+            res = e.check_batch([RelationTuple.from_string(query)], 100)[0]
+            assert res.error is None
+            want = expected == Membership.IS_MEMBER
+            assert res.allowed == want, query
+        assert calls["n"] > 0, "counted branch never traced (cache hit?)"
+
+    def test_counted_branch_early_exit_equivalence(self, monkeypatch):
+        """A batch that resolves in ~2 steps must produce identical
+        verdicts through both loop constructs (the cond pass-through
+        must not perturb state)."""
+        ns = [Namespace(name="n", relations=[Relation(name="r")])]
+        tuples = [f"n:o{i}#r@u{i}" for i in range(64)]
+        queries = [
+            RelationTuple.from_string(f"n:o{i}#r@u{i % 3}") for i in range(64)
+        ]
+        e1 = make_tpu_engine(ns, tuples)
+        base = [r.allowed for r in e1.check_batch(queries)]
+        calls = self._force_counted(monkeypatch)
+        e2 = make_tpu_engine(ns, tuples)
+        forced = [r.allowed for r in e2.check_batch(queries)]
+        assert forced == base
+        assert calls["n"] > 0, "counted branch never traced (cache hit?)"
+
+    def test_counted_branch_expand_kernel(self, monkeypatch):
+        """The expand kernel shares bounded_loop; its counted branch
+        must assemble identical trees."""
+        ns = [Namespace(name="n", relations=[
+            Relation(name="r"), Relation(name="g"),
+        ])]
+        tuples = (
+            [f"n:o#r@(n:m{i}#g)" for i in range(4)]
+            + [f"n:m{i}#g@u{j}" for i in range(4) for j in range(3)]
+        )
+        e1 = make_tpu_engine(ns, tuples)
+        sub = SubjectSet("n", "o", "r")
+        base = e1.expand_batch([sub], 4)[0]
+        calls = self._force_counted(monkeypatch)
+        e2 = make_tpu_engine(ns, tuples)
+        forced = e2.expand_batch([sub], 4)[0]
+        assert str(forced) == str(base)
+        assert calls["n"] > 0, "counted branch never traced (cache hit?)"
+
