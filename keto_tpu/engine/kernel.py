@@ -585,7 +585,7 @@ def expand_phase(
     # stays. Nonempty segments have strictly increasing starts, so both
     # reconstruct the identical mapping.
     j = jnp.arange(F, dtype=jnp.int32)
-    if counted_loop_backend():
+    if scan_seg_map_backend():
         startpos = jnp.where(flat_counts > 0, offsets, F)  # empty segs drop
         marks = jnp.zeros(F, jnp.int32).at[startpos].max(
             jnp.arange(1, F * S + 1, dtype=jnp.int32), mode="drop"

@@ -138,11 +138,12 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
                 ctx_hit, needs_host, *isl_state, st.step + 1,
             )
 
-        # counted loop + cond-gated body (run_bfs_loop): while_loop pays
-        # ~3.8 ms/iteration of backend overhead through the axon tunnel.
-        # The cond predicate is a pure function of the REPLICATED state,
-        # so every shard takes the same branch and the collectives
-        # inside step_fn stay aligned across the mesh.
+        # loop construct per backend (engine/kernel.bounded_loop via
+        # run_bfs_loop: counted fori+cond on TPU-class backends, early-
+        # exiting while_loop on CPU meshes). The trip decision is a pure
+        # function of the REPLICATED state either way, so every shard
+        # takes the same branch and the collectives inside step_fn stay
+        # aligned across the mesh.
         init = seed_state(q_obj, q_rel, q_depth, q_valid, F, n_island_cap, K)
         final = run_bfs_loop(step_fn, init, max_steps, B)
         return finalize(final, max_steps, B)

@@ -1,0 +1,150 @@
+"""Open-loop load generator for the serving plane.
+
+The r04 served numbers were CLOSED-loop: N clients each waiting for
+their previous response, so offered load is capped at N / latency and a
+slow server hides its own queueing (coordinated omission). This drives
+the read plane OPEN-loop: requests are scheduled on a fixed timeline at
+`--rate` regardless of completions, so latency-under-load and the
+saturation knee are visible.
+
+Two request shapes:
+  --mode single   one check per RPC (the v1alpha2 parity surface)
+  --mode batch    one BatchCheck RPC per tick carrying --batch checks
+                  (the keto_tpu extension; offered checks/s =
+                  rate * batch)
+
+    python tools/load_gen.py --addr 127.0.0.1:4466 --rate 200 \
+        --seconds 10 --mode batch --batch 512
+
+Prints one JSON line: offered vs achieved rate, completion latency
+percentiles (measured from SCHEDULED send time — queueing delay from a
+saturated server counts, as it should), error/timeout counts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--addr", default="127.0.0.1:4466")
+    ap.add_argument("--rate", type=float, default=100.0,
+                    help="request ticks per second (open-loop schedule)")
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--mode", choices=("single", "batch"), default="single")
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--workers", type=int, default=64,
+                    help="in-flight cap (past it, ticks count as shed)")
+    ap.add_argument("--queries", default=None,
+                    help="JSON file of relation tuples; default: the "
+                         "bench dataset's query mix")
+    args = ap.parse_args()
+
+    from keto_tpu.api import ReadClient, open_channel
+    from keto_tpu.ketoapi import RelationTuple
+
+    if args.queries:
+        with open(args.queries) as f:
+            queries = [RelationTuple.from_dict(d) for d in json.load(f)]
+    else:
+        import bench
+
+        _, _, queries = bench.build_dataset()
+
+    rng = random.Random(0)
+    qn = len(queries)
+
+    # a small client pool: gRPC channels multiplex, but one channel's
+    # Python-side completion queue serializes; a handful spreads it
+    clients = [ReadClient(open_channel(args.addr)) for _ in range(8)]
+
+    lock = threading.Lock()
+    lat: list[float] = []
+    errors = [0]
+    checks_done = [0]
+    shed = [0]
+    inflight = threading.Semaphore(args.workers)
+
+    def fire(scheduled: float, client: ReadClient) -> None:
+        try:
+            if args.mode == "single":
+                q = queries[rng.randrange(qn)]
+                client.check(q, timeout=args.timeout)
+                n = 1
+            else:
+                start = rng.randrange(qn)
+                qs = [queries[(start + j) % qn] for j in range(args.batch)]
+                client.check_batch(qs, timeout=args.timeout)
+                n = args.batch
+            done = time.perf_counter()
+            with lock:
+                lat.append(done - scheduled)
+                checks_done[0] += n
+        except Exception:
+            with lock:
+                errors[0] += 1
+        finally:
+            inflight.release()
+
+    n_ticks = int(args.rate * args.seconds)
+    interval = 1.0 / args.rate
+    t0 = time.perf_counter()
+    threads: list[threading.Thread] = []
+    for i in range(n_ticks):
+        scheduled = t0 + i * interval
+        now = time.perf_counter()
+        if scheduled > now:
+            time.sleep(scheduled - now)
+        if not inflight.acquire(blocking=False):
+            with lock:
+                shed[0] += 1
+            continue
+        th = threading.Thread(
+            target=fire, args=(scheduled, clients[i % len(clients)]),
+            daemon=True,
+        )
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=args.timeout + 5)
+    wall = time.perf_counter() - t0
+    for c in clients:
+        c.close()
+
+    import numpy as np
+
+    out = {
+        "mode": args.mode,
+        "offered_rps": args.rate,
+        "offered_checks_per_s": args.rate * (
+            1 if args.mode == "single" else args.batch
+        ),
+        "achieved_checks_per_s": round(checks_done[0] / wall, 1),
+        "completed_rpcs": len(lat),
+        "errors": errors[0],
+        "shed_ticks": shed[0],
+        "wall_s": round(wall, 2),
+    }
+    if lat:
+        a = np.array(lat) * 1e3
+        out.update({
+            "lat_p50_ms": round(float(np.percentile(a, 50)), 2),
+            "lat_p95_ms": round(float(np.percentile(a, 95)), 2),
+            "lat_p99_ms": round(float(np.percentile(a, 99)), 2),
+        })
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
