@@ -376,3 +376,68 @@ class TestBatcherBackpressure:
         for t in threads:
             t.join(timeout=20)
         assert not any(t.is_alive() for t in threads), "caller deadlocked"
+
+
+class TestSnaptokenConcurrency:
+    """Read-your-writes via snaptokens under concurrent writers/readers:
+    every write's token, presented immediately to the enforcement path
+    (engine/snaptoken.enforce_snaptoken) and then evaluated, must see
+    the write — across interleaved writers on the SAME registry."""
+
+    def test_tokens_always_satisfied_and_fresh(self):
+        from keto_tpu.engine.snaptoken import (
+            encode_snaptoken,
+            enforce_snaptoken,
+        )
+        from keto_tpu.registry import Registry
+
+        cfg = Config({
+            "dsn": "memory",
+            "check": {"engine": "tpu"},
+            "serve": {
+                "read": {"host": "127.0.0.1", "port": 0},
+                "write": {"host": "127.0.0.1", "port": 0},
+                "metrics": {"host": "127.0.0.1", "port": 0},
+            },
+        })
+        cfg.set_namespaces(NS)
+        reg = Registry(cfg)
+        manager = reg.relation_tuple_manager()
+        engine = reg.check_engine()
+        nid = reg.nid
+        errors: list[str] = []
+        stop = threading.Event()
+
+        def writer(wid: int) -> None:
+            i = 0
+            while not stop.is_set() and i < 25:
+                t = RelationTuple.from_string(f"f:w{wid}x{i}#owner@u{wid}")
+                manager.write_relation_tuples([t], nid=nid)
+                token = encode_snaptoken(manager.version(nid=nid), nid)
+                try:
+                    # enforcement must accept a just-minted token...
+                    enforce_snaptoken(reg, token, nid)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"w{wid}: token rejected: {e}")
+                    return
+                # ...and the evaluated verdict must include the write
+                res = engine.check_batch([t])[0]
+                if res.error is not None or not res.allowed:
+                    errors.append(f"w{wid}x{i}: stale read after token")
+                    return
+                i += 1
+
+        threads = [
+            threading.Thread(target=writer, args=(w,)) for w in range(4)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=120)
+        stop.set()
+        assert not errors, errors[:3]
+        # tokens from the far future still fail after all the writes
+        from keto_tpu.engine.snaptoken import SnaptokenUnsatisfiableError
+
+        with pytest.raises(SnaptokenUnsatisfiableError):
+            enforce_snaptoken(reg, encode_snaptoken(10**9, nid), nid)
