@@ -131,12 +131,12 @@ class SnapshotView:
 def _fixed_capacity_table(keys, values, capacity: int):
     """_build_hash_table with a hard shape: raises DeltaOverflow when the
     build needs more capacity or deeper probing than the statics allow."""
-    # boost_pair_load=False: these shapes are STATIC (DELTA_CAPACITY /
-    # DIRTY_CAPACITY compile into the kernel); the pair-load boost would
+    # boost_load=False: these shapes are STATIC (DELTA_CAPACITY /
+    # DIRTY_CAPACITY compile into the kernel); the load boost would
     # grow a full-threshold batch past the fixed shape and force the
     # spurious compaction the capacity was sized to prevent
     built = _build_hash_table(
-        keys, values, min_capacity=capacity, boost_pair_load=False
+        keys, values, min_capacity=capacity, boost_load=False
     )
     *cols, probes = built
     if cols[0].shape[0] != capacity or probes > DELTA_PROBES:

@@ -145,26 +145,30 @@ def hash_table_capacity(n: int, min_capacity: int = 64) -> int:
     return cap
 
 
-def table_capacity(n: int, n_key_cols: int, min_capacity: int = 64) -> int:
+def table_capacity(n: int, min_capacity: int = 64) -> int:
     """Capacity rule shared by the builder AND the sharded equal-capacity
     seed estimates (a mismatched seed makes every sharded build run
-    twice through the grow-retry loop). Pair tables run HALF the load of
-    edge tables: 16-slot buckets at cap=4n average ~3.75 keys/bucket and
-    the bucketized probe limit IS the max bucket occupancy (measured
-    14-16 on real tables — tripling CPU probe volume and risking PB=2 on
-    TPU); cap=8n puts the max back to ~8-10 for 2x a SMALL table's
-    bytes. Fixed-capacity callers (the delta overlay's static shapes,
-    where occupancy is tiny and shape stability is the contract) pass
-    boost_pair_load=False to _build_hash_table instead."""
+    twice through the grow-retry loop). ALL bucketized tables run half
+    the classic 4n sizing: the probe limit IS the max bucket occupancy,
+    and cap=8n keeps chains inside one bucket (bench tables: dh probes
+    8 -> 5, rh 14 -> 9). Fixed-capacity callers (the delta overlay's
+    static shapes, where occupancy is tiny and shape stability is the
+    contract) pass boost_load=False to _build_hash_table instead."""
     cap = hash_table_capacity(n, min_capacity)
-    if slots_per_bucket(n_key_cols) == 16 and cap < 8 * n:
+    if cap < 8 * n:
+        # bucketized tables run HALF the classic load: the probe limit
+        # IS the max bucket occupancy, so average occupancy ~1 (8-slot
+        # edge buckets) / ~2 (16-slot pair buckets) keeps chains inside
+        # one bucket on TPU and the CPU fallback's probe volume near the
+        # double-hashing era's. 2x bytes; at 1e8 that is ~5.8 GB/device
+        # of a v5e's 16 GB.
         cap *= 2
     return cap
 
 
 def _build_hash_table(
     keys: tuple[np.ndarray, ...], values: np.ndarray, min_capacity: int = 64,
-    boost_pair_load: bool = True,
+    boost_load: bool = True,
 ) -> tuple[np.ndarray, ...]:
     """Build an open-addressing table (double hashing, power-of-two size,
     load ≤ 0.25 per hash_table_capacity). Returns (slot arrays for each
@@ -174,8 +178,8 @@ def _build_hash_table(
     """
     n = len(values)
     cap = (
-        table_capacity(n, len(keys), min_capacity)
-        if boost_pair_load
+        table_capacity(n, min_capacity)
+        if boost_load
         else hash_table_capacity(n, min_capacity)
     )
     h1_all = hash_combine(*keys)

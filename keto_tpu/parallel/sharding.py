@@ -32,7 +32,6 @@ from ..engine.snapshot import (
     build_edge_tables,
     build_snapshot,
     encode_edge_arrays,
-    hash_table_capacity,
     mix32,
     table_capacity,
 )
@@ -112,14 +111,14 @@ def _stack_sharded_edge_tables(
 
     # equal capacities across shards: start from the max natural need and
     # grow until every shard builds without internal growth
+    # seed with the SAME capacity rule the builder applies
+    # (table_capacity's half-load boost), or every sharded build's
+    # first pass mismatches and rebuilds all shards
     dh_cap = max(
-        hash_table_capacity(int(m.sum())) for m in masks
+        table_capacity(int(m.sum())) for m in masks
     )
-    # rh/fh are 2-key pair tables: seed with the SAME capacity rule the
-    # builder applies (table_capacity's pair-load boost), or every
-    # sharded build's first pass mismatches and rebuilds all shards
     rh_cap = max(
-        table_capacity(int((m & (t_skind == 1)).sum()), 2) for m in masks
+        table_capacity(int((m & (t_skind == 1)).sum())) for m in masks
     )
     while True:
         per_shard = [
@@ -314,7 +313,7 @@ def sharded_full_csr_from_encoded(
         key = t_obj[m].astype(np.int64) * (1 << 31) + t_rel[m].astype(np.int64)
         return int(np.unique(key).size)
 
-    fh_cap = max(table_capacity(n_rows_of(m), 2) for m in masks)
+    fh_cap = max(table_capacity(n_rows_of(m)) for m in masks)
     while True:
         per_shard = []
         for m in masks:

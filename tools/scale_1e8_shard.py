@@ -73,7 +73,6 @@ def build_phase(args) -> int:
     from keto_tpu.engine.snapshot import (
         build_edge_tables,
         columnar_encode,
-        hash_table_capacity,
         table_capacity,
     )
     from keto_tpu.parallel.sharding import shard_of_objslot
@@ -125,8 +124,8 @@ def build_phase(args) -> int:
     set_counts = np.bincount(
         shard[t_skind == 1], minlength=N_SHARDS
     )
-    dh_cap = max(hash_table_capacity(int(c)) for c in counts)
-    rh_cap = max(table_capacity(int(c), 2) for c in set_counts)
+    dh_cap = max(table_capacity(int(c)) for c in counts)
+    rh_cap = max(table_capacity(int(c)) for c in set_counts)
     record["edges_per_shard"] = counts.tolist()
     record["dh_cap"] = int(dh_cap)
     record["rh_cap"] = int(rh_cap)
