@@ -186,6 +186,39 @@ def build_dataset():
     return namespaces, tuples, queries
 
 
+def _calibrate_batch(candidates) -> dict:
+    """Short pipelined burst per candidate batch size on the flagship
+    dataset; returns {"best": B, "rates": {B: qps}}. Separate engines
+    (frontier scales with the batch) — each pays one XLA compile, then 8
+    pipelined launches measure the steady rate."""
+    from keto_tpu.config import Config
+    from keto_tpu.engine.tpu_engine import TPUCheckEngine
+    from keto_tpu.storage import MemoryManager
+
+    namespaces, tuples, queries = build_dataset()
+    cfg = Config({"limit": {"max_read_depth": 5}})
+    cfg.set_namespaces(namespaces)
+    manager = MemoryManager()
+    manager.write_relation_tuples(tuples)
+    rates: dict = {}
+    for B in candidates:
+        engine = TPUCheckEngine(manager, cfg, frontier_cap=2 * B)
+        qs = [queries[i % len(queries)] for i in range(B)]
+        engine.check_batch(qs)  # compile + warm
+        n, window = 8, 4
+        t0 = time.perf_counter()
+        handles = []
+        for _ in range(n):
+            handles.append(engine.check_batch_submit(qs))
+            if len(handles) > window:
+                engine.check_batch_resolve(handles.pop(0))
+        for h in handles:
+            engine.check_batch_resolve(h)
+        rates[B] = round(n * B / (time.perf_counter() - t0), 1)
+    best = max(rates, key=rates.get)
+    return {"best": best, "rates": {str(k): v for k, v in rates.items()}}
+
+
 def bench_kernel(namespaces, tuples, queries) -> dict:
     """Device-kernel path: warm-up (snapshot build + XLA compile) is kept
     out of the timed region.
@@ -831,6 +864,17 @@ def main() -> int:
         BATCH = 16384
     if not _EXPAND_FROM_ENV and platform == "tpu":
         EXPAND_BATCH = 1024
+    calibrated = None
+    if not _BATCH_FROM_ENV and platform == "tpu":
+        # the round-5 counted-loop fix collapsed the kernel's fixed cost,
+        # which moves the launch-amortization sweet spot; calibrate with
+        # a short pipelined burst at each candidate instead of trusting
+        # the r04 sweep. ~1 compile + ~8 launches per candidate.
+        try:
+            calibrated = _calibrate_batch((16384, 32768))
+            BATCH = calibrated["best"]
+        except Exception as e:  # calibration must never sink the bench
+            calibrated = {"error": f"{type(e).__name__}: {e}"[:200]}
 
     record: dict = {
         "metric": "batched_check_qps",
