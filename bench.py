@@ -77,6 +77,9 @@ SERVE_SECONDS = 8.0
 # RPC carries thousands per round-trip)
 SERVE_BATCH_SIZE = int(os.environ.get("KETO_BENCH_SERVE_BATCH", 2048))
 SERVE_BATCH_CLIENTS = int(os.environ.get("KETO_BENCH_SERVE_BATCH_CLIENTS", 4))
+# reverse-reachability leg (ListObjects/ListSubjects, bench_reverse):
+# batch of concurrent enumerations per device launch
+LIST_BATCH = int(os.environ.get("KETO_BENCH_LIST_BATCH", 256))
 
 _PROBE_SCRIPT = (
     "import jax, jax.numpy as jnp; d = jax.devices();"
@@ -456,6 +459,80 @@ def bench_config3_expand() -> dict:
         # timed-region fallbacks only (warm-up batch excluded)
         "expand_host": engine.stats.get("host_expands", 0) - host_after_warmup,
     }
+
+
+def bench_reverse(namespaces, tuples) -> dict:
+    """Reverse-reachability workload (engine/reverse_kernel.py): the
+    subject-centric inverse of the flagship check bench. ListObjects asks
+    "which videos can this user view?" for LIST_BATCH random users over
+    the cat-videos topology (reverse BFS over the transposed mirror);
+    ListSubjects asks "who can view this video?" over random files of
+    the same topology (forward enumeration over the full-edge CSR +
+    rewrites: the owner computed-set and the parent-folder TTU both
+    traverse per query). Caps are sized so the workload stays on device —
+    a fallback would silently measure the O(candidates x check) host
+    oracle instead."""
+    import random as _random
+
+    from keto_tpu.config import Config
+    from keto_tpu.engine.tpu_engine import TPUCheckEngine
+    from keto_tpu.storage import MemoryManager
+
+    rng = _random.Random(11)
+    cfg = Config({"limit": {"max_read_depth": 5}})
+    cfg.set_namespaces(namespaces)
+    m = MemoryManager()
+    m.write_relation_tuples(tuples)
+    engine = TPUCheckEngine(m, cfg)
+    B = LIST_BATCH
+    lo_queries = [
+        ("videos", "view", f"user{rng.randrange(N_USERS)}") for _ in range(B)
+    ]
+    ls_queries = [
+        (
+            "videos",
+            f"/d{rng.randrange(N_FOLDERS)}/v{rng.randrange(FILES_PER_FOLDER)}.mp4",
+            "view",
+        )
+        for _ in range(B)
+    ]
+    caps = dict(
+        frontier_cap=max(16384, 4 * B),
+        result_cap=2048,
+        pool_cap=64 * B,
+    )
+    out: dict = {"list_batch": B}
+    rounds = 5
+
+    t0 = time.perf_counter()
+    engine.list_objects_batch(lo_queries, 5, **caps)  # build + compile
+    out["listobjects_warmup_s"] = round(time.perf_counter() - t0, 2)
+    host0 = engine.stats.get("host_list_objects", 0)
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        res = engine.list_objects_batch(lo_queries, 5, **caps)
+    wall = time.perf_counter() - t0
+    out["listobjects_qps"] = round(rounds * B / wall, 1)
+    out["listobjects_avg_results"] = round(
+        sum(len(r) for r in res) / max(len(res), 1), 1
+    )
+    # timed-region fallbacks only (device-exactness health signal)
+    out["listobjects_host"] = engine.stats.get("host_list_objects", 0) - host0
+
+    t0 = time.perf_counter()
+    engine.list_subjects_batch(ls_queries, 5, **caps)
+    out["listsubjects_warmup_s"] = round(time.perf_counter() - t0, 2)
+    host0 = engine.stats.get("host_list_subjects", 0)
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        res = engine.list_subjects_batch(ls_queries, 5, **caps)
+    wall = time.perf_counter() - t0
+    out["listsubjects_qps"] = round(rounds * B / wall, 1)
+    out["listsubjects_avg_results"] = round(
+        sum(len(r) for r in res) / max(len(res), 1), 1
+    )
+    out["listsubjects_host"] = engine.stats.get("host_list_subjects", 0) - host0
+    return out
 
 
 def _tree_size(tree) -> int:
@@ -908,6 +985,7 @@ def main() -> int:
         record.update(bench_config3_islands())
         record.update(bench_config3_expand())
         record.update(bench_config4_deep())
+        record.update(bench_reverse(namespaces, tuples))
 
         if not args.skip_serve:
             record.update(bench_served(namespaces, tuples, queries))

@@ -287,6 +287,7 @@ def _aio_handlers(service: _AioReadServices):
         EXPAND_SERVICE,
         HEALTH_SERVICE,
         READ_SERVICE,
+        REVERSE_READ_SERVICE,
         VERSION_SERVICE,
     )
 
@@ -323,6 +324,18 @@ def _aio_handlers(service: _AioReadServices):
                     "ListRelationTuples", svc.list_relation_tuples
                 ),
                 pb.ListRelationTuplesRequest,
+            ),
+        }),
+        # reverse-reachability extension: blocking device/store work,
+        # delegated like Expand/List
+        grpc.method_handlers_generic_handler(REVERSE_READ_SERVICE, {
+            "ListObjects": unary(
+                service._delegated("ListObjects", svc.list_objects),
+                pb.ListObjectsRequest,
+            ),
+            "ListSubjects": unary(
+                service._delegated("ListSubjects", svc.list_subjects),
+                pb.ListSubjectsRequest,
             ),
         }),
         grpc.method_handlers_generic_handler(VERSION_SERVICE, {

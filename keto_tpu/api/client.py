@@ -21,6 +21,7 @@ from .descriptors import (
     EXPAND_SERVICE,
     HEALTH_SERVICE,
     READ_SERVICE,
+    REVERSE_READ_SERVICE,
     VERSION_SERVICE,
     WRITE_SERVICE,
     pb,
@@ -149,6 +150,56 @@ class ReadClient(_BaseClient):
         req.subject.CopyFrom(subject_to_proto(subject))
         resp = self._rpc(EXPAND_SERVICE, "Expand", req, pb.ExpandResponse, timeout)
         return tree_from_proto(resp.tree)
+
+    def list_objects(
+        self,
+        namespace: str,
+        relation: str,
+        subject: Subject,
+        max_depth: int = 0,
+        page_size: int = 0,
+        page_token: str = "",
+        timeout=None,
+        snaptoken: str = "",
+    ) -> tuple[list[str], str, str]:
+        """keto_tpu reverse-reachability extension (ReverseReadService):
+        (sorted object names, next_page_token, response snaptoken). Only
+        this framework's server implements the service; a stock Keto
+        deployment raises UNIMPLEMENTED."""
+        req = pb.ListObjectsRequest(
+            namespace=namespace, relation=relation, max_depth=max_depth,
+            page_size=page_size, page_token=page_token, snaptoken=snaptoken,
+        )
+        req.subject.CopyFrom(subject_to_proto(subject))
+        resp = self._rpc(
+            REVERSE_READ_SERVICE, "ListObjects", req,
+            pb.ListObjectsResponse, timeout,
+        )
+        return list(resp.objects), resp.next_page_token, resp.snaptoken
+
+    def list_subjects(
+        self,
+        namespace: str,
+        obj: str,
+        relation: str,
+        max_depth: int = 0,
+        page_size: int = 0,
+        page_token: str = "",
+        timeout=None,
+        snaptoken: str = "",
+    ) -> tuple[list[str], str, str]:
+        """keto_tpu reverse-reachability extension: (sorted plain subject
+        ids, next_page_token, response snaptoken)."""
+        req = pb.ListSubjectsRequest(
+            namespace=namespace, object=obj, relation=relation,
+            max_depth=max_depth, page_size=page_size, page_token=page_token,
+            snaptoken=snaptoken,
+        )
+        resp = self._rpc(
+            REVERSE_READ_SERVICE, "ListSubjects", req,
+            pb.ListSubjectsResponse, timeout,
+        )
+        return list(resp.subject_ids), resp.next_page_token, resp.snaptoken
 
     def list_relation_tuples(
         self,

@@ -63,6 +63,12 @@ pb = SimpleNamespace(
     BatchCheckRequest=_msg("keto_tpu.batch.v1.BatchCheckRequest"),
     BatchCheckResult=_msg("keto_tpu.batch.v1.BatchCheckResult"),
     BatchCheckResponse=_msg("keto_tpu.batch.v1.BatchCheckResponse"),
+    # reverse-reachability extension (keto_tpu_reverse.proto; descriptor
+    # appended by tools/gen_reverse_descriptor.py — the image has no protoc)
+    ListObjectsRequest=_msg("keto_tpu.reverse.v1.ListObjectsRequest"),
+    ListObjectsResponse=_msg("keto_tpu.reverse.v1.ListObjectsResponse"),
+    ListSubjectsRequest=_msg("keto_tpu.reverse.v1.ListSubjectsRequest"),
+    ListSubjectsResponse=_msg("keto_tpu.reverse.v1.ListSubjectsResponse"),
 )
 
 NODE_TYPE = _pool.FindEnumTypeByName(f"{_PKG}.NodeType")
@@ -79,3 +85,5 @@ VERSION_SERVICE = f"{_PKG}.VersionService"
 HEALTH_SERVICE = "grpc.health.v1.Health"
 # extension (keto_tpu_batch.proto): batched Check beside the parity API
 BATCH_CHECK_SERVICE = "keto_tpu.batch.v1.BatchCheckService"
+# extension (keto_tpu_reverse.proto): ListObjects / ListSubjects
+REVERSE_READ_SERVICE = "keto_tpu.reverse.v1.ReverseReadService"

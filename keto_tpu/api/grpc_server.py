@@ -39,6 +39,7 @@ from .descriptors import (
     EXPAND_SERVICE,
     HEALTH_SERVICE,
     READ_SERVICE,
+    REVERSE_READ_SERVICE,
     VERSION_SERVICE,
     WRITE_SERVICE,
     pb,
@@ -225,6 +226,63 @@ class _Services:
         resp.tree.CopyFrom(tree_to_proto(tree))
         return resp
 
+    # -- ReverseReadService (keto_tpu extension) ------------------------------
+
+    def list_objects(self, req, context):
+        """keto_tpu extension (keto_tpu_reverse.proto): which objects in
+        a namespace can this subject reach via a relation — the inverse
+        of Check, served by the reverse-BFS kernel over the transposed
+        device mirror (engine/reverse_kernel.py). Paginated and
+        snaptoken-enforced like Check; unknown namespace is an ERROR
+        (gRPC plane semantics)."""
+        from ..engine.snaptoken import encode_snaptoken
+        from ..ketoapi import RelationQuery
+
+        sub = subject_from_proto(req.subject)
+        if sub is None:
+            from ..errors import NilSubjectError
+
+            raise NilSubjectError()
+        self.registry.validate_namespaces(
+            RelationQuery(namespace=req.namespace),
+            sub if isinstance(sub, SubjectSet) else None,
+        )
+        nid = self._nid(context)
+        version = self._enforce_snaptoken(req.snaptoken, nid)
+        engine = self.registry.check_engine(nid)
+        page_size = int(req.page_size) or self.registry.config.page_size()
+        objects, next_token = engine.list_objects(
+            req.namespace, req.relation, sub, int(req.max_depth),
+            page_size=page_size, page_token=req.page_token,
+        )
+        resp = pb.ListObjectsResponse(
+            next_page_token=next_token, snaptoken=encode_snaptoken(version, nid)
+        )
+        resp.objects.extend(objects)
+        return resp
+
+    def list_subjects(self, req, context):
+        """keto_tpu extension: which plain subject ids reach
+        namespace:object#relation — forward enumeration over the
+        full-edge CSR + rewrite instructions."""
+        from ..engine.snaptoken import encode_snaptoken
+        from ..ketoapi import RelationQuery
+
+        self.registry.validate_namespaces(RelationQuery(namespace=req.namespace))
+        nid = self._nid(context)
+        version = self._enforce_snaptoken(req.snaptoken, nid)
+        engine = self.registry.check_engine(nid)
+        page_size = int(req.page_size) or self.registry.config.page_size()
+        subjects, next_token = engine.list_subjects(
+            req.namespace, req.object, req.relation, int(req.max_depth),
+            page_size=page_size, page_token=req.page_token,
+        )
+        resp = pb.ListSubjectsResponse(
+            next_page_token=next_token, snaptoken=encode_snaptoken(version, nid)
+        )
+        resp.subject_ids.extend(subjects)
+        return resp
+
     # -- ReadService ----------------------------------------------------------
 
     def list_relation_tuples(self, req, context):
@@ -392,6 +450,19 @@ def _service_handlers(services: _Services, write: bool):
                             s, "ListRelationTuples", s.list_relation_tuples,
                             pb.ListRelationTuplesRequest,
                         )
+                    },
+                ),
+                grpc.method_handlers_generic_handler(
+                    REVERSE_READ_SERVICE,
+                    {
+                        "ListObjects": _unary(
+                            s, "ListObjects", s.list_objects,
+                            pb.ListObjectsRequest,
+                        ),
+                        "ListSubjects": _unary(
+                            s, "ListSubjects", s.list_subjects,
+                            pb.ListSubjectsRequest,
+                        ),
                     },
                 ),
             ]

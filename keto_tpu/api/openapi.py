@@ -16,6 +16,8 @@ from .rest_server import (
     CHECK_OPENAPI_ROUTE,
     CHECK_ROUTE_BASE,
     EXPAND_ROUTE,
+    LIST_OBJECTS_ROUTE,
+    LIST_SUBJECTS_ROUTE,
     READ_ROUTE_BASE,
     READY_PATH,
     ROUTE_KINDS,
@@ -107,6 +109,32 @@ def _schemas() -> dict:
                         },
                     },
                 },
+            },
+        },
+        "listObjectsResponse": {
+            "type": "object",
+            "required": ["objects"],
+            "properties": {
+                "objects": {
+                    "type": "array",
+                    "items": {"type": "string"},
+                    "description": "sorted object names the subject "
+                                   "reaches (deterministic pagination)",
+                },
+                "next_page_token": {"type": "string"},
+            },
+        },
+        "listSubjectsResponse": {
+            "type": "object",
+            "required": ["subject_ids"],
+            "properties": {
+                "subject_ids": {
+                    "type": "array",
+                    "items": {"type": "string"},
+                    "description": "sorted plain subject ids that reach "
+                                   "the object",
+                },
+                "next_page_token": {"type": "string"},
             },
         },
         "getResponse": {
@@ -300,6 +328,67 @@ def build_spec(version: str = "", kind: str | None = None) -> dict:
                 },
             }
         },
+        LIST_OBJECTS_ROUTE: {
+            "get": {
+                "summary": "List the objects a subject reaches via a "
+                           "relation (keto_tpu reverse-reachability "
+                           "extension)",
+                "parameters": _SUBJECT_QUERY_PARAMS + [
+                    _MAX_DEPTH_PARAM, snaptoken_param,
+                    {"name": "page_size", "in": "query",
+                     "schema": {"type": "integer"}},
+                    {"name": "page_token", "in": "query",
+                     "schema": {"type": "string"}},
+                ],
+                "responses": {
+                    "200": {
+                        **_json_response(
+                            "objects the subject reaches",
+                            "listObjectsResponse",
+                        ),
+                        "headers": snaptoken_header,
+                    },
+                    "400": _json_response("malformed input", "errorGeneric"),
+                    "404": _json_response("unknown namespace", "errorGeneric"),
+                    "409": _json_response(
+                        "snaptoken demands a newer snapshot", "errorGeneric"
+                    ),
+                },
+            }
+        },
+        LIST_SUBJECTS_ROUTE: {
+            "get": {
+                "summary": "List the subject ids that reach an object "
+                           "(keto_tpu reverse-reachability extension)",
+                "parameters": [
+                    {"name": "namespace", "in": "query", "required": True,
+                     "schema": {"type": "string"}},
+                    {"name": "object", "in": "query", "required": True,
+                     "schema": {"type": "string"}},
+                    {"name": "relation", "in": "query", "required": True,
+                     "schema": {"type": "string"}},
+                    _MAX_DEPTH_PARAM, snaptoken_param,
+                    {"name": "page_size", "in": "query",
+                     "schema": {"type": "integer"}},
+                    {"name": "page_token", "in": "query",
+                     "schema": {"type": "string"}},
+                ],
+                "responses": {
+                    "200": {
+                        **_json_response(
+                            "subject ids that reach the object",
+                            "listSubjectsResponse",
+                        ),
+                        "headers": snaptoken_header,
+                    },
+                    "400": _json_response("malformed input", "errorGeneric"),
+                    "404": _json_response("unknown namespace", "errorGeneric"),
+                    "409": _json_response(
+                        "snaptoken demands a newer snapshot", "errorGeneric"
+                    ),
+                },
+            }
+        },
         WRITE_ROUTE_BASE: {
             "put": {
                 "summary": "Create one relation tuple",
@@ -356,6 +445,8 @@ def build_spec(version: str = "", kind: str | None = None) -> dict:
         (CHECK_OPENAPI_ROUTE, "post"): "postCheck",
         (CHECK_BATCH_ROUTE, "post"): "postBatchCheck",
         (EXPAND_ROUTE, "get"): "getExpand",
+        (LIST_OBJECTS_ROUTE, "get"): "getListObjects",
+        (LIST_SUBJECTS_ROUTE, "get"): "getListSubjects",
         (WRITE_ROUTE_BASE, "put"): "createRelationTuple",
         (WRITE_ROUTE_BASE, "delete"): "deleteRelationTuples",
         (WRITE_ROUTE_BASE, "patch"): "patchRelationTuples",

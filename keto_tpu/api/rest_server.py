@@ -43,6 +43,11 @@ CHECK_OPENAPI_ROUTE = "/relation-tuples/check/openapi"
 # check API — check/handler.go resolves one tuple per request)
 CHECK_BATCH_ROUTE = "/relation-tuples/check/batch"
 EXPAND_ROUTE = "/relation-tuples/expand"
+# keto_tpu reverse-reachability extension (engine/reverse_kernel.py):
+# "which objects can this subject reach" / "which subjects reach this
+# object" — the reference has no such routes (Zanzibar's Leopard family)
+LIST_OBJECTS_ROUTE = "/relation-tuples/list-objects"
+LIST_SUBJECTS_ROUTE = "/relation-tuples/list-subjects"
 WRITE_ROUTE_BASE = "/admin/relation-tuples"
 ALIVE_PATH = "/health/alive"
 READY_PATH = "/health/ready"
@@ -59,6 +64,8 @@ ROUTE_KINDS = {
     CHECK_OPENAPI_ROUTE: "read",
     CHECK_BATCH_ROUTE: "read",
     EXPAND_ROUTE: "read",
+    LIST_OBJECTS_ROUTE: "read",
+    LIST_SUBJECTS_ROUTE: "read",
     WRITE_ROUTE_BASE: "write",
     ALIVE_PATH: "shared",
     READY_PATH: "shared",
@@ -66,6 +73,17 @@ ROUTE_KINDS = {
     SPEC_ROUTE: "shared",
     METRICS_PATH: "metrics",
 }
+
+
+def _get_page_size(params: dict[str, str], default: int) -> int:
+    """page_size query param; malformed values are a 400, not a 500."""
+    raw = params.get("page_size", "")
+    if not raw:
+        return default
+    try:
+        return int(raw) or default
+    except ValueError:
+        raise MalformedInputError(debug=f"invalid page_size {raw!r}")
 
 
 def _get_max_depth(params: dict[str, str]) -> int:
@@ -258,6 +276,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return CHECK_BATCH_ROUTE, self._check_batch
             if method == "GET" and path == EXPAND_ROUTE:
                 return EXPAND_ROUTE, self._expand
+            if method == "GET" and path == LIST_OBJECTS_ROUTE:
+                return LIST_OBJECTS_ROUTE, self._list_objects
+            if method == "GET" and path == LIST_SUBJECTS_ROUTE:
+                return LIST_SUBJECTS_ROUTE, self._list_subjects
             return None
 
         # write router
@@ -345,11 +367,16 @@ class _Handler(BaseHTTPRequestHandler):
         body = self._body_json()
         if isinstance(body, dict):
             raw = body.get("tuples")
-            try:
-                max_depth = int(body.get("max_depth") or 0)
-            except (TypeError, ValueError):
-                raise MalformedInputError("max_depth must be an integer")
-            max_depth = max_depth or _get_max_depth(params)
+            raw_depth = body.get("max_depth")
+            if raw_depth is None:
+                # ABSENCE, not falsiness: an explicit JSON max_depth of 0
+                # must override a non-zero ?max-depth query param
+                max_depth = _get_max_depth(params)
+            else:
+                try:
+                    max_depth = int(raw_depth)
+                except (TypeError, ValueError):
+                    raise MalformedInputError("max_depth must be an integer")
         else:
             raw = body
             max_depth = _get_max_depth(params)
@@ -417,6 +444,89 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(404, NotFoundError("no relation tuples found").to_dict())
             return
         self._json(200, tree.to_dict())
+
+    def _list_objects(self) -> None:
+        """keto_tpu reverse-reachability extension: GET with namespace,
+        relation, and a subject (subject_id or subject_set.*) -> the
+        sorted objects the subject reaches, paginated; snaptoken-
+        enforced like check, evaluated-version token in the
+        X-Keto-Snaptoken header."""
+        from ..engine.snaptoken import encode_snaptoken
+
+        params = self._params()
+        max_depth = _get_max_depth(params)
+        namespace = params.get("namespace")
+        relation = params.get("relation")
+        if not namespace or not relation:
+            raise MalformedInputError(
+                debug="list-objects requires namespace and relation"
+            )
+        subject = self._subject_from_params(params)
+        nid = self._nid()
+        version = self._enforce_snaptoken(params.get("snaptoken", ""), nid)
+        self.registry.validate_namespaces(
+            RelationQuery(namespace=namespace),
+            subject if isinstance(subject, SubjectSet) else None,
+        )
+        page_size = _get_page_size(params, self.registry.config.page_size())
+        engine = self.registry.check_engine(nid)
+        objects, next_token = engine.list_objects(
+            namespace, relation, subject, max_depth,
+            page_size=page_size, page_token=params.get("page_token", ""),
+        )
+        self._json(
+            200,
+            {"objects": objects, "next_page_token": next_token},
+            extra_headers=[("X-Keto-Snaptoken", encode_snaptoken(version, nid))],
+        )
+
+    def _list_subjects(self) -> None:
+        """keto_tpu reverse-reachability extension: GET with namespace,
+        object, relation -> the sorted plain subject ids that reach the
+        node, paginated."""
+        from ..engine.snaptoken import encode_snaptoken
+
+        params = self._params()
+        max_depth = _get_max_depth(params)
+        try:
+            namespace = params["namespace"]
+            obj = params["object"]
+            relation = params["relation"]
+        except KeyError:
+            raise MalformedInputError(
+                debug="list-subjects requires namespace, object, and relation"
+            )
+        nid = self._nid()
+        version = self._enforce_snaptoken(params.get("snaptoken", ""), nid)
+        self.registry.validate_namespaces(RelationQuery(namespace=namespace))
+        page_size = _get_page_size(params, self.registry.config.page_size())
+        engine = self.registry.check_engine(nid)
+        subjects, next_token = engine.list_subjects(
+            namespace, obj, relation, max_depth,
+            page_size=page_size, page_token=params.get("page_token", ""),
+        )
+        self._json(
+            200,
+            {"subject_ids": subjects, "next_page_token": next_token},
+            extra_headers=[("X-Keto-Snaptoken", encode_snaptoken(version, nid))],
+        )
+
+    @staticmethod
+    def _subject_from_params(params: dict[str, str]):
+        """subject_id or subject_set.{namespace,object,relation} from URL
+        params (the check route's subject vocabulary)."""
+        if "subject_id" in params:
+            return params["subject_id"]
+        try:
+            return SubjectSet(
+                namespace=params["subject_set.namespace"],
+                object=params["subject_set.object"],
+                relation=params["subject_set.relation"],
+            )
+        except KeyError:
+            raise MalformedInputError(
+                debug="a subject_id or subject_set.* subject is required"
+            )
 
     # -- write handlers -------------------------------------------------------
 

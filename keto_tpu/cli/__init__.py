@@ -468,6 +468,61 @@ def cmd_expand(args) -> int:
     return 0
 
 
+def cmd_list_objects(args) -> int:
+    """keto_tpu extension: which objects can this subject reach — the
+    reverse of `check`, served by the transposed-mirror kernel. The
+    subject is a plain id positional or --subject-set
+    "namespace:object#relation"."""
+    if args.subject is None and not args.subject_set:
+        raise CLIError("a subject id or --subject-set is required")
+    subject = (
+        SubjectSet.from_string(args.subject_set)
+        if args.subject_set
+        else args.subject
+    )
+    client = _read_client(args)
+    try:
+        objects, next_token, token = client.list_objects(
+            args.namespace, args.relation, subject,
+            max_depth=args.max_depth, page_size=args.page_size,
+            page_token=args.page_token, snaptoken=args.snaptoken or "",
+        )
+    finally:
+        client.close()
+    obj = {"objects": objects, "next_page_token": next_token}
+    text = "\n".join(objects) if objects else "<no objects>"
+    if next_token:
+        text += f"\nNEXT PAGE TOKEN\t{next_token}"
+    if getattr(args, "print_snaptoken", False):
+        obj["snaptoken"] = token
+        text += f"\n{token}"
+    _print_formatted(args, obj, text)
+    return 0
+
+
+def cmd_list_subjects(args) -> int:
+    """keto_tpu extension: which plain subject ids reach
+    <namespace>:<object>#<relation> (arg order mirrors `expand`)."""
+    client = _read_client(args)
+    try:
+        subjects, next_token, token = client.list_subjects(
+            args.namespace, args.object, args.relation,
+            max_depth=args.max_depth, page_size=args.page_size,
+            page_token=args.page_token, snaptoken=args.snaptoken or "",
+        )
+    finally:
+        client.close()
+    obj = {"subject_ids": subjects, "next_page_token": next_token}
+    text = "\n".join(subjects) if subjects else "<no subjects>"
+    if next_token:
+        text += f"\nNEXT PAGE TOKEN\t{next_token}"
+    if getattr(args, "print_snaptoken", False):
+        obj["snaptoken"] = token
+        text += f"\n{token}"
+    _print_formatted(args, obj, text)
+    return 0
+
+
 def cmd_status(args) -> int:
     """ref: cmd/status/root.go — health polling, --block retries."""
     make = _write_client if args.endpoint == "write" else _read_client
@@ -627,6 +682,44 @@ def build_parser() -> argparse.ArgumentParser:
     _add_remote_flags(p, read=True)
     _add_format_flag(p)
     p.set_defaults(fn=cmd_expand)
+
+    p = sub.add_parser(
+        "list-objects",
+        help="list the objects a subject reaches via a relation "
+             "(reverse reachability)",
+    )
+    p.add_argument("subject", nargs="?", default=None,
+                   help="plain subject id (or use --subject-set)")
+    p.add_argument("relation")
+    p.add_argument("namespace")
+    p.add_argument("--subject-set", default=None,
+                   help='"namespace:object#relation"')
+    p.add_argument("--max-depth", "-d", type=int, default=0)
+    p.add_argument("--page-size", type=int, default=100)
+    p.add_argument("--page-token", default="")
+    p.add_argument("--snaptoken", default=None,
+                   help="pin the read to at least this snapshot")
+    p.add_argument("--print-snaptoken", action="store_true")
+    _add_remote_flags(p, read=True)
+    _add_format_flag(p)
+    p.set_defaults(fn=cmd_list_objects)
+
+    p = sub.add_parser(
+        "list-subjects",
+        help="list the subject ids that reach an object via a relation",
+    )
+    p.add_argument("relation")
+    p.add_argument("namespace")
+    p.add_argument("object")
+    p.add_argument("--max-depth", "-d", type=int, default=0)
+    p.add_argument("--page-size", type=int, default=100)
+    p.add_argument("--page-token", default="")
+    p.add_argument("--snaptoken", default=None,
+                   help="pin the read to at least this snapshot")
+    p.add_argument("--print-snaptoken", action="store_true")
+    _add_remote_flags(p, read=True)
+    _add_format_flag(p)
+    p.set_defaults(fn=cmd_list_subjects)
 
     p = sub.add_parser("status", help="poll server health")
     p.add_argument("--block", action="store_true")

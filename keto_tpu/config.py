@@ -21,7 +21,11 @@ from __future__ import annotations
 import json
 import logging
 import os
-import tomllib
+
+try:  # stdlib from 3.11; TOML config support degrades gracefully on 3.10
+    import tomllib
+except ModuleNotFoundError:  # pragma: no cover - interpreter-dependent
+    tomllib = None
 from dataclasses import dataclass
 from typing import Any, Mapping, Optional
 
@@ -108,6 +112,10 @@ class NamespaceFileManager:
             elif ext == "json":
                 raw = json.load(f)
             elif ext == "toml":
+                if tomllib is None:
+                    raise ConfigError(
+                        f"TOML namespace files need Python >= 3.11: {path}"
+                    )
                 raw = tomllib.load(f)
             else:
                 raise ConfigError(f"unknown namespace file extension: {path}")
@@ -246,6 +254,10 @@ class Config:
             elif path.endswith(".json"):
                 values = json.load(f)
             elif path.endswith(".toml"):
+                if tomllib is None:
+                    raise ConfigError(
+                        f"TOML config files need Python >= 3.11: {path}"
+                    )
                 values = tomllib.load(f)
             else:
                 raise ConfigError(f"unknown config file extension: {path}")

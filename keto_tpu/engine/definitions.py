@@ -33,6 +33,32 @@ def subject_visited_key(sub) -> str:
     return f"id:{sub}"
 
 
+def paginate_names(
+    names: list, page_size: int, page_token: str
+) -> tuple[list, str]:
+    """Offset pagination over a sorted enumeration (the reverse legs'
+    ListObjects/ListSubjects): the token is the next start offset, ""
+    when exhausted. Shared by the device and host engine facades — both
+    must produce identical pages for the same enumeration."""
+    if page_token:
+        try:
+            start = int(page_token)
+        except ValueError:
+            start = -1
+        if start < 0:
+            # a negative offset would slice from the tail (empty page +
+            # bogus continuation token) — reject like any malformed token
+            from ..errors import MalformedInputError
+
+            raise MalformedInputError(f"invalid page token {page_token!r}")
+    else:
+        start = 0
+    size = page_size if page_size > 0 else len(names)
+    page = names[start : start + size]
+    next_token = str(start + size) if start + size < len(names) else ""
+    return page, next_token
+
+
 class Membership(IntEnum):
     # ref: checkgroup/definitions.go:65-69 (iota: Unknown, IsMember, NotMember)
     UNKNOWN = 0

@@ -49,6 +49,11 @@ from .snapshot import EMPTY, GraphSnapshot, _build_hash_table
 # >1024 distinct rows would spuriously force a full compaction.
 DELTA_CAPACITY = 8192
 DIRTY_CAPACITY = 8192
+# reverse-dirty table (engine/reverse_kernel.py): each op contributes up
+# to TWO distinct entries (its subject's seed key + its subject slot's
+# reverse row), so 4 * 2 * DELTA_COMPACT_THRESHOLD keeps a full-threshold
+# batch inside the fixed shape
+RDIRTY_CAPACITY = 16384
 DELTA_COMPACT_THRESHOLD = 2048
 DELTA_PROBES = 8  # static probe unroll; a build needing deeper probing
 # signals compaction instead of growing the fixed-shape table
@@ -155,6 +160,11 @@ def empty_delta_tables() -> dict[str, np.ndarray]:
         "dirty_obj": np.full(DIRTY_CAPACITY, EMPTY, np.int32),
         "dirty_rel": np.full(DIRTY_CAPACITY, EMPTY, np.int32),
         "dirty_val": np.full(DIRTY_CAPACITY, EMPTY, np.int32),
+        # reverse-dirty: keyed (subject slot/id, reverse_subject_tag) for
+        # seed staleness, (subject slot, 0) for reverse-row staleness
+        "rd_obj": np.full(RDIRTY_CAPACITY, EMPTY, np.int32),
+        "rd_tag": np.full(RDIRTY_CAPACITY, EMPTY, np.int32),
+        "rd_val": np.full(RDIRTY_CAPACITY, EMPTY, np.int32),
     }
 
 
@@ -237,15 +247,23 @@ def build_delta_tables(
         raise DeltaOverflow
 
     # last-op-wins on the exact edge key
+    from .snapshot import reverse_subject_tag
+
     last: dict[tuple[int, int, int, int, int], int] = {}
     dirty_ss: set[tuple[int, int]] = set()
     dirty_all: set[tuple[int, int]] = set()
+    # reverse-mirror staleness (engine/reverse_kernel.py): a changed edge
+    # invalidates its SUBJECT's seed row (any op) and, for subject-set
+    # edges, the subject slot's reverse-edge row
+    rdirty: set[tuple[int, int]] = set()
     for op, t in ops:
         obj, rel = view.encode_node(t.namespace, t.object, t.relation)
         skind, sa, sb = view.encode_subject(t)
         if skind == 1:
             dirty_ss.add((obj, rel))
+            rdirty.add((sa, 0))
         dirty_all.add((obj, rel))
+        rdirty.add((sa, int(reverse_subject_tag(skind, sb))))
         last[(obj, rel, skind, sa, sb)] = 1 if op == "insert" else 0
 
     tables = empty_delta_tables()
@@ -267,4 +285,9 @@ def build_delta_tables(
         vals = np.array(list(marks.values()), dtype=np.int32)
         cols = _fixed_capacity_table(tuple(keys), vals, DIRTY_CAPACITY)
         tables["dirty_obj"], tables["dirty_rel"], tables["dirty_val"] = cols
+    if rdirty:
+        keys = np.array(sorted(rdirty), dtype=np.int32).T
+        vals = np.ones(len(rdirty), dtype=np.int32)
+        cols = _fixed_capacity_table(tuple(keys), vals, RDIRTY_CAPACITY)
+        tables["rd_obj"], tables["rd_tag"], tables["rd_val"] = cols
     return tables

@@ -65,6 +65,7 @@ from .snapshot import (
     INSTR_NONE,
     INSTR_TTU,
     GraphSnapshot,
+    slots_per_bucket,
 )
 
 _GOLDEN = jnp.uint32(0x9E3779B9)
@@ -126,7 +127,7 @@ def _isolate(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def _bucket_rows(pack: jnp.ndarray, h1: jnp.ndarray, h2: jnp.ndarray,
-                 probes: int) -> jnp.ndarray:
+                 probes: int, spb: int) -> jnp.ndarray:
     """Gather every table row a probe chain of `probes` slots can touch,
     as BUCKET rows: the device twin of snapshot.probe_slot's bucketized
     sequence. `pack` is [cap, w]; slots j = 0..probes-1 live in buckets
@@ -135,16 +136,18 @@ def _bucket_rows(pack: jnp.ndarray, h1: jnp.ndarray, h2: jnp.ndarray,
     the chain. Returns [..., PB*spb, w] slot rows (leading dims = h1's
     shape).
 
+    `spb` MUST be snapshot.slots_per_bucket(n_key_cols) for the probed
+    table — each probe helper passes it from the same single source the
+    builders key off, so a future table with a new (width, key-count)
+    pairing cannot silently probe a different sequence than it was built
+    with.
+
     This is the gather-volume lever (tools/microbench_gather_layout.py:
     a gathered row costs ~the same at any width 32-256 B, and adjacent
     rows do NOT coalesce): one spb-slot bucket row per spb probe slots
     instead of one slot row per probe — the dominant per-step cost
     divides by ~min(probes, spb)."""
     cap, w = pack.shape
-    # snapshot.slots_per_bucket's device twin: every bucket is one
-    # 256-byte row — 8-int edge entries pack 8 per bucket, 4-int pair
-    # entries 16
-    spb = 8 if w == 8 else 16
     nb = cap // spb
     PB = (probes + spb - 1) // spb
     jb = jnp.arange(PB, dtype=jnp.uint32)
@@ -173,7 +176,9 @@ def _edge_key_probe(tables, prefix, obj, rel, skind, sa, sb, probes: int,
     delta overlay) build the matrix once. Returns (found[F], value[F])."""
     h1 = _hash_combine(obj, rel, skind, sa, sb)
     h2 = _mix32(h1 ^ _GOLDEN) | jnp.uint32(1)
-    rows = _bucket_rows(tables[f"{prefix}_pack"], h1, h2, probes)  # [F,PB*8,8]
+    rows = _bucket_rows(
+        tables[f"{prefix}_pack"], h1, h2, probes, slots_per_bucket(5)
+    )  # [F, PB*8, 8]
     if key is None:
         key = edge_probe_key(obj, rel, skind, sa, sb)
     lane = jnp.arange(8, dtype=jnp.int32)
@@ -207,7 +212,9 @@ def _multi_pair_key_probe(tables, prefix, obj, rels, probes: int,
     F, S = rels.shape
     h1 = _hash_combine(obj[:, None], rels)  # [F, S]
     h2 = _mix32(h1 ^ _GOLDEN) | jnp.uint32(1)
-    rows = _bucket_rows(tables[f"{prefix}_pack"], h1, h2, probes)
+    rows = _bucket_rows(
+        tables[f"{prefix}_pack"], h1, h2, probes, slots_per_bucket(2)
+    )
     # rows: [F, S, PB*8, 4]
     z = jnp.zeros_like(rels)
     key = jnp.stack(
@@ -305,6 +312,11 @@ def pack_delta_tables(delta: dict) -> dict:
         ),
         "dirty_pack": pack_pair_table(
             delta["dirty_obj"], delta["dirty_rel"], delta["dirty_val"]
+        ),
+        # reverse-mirror staleness (engine/reverse_kernel.py); packed
+        # here so ONE delta dict serves both traversal directions
+        "rd_pack": pack_pair_table(
+            delta["rd_obj"], delta["rd_tag"], delta["rd_val"]
         ),
     }
 

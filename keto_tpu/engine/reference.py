@@ -368,6 +368,111 @@ class ReferenceEngine:
             tree.children.append(res.tree)
         return CheckResult(Membership.IS_MEMBER, tree=tree)
 
+    # -- reverse reachability (keto_tpu extension; no reference analog) -------
+    #
+    # The reference has no ListObjects/ListSubjects (Zanzibar serves them
+    # from the Leopard index). These are the EXACT host oracles for the
+    # device reverse kernels (engine/reverse_kernel.py) and the fallback
+    # evaluators for flagged queries. Semantics, by definition:
+    #   ListObjects(ns, rel, subject)  = { obj : Check(ns:obj#rel@subject)
+    #                                      is IS_MEMBER }
+    #   ListSubjects(ns, obj, rel)     = { subject ids S :
+    #                                      Check(ns:obj#rel@S) is IS_MEMBER }
+    # Candidates whose check ERRORS (relation-not-found and friends) are
+    # omitted rather than failing the enumeration — a list query asks
+    # "who/what is allowed", and an object whose check cannot complete is
+    # not known to be allowed. Results are sorted (deterministic
+    # pagination). Candidate sets are finite and complete: a member check
+    # must bottom out in a direct edge, and every traversal step from
+    # node ns:obj stays on tuples whose object IS ns:obj — so member
+    # objects own at least one tuple, and member subjects appear as some
+    # tuple's subject.
+    #
+    # Membership is evaluated with visited-set pruning DISABLED: the
+    # pruned walk can miss members first reached at an exhausted depth
+    # (see __init__), while the device kernels explore completely — the
+    # list surfaces define membership by the complete walk so the device
+    # path and this oracle agree on every graph, cyclic ones included.
+
+    def _complete_checker(self) -> "ReferenceEngine":
+        if not self.visited_pruning:
+            return self
+        return ReferenceEngine(
+            self.manager, self.config, visited_pruning=False
+        )
+
+    def _all_tuples(self, nid: str):
+        query = RelationQuery()
+        page_token = ""
+        while True:
+            tuples, page_token = self.manager.get_relation_tuples(
+                query, page_token=page_token, nid=nid
+            )
+            yield from tuples
+            if not page_token:
+                break
+
+    def list_objects(
+        self,
+        namespace: str,
+        relation: str,
+        subject: Subject,
+        max_depth: int = 0,
+        nid: str = DEFAULT_NETWORK,
+    ) -> list[str]:
+        """Sorted objects in `namespace` the subject reaches via
+        `relation` (exact, sequential — the differential oracle)."""
+        candidates: set[str] = set()
+        query = RelationQuery(namespace=namespace)
+        page_token = ""
+        while True:
+            tuples, page_token = self.manager.get_relation_tuples(
+                query, page_token=page_token, nid=nid
+            )
+            candidates.update(t.object for t in tuples)
+            if not page_token:
+                break
+        checker = self._complete_checker()
+        out: list[str] = []
+        for obj in sorted(candidates):
+            r = RelationTuple(namespace=namespace, object=obj, relation=relation)
+            if isinstance(subject, SubjectSet):
+                r.subject_set = subject
+            else:
+                r.subject_id = subject
+            res = checker.check_relation_tuple(r, max_depth, nid)
+            if res.error is None and res.membership == Membership.IS_MEMBER:
+                out.append(obj)
+        return out
+
+    def list_subjects(
+        self,
+        namespace: str,
+        obj: str,
+        relation: str,
+        max_depth: int = 0,
+        nid: str = DEFAULT_NETWORK,
+    ) -> list[str]:
+        """Sorted plain subject ids that reach ns:obj#relation (exact,
+        sequential). Subject-set subjects are not enumerated — the
+        production question is "which users", and subject-set reachability
+        is the expand tree's job."""
+        candidates: set[str] = set()
+        for t in self._all_tuples(nid):
+            if t.subject_id is not None:
+                candidates.add(t.subject_id)
+        checker = self._complete_checker()
+        out: list[str] = []
+        for sid in sorted(candidates):
+            r = RelationTuple(
+                namespace=namespace, object=obj, relation=relation,
+                subject_id=sid,
+            )
+            res = checker.check_relation_tuple(r, max_depth, nid)
+            if res.error is None and res.membership == Membership.IS_MEMBER:
+                out.append(sid)
+        return out
+
     # -- expand (ref: internal/expand/engine.go) ------------------------------
 
     def _build_tree(

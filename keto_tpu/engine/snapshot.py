@@ -1418,6 +1418,211 @@ def encode_query_batch(view, tuples, B: int):
     return q_obj, q_rel, q_skind, q_sa, q_sb, q_valid
 
 
+# -- transposed (reverse-reachability) mirror ---------------------------------
+#
+# The forward tables answer "expand from (obj, rel)"; the reverse subsystem
+# (engine/reverse_kernel.py) walks the SAME graph backwards — "which
+# (obj, rel) nodes can reach this subject?" — over a transposed twin of the
+# forward layout, built from the same encoded edge arrays:
+#
+#   - reverse-edge CSR: subject-set edges grouped by their SUBJECT object
+#     slot (key (sa, 0)), payload (parent obj, parent rel, edge sb).
+#     Reverse-BFS expansion gathers one row per reached node and inverts
+#     checkExpandSubject (sb == task rel) and TTU traversal (row relation
+#     matches an inverted TTU instruction) per edge.
+#   - reverse-seed CSR: ALL direct edges grouped by their full subject key
+#     (sa, reverse_subject_tag(skind, sb)) with payload (obj, rel) — the
+#     per-query seed frontier is exactly the nodes whose direct probe the
+#     forward kernel would hit for that subject.
+#   - inverted rewrite programs: for every monotone rewrite instruction
+#     "(ns, rel_p) reaches rel_c via COMPUTED/TTU", one entry keyed by
+#     rel_c so a reverse task (obj, rel_c) can enumerate its rewrite
+#     predecessors. Non-monotone programs compile to POISON entries
+#     (reaching their leaf relations host-flags the query), and any NOT in
+#     the config disables the device path entirely (NOT-members are not
+#     reverse-enumerable: "NOT deny" is a member exactly when no deny path
+#     exists for the subject, which a reachability walk cannot observe).
+#
+# Same open-addressing/probe discipline as the forward tables
+# (slots_per_bucket keyed off the key-column count), so the device kernel's
+# bucketized row gathers serve both directions unchanged.
+
+# reverse-instruction kinds (rinstr_kind lanes)
+RINSTR_NONE = 0
+RINSTR_COMPUTED = 1  # pred (task obj, rel_p) at the SAME depth, ns-gated
+RINSTR_TTU = 2  # pred (edge obj, rel_p) at depth-1 when edge rel == rel_t
+RINSTR_POISON = 3  # island program pulls from this rel: host-flag the query
+
+# inverted-entry cap per target relation: a rel_c referenced by more
+# rewrite instructions than this gets one POISON row instead (host
+# fallback), mirroring the forward K/CIRCUIT caps' exactness contract
+RINSTR_CAP = 16
+
+
+# plain/set discriminator stride in reverse_subject_tag: a FIXED constant
+# (not the relation-vocab size) so builders, the delta's reverse-dirty
+# entries, and query encoding can never disagree on the tag basis across
+# vocab growth (a retained mirror patched through a compaction keeps
+# serving while the vocab grows). Relation ids are dense small ints —
+# far below this.
+_REVERSE_TAG_STRIDE = 1 << 20
+
+
+def reverse_subject_tag(skind, sb):
+    """Second key column of the reverse-seed CSR: disambiguates plain
+    subject ids from subject-set slots sharing an int (subject vocabs
+    overlap numerically). Vectorized over numpy arrays. Tag 0 is
+    reserved (the delta reverse-dirty table uses it for row-level
+    entries)."""
+    return (
+        np.asarray(skind, dtype=np.int32) * np.int32(_REVERSE_TAG_STRIDE)
+        + np.asarray(sb, dtype=np.int32)
+        + np.int32(1)
+    )
+
+
+def build_reverse_tables(
+    t_obj: np.ndarray,
+    t_rel: np.ndarray,
+    t_skind: np.ndarray,
+    t_sa: np.ndarray,
+    t_sb: np.ndarray,
+) -> dict:
+    """Transposed twin of build_edge_tables from the SAME encoded edge
+    arrays: reverse-edge CSR (subject-set edges by subject slot) +
+    reverse-seed CSR (all edges by full subject key)."""
+    is_set = np.asarray(t_skind) == 1
+    rvh_obj, _rvh_rel, rvh_row, rvh_probes, rv_row_ptr, (
+        rv_pobj, rv_prel, rv_sb,
+    ) = group_rows_csr(
+        t_sa[is_set].astype(np.int32),
+        np.zeros(int(is_set.sum()), dtype=np.int32),
+        (
+            t_obj[is_set].astype(np.int32),
+            t_rel[is_set].astype(np.int32),
+            t_sb[is_set].astype(np.int32),
+        ),
+    )
+    tags = reverse_subject_tag(t_skind, t_sb)
+    rsh_obj, rsh_tag, rsh_row, rsh_probes, rs_row_ptr, (rs_obj, rs_rel) = (
+        group_rows_csr(
+            t_sa.astype(np.int32),
+            tags,
+            (t_obj.astype(np.int32), t_rel.astype(np.int32)),
+        )
+    )
+    return {
+        "rvh_obj": rvh_obj, "rvh_rel": _rvh_rel, "rvh_row": rvh_row,
+        "rvh_probes": rvh_probes, "rv_row_ptr": rv_row_ptr,
+        "rv_pobj": rv_pobj, "rv_prel": rv_prel, "rv_sb": rv_sb,
+        "rsh_obj": rsh_obj, "rsh_tag": rsh_tag, "rsh_row": rsh_row,
+        "rsh_probes": rsh_probes, "rs_row_ptr": rs_row_ptr,
+        "rs_obj": rs_obj, "rs_rel": rs_rel,
+    }
+
+
+def _walk_rewrite_leaves(rw: ast.SubjectSetRewrite, has_not: bool = False):
+    """Yield (kind, relation, relation2, under_not) for every leaf of a
+    rewrite tree, including leaves inside AND/NOT islands (unlike
+    _compile_rewrite, which drops oversized programs — the INVERTED table
+    must see every leaf to know when a reverse walk enters a program's
+    pull range)."""
+    for child in rw.children:
+        if isinstance(child, ast.ComputedSubjectSet):
+            yield ("computed", child.relation, "", has_not)
+        elif isinstance(child, ast.TupleToSubjectSet):
+            yield (
+                "ttu", child.relation, child.computed_subject_set_relation,
+                has_not,
+            )
+        elif isinstance(child, ast.SubjectSetRewrite):
+            yield from _walk_rewrite_leaves(child, has_not)
+        elif isinstance(child, ast.InvertResult):
+            sub = child.child
+            if isinstance(sub, ast.SubjectSetRewrite):
+                yield from _walk_rewrite_leaves(sub, True)
+            elif isinstance(sub, ast.ComputedSubjectSet):
+                yield ("computed", sub.relation, "", True)
+            elif isinstance(sub, ast.TupleToSubjectSet):
+                yield (
+                    "ttu", sub.relation, sub.computed_subject_set_relation,
+                    True,
+                )
+
+
+def build_reverse_programs(
+    namespaces, ns_ids: dict, rel_ids: dict, n_config_rels: int,
+    cap: int = RINSTR_CAP,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, int, bool]:
+    """Invert every namespace relation's rewrite for reverse-BFS.
+
+    Returns (rinstr_kind, rinstr_relp, rinstr_relt, rinstr_ns) dense
+    [n_config_rels, RK] tables keyed by TARGET relation rel_c, the
+    effective RK, and `host_all`:
+
+      - monotone programs invert exactly: COMPUTED(rel_c) in (ns, rel_p)
+        -> entry (RINSTR_COMPUTED, rel_p, 0, ns) under rel_c;
+        TTU(rel_t, rel_c) -> (RINSTR_TTU, rel_p, rel_t, ns). Oversized
+        monotone programs (forward FLAG_HOST_ONLY) invert fine — reverse
+        traversal evaluates one entry per step, not a K-bounded program.
+      - AND-island programs emit POISON entries under each leaf's rel_c:
+        a member of the island implies EVERY leaf sub-check is a member,
+        so the reverse walk is guaranteed to reach a leaf relation node
+        and trip the poison before the island's members could be missed.
+        COMPUTED poisons are ns-gated (the leaf shares the island's
+        object); TTU poisons use ns = -1 (their leaf objects live in
+        arbitrary namespaces).
+      - any NOT => host_all=True: NOT-members exist precisely where NO
+        path exists, which reverse reachability cannot enumerate; the
+        engine routes every reverse query to the host oracle.
+      - more than `cap` entries under one rel_c => that row collapses to
+        a single any-ns POISON (cause-coded fallback, never truncation).
+    """
+    per_target: dict[int, list[tuple[int, int, int, int]]] = {}
+    host_all = False
+    for ns in namespaces:
+        nsid = ns_ids[ns.name]
+        for rel in ns.relations:
+            rw = rel.subject_set_rewrite
+            if rw is None:
+                continue
+            rel_p = rel_ids[rel.name]
+            monotone = _is_monotone(rw)
+            for kind, a, b, under_not in _walk_rewrite_leaves(rw):
+                if under_not:
+                    host_all = True
+                if kind == "computed":
+                    rel_c, rel_t = rel_ids[a], 0
+                    ekind = RINSTR_COMPUTED if monotone else RINSTR_POISON
+                    ens = nsid
+                else:
+                    rel_c, rel_t = rel_ids[b], rel_ids[a]
+                    ekind = RINSTR_TTU if monotone else RINSTR_POISON
+                    ens = nsid if monotone else -1
+                per_target.setdefault(rel_c, []).append(
+                    (ekind, rel_p, rel_t, ens)
+                )
+    # dedupe (shared sub-rewrites register identical entries) + cap
+    for rel_c, entries in per_target.items():
+        uniq = list(dict.fromkeys(entries))
+        if len(uniq) > cap:
+            uniq = [(RINSTR_POISON, 0, 0, -1)]
+        per_target[rel_c] = uniq
+    RK = max([len(v) for v in per_target.values()] + [1])
+    NR = max(n_config_rels, 1)
+    rinstr_kind = np.zeros((NR, RK), dtype=np.int32)
+    rinstr_relp = np.zeros((NR, RK), dtype=np.int32)
+    rinstr_relt = np.zeros((NR, RK), dtype=np.int32)
+    rinstr_ns = np.zeros((NR, RK), dtype=np.int32)
+    for rel_c, entries in per_target.items():
+        for k, (ekind, rel_p, rel_t, ens) in enumerate(entries):
+            rinstr_kind[rel_c, k] = ekind
+            rinstr_relp[rel_c, k] = rel_p
+            rinstr_relt[rel_c, k] = rel_t
+            rinstr_ns[rel_c, k] = ens
+    return rinstr_kind, rinstr_relp, rinstr_relt, rinstr_ns, RK, host_all
+
+
 def _walk_rewrite_relations(rw: ast.SubjectSetRewrite):
     """Yield (kind, relation, relation2) for every leaf referenced by a
     rewrite tree (used only to pre-register relation names in the vocab)."""

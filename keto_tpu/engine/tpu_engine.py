@@ -41,6 +41,7 @@ from .definitions import (
     RESULT_NOT_MEMBER,
     CheckResult,
     Membership,
+    paginate_names,
 )
 from .delta import SnapshotView, empty_delta_tables
 from .kernel import (
@@ -60,6 +61,8 @@ from .snapshot import (
 )
 
 _BUCKETS = (16, 64, 256, 1024, 4096, 16384)
+
+_paginate = paginate_names
 
 
 @dataclass
@@ -90,6 +93,14 @@ class _EngineState:
     # at 1e7 (SCALE_1e7_r04). ~1 GB extra host RAM at 1e7; "garbage"
     # counts tail-rewritten slots for the amortizing rebuild
     expand_np: Optional[dict] = None
+    # reverse-reachability subsystem (lazy, engine/reverse_kernel.py):
+    # host transposed mirror (patchable by incremental compaction, same
+    # retention rationale as expand_np) + its device tables; the
+    # list-subjects leg packs its device tables from the expand full CSR
+    reverse_np: Optional[dict] = None
+    reverse_tables: Optional[dict] = None
+    subjects_tables: Optional[dict] = None
+    subjects_probes: Optional[int] = None
 
 
 class TPUCheckEngine:
@@ -345,6 +356,23 @@ class TPUCheckEngine:
             new_state.base_decoder = state.base_decoder
             new_state.decoder = state.base_decoder.extended(overlay)
             new_state.expand_np = state.expand_np
+        # reverse-reachability state rides along: the big transposed CSRs
+        # follow the BASE snapshot; only the reverse-dirty overlay (rd)
+        # re-derives from the fresh delta — queries touching changed
+        # subjects/rows host-replay, so no table rebuild on the write path
+        if state.reverse_tables is not None:
+            new_state.reverse_np = state.reverse_np
+            new_state.reverse_tables = self._merge_reverse_dirty(
+                state.reverse_tables, delta
+            )
+        if state.subjects_tables is not None:
+            new_state.subjects_tables = self._merge_subjects_dirty(
+                state.subjects_tables, delta
+            )
+            new_state.subjects_probes = state.subjects_probes
+        if state.base_decoder is not None and new_state.base_decoder is None:
+            new_state.base_decoder = state.base_decoder
+            new_state.decoder = state.base_decoder.extended(overlay)
         return new_state
 
     def _incremental_compact(
@@ -396,6 +424,24 @@ class TPUCheckEngine:
             new_state.expand_tables = self._merge_expand_dirty(
                 device_csr, new_state.delta_np
             )
+        # patch the retained transposed mirror with the same op set (the
+        # reverse twin of the expand patch; None => lazy rebuild). The
+        # subjects_tables leg stays None — it re-packs from the freshly
+        # patched expand full CSR on the next ListSubjects call (a pack,
+        # not a rebuild).
+        reverse_np, reverse_tables = self._patched_reverse_state(
+            state, enc_u, ins_u, merged
+        )
+        if reverse_np is not None:
+            new_state.reverse_np = reverse_np
+            new_state.reverse_tables = self._merge_reverse_dirty(
+                reverse_tables, new_state.delta_np
+            )
+            if new_state.base_decoder is None:
+                from .expand_kernel import ExpandDecoder
+
+                new_state.base_decoder = ExpandDecoder(merged)
+                new_state.decoder = new_state.base_decoder.extended(None)
         self.stats["incremental_merges"] = (
             self.stats.get("incremental_merges", 0) + 1
         )
@@ -465,6 +511,88 @@ class TPUCheckEngine:
         }
         return expand_np, self._pack_expand_csr(expand_np), fh_probes
 
+    def _patched_reverse_state(self, state: _EngineState, enc_u, ins_u, merged):
+        """Patch the retained transposed mirror (reverse-edge CSR rows
+        keyed by subject slot, seed CSR rows keyed by full subject key)
+        with the merged ops — the same patch_csr machinery the forward
+        CSRs use. Returns (reverse_np, device tables) or (None, None) for
+        the lazy rebuild (no mirror retained, pathological clustering, or
+        garbage past the amortization threshold)."""
+        from .compact import (
+            GARBAGE_FLOOR,
+            GARBAGE_FRACTION,
+            MergeFallback,
+            patch_csr,
+        )
+        from .reverse_kernel import pack_reverse_tables
+        from .snapshot import reverse_subject_tag
+
+        src = state.reverse_np
+        if src is None:
+            return None, None
+        per_rev: dict = {}
+        per_seed: dict = {}
+
+        def _apply(per_row, key, pay, ins):
+            ch = per_row.setdefault(key, {"ins": [], "del": set()})
+            if ins:
+                ch["ins"].append(pay)
+                ch["del"].discard(pay)
+            else:
+                ch["del"].add(pay)
+                ch["ins"] = [t for t in ch["ins"] if t != pay]
+
+        for (obj, rel, sk, sa, sb), ins in zip(enc_u.tolist(), ins_u.tolist()):
+            if sk == 1:
+                _apply(per_rev, (sa, 0), (obj, rel, sb), ins)
+            tag = int(reverse_subject_tag(sk, sb))
+            _apply(per_seed, (sa, tag), (obj, rel), ins)
+        try:
+            (
+                (rvh_obj, rvh_rel, rvh_row), rvh_probes, rv_row_ptr,
+                (rv_pobj, rv_prel, rv_sb), g_rev,
+            ) = patch_csr(
+                (src["rvh_obj"], src["rvh_rel"], src["rvh_row"]),
+                src["rvh_probes"],
+                src["rv_row_ptr"],
+                (src["rv_pobj"], src["rv_prel"], src["rv_sb"]),
+                per_rev,
+            )
+            (
+                (rsh_obj, rsh_tag, rsh_row), rsh_probes, rs_row_ptr,
+                (rs_obj, rs_rel), g_seed,
+            ) = patch_csr(
+                (src["rsh_obj"], src["rsh_tag"], src["rsh_row"]),
+                src["rsh_probes"],
+                src["rs_row_ptr"],
+                (src["rs_obj"], src["rs_rel"]),
+                per_seed,
+            )
+        except MergeFallback:
+            return None, None
+        total_garbage = src["garbage"] + g_rev + g_seed
+        if total_garbage > max(
+            GARBAGE_FLOOR, GARBAGE_FRACTION * (len(rv_pobj) + len(rs_obj))
+        ):
+            return None, None
+        reverse_np = {
+            **src,
+            "rvh_obj": rvh_obj, "rvh_rel": rvh_rel, "rvh_row": rvh_row,
+            "rvh_probes": rvh_probes, "rv_row_ptr": rv_row_ptr,
+            "rv_pobj": rv_pobj, "rv_prel": rv_prel, "rv_sb": rv_sb,
+            "rsh_obj": rsh_obj, "rsh_tag": rsh_tag, "rsh_row": rsh_row,
+            "rsh_probes": rsh_probes, "rs_row_ptr": rs_row_ptr,
+            "rs_obj": rs_obj, "rs_rel": rs_rel,
+            "garbage": total_garbage,
+        }
+        import jax.numpy as jnp
+
+        tables = {
+            k: jnp.asarray(v)
+            for k, v in pack_reverse_tables(reverse_np, merged).items()
+        }
+        return reverse_np, tables
+
     @staticmethod
     def _merge_expand_dirty(base_csr: dict, delta_np: dict) -> dict:
         import jax.numpy as jnp
@@ -474,6 +602,37 @@ class TPUCheckEngine:
         merged = dict(base_csr)
         merged["dirty_pack"] = jnp.asarray(
             pack_delta_tables(delta_np)["dirty_pack"]
+        )
+        return merged
+
+    @staticmethod
+    def _merge_reverse_dirty(base_tables: dict, delta_np: dict) -> dict:
+        """Reverse-kernel tables + the delta's reverse-dirty (rd) overlay
+        — only the small rd pack re-uploads on a delta refresh."""
+        import jax.numpy as jnp
+
+        from .kernel import pack_pair_table
+
+        merged = {k: v for k, v in base_tables.items() if k != "rd_pack"}
+        merged["rd_pack"] = jnp.asarray(
+            pack_pair_table(
+                delta_np["rd_obj"], delta_np["rd_tag"], delta_np["rd_val"]
+            )
+        )
+        return merged
+
+    @staticmethod
+    def _merge_subjects_dirty(base_tables: dict, delta_np: dict) -> dict:
+        import jax.numpy as jnp
+
+        from .kernel import pack_pair_table
+
+        merged = {k: v for k, v in base_tables.items() if k != "dirty_pack"}
+        merged["dirty_pack"] = jnp.asarray(
+            pack_pair_table(
+                delta_np["dirty_obj"], delta_np["dirty_rel"],
+                delta_np["dirty_val"],
+            )
         )
         return merged
 
@@ -677,6 +836,383 @@ class TPUCheckEngine:
                 device_csr, state.delta_np
             )
             return state
+
+    def _ensure_reverse_state(self) -> _EngineState:
+        """State with the transposed mirror (reverse-edge CSR + seed CSR
+        + inverted programs) built and on device. Lazy like the expand
+        state: the mirror follows the BASE snapshot; writes since then
+        ride the delta's reverse-dirty table — affected queries host-
+        replay, so the write path never rebuilds it. Under a mesh the
+        reverse tables are built unsharded (replicated execution): the
+        reverse workload is an analytical read, not the sharded check hot
+        path."""
+        state = self._ensure_state()
+        if state.reverse_tables is not None:
+            return state
+        import jax.numpy as jnp
+
+        from .expand_kernel import ExpandDecoder
+        from .reverse_kernel import (
+            build_reverse_state,
+            build_reverse_state_columnar,
+            pack_reverse_tables,
+        )
+
+        namespaces = self.config.namespace_manager().namespaces()
+        with self._lock:
+            if state.reverse_tables is not None:  # raced another filler
+                return state
+            columns_fn = getattr(self.manager, "all_tuple_columns", None)
+            if columns_fn is not None:
+                rnp = build_reverse_state_columnar(
+                    columns_fn(nid=self.nid), state.snapshot, namespaces
+                )
+            else:
+                rnp = build_reverse_state(
+                    list(self.manager.all_relation_tuples(nid=self.nid)),
+                    state.snapshot, namespaces, view=state.view,
+                )
+            state.reverse_np = rnp
+            if state.base_decoder is None:
+                state.base_decoder = ExpandDecoder(state.snapshot)
+                state.decoder = state.base_decoder.extended(state.view.overlay)
+            tables = {
+                k: jnp.asarray(v)
+                for k, v in pack_reverse_tables(rnp, state.snapshot).items()
+            }
+            # reverse_tables is the readiness signal: set it last
+            state.reverse_tables = self._merge_reverse_dirty(
+                tables, state.delta_np
+            )
+            return state
+
+    def _ensure_subjects_state(self) -> _EngineState:
+        """State with the list-subjects tables (span-packed full-edge CSR
+        + instruction lanes) on device. Reuses the expand state's host
+        full-CSR mirror when available (single-device path — including
+        its incremental-compaction patches); under a mesh it builds its
+        own unsharded CSR."""
+        state = self._ensure_state()
+        if state.subjects_tables is not None:
+            return state
+        if self.mesh is None:
+            state = self._ensure_expand_state()
+        import jax.numpy as jnp
+
+        from .expand_kernel import (
+            ExpandDecoder,
+            build_full_csr,
+            build_full_csr_columnar,
+        )
+        from .reverse_kernel import pack_subjects_tables
+
+        with self._lock:
+            if state.subjects_tables is not None:
+                return state
+            csr = state.expand_np
+            if csr is None:
+                columns_fn = getattr(self.manager, "all_tuple_columns", None)
+                if columns_fn is not None:
+                    csr = build_full_csr_columnar(
+                        columns_fn(nid=self.nid), state.snapshot
+                    )
+                else:
+                    csr = build_full_csr(
+                        list(self.manager.all_relation_tuples(nid=self.nid)),
+                        state.snapshot, view=state.view,
+                    )
+            state.subjects_probes = int(csr["fh_probes"])
+            if state.base_decoder is None:
+                state.base_decoder = ExpandDecoder(state.snapshot)
+                state.decoder = state.base_decoder.extended(state.view.overlay)
+            tables = {
+                k: jnp.asarray(v)
+                for k, v in pack_subjects_tables(csr, state.snapshot).items()
+            }
+            state.subjects_tables = self._merge_subjects_dirty(
+                tables, state.delta_np
+            )
+            return state
+
+    # -- reverse reachability (ListObjects / ListSubjects) --------------------
+
+    def _count_reverse(self, leg: str, n_device: int, n_host: int, causes):
+        self.stats[f"device_{leg}"] = (
+            self.stats.get(f"device_{leg}", 0) + n_device
+        )
+        self.stats[f"host_{leg}"] = self.stats.get(f"host_{leg}", 0) + n_host
+        for cause, cnt in causes.items():
+            self.stats["host_cause"][cause] = (
+                self.stats["host_cause"].get(cause, 0) + cnt
+            )
+
+    def list_objects_batch(
+        self,
+        queries: Sequence[tuple],
+        max_depth: int = 0,
+        frontier_cap: int = 4096,
+        result_cap: int = 2048,
+        pool_cap: int = 0,
+    ) -> list[list[str]]:
+        """Batched reverse reachability: queries are (namespace,
+        relation, subject) triples; each answer is the SORTED list of
+        objects in `namespace` the subject reaches via `relation` —
+        exactly { obj : Check(ns:obj#rel@subject) is IS_MEMBER }, the
+        host oracle's definition (reference.list_objects).
+
+        One device launch per batch (reverse BFS over the transposed
+        mirror); queries the kernel cause-flags (AND/NOT programs, dirty
+        rows, frontier/result overflow, step exhaustion, error-semantics
+        nodes) replay on the exact host oracle. Names the graph+config
+        never mention answer [] directly — no edge can seed or match, so
+        the enumeration is exactly empty."""
+        from ..ketoapi import RelationTuple as _RT
+        from ..ketoapi import SubjectSet as _SubjectSet
+        from .reverse_kernel import (
+            decode_pool_slice,
+            list_objects_kernel_packed,
+            unpack_list_results,
+        )
+        from .snapshot import reverse_subject_tag
+
+        n = len(queries)
+        if n == 0:
+            return []
+        state = self._ensure_reverse_state()
+        global_max = self.config.max_read_depth()
+        depth = max_depth if 0 < max_depth <= global_max else global_max
+        rnp = state.reverse_np
+
+        if rnp["host_all"]:
+            # a NOT exists somewhere in the config: NOT-members exist
+            # precisely where no path exists, which reverse reachability
+            # cannot enumerate — exact host oracle for every query
+            self._count_reverse(
+                "list_objects", 0, n, {"island_host": n}
+            )
+            return [
+                self.reference.list_objects(ns, rel, sub, max_depth, self.nid)
+                for ns, rel, sub in queries
+            ]
+
+        B = next((b for b in _BUCKETS if b >= n), None)
+        if B is None:
+            out = []
+            step = _BUCKETS[-1]
+            for i in range(0, n, step):
+                out.extend(
+                    self.list_objects_batch(
+                        queries[i : i + step], max_depth, frontier_cap,
+                        result_cap, pool_cap,
+                    )
+                )
+            return out
+
+        q_sa = np.zeros(B, dtype=np.int32)
+        q_tag = np.zeros(B, dtype=np.int32)
+        q_ns = np.zeros(B, dtype=np.int32)
+        q_rel = np.zeros(B, dtype=np.int32)
+        q_valid = np.zeros(B, dtype=bool)
+        empty_idx: set[int] = set()
+        for i, (ns_name, rel_name, subject) in enumerate(queries):
+            ns_id = state.view.ns_id(ns_name)
+            rel_id = state.view.rel_id(rel_name)
+            proxy = _RT(namespace=ns_name, object="", relation=rel_name)
+            if isinstance(subject, _SubjectSet):
+                proxy.subject_set = subject
+            else:
+                proxy.subject_id = subject
+            sub = state.view.encode_subject(proxy)
+            if ns_id is None or rel_id is None or sub is None:
+                empty_idx.add(i)
+                continue
+            skind, sa, sb = sub
+            q_sa[i] = sa
+            q_tag[i] = int(reverse_subject_tag(skind, sb))
+            q_ns[i] = ns_id
+            q_rel[i] = rel_id
+            q_valid[i] = True
+
+        qpack = np.stack(
+            [
+                q_sa, q_tag, q_ns, q_rel,
+                np.full(B, depth, dtype=np.int32),
+                q_valid.astype(np.int32),
+            ]
+        ).astype(np.int32)
+        with self.tracer.span("engine.list_objects_launch", batch=B):
+            flat = list_objects_kernel_packed(
+                state.reverse_tables,
+                qpack,
+                rvh_probes=rnp["rvh_probes"],
+                rsh_probes=rnp["rsh_probes"],
+                RK=rnp["RK"],
+                max_steps=int(global_max + state.snapshot.n_config_rels + 4),
+                wildcard_rel=state.snapshot.wildcard_rel,
+                n_config_rels=max(state.snapshot.n_config_rels, 1),
+                frontier_cap=max(frontier_cap, B),
+                result_cap=result_cap,
+                # default pool sizes for serve-path result sets; callers
+                # expecting wide enumerations (the bench) pass pool_cap
+                pool_cap=pool_cap or max(8 * B, 4096),
+                has_delta=state.has_delta,
+            )
+        offs, needs, pool = unpack_list_results(np.asarray(flat), B)
+        return self._resolve_reverse(
+            "list_objects", queries, empty_idx, q_valid, needs,
+            lambda i: sorted(
+                state.decoder.slot_to_obj[slot][1]
+                for slot in decode_pool_slice(pool, int(offs[i]), int(offs[i + 1]))
+            ),
+            lambda qr: self.reference.list_objects(
+                qr[0], qr[1], qr[2], max_depth, self.nid
+            ),
+        )
+
+    def list_subjects_batch(
+        self,
+        queries: Sequence[tuple],
+        max_depth: int = 0,
+        frontier_cap: int = 4096,
+        result_cap: int = 2048,
+        pool_cap: int = 0,
+    ) -> list[list[str]]:
+        """Batched subject enumeration: queries are (namespace, object,
+        relation) triples; each answer is the SORTED list of plain
+        subject ids with Check(ns:obj#rel@id) IS_MEMBER (the host
+        oracle's definition, reference.list_subjects). Forward BFS over
+        the full-edge CSR + rewrite instructions with the check kernel's
+        exact depth bookkeeping; same cause-coded fallback contract as
+        list_objects_batch."""
+        from .reverse_kernel import (
+            decode_pool_slice,
+            list_subjects_kernel_packed,
+            unpack_list_results,
+        )
+
+        n = len(queries)
+        if n == 0:
+            return []
+        state = self._ensure_subjects_state()
+        global_max = self.config.max_read_depth()
+        depth = max_depth if 0 < max_depth <= global_max else global_max
+
+        B = next((b for b in _BUCKETS if b >= n), None)
+        if B is None:
+            out = []
+            step = _BUCKETS[-1]
+            for i in range(0, n, step):
+                out.extend(
+                    self.list_subjects_batch(
+                        queries[i : i + step], max_depth, frontier_cap,
+                        result_cap, pool_cap,
+                    )
+                )
+            return out
+
+        q_obj = np.zeros(B, dtype=np.int32)
+        q_rel = np.zeros(B, dtype=np.int32)
+        q_valid = np.zeros(B, dtype=bool)
+        empty_idx: set[int] = set()
+        for i, (ns_name, obj_name, rel_name) in enumerate(queries):
+            node = state.view.encode_node(ns_name, obj_name, rel_name)
+            if node is None:
+                empty_idx.add(i)
+                continue
+            q_obj[i], q_rel[i] = node
+            q_valid[i] = True
+
+        qpack = np.stack(
+            [
+                q_obj, q_rel,
+                np.full(B, depth, dtype=np.int32),
+                q_valid.astype(np.int32),
+            ]
+        ).astype(np.int32)
+        with self.tracer.span("engine.list_subjects_launch", batch=B):
+            flat = list_subjects_kernel_packed(
+                state.subjects_tables,
+                qpack,
+                K=state.snapshot.K,
+                fsh_probes=state.subjects_probes,
+                max_steps=int(global_max + state.snapshot.n_config_rels + 4),
+                wildcard_rel=state.snapshot.wildcard_rel,
+                n_config_rels=max(state.snapshot.n_config_rels, 1),
+                frontier_cap=max(frontier_cap, B),
+                result_cap=result_cap,
+                # default pool sizes for serve-path result sets; callers
+                # expecting wide enumerations (the bench) pass pool_cap
+                pool_cap=pool_cap or max(8 * B, 4096),
+                has_delta=state.has_delta,
+            )
+        offs, needs, pool = unpack_list_results(np.asarray(flat), B)
+        return self._resolve_reverse(
+            "list_subjects", queries, empty_idx, q_valid, needs,
+            lambda i: sorted(
+                state.decoder.subject_name(sid)
+                for sid in decode_pool_slice(pool, int(offs[i]), int(offs[i + 1]))
+            ),
+            lambda qr: self.reference.list_subjects(
+                qr[0], qr[1], qr[2], max_depth, self.nid
+            ),
+        )
+
+    def _resolve_reverse(
+        self, leg, queries, empty_idx, q_valid, needs, decode_fn, host_fn
+    ) -> list[list[str]]:
+        """Shared result assembly for the two reverse legs: device
+        decodes, cause-coded host replays, and stats bookkeeping."""
+        results: list[list[str]] = []
+        n_host = 0
+        causes: dict[str, int] = {}
+        for i, qr in enumerate(queries):
+            if i in empty_idx:
+                # names unknown to graph+config: exactly-empty enumeration
+                results.append([])
+                continue
+            if not q_valid[i] or needs[i]:
+                n_host += 1
+                cause = (
+                    CAUSE_NAMES.get(int(needs[i]), CAUSE_NAME_UNINDEXED)
+                    if q_valid[i]
+                    else CAUSE_NAME_UNINDEXED
+                )
+                causes[cause] = causes.get(cause, 0) + 1
+                results.append(host_fn(qr))
+                continue
+            results.append(decode_fn(i))
+        self._count_reverse(leg, len(queries) - n_host, n_host, causes)
+        return results
+
+    def list_objects(
+        self,
+        namespace: str,
+        relation: str,
+        subject,
+        max_depth: int = 0,
+        page_size: int = 100,
+        page_token: str = "",
+    ) -> tuple[list[str], str]:
+        """Paginated single-query ListObjects: (object names, next page
+        token). Tokens are offsets into the sorted enumeration (the batch
+        path returns deterministic sorted results, so tokens are stable
+        for a fixed snapshot)."""
+        objs = self.list_objects_batch([(namespace, relation, subject)], max_depth)[0]
+        return _paginate(objs, page_size, page_token)
+
+    def list_subjects(
+        self,
+        namespace: str,
+        obj: str,
+        relation: str,
+        max_depth: int = 0,
+        page_size: int = 100,
+        page_token: str = "",
+    ) -> tuple[list[str], str]:
+        """Paginated single-query ListSubjects: (subject ids, next page
+        token)."""
+        subs = self.list_subjects_batch([(namespace, obj, relation)], max_depth)[0]
+        return _paginate(subs, page_size, page_token)
 
     # -- check API ------------------------------------------------------------
 

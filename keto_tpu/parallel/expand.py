@@ -28,10 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.4.35 exposes shard_map at the top level
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+# shard_map with the check_vma/check_rep compat shim (see parallel/kernel)
+from .kernel import _shard_map
 
 from ..engine.delta import DIRTY_FOR_EXPAND
 from ..engine.expand_kernel import _ExpandState

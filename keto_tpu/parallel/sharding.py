@@ -50,7 +50,9 @@ _REPLICATED_KEYS = (
     "objslot_ns", "ns_has_config", "instr_pack", "prog_flags",
 )
 # delta-overlay tables (engine/delta.py): small + fixed-shape, replicated
-_DELTA_DEVICE_KEYS = ("dd_pack", "dirty_pack")
+# (rd_pack is the reverse-dirty table — unused by the sharded check
+# kernel but packed by the same pack_delta_tables, so it rides along)
+_DELTA_DEVICE_KEYS = ("dd_pack", "dirty_pack", "rd_pack")
 
 
 def shard_of_objslot(obj_slot: np.ndarray, n_shards: int) -> np.ndarray:

@@ -342,5 +342,37 @@ class _HostEngineFacade:
     def expand(self, subject, max_depth: int = 0):
         return self.reference.expand(subject, max_depth, self.nid)
 
+    def list_objects(
+        self, namespace, relation, subject, max_depth: int = 0,
+        page_size: int = 100, page_token: str = "",
+    ):
+        from .engine.definitions import paginate_names
+
+        self.stats["host_list_objects"] = (
+            self.stats.get("host_list_objects", 0) + 1
+        )
+        return paginate_names(
+            self.reference.list_objects(
+                namespace, relation, subject, max_depth, self.nid
+            ),
+            page_size, page_token,
+        )
+
+    def list_subjects(
+        self, namespace, obj, relation, max_depth: int = 0,
+        page_size: int = 100, page_token: str = "",
+    ):
+        from .engine.definitions import paginate_names
+
+        self.stats["host_list_subjects"] = (
+            self.stats.get("host_list_subjects", 0) + 1
+        )
+        return paginate_names(
+            self.reference.list_subjects(
+                namespace, obj, relation, max_depth, self.nid
+            ),
+            page_size, page_token,
+        )
+
     def invalidate(self) -> None:
         pass

@@ -89,11 +89,13 @@ class Dialect:
 
     def prep(self, sql: str) -> str:
         """Canonical qmark statement -> this driver's paramstyle.
-        Literal-aware: only '?' OUTSIDE single-quoted string literals
-        are placeholders (the regex consumes whole literals including
-        SQL's '' escape, so quote parity can't flip mid-statement), so a
-        statement containing a literal '?' can never be silently
-        corrupted on %s dialects."""
+        Literal-aware for SINGLE-QUOTED string literals only: a '?'
+        inside one is never rewritten (the regex consumes whole literals
+        including SQL's '' escape, so quote parity can't flip
+        mid-statement). A '?' inside a double-quoted identifier, a SQL
+        comment, or a Postgres dollar-quoted string WOULD still be
+        rewritten on %s dialects — no persister statement uses those
+        forms; extend _SQL_LITERAL_RE before introducing one."""
         if self.placeholder == "?":
             return sql
         out = []

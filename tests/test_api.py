@@ -707,3 +707,99 @@ class TestTPUEngineAPI:
             wc.close()
         finally:
             d.stop()
+
+
+class TestReverseAPI:
+    """ReverseReadService + REST list routes (keto_tpu reverse-
+    reachability extension): served behavior over the host facade —
+    the device engine's facade is differential-tested in
+    tests/test_reverse.py; here the wire planes and error semantics."""
+
+    def _seed(self, daemon):
+        daemon.registry.relation_tuple_manager().write_relation_tuples(
+            [
+                RelationTuple.from_string("videos:v1#owner@alice"),
+                RelationTuple.from_string("videos:v2#owner@alice"),
+                RelationTuple.from_string("videos:v3#owner@bob"),
+            ],
+            nid=daemon.registry.nid,
+        )
+
+    def test_grpc_list_objects(self, daemon, clients):
+        self._seed(daemon)
+        rc, _ = clients
+        objects, next_token, token = rc.list_objects(
+            "videos", "view", "alice"
+        )
+        assert objects == ["v1", "v2"]
+        assert next_token == ""
+        assert token  # real snaptoken rides the response
+
+    def test_grpc_list_objects_pagination(self, daemon, clients):
+        self._seed(daemon)
+        rc, _ = clients
+        page1, token1, _ = rc.list_objects(
+            "videos", "view", "alice", page_size=1
+        )
+        assert page1 == ["v1"] and token1
+        page2, token2, _ = rc.list_objects(
+            "videos", "view", "alice", page_size=1, page_token=token1
+        )
+        assert page2 == ["v2"] and token2 == ""
+
+    def test_grpc_list_subjects(self, daemon, clients):
+        self._seed(daemon)
+        rc, _ = clients
+        subjects, _, _ = rc.list_subjects("videos", "v1", "view")
+        assert subjects == ["alice"]
+
+    def test_grpc_unknown_namespace_is_error(self, daemon, clients):
+        rc, _ = clients
+        with pytest.raises(grpc.RpcError) as err:
+            rc.list_objects("nope", "view", "alice")
+        assert err.value.code() == grpc.StatusCode.NOT_FOUND
+
+    def test_rest_list_objects(self, daemon):
+        self._seed(daemon)
+        status, body, headers = http(
+            "GET", daemon.read_port,
+            "/relation-tuples/list-objects?namespace=videos&relation=view"
+            "&subject_id=alice",
+        )
+        assert status == 200
+        assert body == {"objects": ["v1", "v2"], "next_page_token": ""}
+        assert headers.get("X-Keto-Snaptoken")
+
+    def test_rest_list_objects_requires_subject(self, daemon):
+        status, body, _ = http(
+            "GET", daemon.read_port,
+            "/relation-tuples/list-objects?namespace=videos&relation=view",
+        )
+        assert status == 400
+
+    def test_rest_list_subjects(self, daemon):
+        self._seed(daemon)
+        status, body, _ = http(
+            "GET", daemon.read_port,
+            "/relation-tuples/list-subjects?namespace=videos&object=v3"
+            "&relation=owner",
+        )
+        assert status == 200
+        assert body == {"subject_ids": ["bob"], "next_page_token": ""}
+
+    def test_rest_routes_are_read_only(self, daemon):
+        # the write router must 404 the read-owned list routes
+        status, _, _ = http(
+            "GET", daemon.write_port,
+            "/relation-tuples/list-objects?namespace=videos&relation=view"
+            "&subject_id=alice",
+        )
+        assert status == 404
+
+    def test_spec_advertises_list_routes(self, daemon):
+        status, spec, _ = http(
+            "GET", daemon.read_port, "/.well-known/openapi.json"
+        )
+        assert status == 200
+        assert "/relation-tuples/list-objects" in spec["paths"]
+        assert "/relation-tuples/list-subjects" in spec["paths"]

@@ -361,3 +361,59 @@ class TestProfiling:
     def test_profiling_config_key_validates(self):
         cfg = Config({"profiling": "cpu", "version": "v0.11.1"})
         assert cfg.get("profiling") == "cpu"
+
+
+class TestReverseCLI:
+    """keto_tpu list-objects / list-subjects verbs (reverse-reachability
+    extension) against the in-process daemon."""
+
+    def _seed(self, capsys, tmp_path, remotes):
+        f = tmp_path / "tuples.json"
+        f.write_text(json.dumps([
+            {"namespace": "videos", "object": "v1", "relation": "owner",
+             "subject_id": "alice"},
+            {"namespace": "videos", "object": "v2", "relation": "owner",
+             "subject_id": "alice"},
+        ]))
+        code, _, _ = run(capsys, ["relation-tuple", "create", str(f), *remotes])
+        assert code == 0
+
+    def test_list_objects(self, capsys, tmp_path, remotes):
+        self._seed(capsys, tmp_path, remotes)
+        code, out, _ = run(
+            capsys, ["list-objects", "alice", "owner", "videos", *remotes]
+        )
+        assert code == 0
+        assert out.splitlines() == ["v1", "v2"]
+
+    def test_list_objects_json_and_paging(self, capsys, tmp_path, remotes):
+        self._seed(capsys, tmp_path, remotes)
+        code, out, _ = run(capsys, [
+            "list-objects", "alice", "owner", "videos",
+            "--page-size", "1", "--format", "json", *remotes,
+        ])
+        assert code == 0
+        body = json.loads(out)
+        assert body["objects"] == ["v1"]
+        assert body["next_page_token"] == "1"
+
+    def test_list_objects_requires_subject(self, capsys, remotes):
+        code, _, err = run(capsys, ["list-objects", "owner", "videos",
+                                    *remotes])
+        assert code == 1
+        assert "subject" in err
+
+    def test_list_subjects(self, capsys, tmp_path, remotes):
+        self._seed(capsys, tmp_path, remotes)
+        code, out, _ = run(
+            capsys, ["list-subjects", "owner", "videos", "v1", *remotes]
+        )
+        assert code == 0
+        assert out.splitlines() == ["alice"]
+
+    def test_list_subjects_empty(self, capsys, remotes):
+        code, out, _ = run(
+            capsys, ["list-subjects", "owner", "videos", "ghost", *remotes]
+        )
+        assert code == 0
+        assert "<no subjects>" in out

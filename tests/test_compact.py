@@ -351,3 +351,58 @@ class TestExpandStateMerge:
             g = {str(c.tuple) for c in (got.children if got else ())}
             w = {str(c.tuple) for c in (want.children if want else ())}
             assert g == w, sub
+
+
+class TestReverseStateMerge:
+    """The transposed mirror (reverse-reachability subsystem) is PATCHED
+    by a delta-overflow merge — reverse rows keyed by the changed
+    subjects rewrite at the tail via the same patch_csr machinery as the
+    forward CSRs — and enumerations stay exactly equal to the oracle
+    through interleaved writes and the compaction itself."""
+
+    def test_reverse_state_survives_merge(self):
+        eng = make_engine(tuples=base_tuples())
+        assert eng.list_objects_batch([("f", "owner", "alice")]) == [["doc"]]
+        assert eng._state.reverse_np is not None
+
+        writes = overflow_writes() + ts("f:extra#owner@alice")
+        eng.manager.write_relation_tuples(writes)
+        eng.manager.delete_relation_tuples(ts("f:doc#owner@alice"))
+        assert eng.list_objects_batch([("f", "owner", "alice")]) == [["extra"]]
+        assert eng.stats.get("incremental_merges", 0) == 1
+        assert eng.stats["snapshot_builds"] == 1  # merged, not rebuilt
+        # the merged state still carries a ready (patched) reverse mirror
+        assert eng._state.reverse_tables is not None
+        assert eng._state.reverse_np is not None
+        assert eng._state.reverse_np["garbage"] > 0  # rows were rewritten
+
+        # merged-in rows serve from the DEVICE reverse path (clean base)
+        before = eng.stats.get("device_list_objects", 0)
+        assert eng.list_objects_batch(
+            [("f", "member", "ubulk3")]
+        ) == [["bulk3"]]
+        assert eng.stats.get("device_list_objects", 0) == before + 1
+
+    def test_reverse_differential_after_merge(self):
+        from keto_tpu.engine.reference import ReferenceEngine
+
+        eng = make_engine(tuples=base_tuples())
+        eng.list_objects_batch([("f", "owner", "alice")])
+        eng.list_subjects_batch([("f", "dir", "member")])
+        eng.manager.write_relation_tuples(
+            overflow_writes()
+            + ts("f:doc2#parent@(f:dir#member)", "f:dir#member@zoe")
+        )
+        eng.check_batch([t("f:bulk0#member@ubulk0")], max_depth=6)
+        assert eng.stats.get("incremental_merges", 0) == 1
+        ref = ReferenceEngine(eng.manager, eng.config)
+        for sub in ("alice", "bob", "zoe", "ubulk5", "nobody"):
+            for rel in ("owner", "member", "view"):
+                got = eng.list_objects_batch([("f", rel, sub)])[0]
+                want = ref.list_objects("f", rel, sub, 0)
+                assert got == want, (sub, rel, got, want)
+        for obj in ("doc", "doc2", "dir", "bulk7"):
+            for rel in ("member", "view"):
+                got = eng.list_subjects_batch([("f", obj, rel)])[0]
+                want = ref.list_subjects("f", obj, rel, 0)
+                assert got == want, (obj, rel, got, want)

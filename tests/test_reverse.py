@@ -1,0 +1,462 @@
+"""Differential tests: device ListObjects / ListSubjects (reverse-
+reachability subsystem, engine/reverse_kernel.py) vs the exact host
+oracle (reference.list_objects / list_subjects), mirroring how the check
+and expand kernels are tested.
+
+The oracle is itself definitional (candidate enumeration + exact
+per-candidate checks), so the contract asserted here is total equality —
+device-exact results on the monotone fragment, and cause-coded host
+fallbacks (which replay ON the oracle) everywhere else: zero silent
+divergence by construction, verified by comparing the facade's final
+answers against a fresh oracle run.
+"""
+
+import random
+
+import pytest
+
+from keto_tpu.config import Config
+from keto_tpu.engine.reference import ReferenceEngine
+from keto_tpu.engine.tpu_engine import TPUCheckEngine
+from keto_tpu.ketoapi import RelationTuple, SubjectSet
+from keto_tpu.namespace import Namespace
+from keto_tpu.namespace.ast import (
+    ComputedSubjectSet,
+    InvertResult,
+    Operator,
+    Relation,
+    SubjectSetRewrite,
+    TupleToSubjectSet,
+)
+from keto_tpu.storage.memory import MemoryManager
+
+CAT_NS = [
+    Namespace(name="videos", relations=[
+        Relation(name="owner"),
+        Relation(name="parent"),
+        Relation(name="view", subject_set_rewrite=SubjectSetRewrite(children=[
+            ComputedSubjectSet(relation="owner"),
+            TupleToSubjectSet(relation="parent",
+                              computed_subject_set_relation="view"),
+        ])),
+    ]),
+    Namespace(name="groups", relations=[Relation(name="member")]),
+]
+
+CAT_TUPLES = [
+    "videos:/d1#owner@alice",
+    "videos:/d1/v1#parent@(videos:/d1#...)",
+    "videos:/d1/v2#parent@(videos:/d1#...)",
+    "videos:/d2#owner@bob",
+    "videos:/d2/v1#parent@(videos:/d2#...)",
+    "videos:/d2/v1#owner@alice",
+    "videos:/d1#view@(groups:eng#member)",
+    "groups:eng#member@carol",
+    "groups:eng#member@(groups:leads#member)",
+    "groups:leads#member@dana",
+]
+
+
+def make_engine(tuples, namespaces=None, max_depth=8, mesh=None):
+    manager = MemoryManager()
+    manager.write_relation_tuples(
+        [RelationTuple.from_string(s) for s in tuples]
+    )
+    config = Config({"limit": {"max_read_depth": max_depth}})
+    config.set_namespaces(
+        namespaces
+        if namespaces is not None
+        else [Namespace(name=n) for n in ("v", "files", "groups")]
+    )
+    engine = TPUCheckEngine(manager, config, mesh=mesh)
+    return engine, ReferenceEngine(manager, config)
+
+
+def assert_objects_match(engine, reference, queries, max_depth=0):
+    got = engine.list_objects_batch(queries, max_depth)
+    want = [
+        reference.list_objects(ns, rel, sub, max_depth)
+        for ns, rel, sub in queries
+    ]
+    assert got == want, (queries, got, want)
+    return got
+
+
+def assert_subjects_match(engine, reference, queries, max_depth=0):
+    got = engine.list_subjects_batch(queries, max_depth)
+    want = [
+        reference.list_subjects(ns, obj, rel, max_depth)
+        for ns, obj, rel in queries
+    ]
+    assert got == want, (queries, got, want)
+    return got
+
+
+class TestListObjectsDifferential:
+    def test_direct_edges(self):
+        e, r = make_engine(
+            ["files:a#owner@alice", "files:b#owner@alice", "files:c#owner@bob"]
+        )
+        got = assert_objects_match(
+            e, r,
+            [("files", "owner", "alice"), ("files", "owner", "bob"),
+             ("files", "owner", "nobody")],
+        )
+        assert got[0] == ["a", "b"]
+        assert e.stats.get("host_list_objects", 0) == 0
+
+    def test_subject_set_indirection(self):
+        e, r = make_engine(
+            [
+                "files:doc#view@(groups:eng#member)",
+                "files:doc2#view@(groups:leads#member)",
+                "groups:eng#member@alice",
+                "groups:eng#member@(groups:leads#member)",
+                "groups:leads#member@carol",
+            ]
+        )
+        assert_objects_match(
+            e, r,
+            [("files", "view", "alice"), ("files", "view", "carol"),
+             ("groups", "member", "carol")],
+        )
+        assert e.stats.get("host_list_objects", 0) == 0
+
+    def test_rewrites_cat_videos(self):
+        e, r = make_engine(CAT_TUPLES, CAT_NS)
+        assert_objects_match(
+            e, r,
+            [("videos", "view", s) for s in ("alice", "bob", "carol", "dana")],
+        )
+        assert e.stats.get("host_list_objects", 0) == 0
+
+    def test_subject_set_query_subject(self):
+        e, r = make_engine(CAT_TUPLES, CAT_NS)
+        sub = SubjectSet("groups", "eng", "member")
+        assert_objects_match(e, r, [("videos", "view", sub)])
+        assert e.stats.get("host_list_objects", 0) == 0
+
+    def test_deep_chain(self):
+        # reachability through a depth-11 parent chain (>= 8 per the
+        # acceptance criteria) plus a cycle edge back into the chain
+        tuples = [
+            f"v:c{i}#parent@(v:c{i + 1}#...)" for i in range(10)
+        ] + ["v:c10#owner@u1", "v:c3#parent@(v:c0#...)"]
+        ns = [Namespace(name="v", relations=[
+            Relation(name="owner"),
+            Relation(name="parent"),
+            Relation(name="viewer", subject_set_rewrite=SubjectSetRewrite(
+                children=[
+                    ComputedSubjectSet(relation="owner"),
+                    TupleToSubjectSet(relation="parent",
+                                      computed_subject_set_relation="viewer"),
+                ])),
+        ])]
+        e, r = make_engine(tuples, ns, max_depth=16)
+        got = assert_objects_match(e, r, [("v", "viewer", "u1")])
+        assert len(got[0]) == 11  # the whole chain resolves
+        assert e.stats.get("host_list_objects", 0) == 0
+
+    def test_cycles(self):
+        e, r = make_engine(
+            [
+                "groups:a#member@(groups:b#member)",
+                "groups:b#member@(groups:a#member)",
+                "groups:b#member@bob",
+            ],
+            max_depth=10,
+        )
+        assert_objects_match(
+            e, r, [("groups", "member", "bob")], max_depth=10
+        )
+
+    def test_unknown_names_are_empty(self):
+        e, r = make_engine(["files:a#owner@alice"])
+        got = assert_objects_match(
+            e, r,
+            [("nope", "owner", "alice"), ("files", "nope", "alice"),
+             ("files", "owner", "ghost")],
+        )
+        assert got == [[], [], []]
+        # exactly-empty answers never pay the host oracle
+        assert e.stats.get("host_list_objects", 0) == 0
+
+    def test_and_island_fallback_is_exact(self):
+        ns = [Namespace(name="acl", relations=[
+            Relation(name="allow"),
+            Relation(name="paid"),
+            Relation(name="access", subject_set_rewrite=SubjectSetRewrite(
+                operation=Operator.AND,
+                children=[ComputedSubjectSet(relation="allow"),
+                          ComputedSubjectSet(relation="paid")])),
+        ])]
+        e, r = make_engine(
+            ["acl:d1#allow@u1", "acl:d1#paid@u1", "acl:d2#allow@u1",
+             "acl:d3#paid@u2"],
+            ns,
+        )
+        assert_objects_match(
+            e, r,
+            [("acl", "access", s) for s in ("u1", "u2", "u3")],
+        )
+        # u1/u2 reach an AND-island leaf relation: cause-coded fallback,
+        # never silent divergence
+        assert e.stats["host_cause"].get("island_host", 0) >= 1
+
+    def test_not_config_routes_every_query_to_host(self):
+        ns = [Namespace(name="n", relations=[
+            Relation(name="allow"),
+            Relation(name="deny"),
+            Relation(name="access", subject_set_rewrite=SubjectSetRewrite(
+                operation=Operator.AND,
+                children=[
+                    ComputedSubjectSet(relation="allow"),
+                    InvertResult(child=ComputedSubjectSet(relation="deny")),
+                ])),
+        ])]
+        e, r = make_engine(
+            ["n:d1#allow@u1", "n:d2#allow@u1", "n:d2#deny@u1"], ns
+        )
+        got = assert_objects_match(e, r, [("n", "access", "u1")])
+        assert got[0] == ["d1"]  # NOT semantics exact via the oracle
+        assert e.stats["host_cause"].get("island_host", 0) == 1
+        assert e.stats.get("device_list_objects", 0) == 0
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_random_graphs_differential(self, seed):
+        rng = random.Random(seed)
+        objects = [f"o{i}" for i in range(12)]
+        relations = ["r1", "r2"]
+        subjects = [f"u{i}" for i in range(8)]
+        tuples = set()
+        for _ in range(60):
+            obj, rel = rng.choice(objects), rng.choice(relations)
+            if rng.random() < 0.45:
+                tuples.add(
+                    f"v:{obj}#{rel}@(v:{rng.choice(objects)}"
+                    f"#{rng.choice(relations)})"
+                )
+            else:
+                tuples.add(f"v:{obj}#{rel}@{rng.choice(subjects)}")
+        e, r = make_engine(sorted(tuples), max_depth=10)
+        queries = [
+            ("v", rel, sub) for sub in subjects for rel in relations
+        ]
+        for depth in (2, 4, 0):
+            assert_objects_match(e, r, queries, max_depth=depth)
+
+    def test_pagination_tokens_chain(self):
+        e, _ = make_engine(
+            [f"files:o{i:02d}#owner@alice" for i in range(10)]
+        )
+        seen: list[str] = []
+        token = ""
+        while True:
+            page, token = e.list_objects(
+                "files", "owner", "alice", page_size=3, page_token=token
+            )
+            assert len(page) <= 3
+            seen.extend(page)
+            if not token:
+                break
+        assert seen == sorted(f"o{i:02d}" for i in range(10))
+
+
+class TestListSubjectsDifferential:
+    def test_direct_and_rewrites(self):
+        e, r = make_engine(CAT_TUPLES, CAT_NS)
+        assert_subjects_match(
+            e, r,
+            [("videos", "/d1/v1", "view"), ("videos", "/d2/v1", "view"),
+             ("videos", "/d1", "owner"), ("groups", "eng", "member")],
+        )
+        assert e.stats.get("host_list_subjects", 0) == 0
+
+    def test_depth_clamps_subjects(self):
+        e, r = make_engine(CAT_TUPLES, CAT_NS)
+        # at depth 1 only the node's own direct subjects are reachable
+        assert_subjects_match(
+            e, r, [("videos", "/d1/v1", "view")], max_depth=1
+        )
+        assert_subjects_match(
+            e, r, [("videos", "/d1", "view")], max_depth=2
+        )
+
+    def test_unknown_node_is_empty(self):
+        e, r = make_engine(["files:a#owner@alice"])
+        got = assert_subjects_match(e, r, [("files", "zzz", "owner")])
+        assert got == [[]]
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_random_graphs_differential(self, seed):
+        rng = random.Random(100 + seed)
+        objects = [f"o{i}" for i in range(10)]
+        relations = ["r1", "r2"]
+        subjects = [f"u{i}" for i in range(6)]
+        tuples = set()
+        for _ in range(50):
+            obj, rel = rng.choice(objects), rng.choice(relations)
+            if rng.random() < 0.4:
+                tuples.add(
+                    f"v:{obj}#{rel}@(v:{rng.choice(objects)}"
+                    f"#{rng.choice(relations)})"
+                )
+            else:
+                tuples.add(f"v:{obj}#{rel}@{rng.choice(subjects)}")
+        e, r = make_engine(sorted(tuples), max_depth=10)
+        queries = [("v", obj, rel) for obj in objects[:6] for rel in relations]
+        for depth in (1, 3, 0):
+            assert_subjects_match(e, r, queries, max_depth=depth)
+
+
+class TestReverseOnMesh:
+    """The 8-device virtual mesh path (acceptance criterion): a
+    mesh-configured engine answers reverse queries exactly — the reverse
+    tables are built unsharded beside the sharded check tables."""
+
+    def _mesh(self, n=8):
+        import jax
+
+        from keto_tpu.parallel import default_mesh
+
+        if len(jax.devices()) < n:
+            pytest.skip(f"needs {n} virtual devices")
+        return default_mesh(n)
+
+    def test_mesh_list_objects_differential(self):
+        e, r = make_engine(CAT_TUPLES, CAT_NS, mesh=self._mesh())
+        assert_objects_match(
+            e, r,
+            [("videos", "view", s) for s in ("alice", "bob", "carol", "dana")],
+        )
+        assert e.stats.get("host_list_objects", 0) == 0
+
+    def test_mesh_list_subjects_differential(self):
+        e, r = make_engine(CAT_TUPLES, CAT_NS, mesh=self._mesh())
+        assert_subjects_match(
+            e, r,
+            [("videos", "/d1/v1", "view"), ("videos", "/d2", "owner")],
+        )
+        assert e.stats.get("host_list_subjects", 0) == 0
+
+
+class TestReverseWrites:
+    """Delta-overlay consistency: writes after the transposed mirror is
+    built must never produce stale enumerations — affected queries are
+    reverse-dirty-flagged onto the exact host path."""
+
+    def test_insert_after_build_is_visible(self):
+        e, r = make_engine(["files:a#owner@alice"])
+        assert_objects_match(e, r, [("files", "owner", "alice")])
+        e.manager.write_relation_tuples(
+            [RelationTuple.from_string("files:b#owner@alice")]
+        )
+        got = assert_objects_match(e, r, [("files", "owner", "alice")])
+        assert got[0] == ["a", "b"]
+        assert e.stats["host_cause"].get("dirty_row", 0) >= 1
+
+    def test_delete_after_build_disappears(self):
+        e, r = make_engine(["files:a#owner@alice", "files:b#owner@alice"])
+        assert_objects_match(e, r, [("files", "owner", "alice")])
+        e.manager.delete_relation_tuples(
+            [RelationTuple.from_string("files:b#owner@alice")]
+        )
+        got = assert_objects_match(e, r, [("files", "owner", "alice")])
+        assert got[0] == ["a"]
+
+    def test_unrelated_subject_stays_on_device(self):
+        e, r = make_engine(
+            ["files:a#owner@alice", "files:c#owner@carl"]
+        )
+        assert_objects_match(e, r, [("files", "owner", "carl")])
+        before = e.stats.get("device_list_objects", 0)
+        e.manager.write_relation_tuples(
+            [RelationTuple.from_string("files:b#owner@alice")]
+        )
+        # carl's seed row and reverse rows are untouched by alice's write
+        assert_objects_match(e, r, [("files", "owner", "carl")])
+        assert e.stats.get("device_list_objects", 0) == before + 1
+
+    def test_subject_set_edge_write_dirties_reverse_row(self):
+        e, r = make_engine(
+            [
+                "files:doc#view@(groups:eng#member)",
+                "groups:eng#member@alice",
+            ]
+        )
+        assert_objects_match(e, r, [("files", "view", "alice")])
+        e.manager.write_relation_tuples(
+            [RelationTuple.from_string("files:doc2#view@(groups:eng#member)")]
+        )
+        got = assert_objects_match(e, r, [("files", "view", "alice")])
+        assert got[0] == ["doc", "doc2"]
+
+    def test_interleaved_writes_and_list_subjects(self):
+        e, r = make_engine(CAT_TUPLES, CAT_NS)
+        assert_subjects_match(e, r, [("videos", "/d1/v1", "view")])
+        e.manager.write_relation_tuples(
+            [RelationTuple.from_string("videos:/d1/v1#owner@erin")]
+        )
+        got = assert_subjects_match(e, r, [("videos", "/d1/v1", "view")])
+        assert "erin" in got[0]
+        e.manager.delete_relation_tuples(
+            [RelationTuple.from_string("videos:/d1/v1#owner@erin")]
+        )
+        got = assert_subjects_match(e, r, [("videos", "/d1/v1", "view")])
+        assert "erin" not in got[0]
+
+
+class TestReverseSnapshotBuilders:
+    def test_reverse_programs_invert_monotone(self):
+        from keto_tpu.engine.snapshot import (
+            RINSTR_COMPUTED,
+            RINSTR_TTU,
+            build_reverse_programs,
+        )
+
+        ns_ids = {"videos": 0, "groups": 1}
+        rel_ids = {"...": 0, "owner": 1, "parent": 2, "view": 3, "member": 4}
+        kind, relp, relt, rns, RK, host_all = build_reverse_programs(
+            CAT_NS, ns_ids, rel_ids, n_config_rels=5
+        )
+        assert not host_all
+        # owner is pulled by view via COMPUTED in namespace videos
+        row = kind[rel_ids["owner"]]
+        k = [int(x) for x in row if x != 0]
+        assert k == [RINSTR_COMPUTED]
+        # view is pulled by view via TTU over parent rows
+        row_view = [int(x) for x in kind[rel_ids["view"]] if x != 0]
+        assert row_view == [RINSTR_TTU]
+        ttu_slot = list(kind[rel_ids["view"]]).index(RINSTR_TTU)
+        assert int(relt[rel_ids["view"]][ttu_slot]) == rel_ids["parent"]
+        assert int(relp[rel_ids["view"]][ttu_slot]) == rel_ids["view"]
+
+    def test_not_sets_host_all(self):
+        from keto_tpu.engine.snapshot import build_reverse_programs
+
+        ns = [Namespace(name="n", relations=[
+            Relation(name="a"),
+            Relation(name="x", subject_set_rewrite=SubjectSetRewrite(
+                children=[InvertResult(
+                    child=ComputedSubjectSet(relation="a")
+                )])),
+        ])]
+        _, _, _, _, _, host_all = build_reverse_programs(
+            ns, {"n": 0}, {"...": 0, "a": 1, "x": 2}, n_config_rels=3
+        )
+        assert host_all
+
+    def test_reverse_seed_tags_disambiguate_kinds(self):
+        from keto_tpu.engine.snapshot import reverse_subject_tag
+
+        n_rels = 5
+        tags = {
+            int(reverse_subject_tag(0, 0)),
+            *(int(reverse_subject_tag(1, sb)) for sb in range(n_rels)),
+        }
+        # plain-subject tag never collides with any subject-set tag, and
+        # the basis is a fixed constant — vocab growth (a patched mirror
+        # serving across a compaction that added relations) can never
+        # skew builder vs delta vs query tags
+        assert len(tags) == n_rels + 1
+        assert 0 not in tags  # 0 is reserved for reverse-row dirty entries

@@ -61,8 +61,10 @@ def store(request):
         # pin sqlite and try to open the URL as a file path
         p = SQLPersister(os.environ[env])
         yield p
-        # live servers persist between test runs: drop this run's rows
+        # live servers persist between test runs: drop this run's rows,
+        # then close — without it every test leaks a server connection
         p.delete_all_relation_tuples(RelationQuery())
+        p.close()
     else:
         yield SQLitePersister("memory")
 
