@@ -535,6 +535,70 @@ def bench_reverse(namespaces, tuples) -> dict:
     return out
 
 
+def bench_watch(n_events: int = 2000, n_subs: int = 4) -> dict:
+    """Watch-subsystem leg (keto_tpu/watch): one writer churning
+    single-tuple transactions against N live subscribers on the
+    in-process hub — the event-consumer workload (cache sync, audit,
+    replication) end to end minus the wire. Reports aggregate delivered
+    changes/sec across subscribers and the p95 write-commit-to-delivery
+    lag; resets must be 0 (the buffer is sized for the churn)."""
+    import threading as _threading
+
+    from keto_tpu.ketoapi import RelationTuple
+    from keto_tpu.storage import MemoryManager
+    from keto_tpu.watch import WatchHub
+
+    manager = MemoryManager()
+    hub = WatchHub(manager, poll_interval=0.05, buffer=n_events + 16)
+    write_ts: list[float] = [0.0] * (n_events + 1)
+    lags: list[list[float]] = [[] for _ in range(n_subs)]
+    resets = [0]
+
+    def consume(i: int) -> None:
+        sub = hub.subscribe("default")
+        try:
+            seen = 0
+            while seen < n_events:
+                event = sub.get(timeout=10.0)
+                if event is None:
+                    return  # stalled: the partial lag sample still reports
+                if event.is_reset:
+                    resets[0] += 1
+                    continue
+                now = time.perf_counter()
+                lags[i].append(now - write_ts[event.version])
+                seen += len(event.changes)
+        finally:
+            sub.close()
+
+    threads = [
+        _threading.Thread(target=consume, args=(i,), daemon=True)
+        for i in range(n_subs)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)  # subscribers parked on their buffers
+    t0 = time.perf_counter()
+    for v in range(1, n_events + 1):
+        write_ts[v] = time.perf_counter()
+        manager.write_relation_tuples(
+            [RelationTuple("videos", f"w{v}", "owner", subject_id="writer")]
+        )
+    for t in threads:
+        t.join(timeout=30)
+    wall = time.perf_counter() - t0
+    all_lags = sorted(lag for per_sub in lags for lag in per_sub)
+    delivered = len(all_lags)
+    p95 = all_lags[int(0.95 * (delivered - 1))] if delivered else 0.0
+    return {
+        "watch_subscribers": n_subs,
+        "watch_churn_events": n_events,
+        "watch_events_per_sec": round(delivered / wall, 1),
+        "watch_p95_lag_ms": round(p95 * 1e3, 3),
+        "watch_resets": resets[0],
+    }
+
+
 def _tree_size(tree) -> int:
     if tree is None:
         return 0
@@ -986,6 +1050,7 @@ def main() -> int:
         record.update(bench_config3_expand())
         record.update(bench_config4_deep())
         record.update(bench_reverse(namespaces, tuples))
+        record.update(bench_watch())
 
         if not args.skip_serve:
             record.update(bench_served(namespaces, tuples, queries))

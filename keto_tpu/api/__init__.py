@@ -6,6 +6,9 @@ daemon.go; proto package ory.keto.relation_tuples.v1alpha2.
 """
 
 from .batcher import CheckBatcher
-from .client import ReadClient, WriteClient, open_channel
+from .client import ReadClient, WatchStreamEvent, WriteClient, open_channel
 
-__all__ = ["CheckBatcher", "ReadClient", "WriteClient", "open_channel"]
+__all__ = [
+    "CheckBatcher", "ReadClient", "WatchStreamEvent", "WriteClient",
+    "open_channel",
+]

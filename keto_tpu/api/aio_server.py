@@ -194,9 +194,14 @@ class _AioReadServices:
             max_workers=4, thread_name_prefix="keto-aio-blocking"
         )
         # health watchers park a thread in ready.wait_change for up to
-        # 5 s per wake; pool sized to the sync plane's 16-watcher cap
+        # 5 s per wake; pool sized to the shared watcher cap
+        # (serve.read.grpc.max_watchers). Tuple WatchService streams do
+        # NOT draw from this pool — they are loop-native (see
+        # watch_tuples: producer-side wakeups via call_soon_threadsafe,
+        # no thread parks per stream).
         self._watch_pool = ThreadPoolExecutor(
-            max_workers=16, thread_name_prefix="keto-aio-watch"
+            max_workers=services.max_watchers,
+            thread_name_prefix="keto-aio-watch",
         )
 
     async def _observed(self, method, coro_fn, req, context):
@@ -252,6 +257,64 @@ class _AioReadServices:
     async def health_check(self, req, context):
         return self._svc.health_check(req, context)
 
+    async def watch_tuples(self, req, context):
+        """Changelog watch as a NATIVE async generator: the hub pushes a
+        loop wakeup via call_soon_threadsafe and the stream drains the
+        subscription buffer in-loop — no thread pinned per stream (the
+        sync plane parks a worker thread in Subscription.get instead).
+        Same cursor/RESET contract and watcher cap as the sync plane."""
+        svc = self._svc
+        if not svc._watch_slots.acquire(blocking=False):
+            await context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                "too many concurrent watchers",
+            )
+        try:
+            loop = asyncio.get_running_loop()
+            try:
+                # subscribe replays history from the store — off-loop
+                sub = await loop.run_in_executor(
+                    self._blocking, svc.watch_subscribe, req, context
+                )
+            except KetoError as e:
+                await context.abort(_grpc_code(e), e.message)
+            wake = asyncio.Event()
+
+            def _wake():
+                try:
+                    loop.call_soon_threadsafe(wake.set)
+                except RuntimeError:
+                    pass  # loop shutting down; the stream is ending
+
+            sub.add_notify(_wake)
+            hub = svc.registry.watch_hub()
+            try:
+                while not context.cancelled():
+                    event, needs_resume = sub.pop_nowait()
+                    if needs_resume:
+                        # overflow resume re-reads the store changelog —
+                        # off-loop, like subscribe
+                        event = await loop.run_in_executor(
+                            self._blocking, hub._resume, sub
+                        )
+                    if event is None:
+                        if sub.closed:  # daemon drain ends the stream
+                            break
+                        try:
+                            await asyncio.wait_for(wake.wait(), timeout=0.5)
+                        except asyncio.TimeoutError:
+                            pass
+                        wake.clear()
+                        continue
+                    event = event.filtered(req.namespace)
+                    if event is None:
+                        continue
+                    yield svc.watch_event_to_proto(event)
+            finally:
+                sub.close()
+        finally:
+            svc._watch_slots.release()
+
     async def health_watch(self, req, context):
         """Async twin of _Services.health_watch: same event-driven
         contract and watcher cap; only the wait parks on an executor."""
@@ -289,6 +352,7 @@ def _aio_handlers(service: _AioReadServices):
         READ_SERVICE,
         REVERSE_READ_SERVICE,
         VERSION_SERVICE,
+        WATCH_SERVICE,
     )
 
     def unary(fn, req_cls):
@@ -336,6 +400,14 @@ def _aio_handlers(service: _AioReadServices):
             "ListSubjects": unary(
                 service._delegated("ListSubjects", svc.list_subjects),
                 pb.ListSubjectsRequest,
+            ),
+        }),
+        # changelog watch extension: loop-native async stream
+        grpc.method_handlers_generic_handler(WATCH_SERVICE, {
+            "Watch": grpc.unary_stream_rpc_method_handler(
+                service.watch_tuples,
+                request_deserializer=pb.WatchRequest.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
             ),
         }),
         grpc.method_handlers_generic_handler(VERSION_SERVICE, {

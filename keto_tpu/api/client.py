@@ -10,7 +10,7 @@ AND any real Keto deployment (same wire format).
 from __future__ import annotations
 
 import os
-from typing import Iterable, Optional
+from typing import Iterable, Iterator, NamedTuple, Optional
 
 import grpc
 
@@ -23,6 +23,7 @@ from .descriptors import (
     READ_SERVICE,
     REVERSE_READ_SERVICE,
     VERSION_SERVICE,
+    WATCH_SERVICE,
     WRITE_SERVICE,
     pb,
 )
@@ -96,6 +97,15 @@ class _BaseClient:
 
     def close(self) -> None:
         self.channel.close()
+
+
+class WatchStreamEvent(NamedTuple):
+    """One event off ReadClient.watch(): a committed store version
+    ("change") or an explicit gap signal ("reset")."""
+
+    event_type: str  # "change" | "reset"
+    snaptoken: str  # the resumable cursor
+    changes: list  # [("insert" | "delete", RelationTuple), ...]
 
 
 class ReadClient(_BaseClient):
@@ -200,6 +210,52 @@ class ReadClient(_BaseClient):
             pb.ListSubjectsResponse, timeout,
         )
         return list(resp.subject_ids), resp.next_page_token, resp.snaptoken
+
+    def watch(
+        self,
+        snaptoken: str = "",
+        namespace: str = "",
+        timeout=None,
+        max_events: Optional[int] = None,
+    ) -> Iterator["WatchStreamEvent"]:
+        """keto_tpu watch extension (WatchService): iterate the server's
+        changelog stream. Each yielded event is one committed store
+        version — `changes` holds that version's ("insert" | "delete",
+        RelationTuple) pairs and `snaptoken` is the resumable cursor to
+        persist; an `event_type == "reset"` event signals an
+        unrecoverable gap (overflow / trimmed changelog): re-read your
+        downstream state, then keep iterating. Resume after a disconnect
+        by passing the last event's snaptoken. Blocks between events;
+        `timeout` bounds the whole stream (gRPC deadline) and
+        `max_events` ends it after N events. Abandoning the iterator
+        (break / close) cancels the server stream. Only this framework's
+        server implements the service."""
+        req = pb.WatchRequest(snaptoken=snaptoken, namespace=namespace)
+        key = (WATCH_SERVICE, "Watch")
+        callable_ = self._callables.get(key)
+        if callable_ is None:
+            callable_ = self._callables[key] = self.channel.unary_stream(
+                f"/{WATCH_SERVICE}/Watch",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.WatchResponse.FromString,
+            )
+        call = callable_(req, timeout=timeout)
+        yielded = 0
+        try:
+            for resp in call:
+                yield WatchStreamEvent(
+                    event_type=resp.event_type,
+                    snaptoken=resp.snaptoken,
+                    changes=[
+                        (c.action, tuple_from_proto(c.relation_tuple))
+                        for c in resp.changes
+                    ],
+                )
+                yielded += 1
+                if max_events is not None and yielded >= max_events:
+                    return
+        finally:
+            call.cancel()
 
     def list_relation_tuples(
         self,

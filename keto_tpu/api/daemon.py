@@ -294,6 +294,10 @@ class Daemon:
         self._rest["metrics"].start()
         for m in self._muxes.values():
             m.start()
+        # changelog streaming hub: built now (not lazily at first watcher)
+        # so the store write hooks and engine push-invalidation are live
+        # from the first request
+        reg.watch_hub()
         reg.ready.set()
         self._started = True
         logger.info(
@@ -352,6 +356,10 @@ class Daemon:
     def stop(self, grace: float = 5.0) -> None:
         """Graceful drain: readiness off, stop accepting, stop servers."""
         self.registry.ready.clear()
+        # end watch streams first so draining servers aren't pinned by
+        # parked subscriber threads
+        if self.registry._watch_hub is not None:
+            self.registry._watch_hub.stop()
         for m in self._muxes.values():
             m.stop()
         if getattr(self, "_aio_read", None) is not None:

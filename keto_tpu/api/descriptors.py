@@ -69,6 +69,11 @@ pb = SimpleNamespace(
     ListObjectsResponse=_msg("keto_tpu.reverse.v1.ListObjectsResponse"),
     ListSubjectsRequest=_msg("keto_tpu.reverse.v1.ListSubjectsRequest"),
     ListSubjectsResponse=_msg("keto_tpu.reverse.v1.ListSubjectsResponse"),
+    # watch extension (keto_tpu_watch.proto; descriptor appended by
+    # tools/gen_watch_descriptor.py): streaming changelog
+    WatchRequest=_msg("keto_tpu.watch.v1.WatchRequest"),
+    WatchChange=_msg("keto_tpu.watch.v1.WatchChange"),
+    WatchResponse=_msg("keto_tpu.watch.v1.WatchResponse"),
 )
 
 NODE_TYPE = _pool.FindEnumTypeByName(f"{_PKG}.NodeType")
@@ -87,3 +92,5 @@ HEALTH_SERVICE = "grpc.health.v1.Health"
 BATCH_CHECK_SERVICE = "keto_tpu.batch.v1.BatchCheckService"
 # extension (keto_tpu_reverse.proto): ListObjects / ListSubjects
 REVERSE_READ_SERVICE = "keto_tpu.reverse.v1.ReverseReadService"
+# extension (keto_tpu_watch.proto): server-streaming changelog watch
+WATCH_SERVICE = "keto_tpu.watch.v1.WatchService"

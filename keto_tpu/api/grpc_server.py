@@ -41,6 +41,7 @@ from .descriptors import (
     READ_SERVICE,
     REVERSE_READ_SERVICE,
     VERSION_SERVICE,
+    WATCH_SERVICE,
     WRITE_SERVICE,
     pb,
 )
@@ -84,11 +85,16 @@ class _Services:
         self.registry = registry
         self.batcher = batcher
         self.metrics = registry.metrics()
-        # health Watch streams pin one sync-server worker thread each for
-        # their lifetime; cap them so watchers can't starve the pool
+        # streaming RPCs (health Watch, tuple WatchService) pin one
+        # sync-server worker thread each for their lifetime; ONE shared
+        # cap keeps all watcher kinds from starving the pool. Config:
+        # serve.read.grpc.max_watchers (schema-validated), default 16.
         import threading as _threading
 
-        self._watch_slots = _threading.BoundedSemaphore(16)
+        self.max_watchers = int(
+            registry.config.get("serve.read.grpc.max_watchers", 16)
+        )
+        self._watch_slots = _threading.BoundedSemaphore(self.max_watchers)
 
     # -- helpers --------------------------------------------------------------
 
@@ -352,6 +358,65 @@ class _Services:
         status = 1 if self.registry.ready.is_set() else 2  # SERVING / NOT_SERVING
         return pb.HealthCheckResponse(status=status)
 
+    # -- WatchService (keto_tpu extension) ------------------------------------
+
+    @staticmethod
+    def watch_event_to_proto(event):
+        """WatchEvent (watch/hub.py) -> WatchResponse proto."""
+        resp = pb.WatchResponse(
+            event_type=event.kind, snaptoken=event.snaptoken
+        )
+        for op, t in event.changes:
+            c = resp.changes.add()
+            c.action = op
+            c.relation_tuple.CopyFrom(tuple_to_proto(t))
+        return resp
+
+    def watch_subscribe(self, req, context):
+        """Shared stream setup for the sync and aio planes: parse +
+        validate the resume cursor, open the hub subscription. Raises
+        KetoError (snaptoken 400/409) for the caller to map."""
+        from ..engine.snaptoken import parse_snaptoken
+
+        nid = self._nid(context)
+        if req.namespace:
+            self.registry.validate_namespaces(
+                RelationQuery(namespace=req.namespace)
+            )
+        min_version = parse_snaptoken(req.snaptoken, nid)
+        return self.registry.watch_hub().subscribe(nid, min_version)
+
+    def watch_tuples(self, req, context):
+        """Server-streaming changelog watch (keto_tpu.watch.v1): resume
+        from the request snaptoken, then live-tail; overflow surfaces as
+        an in-band RESET event, never a silent gap. Shares the watcher
+        cap with health Watch (both pin a worker thread)."""
+        if not self._watch_slots.acquire(blocking=False):
+            context.abort(
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                "too many concurrent watchers",
+            )
+        try:
+            try:
+                sub = self.watch_subscribe(req, context)
+            except KetoError as e:
+                context.abort(_grpc_code(e), e.message)
+            try:
+                while context.is_active():
+                    event = sub.get(timeout=0.5)
+                    if event is None:
+                        if sub.closed:  # daemon drain ends the stream
+                            break
+                        continue
+                    event = event.filtered(req.namespace)
+                    if event is None:
+                        continue
+                    yield self.watch_event_to_proto(event)
+            finally:
+                sub.close()
+        finally:
+            self._watch_slots.release()
+
     def health_watch(self, req, context):
         """Streams the current status, then pushes changes until the client
         disconnects (grpc.health.v1 Watch contract). Event-driven: the
@@ -462,6 +527,16 @@ def _service_handlers(services: _Services, write: bool):
                         "ListSubjects": _unary(
                             s, "ListSubjects", s.list_subjects,
                             pb.ListSubjectsRequest,
+                        ),
+                    },
+                ),
+                grpc.method_handlers_generic_handler(
+                    WATCH_SERVICE,
+                    {
+                        "Watch": grpc.unary_stream_rpc_method_handler(
+                            lambda req, ctx: s.watch_tuples(req, ctx),
+                            request_deserializer=pb.WatchRequest.FromString,
+                            response_serializer=lambda m: m.SerializeToString(),
                         ),
                     },
                 ),

@@ -22,6 +22,7 @@ from .rest_server import (
     READY_PATH,
     ROUTE_KINDS,
     VERSION_PATH,
+    WATCH_ROUTE,
     WRITE_ROUTE_BASE,
 )
 
@@ -182,6 +183,41 @@ def _schemas() -> dict:
         "healthStatus": {
             "type": "object",
             "properties": {"status": {"type": "string"}},
+        },
+        "watchEvent": {
+            "type": "object",
+            "required": ["event_type", "snaptoken", "changes"],
+            "properties": {
+                "event_type": {
+                    "type": "string",
+                    "enum": ["change", "reset"],
+                    "description": "change = one committed store version; "
+                                   "reset = unrecoverable gap (overflow, "
+                                   "trimmed changelog) — re-read state and "
+                                   "resume from the carried snaptoken",
+                },
+                "snaptoken": {
+                    "type": "string",
+                    "description": "the committed version's token — the "
+                                   "resumable cursor",
+                },
+                "changes": {
+                    "type": "array",
+                    "items": {
+                        "type": "object",
+                        "required": ["action", "relation_tuple"],
+                        "properties": {
+                            "action": {
+                                "type": "string",
+                                "enum": ["insert", "delete"],
+                            },
+                            "relation_tuple": {
+                                "$ref": "#/components/schemas/relationTuple"
+                            },
+                        },
+                    },
+                },
+            },
         },
         "errorGeneric": {
             "type": "object",
@@ -389,6 +425,45 @@ def build_spec(version: str = "", kind: str | None = None) -> dict:
                 },
             }
         },
+        WATCH_ROUTE: {
+            "get": {
+                "summary": "Stream the tuple changelog as Server-Sent "
+                           "Events (keto_tpu watch extension; Zanzibar's "
+                           "Watch API)",
+                "parameters": [
+                    snaptoken_param,
+                    {"name": "namespace", "in": "query",
+                     "schema": {"type": "string"},
+                     "description": "only stream changes in this "
+                                    "namespace (reset events always "
+                                    "pass the filter)"},
+                    {"name": "max_events", "in": "query",
+                     "schema": {"type": "integer"},
+                     "description": "close the stream after N events "
+                                    "(scripting/testing aid)"},
+                ],
+                "responses": {
+                    "200": {
+                        "description": "SSE stream; each message is one "
+                                       "committed store version (event: "
+                                       "change|reset, data: watchEvent)",
+                        "content": {
+                            "text/event-stream": {
+                                "schema": {
+                                    "$ref": "#/components/schemas/watchEvent"
+                                }
+                            }
+                        },
+                    },
+                    "400": _json_response("malformed snaptoken",
+                                          "errorGeneric"),
+                    "404": _json_response("unknown namespace", "errorGeneric"),
+                    "409": _json_response(
+                        "snaptoken demands a newer snapshot", "errorGeneric"
+                    ),
+                },
+            }
+        },
         WRITE_ROUTE_BASE: {
             "put": {
                 "summary": "Create one relation tuple",
@@ -447,6 +522,7 @@ def build_spec(version: str = "", kind: str | None = None) -> dict:
         (EXPAND_ROUTE, "get"): "getExpand",
         (LIST_OBJECTS_ROUTE, "get"): "getListObjects",
         (LIST_SUBJECTS_ROUTE, "get"): "getListSubjects",
+        (WATCH_ROUTE, "get"): "getWatch",
         (WRITE_ROUTE_BASE, "put"): "createRelationTuple",
         (WRITE_ROUTE_BASE, "delete"): "deleteRelationTuples",
         (WRITE_ROUTE_BASE, "patch"): "patchRelationTuples",

@@ -48,6 +48,10 @@ EXPAND_ROUTE = "/relation-tuples/expand"
 # object" — the reference has no such routes (Zanzibar's Leopard family)
 LIST_OBJECTS_ROUTE = "/relation-tuples/list-objects"
 LIST_SUBJECTS_ROUTE = "/relation-tuples/list-subjects"
+# keto_tpu watch extension (keto_tpu/watch): the streaming changelog as
+# Server-Sent Events — Zanzibar's Watch API (§2.4.3), absent from the
+# reference
+WATCH_ROUTE = "/relation-tuples/watch"
 WRITE_ROUTE_BASE = "/admin/relation-tuples"
 ALIVE_PATH = "/health/alive"
 READY_PATH = "/health/ready"
@@ -66,6 +70,7 @@ ROUTE_KINDS = {
     EXPAND_ROUTE: "read",
     LIST_OBJECTS_ROUTE: "read",
     LIST_SUBJECTS_ROUTE: "read",
+    WATCH_ROUTE: "read",
     WRITE_ROUTE_BASE: "write",
     ALIVE_PATH: "shared",
     READY_PATH: "shared",
@@ -106,6 +111,7 @@ class _Handler(BaseHTTPRequestHandler):
     batcher = None
     kind = "read"  # read | write | metrics
     cors = None  # serve.<kind>.cors config dict (ref: daemon.go:289-349)
+    watch_slots = None  # per-listener SSE watcher cap (make_handler_class)
 
     # -- plumbing -------------------------------------------------------------
 
@@ -280,6 +286,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return LIST_OBJECTS_ROUTE, self._list_objects
             if method == "GET" and path == LIST_SUBJECTS_ROUTE:
                 return LIST_SUBJECTS_ROUTE, self._list_subjects
+            if method == "GET" and path == WATCH_ROUTE:
+                return WATCH_ROUTE, self._watch
             return None
 
         # write router
@@ -511,6 +519,95 @@ class _Handler(BaseHTTPRequestHandler):
             extra_headers=[("X-Keto-Snaptoken", encode_snaptoken(version, nid))],
         )
 
+    # SSE keep-alive cadence: also the disconnect-detection bound (a
+    # vanished client is only noticed on the next write)
+    WATCH_HEARTBEAT_S = 5.0
+
+    def _watch(self) -> None:
+        """keto_tpu watch extension: the streaming changelog as
+        Server-Sent Events. `snaptoken` resumes the cursor (every change
+        strictly after it, exactly once, in version order — 409 when the
+        token is ahead of the store, an explicit `reset` event when the
+        bounded changelog no longer reaches it); `namespace` filters;
+        `max_events` (scripting/testing aid) closes the stream after N
+        events. Each SSE message is one committed store version:
+
+            event: change | reset
+            data: {"event_type", "snaptoken", "changes": [
+                      {"action": "insert"|"delete", "relation_tuple": {...}}]}
+
+        Token/parse errors surface as normal JSON errors (they happen
+        before the stream opens)."""
+        from ..engine.snaptoken import parse_snaptoken
+
+        params = self._params()
+        nid = self._nid()
+        namespace = params.get("namespace", "")
+        if namespace:
+            self.registry.validate_namespaces(RelationQuery(namespace=namespace))
+        max_events = None
+        if params.get("max_events"):
+            try:
+                max_events = int(params["max_events"])
+            except ValueError:
+                raise MalformedInputError(
+                    debug=f"invalid max_events {params['max_events']!r}"
+                )
+        min_version = parse_snaptoken(params.get("snaptoken", ""), nid)
+        # SSE streams pin one server thread each, exactly like gRPC
+        # watch streams pin a worker. The CONFIG KNOB is shared
+        # (serve.read.grpc.max_watchers) but the slot pool is
+        # per-listener: each transport serves from its own thread pool,
+        # so the process-wide ceiling is the knob times the number of
+        # watch-capable listeners
+        if not self.watch_slots.acquire(blocking=False):
+            self._json(
+                429,
+                {"error": {"code": 429, "status": "Too Many Requests",
+                           "message": "too many concurrent watchers"}},
+            )
+            return
+        try:
+            self._watch_stream(nid, namespace, min_version, max_events)
+        finally:
+            self.watch_slots.release()
+
+    def _watch_stream(self, nid, namespace, min_version, max_events) -> None:
+        sub = self.registry.watch_hub().subscribe(nid, min_version)
+        self.close_connection = True  # the stream IS the response body
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            for k, v in self._cors_headers():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(b": stream open\n\n")
+            self.wfile.flush()
+            delivered = 0
+            while max_events is None or delivered < max_events:
+                event = sub.get(timeout=self.WATCH_HEARTBEAT_S)
+                if event is None:
+                    if sub.closed:  # daemon drain ends the stream
+                        break
+                    self.wfile.write(b": keep-alive\n\n")
+                    self.wfile.flush()
+                    continue
+                event = event.filtered(namespace)
+                if event is None:
+                    continue
+                payload = json.dumps(event.to_dict())
+                self.wfile.write(
+                    f"event: {event.kind}\ndata: {payload}\n\n".encode()
+                )
+                self.wfile.flush()
+                delivered += 1
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away: normal end of a watch stream
+        finally:
+            sub.close()
+
     @staticmethod
     def _subject_from_params(params: dict[str, str]):
         """subject_id or subject_set.{namespace,object,relation} from URL
@@ -613,10 +710,16 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 def make_handler_class(registry, kind: str, batcher=None, cors=None):
+    # one watcher-slot pool per listener, shared by every connection of
+    # the handler class (the SSE analog of _Services._watch_slots)
+    watch_slots = threading.BoundedSemaphore(
+        int(registry.config.get("serve.read.grpc.max_watchers", 16))
+    )
     return type(
         f"KetoHTTP{kind.capitalize()}Handler",
         (_Handler,),
-        {"registry": registry, "kind": kind, "batcher": batcher, "cors": cors},
+        {"registry": registry, "kind": kind, "batcher": batcher,
+         "cors": cors, "watch_slots": watch_slots},
     )
 
 

@@ -523,6 +523,42 @@ def cmd_list_subjects(args) -> int:
     return 0
 
 
+def cmd_watch(args) -> int:
+    """keto_tpu extension: stream the tuple changelog (Zanzibar's Watch
+    API). Resumes from --snaptoken, filters with --namespace; --max-events
+    ends the stream after N events (otherwise it runs until ^C). Default
+    output is one line per tuple change plus reset markers; --format json
+    emits one JSON object per event (a committed store version)."""
+    client = _read_client(args)
+    printed = 0
+    try:
+        for event in client.watch(
+            snaptoken=args.snaptoken or "", namespace=args.namespace or ""
+        ):
+            if args.format in (FORMAT_JSON, FORMAT_JSON_PRETTY):
+                obj = {
+                    "event_type": event.event_type,
+                    "snaptoken": event.snaptoken,
+                    "changes": [
+                        {"action": op, "relation_tuple": t.to_dict()}
+                        for op, t in event.changes
+                    ],
+                }
+                indent = 2 if args.format == FORMAT_JSON_PRETTY else None
+                print(json.dumps(obj, indent=indent), flush=True)
+            elif event.event_type == "reset":
+                print(f"RESET\t{event.snaptoken}", flush=True)
+            else:
+                for op, t in event.changes:
+                    print(f"{op.upper()}\t{t}\t{event.snaptoken}", flush=True)
+            printed += 1
+            if args.max_events and printed >= args.max_events:
+                break
+    finally:
+        client.close()
+    return 0
+
+
 def cmd_status(args) -> int:
     """ref: cmd/status/root.go — health polling, --block retries."""
     make = _write_client if args.endpoint == "write" else _read_client
@@ -720,6 +756,21 @@ def build_parser() -> argparse.ArgumentParser:
     _add_remote_flags(p, read=True)
     _add_format_flag(p)
     p.set_defaults(fn=cmd_list_subjects)
+
+    p = sub.add_parser(
+        "watch",
+        help="stream the relation-tuple changelog (resumable snaptoken "
+             "cursor)",
+    )
+    p.add_argument("--snaptoken", default=None,
+                   help="resume the stream from this cursor")
+    p.add_argument("--namespace", default=None,
+                   help="only stream changes in this namespace")
+    p.add_argument("--max-events", type=int, default=0,
+                   help="stop after N events (0 = stream until interrupted)")
+    _add_remote_flags(p, read=True)
+    _add_format_flag(p)
+    p.set_defaults(fn=cmd_watch)
 
     p = sub.add_parser("status", help="poll server health")
     p.add_argument("--block", action="store_true")

@@ -79,6 +79,31 @@ class Metrics:
             "Device graph-mirror rebuild latency",
             registry=self.registry,
         )
+        # watch subsystem (keto_tpu/watch): changelog streaming health
+        self.watch_streams_active = prom.Gauge(
+            "keto_tpu_watch_streams_active",
+            "Open watch subscriptions (gRPC streams + SSE connections)",
+            registry=self.registry,
+        )
+        self.watch_events_delivered_total = prom.Counter(
+            "keto_tpu_watch_events_delivered_total",
+            "Tuple changes delivered to watch subscribers (counts "
+            "individual insert/delete changes, summed over subscribers)",
+            registry=self.registry,
+        )
+        self.watch_resets_total = prom.Counter(
+            "keto_tpu_watch_resets_total",
+            "RESET events handed to watch subscribers (ring-buffer "
+            "overflow, trimmed changelog, bulk load) — every gap is "
+            "explicit, never a silent drop",
+            registry=self.registry,
+        )
+        self.watch_lag_seconds = prom.Gauge(
+            "keto_tpu_watch_lag_seconds",
+            "Delay between the oldest undelivered commit's write hook "
+            "and its fan-out to subscribers (watch hub tail lag)",
+            registry=self.registry,
+        )
         # hot-path cache: (transport, method) -> (duration child,
         # {code: counter child})
         self._observe_cache: dict = {}

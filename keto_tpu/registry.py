@@ -132,6 +132,7 @@ class Registry:
         )
         self._metrics = None
         self._tracer = None
+        self._watch_hub = None
         # health: flipped by the daemon around serving
         # (ref: registry_default.go:98-112 healthx readiness checkers)
         self.ready = ReadyState()
@@ -218,6 +219,11 @@ class Registry:
             # request thread (the compressed write can take seconds)
             def _flush_evicted(engines=tuple(evicted)):
                 for e in engines:
+                    # end the push-refresh thread first: its bound-method
+                    # target would pin the evicted engine in memory
+                    stop = getattr(e, "stop_push_refresh", None)
+                    if stop is not None:
+                        stop()
                     flush = getattr(e, "flush_checkpoints", None)
                     if flush is not None:
                         flush()
@@ -266,6 +272,43 @@ class Registry:
 
     def expand_engine(self, nid: Optional[str] = None):
         return self.check_engine(nid)
+
+    # -- watch subsystem ------------------------------------------------------
+
+    def watch_hub(self):
+        """The process-wide changelog streaming hub (keto_tpu/watch):
+        registers itself as the store's post-commit write listener and
+        trim guard, and push-invalidates cached engines' device mirrors
+        on every commit (delta refresh becomes event-driven instead of
+        per-request changes_since polling)."""
+        with self._lock:
+            if self._watch_hub is None:
+                from .watch import WatchHub
+
+                self._watch_hub = WatchHub(
+                    self.relation_tuple_manager(),
+                    poll_interval=float(
+                        self.config.get("watch.poll_interval", 0.25)
+                    ),
+                    buffer=int(self.config.get("watch.buffer", 256)),
+                    metrics=self.metrics(),
+                )
+                self._watch_hub.add_commit_listener(self._push_invalidate)
+            return self._watch_hub
+
+    def _push_invalidate(self, nid: str) -> None:
+        """Hub commit listener: poke the ALREADY-BUILT engine for `nid`
+        (never builds one — a tenant nobody queries must not get a device
+        mirror just because someone wrote to it)."""
+        with self._lock:
+            engine = (
+                self._engine if nid == self.nid else self._nid_engines.get(nid)
+            )
+        if engine is None:
+            return
+        poke = getattr(engine, "notify_write", None)
+        if poke is not None:
+            poke()
 
     def namespace_manager(self):
         return self.config.namespace_manager()

@@ -40,6 +40,7 @@ from .columns import TupleColumns, concat_columns
 from .definitions import (
     DEFAULT_NETWORK,
     DEFAULT_PAGE_SIZE,
+    WriteHookMixin,
 )
 
 CHANGE_LOG_CAP = 1 << 16
@@ -203,12 +204,15 @@ class _ColumnarNetwork:
         self.rebuild_base_index(all_keys)
 
 
-class ColumnarStore:
+class ColumnarStore(WriteHookMixin):
     """Manager implementation over columnar per-network stores."""
 
     def __init__(self):
         self._lock = threading.RLock()
         self._networks: dict[str, _ColumnarNetwork] = {}
+        # post-commit write hooks (WriteHookMixin): fired outside _lock;
+        # bulk_load notifies too — its log reset surfaces as a RESET
+        self._write_listeners: list = []
 
     _EMPTY = _ColumnarNetwork()
 
@@ -275,6 +279,9 @@ class ColumnarStore:
             net.version += 1
             net.log.clear()
             net.log_floor = net.version
+        # the floor reset means changelog_since() == None: watchers see
+        # an explicit RESET, the engine compacts — both event-driven
+        self._notify_write(nid, True)
 
     def all_tuple_columns(self, nid: str = DEFAULT_NETWORK) -> TupleColumns:
         """One consistent columnar view (buffer folded in)."""
@@ -296,53 +303,81 @@ class ColumnarStore:
     def changes_since(
         self, version: int, nid: str = DEFAULT_NETWORK
     ) -> Optional[list]:
+        triples = self.changelog_since(version, nid=nid)
+        if triples is None:
+            return None
+        return [(op, t) for _v, op, t in triples]
+
+    def changelog_since(
+        self, version: int, nid: str = DEFAULT_NETWORK
+    ) -> Optional[list]:
+        """Versioned changelog slice: (version, op, tuple) triples after
+        `version` (the watch feed; see memory.MemoryManager)."""
         with self._lock:
             net = self._net_ro(nid)
             if version < net.log_floor or (
                 net.log and net.log[0][0] > version + 1
             ):
                 return None  # truncated / bulk-loaded: caller compacts
-            return [(op, t) for v, op, t in net.log if v > version]
+            return [(v, op, t) for v, op, t in net.log if v > version]
 
     def write_relation_tuples(
         self, tuples: Sequence[RelationTuple], nid: str = DEFAULT_NETWORK
     ) -> None:
         with self._lock:
-            net = self._net(nid)
-            for t in tuples:
-                ident = _tuple_identity(t)
-                if ident in net.buffer_keys or net.base_find(ident) is not None:
-                    continue  # idempotent insert
-                net.buffer_keys[ident] = len(net.buffer)
-                net.buffer.append(t)
-                net.version += 1
-                net.log.append((net.version, "insert", t))
-            if len(net.buffer) >= _BUFFER_MERGE_THRESHOLD:
-                net.merge_buffer()
+            changed = self._write_locked(tuples, nid)
+        self._notify_write(nid, changed)
+
+    def _write_locked(
+        self, tuples: Sequence[RelationTuple], nid: str
+    ) -> bool:
+        net = self._net(nid)
+        changed = False
+        for t in tuples:
+            ident = _tuple_identity(t)
+            if ident in net.buffer_keys or net.base_find(ident) is not None:
+                continue  # idempotent insert
+            net.buffer_keys[ident] = len(net.buffer)
+            net.buffer.append(t)
+            net.version += 1
+            net.log.append((net.version, "insert", t))
+            changed = True
+        if len(net.buffer) >= _BUFFER_MERGE_THRESHOLD:
+            net.merge_buffer()
+        return changed
 
     def delete_relation_tuples(
         self, tuples: Sequence[RelationTuple], nid: str = DEFAULT_NETWORK
     ) -> None:
         with self._lock:
-            net = self._net(nid)
-            for t in tuples:
-                ident = _tuple_identity(t)
-                bi = net.buffer_keys.pop(ident, None)
-                removed = False
-                if bi is not None:
-                    net.buffer[bi] = None  # type: ignore[assignment]
-                    removed = True
-                row = net.base_find(ident)
-                if row is not None:
-                    net.alive[row] = False
-                    removed = True
-                if removed:
-                    net.version += 1
-                    net.log.append((net.version, "delete", t))
-            net.buffer = [t for t in net.buffer if t is not None]
-            net.buffer_keys = {
-                _tuple_identity(t): i for i, t in enumerate(net.buffer)
-            }
+            changed = self._delete_locked(tuples, nid)
+        self._notify_write(nid, changed)
+
+    def _delete_locked(
+        self, tuples: Sequence[RelationTuple], nid: str
+    ) -> bool:
+        net = self._net(nid)
+        changed = False
+        for t in tuples:
+            ident = _tuple_identity(t)
+            bi = net.buffer_keys.pop(ident, None)
+            removed = False
+            if bi is not None:
+                net.buffer[bi] = None  # type: ignore[assignment]
+                removed = True
+            row = net.base_find(ident)
+            if row is not None:
+                net.alive[row] = False
+                removed = True
+            if removed:
+                net.version += 1
+                net.log.append((net.version, "delete", t))
+                changed = True
+        net.buffer = [t for t in net.buffer if t is not None]
+        net.buffer_keys = {
+            _tuple_identity(t): i for i, t in enumerate(net.buffer)
+        }
+        return changed
 
     def transact_relation_tuples(
         self,
@@ -351,12 +386,14 @@ class ColumnarStore:
         nid: str = DEFAULT_NETWORK,
     ) -> None:
         with self._lock:
-            self.write_relation_tuples(insert, nid=nid)
-            self.delete_relation_tuples(delete, nid=nid)
+            changed = self._write_locked(insert, nid)
+            changed |= self._delete_locked(delete, nid)
+        self._notify_write(nid, changed)
 
     def delete_all_relation_tuples(
         self, query: RelationQuery, nid: str = DEFAULT_NETWORK
     ) -> None:
+        changed = False
         with self._lock:
             net = self._net(nid)
             net.merge_buffer()
@@ -366,6 +403,8 @@ class ColumnarStore:
                 net.alive[row] = False
                 net.version += 1
                 net.log.append((net.version, "delete", t))
+                changed = True
+        self._notify_write(nid, changed)
 
     def relation_tuple_exists(
         self, t: RelationTuple, nid: str = DEFAULT_NETWORK

@@ -59,6 +59,27 @@ def validate_page_token(token: str) -> str:
         raise InvalidPageTokenError(debug=f"invalid pagination token {token!r}")
 
 
+class WriteHookMixin:
+    """Post-commit write notification, shared by every store backend
+    (the watch hub's event-driven feed). Subclasses initialize
+    ``self._write_listeners = []`` and call ``self._notify_write(nid,
+    changed)`` AFTER releasing their store lock — a listener (the hub)
+    takes its own locks and calling it under the store lock would
+    deadlock against the hub's tailer reading the store."""
+
+    _write_listeners: list
+
+    def add_write_listener(self, fn) -> None:
+        """`fn(nid)` runs after every write call that actually changed
+        the store (idempotent no-ops don't fire), outside store locks."""
+        self._write_listeners.append(fn)
+
+    def _notify_write(self, nid: str, changed: bool) -> None:
+        if changed:
+            for fn in tuple(self._write_listeners):
+                fn(nid)
+
+
 class Manager(Protocol):
     """ref: internal/relationtuple/definitions.go:19-25"""
 

@@ -29,6 +29,7 @@ from ..ketoapi import RelationQuery, RelationTuple
 from .definitions import (
     DEFAULT_NETWORK,
     DEFAULT_PAGE_SIZE,
+    WriteHookMixin,
     shard_id,
     validate_page_token,
 )
@@ -66,10 +67,12 @@ def _subject_key(t: RelationTuple) -> str:
     return str(t.subject_set) if t.subject_set is not None else f"id:{t.subject_id}"
 
 
-class MemoryManager:
+class MemoryManager(WriteHookMixin):
     def __init__(self):
         self._lock = threading.RLock()
         self._networks: dict[str, _NetworkStore] = defaultdict(_NetworkStore)
+        # post-commit write hooks (WriteHookMixin): fired outside _lock
+        self._write_listeners: list = []
 
     # An empty store served to read paths for unknown nids, so arbitrary
     # per-request tenant ids can't grow self._networks unboundedly.
@@ -168,6 +171,19 @@ class MemoryManager:
         """Ordered (op, tuple) ops committed after `version`, or None when
         the bounded log no longer reaches back that far (callers must then
         rebuild their mirror from all_relation_tuples)."""
+        triples = self.changelog_since(version, nid=nid)
+        if triples is None:
+            return None
+        return [(op, t) for _v, op, t in triples]
+
+    def changelog_since(
+        self, version: int, nid: str = DEFAULT_NETWORK
+    ) -> Optional[list[tuple[int, str, RelationTuple]]]:
+        """Versioned changelog slice: ordered (version, op, tuple)
+        triples committed after `version`, or None when the bounded log
+        can't prove completeness back that far. The watch subsystem's
+        feed — unlike changes_since it keeps the commit version per op,
+        which is what makes snaptoken cursors resumable."""
         with self._lock:
             net = self._net_ro(nid)
             if version >= net.version:
@@ -181,7 +197,7 @@ class MemoryManager:
             )
             if not complete:
                 return None
-            return [(op, t) for v, op, t in log if v > version]
+            return [(v, op, t) for v, op, t in log if v > version]
 
     # -- writes --------------------------------------------------------------
 
@@ -195,6 +211,7 @@ class MemoryManager:
                 changed |= self._insert(net, nid, t)
             if changed:  # no-op batches must not signal mirror staleness
                 net.version += 1
+        self._notify_write(nid, changed)
 
     def delete_relation_tuples(
         self, tuples: Sequence[RelationTuple], nid: str = DEFAULT_NETWORK
@@ -206,6 +223,7 @@ class MemoryManager:
                 changed |= self._delete(net, nid, t)
             if changed:
                 net.version += 1
+        self._notify_write(nid, changed)
 
     def delete_all_relation_tuples(
         self, query: RelationQuery, nid: str = DEFAULT_NETWORK
@@ -220,6 +238,7 @@ class MemoryManager:
                 changed |= self._delete(net, nid, t)
             if changed:
                 net.version += 1
+        self._notify_write(nid, changed)
 
     def transact_relation_tuples(
         self,
@@ -238,6 +257,7 @@ class MemoryManager:
                 changed |= self._delete(net, nid, t)
             if changed:
                 net.version += 1
+        self._notify_write(nid, changed)
 
     # -- internals -----------------------------------------------------------
 

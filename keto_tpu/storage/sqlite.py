@@ -41,6 +41,7 @@ from ..ketoapi import RelationQuery, RelationTuple, SubjectSet
 from .definitions import (
     DEFAULT_NETWORK,
     DEFAULT_PAGE_SIZE,
+    WriteHookMixin,
     shard_id,
     validate_page_token,
 )
@@ -199,6 +200,17 @@ MIGRATION_TEMPLATES: list[tuple[str, list, list]] = [
         ["DROP TABLE IF EXISTS keto_relation_tuples"],
         ["__recreate_legacy_relation_tuples__"],
     ),
+    (
+        # the pre-watch changelog trim cut by seq and could split the
+        # oldest surviving commit's op group; changelog_since now proves
+        # completeness back to min_version - 1 on the invariant that
+        # version groups are intact (the version-aligned _trim). This
+        # one-time data migration re-establishes the invariant for
+        # databases trimmed by the old code.
+        "20220513200700_align_change_log_trim",
+        ["__align_change_log__"],
+        [],
+    ),
 ]
 
 
@@ -298,9 +310,30 @@ def _recreate_legacy_relation_tuples(persister) -> None:
         persister._conn.execute(stmt)
 
 
+def _align_change_log(persister) -> None:
+    """Drop the oldest version group of any changelog that may ever have
+    been trimmed (count at/over the cap — a log that never filled was
+    never trimmed). The old seq-based trim could leave that group
+    partial; version-aligned completeness (changelog_since) relies on
+    every surviving group being whole."""
+    conn = persister._conn
+    if not persister._table_exists("keto_change_log"):
+        return
+    rows = conn.execute(
+        "SELECT nid, COUNT(*), MIN(version) FROM keto_change_log GROUP BY nid"
+    ).fetchall()
+    for nid, count, min_version in rows:
+        if min_version is not None and count >= persister.CHANGE_LOG_CAP:
+            conn.execute(
+                "DELETE FROM keto_change_log WHERE nid = ? AND version = ?",
+                (nid, min_version),
+            )
+
+
 _DATA_MIGRATIONS = {
     "__migrate_strings_to_uuids__": _migrate_strings_to_uuids,
     "__recreate_legacy_relation_tuples__": _recreate_legacy_relation_tuples,
+    "__align_change_log__": _align_change_log,
 }
 
 _SELECT = """
@@ -366,7 +399,7 @@ class _PrepConn:
         return False
 
 
-class SQLPersister:
+class SQLPersister(WriteHookMixin):
     """Dialect-generic durable persister.
 
     dsn: 'memory' / a filesystem path / sqlite://path (sqlite), or a
@@ -398,6 +431,9 @@ class SQLPersister:
         dialect.on_connect(raw)
         self._conn = _PrepConn(raw, dialect)
         self._lock = threading.RLock()
+        # post-commit write hooks (WriteHookMixin) + changelog trim guard
+        self._write_listeners: list = []
+        self._trim_guard = None
         # numeric namespace-id -> name map for the strings-to-uuids data
         # migration (the reference resolves via namespace.Manager configs)
         self.legacy_namespaces = legacy_namespaces
@@ -711,6 +747,7 @@ class SQLPersister:
         where, params = self._where(nid, query)
         # the WHERE clause (incl. its nid guard) applies directly to the
         # DELETE; "t" aliases the deleted table itself
+        changed = False
         with self._lock, self._conn:
             doomed = [
                 self._row_to_tuple(r)
@@ -723,8 +760,10 @@ class SQLPersister:
                 params,
             )
             if cur.rowcount:
+                changed = True
                 self._bump_version(nid)
                 self._log_changes(nid, [("delete", t) for t in doomed])
+        self._notify_write(nid, changed)
 
     def transact_relation_tuples(
         self,
@@ -775,10 +814,16 @@ class SQLPersister:
             if ops:
                 self._bump_version(nid)
                 self._log_changes(nid, ops)
+        self._notify_write(nid, bool(ops))
 
-    # -- change log (delta-overlay feed) --------------------------------------
+    # -- change log (delta-overlay + watch feed) ------------------------------
 
     CHANGE_LOG_CAP = 1 << 16
+    # retention hard cap: an active watch cursor (see set_trim_guard) can
+    # hold rows past CHANGE_LOG_CAP, but never past HARD_FACTOR times it —
+    # a stuck subscriber must not grow the durable log without bound (it
+    # gets a RESET once its history is finally trimmed)
+    CHANGE_LOG_HARD_FACTOR = 4
 
     def _existing_shard_ids(self, nid: str, sids: Sequence[str]) -> set[str]:
         out: set[str] = set()
@@ -792,6 +837,15 @@ class SQLPersister:
             ).fetchall()
             out.update(r[0] for r in rows)
         return out
+
+    def set_trim_guard(self, fn) -> None:
+        """Retention policy hook: `fn(nid)` returns the lowest store
+        version an active watch cursor may still resume from (or None
+        for no constraint). Rows with version > that value survive the
+        CHANGE_LOG_CAP trim — a resumable snaptoken held by an active
+        cursor is never trimmed out from under it — up to the
+        CHANGE_LOG_HARD_FACTOR bound."""
+        self._trim_guard = fn
 
     def _log_changes(self, nid: str, ops: Sequence[tuple[str, RelationTuple]]) -> None:
         """Called inside the write transaction, after _bump_version."""
@@ -808,32 +862,86 @@ class SQLPersister:
         # subquery is wrapped in a derived table because MySQL rejects a
         # DELETE whose subquery reads the target table directly (error
         # 1093); the wrapped form is valid on all four dialects.
+        guard = None
+        if self._trim_guard is not None:
+            try:
+                guard = self._trim_guard(nid)
+            except Exception:  # a broken policy hook must not fail writes
+                guard = None
+        if guard is None:
+            self._trim(nid, self.CHANGE_LOG_CAP)
+        else:
+            # retention-aware trim: below the soft cap only rows an
+            # active cursor can no longer need (version <= guard) go;
+            # the hard cap prunes unconditionally but is AMORTIZED —
+            # its boundary subquery walks OFFSET 4*cap index entries,
+            # too much for every write, and between passes the log can
+            # only overshoot the hard cap by the amortization interval
+            self._trim(nid, self.CHANGE_LOG_CAP, max_version=int(guard))
+            hard_every = max(1, self.CHANGE_LOG_CAP // 16)
+            if version % hard_every == 0:
+                self._trim(
+                    nid, self.CHANGE_LOG_CAP * self.CHANGE_LOG_HARD_FACTOR
+                )
+
+    def _trim(self, nid: str, cap: int, max_version: int | None = None) -> None:
+        # VERSION-ALIGNED prune (strictly below the boundary row's
+        # version): a commit's op group is never split, so the oldest
+        # surviving version is always complete — that invariant is what
+        # lets changelog_since prove completeness back to min_version - 1
+        # (a resumable cursor pinned by the trim guard stays resumable)
+        guard_clause = "" if max_version is None else " AND version <= ?"
+        params: list = [nid]
+        if max_version is not None:
+            params.append(max_version)
+        params.extend((nid, cap))
         self._conn.execute(
-            "DELETE FROM keto_change_log WHERE nid = ? AND seq <= ("
+            "DELETE FROM keto_change_log WHERE nid = ?" + guard_clause +
+            " AND version < ("
             "  SELECT cutoff FROM ("
-            "    SELECT seq AS cutoff FROM keto_change_log WHERE nid = ?"
+            "    SELECT version AS cutoff FROM keto_change_log WHERE nid = ?"
             "    ORDER BY seq DESC LIMIT 1 OFFSET ?) AS boundary)",
-            (nid, nid, self.CHANGE_LOG_CAP),
+            params,
         )
 
     def changes_since(self, version: int, nid: str = DEFAULT_NETWORK):
         """Ordered (op, tuple) ops after `version`, or None when the
         bounded log can't prove completeness back that far (see
         memory.MemoryManager.changes_since)."""
+        triples = self.changelog_since(version, nid=nid)
+        if triples is None:
+            return None
+        return [(op, t) for _v, op, t in triples]
+
+    def changelog_since(self, version: int, nid: str = DEFAULT_NETWORK):
+        """Versioned changelog slice: (version, op, tuple) triples after
+        `version` in commit order, or None when the bounded log can't
+        prove completeness back that far (the watch feed; see
+        memory.MemoryManager.changelog_since)."""
         with self._lock:
             if version >= self.version(nid):
                 return []
-            n_total, min_version = self._conn.execute(
-                "SELECT COUNT(*), MIN(version) FROM keto_change_log WHERE nid = ?",
+            (min_version,) = self._conn.execute(
+                "SELECT MIN(version) FROM keto_change_log WHERE nid = ?",
                 (nid,),
             ).fetchone()
-            complete = n_total < self.CHANGE_LOG_CAP or (
-                min_version is not None and version >= min_version
-            )
-            if not complete:
+            # completeness is proved from the oldest surviving version
+            # alone: the version-aligned trim (_trim) and the alignment
+            # migration never leave a split commit group, so the log
+            # provably covers everything after min_version - 1 (a
+            # never-trimmed log has min_version 1 and covers all
+            # history). A row-count heuristic would be unsound — the
+            # alignment migration can shrink a trimmed log below the
+            # cap, which must not make it look untrimmed.
+            if min_version is None:
+                # rows exist for this nid's version counter but the log
+                # is empty (wiped by the alignment migration): nothing
+                # is reconstructable below the head
+                return None
+            if version < min_version - 1:
                 return None
             rows = self._conn.execute(
-                "SELECT op, tuple FROM keto_change_log"
+                "SELECT version, op, tuple FROM keto_change_log"
                 # version first: cockroach's SERIAL seq (unique_rowid)
                 # is only monotone within a transaction, and replay must
                 # follow commit order; seq breaks ties inside one version
@@ -841,7 +949,8 @@ class SQLPersister:
                 (nid, version),
             ).fetchall()
         return [
-            (op, RelationTuple.from_dict(json.loads(raw))) for op, raw in rows
+            (v, op, RelationTuple.from_dict(json.loads(raw)))
+            for v, op, raw in rows
         ]
 
     # -- mapping manager protocol (durable) -----------------------------------

@@ -1,0 +1,20 @@
+"""Watch subsystem: the streaming changelog (Zanzibar's Watch API).
+
+The reference never shipped Watch (README.md:40-54 quotes the paper's
+§2.4.3 but Keto v0.9→v0.10 has no watch surface); this package promotes
+the store changelog — until now an internal detail feeding the engine's
+delta overlay — into a first-class streaming subsystem:
+
+  WatchHub       per-process pub/sub fan-out tailing the store changelog
+  Subscription   resumable cursor: bounded buffer + RESET-on-overflow
+  WatchEvent     one committed store version (all its changes + snaptoken)
+
+Served as gRPC server-streaming `keto_tpu.watch.v1.WatchService`, REST
+SSE `GET /relation-tuples/watch`, `ReadClient.watch()`, the aio plane,
+and CLI `keto-tpu watch` (api/, cli/); wired into TPUCheckEngine so the
+device mirror is push-invalidated instead of only lazily polling.
+"""
+
+from .hub import Subscription, WatchEvent, WatchHub
+
+__all__ = ["Subscription", "WatchEvent", "WatchHub"]

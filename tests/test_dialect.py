@@ -88,14 +88,53 @@ class TestRendering:
 
     def test_change_log_prune_avoids_mysql_1093(self):
         # MySQL rejects DELETE with a subquery on the target table; the
-        # prune statement must read through a derived table on every
-        # dialect (it is canonical SQL, prepped not rendered)
+        # prune statement (now in _trim, which _log_changes drives) must
+        # read through a derived table on every dialect (it is canonical
+        # SQL, prepped not rendered)
         import inspect
 
         from keto_tpu.storage import sqlite as sqlite_mod
 
-        src = inspect.getsource(sqlite_mod.SQLPersister._log_changes)
+        src = inspect.getsource(sqlite_mod.SQLPersister._trim)
         assert "AS boundary" in src
+
+    def _change_log_steps(self, dialect):
+        for version, ups, _downs in render_migrations(dialect):
+            if version == "20220513200303_create_change_log":
+                return ups
+        raise AssertionError("change-log migration missing")
+
+    def test_change_log_ddl_golden_shapes(self):
+        # the watch subsystem's durable feed: one template, four
+        # dialect renderings (the reference hand-writes each migration
+        # per engine; keto_change_log has no reference analog so these
+        # goldens pin OUR contract: autoincrementing seq PK, typed nid/
+        # op columns, the (nid, version) tail index)
+        sqlite_sql = "\n".join(self._change_log_steps(SQLiteDialect()))
+        assert "seq INTEGER PRIMARY KEY AUTOINCREMENT" in sqlite_sql
+        assert "nid TEXT NOT NULL" in sqlite_sql
+        assert "op TEXT NOT NULL" in sqlite_sql
+        assert (
+            "keto_change_log_nid_version_idx" in sqlite_sql
+            and "(nid, version)" in sqlite_sql
+        )
+
+        pg_sql = "\n".join(self._change_log_steps(PostgresDialect()))
+        assert "seq BIGSERIAL PRIMARY KEY" in pg_sql
+        assert "nid VARCHAR(64) NOT NULL" in pg_sql
+        assert "op VARCHAR(16) NOT NULL" in pg_sql
+
+        crdb_sql = "\n".join(self._change_log_steps(CockroachDialect()))
+        assert "seq SERIAL PRIMARY KEY" in crdb_sql
+        assert "BIGSERIAL" not in crdb_sql
+
+        mysql_sql = "\n".join(self._change_log_steps(MySQLDialect()))
+        assert "seq BIGINT NOT NULL AUTO_INCREMENT PRIMARY KEY" in mysql_sql
+        # MySQL can't CREATE INDEX IF NOT EXISTS; the index step must
+        # have the clause stripped like every other mysql index
+        for step in self._change_log_steps(MySQLDialect()):
+            if "CREATE INDEX" in step:
+                assert "IF NOT EXISTS" not in step
 
     def test_postgres_transient_classification(self):
         d = PostgresDialect()

@@ -247,11 +247,12 @@ class TestMigrations:
         assert all(s == "Pending" for _, s in p.migration_status())
         p.migrate_up()
         assert all(s == "Applied" for _, s in p.migration_status())
-        # peel 5: the legacy-table drop, the strings-to-uuids data
-        # migration, the uuid table, the change log, and the
-        # store-version table
-        p.migrate_down(5)
+        # peel 6: the change-log alignment, the legacy-table drop, the
+        # strings-to-uuids data migration, the uuid table, the change
+        # log, and the store-version table
+        p.migrate_down(6)
         status = dict(p.migration_status())
+        assert status["20220513200700_align_change_log_trim"] == "Pending"
         assert status["20220513200600_drop_legacy_relation_tuples"] == "Pending"
         assert status["20220513200400_migrate_strings_to_uuids"] == "Pending"
         assert status["20220513200302_create_store_version"] == "Pending"
@@ -481,3 +482,174 @@ class TestSQLiteColumnarSurface:
         assert [x.allowed for x in r] == [True, False]
         # the columnar builder ran: big vocabs are ArrayMaps
         assert isinstance(eng._state.snapshot.obj_slots, ArrayMap)
+
+
+class TestChangelogParity:
+    """Changelog semantics across every backend (the watch subsystem's
+    feed): versioned triples in commit order, agreement with
+    changes_since, nid isolation, and post-commit write listeners.
+    Version GRANULARITY may differ (memory/sqlite commit a batch as one
+    version, columnar bumps per tuple) — the parity contract is ordering
+    and completeness, not batch shape."""
+
+    def test_changelog_matches_changes_since(self, store):
+        store.write_relation_tuples(ts("a:1#r@u1", "a:2#r@u2"))
+        store.delete_relation_tuples(ts("a:1#r@u1"))
+        triples = store.changelog_since(0)
+        assert triples is not None and triples
+        # commit order, versions nondecreasing, ending at the store version
+        versions = [v for v, _op, _t in triples]
+        assert versions == sorted(versions)
+        assert versions[-1] == store.version()
+        # changes_since is exactly the version-stripped view
+        assert store.changes_since(0) == [(op, t) for _v, op, t in triples]
+        # ops replay to the store's current state
+        alive: set[str] = set()
+        for _v, op, t in triples:
+            (alive.add if op == "insert" else alive.discard)(str(t))
+        assert alive == {str(t) for t in store.all_relation_tuples()}
+
+    def test_changelog_since_midpoint_is_suffix(self, store):
+        store.write_relation_tuples(ts("a:1#r@u1"))
+        mid = store.version()
+        store.write_relation_tuples(ts("a:2#r@u2"))
+        store.delete_relation_tuples(ts("a:1#r@u1"))
+        full = store.changelog_since(0)
+        tail = store.changelog_since(mid)
+        assert tail == [t for t in full if t[0] > mid]
+        # at-head and ahead-of-head both yield the empty suffix
+        assert store.changelog_since(store.version()) == []
+
+    def test_changelog_nid_isolation(self, store):
+        store.write_relation_tuples(ts("a:1#r@u1"), nid="net-a")
+        store.write_relation_tuples(ts("a:2#r@u2"), nid="net-b")
+        a = store.changelog_since(0, nid="net-a")
+        b = store.changelog_since(0, nid="net-b")
+        assert [str(t) for _v, _op, t in a] == ["a:1#r@u1"]
+        assert [str(t) for _v, _op, t in b] == ["a:2#r@u2"]
+        assert store.changelog_since(0, nid="net-c") == []
+
+    def test_write_listener_fires_on_commit_only(self, store):
+        calls = []
+        store.add_write_listener(calls.append)
+        store.write_relation_tuples(ts("a:1#r@u1"), nid="net-x")
+        assert calls == ["net-x"]
+        # idempotent re-insert commits nothing -> no notification
+        store.write_relation_tuples(ts("a:1#r@u1"), nid="net-x")
+        assert calls == ["net-x"]
+        store.delete_relation_tuples(ts("a:1#r@u1"), nid="net-x")
+        assert calls == ["net-x", "net-x"]
+        store.delete_relation_tuples(ts("a:1#r@u1"), nid="net-x")
+        assert calls == ["net-x", "net-x"]
+
+
+class TestChangelogTrimCutoff:
+    """The durable store's bounded-log trim (storage/sqlite.py): the
+    version-aligned cutoff never splits a commit's op group, so
+    changelog_since can prove completeness back to the oldest surviving
+    version minus one — and reports None (not a silent gap) beyond it."""
+
+    def _persister(self, cap):
+        from keto_tpu.storage.sqlite import SQLitePersister
+
+        p = SQLitePersister("memory")
+        p.CHANGE_LOG_CAP = cap
+        return p
+
+    def test_trim_reports_none_beyond_cutoff(self):
+        p = self._persister(8)
+        for i in range(20):
+            p.write_relation_tuples(ts(f"a:{i}#r@u"))
+        # old cursors are truncated: explicit None, never a partial slice
+        assert p.changelog_since(0) is None
+        assert p.changes_since(0) is None
+        # recent cursors still replay completely
+        triples = p.changelog_since(15)
+        assert [str(t) for _v, _op, t in triples] == [
+            f"a:{i}#r@u" for i in range(15, 20)
+        ]
+
+    def test_trim_never_splits_a_version_group(self):
+        p = self._persister(4)
+        # one 6-op commit followed by single-op commits: the batch's
+        # group straddles any naive seq cutoff
+        p.write_relation_tuples(ts(*[f"a:batch{i}#r@u" for i in range(6)]))
+        for i in range(6):
+            p.write_relation_tuples(ts(f"a:single{i}#r@u"))
+        rows = p._conn.execute(
+            "SELECT version, COUNT(*) FROM keto_change_log"
+            " GROUP BY version ORDER BY version"
+        ).fetchall()
+        # whatever survived, version groups are intact: the oldest
+        # surviving version's count matches what was committed at it
+        oldest_version, oldest_count = rows[0]
+        expected = 6 if oldest_version == 1 else 1
+        assert oldest_count == expected
+        # and completeness holds exactly back to min_version - 1
+        assert p.changelog_since(oldest_version - 1) is not None
+        if oldest_version > 1:
+            assert p.changelog_since(oldest_version - 2) is None
+
+    def test_memory_log_cap_is_explicit_none(self, monkeypatch):
+        from keto_tpu.storage import memory as memmod
+
+        monkeypatch.setattr(memmod, "CHANGE_LOG_CAP", 8)
+        m = memmod.MemoryManager()
+        for i in range(20):
+            m.write_relation_tuples(ts(f"a:{i}#r@u"))
+        assert m.changelog_since(0) is None
+        assert len(m.changelog_since(15)) == 5
+
+    def test_columnar_bulk_load_resets_log_floor(self):
+        from keto_tpu.storage.columnar import ColumnarStore
+        from keto_tpu.storage.columns import TupleColumns
+
+        s = ColumnarStore()
+        s.write_relation_tuples(ts("a:1#r@u1"))
+        s.bulk_load(TupleColumns.from_tuples(ts("a:2#r@u2", "a:3#r@u3")))
+        # bulk loads are not representable as deltas: explicit None
+        assert s.changelog_since(0) is None
+        assert s.changelog_since(s.version()) == []
+
+    def test_align_migration_restores_group_invariant(self):
+        from keto_tpu.storage.sqlite import _align_change_log
+
+        p = self._persister(4)
+        # one 3-op commit (version 1), then singles (versions 2..5)
+        p.write_relation_tuples(ts(*[f"a:b{i}#r@u" for i in range(3)]))
+        for i in range(4):
+            p.write_relation_tuples(ts(f"a:s{i}#r@u"))
+        # simulate the OLD seq-based trim cutting through v1's group
+        p._conn.execute(
+            "DELETE FROM keto_change_log WHERE seq ="
+            " (SELECT MIN(seq) FROM keto_change_log)"
+        )
+        _align_change_log(p)  # count (6) >= cap (4): drops the v1 group
+        (min_version,) = p._conn.execute(
+            "SELECT MIN(version) FROM keto_change_log"
+        ).fetchone()
+        assert min_version == 2
+        # completeness back to min_version - 1 is now genuinely complete
+        triples = p.changelog_since(1)
+        assert [str(t) for _v, _op, t in triples] == [
+            f"a:s{i}#r@u" for i in range(4)
+        ]
+
+    def test_wiped_log_below_head_is_explicit_none(self):
+        # the alignment migration can shrink (even empty) a trimmed log;
+        # a shrunken log must NOT look untrimmed — completeness is
+        # proved from the oldest surviving version, never a row count
+        p = self._persister(8)
+        for i in range(3):
+            p.write_relation_tuples(ts(f"a:{i}#r@u"))
+        p._conn.execute("DELETE FROM keto_change_log")
+        assert p.changelog_since(0) is None
+        assert p.changelog_since(p.version()) == []
+
+    def test_align_migration_leaves_unfilled_logs_alone(self):
+        from keto_tpu.storage.sqlite import _align_change_log
+
+        p = self._persister(1024)
+        p.write_relation_tuples(ts("a:1#r@u"))
+        _align_change_log(p)  # below the cap: never trimmed, keep all
+        assert len(p.changelog_since(0)) == 1

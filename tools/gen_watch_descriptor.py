@@ -1,0 +1,91 @@
+"""Append the keto_tpu_watch.proto descriptor to keto_descriptors.binpb.
+
+The build image ships no protoc, so the watch extension's
+FileDescriptorProto is constructed programmatically here (field-for-field
+mirror of keto_tpu/api/protos/keto_tpu_watch.proto) and appended to the
+checked-in descriptor set — idempotently: an existing entry with the same
+file name is replaced, so the tool can re-run after edits (the
+gen_reverse_descriptor.py pattern). Run from the repo root:
+
+    python tools/gen_watch_descriptor.py
+
+api/descriptors.py then materializes the message classes from the same
+descriptor pool as every other message — no generated *_pb2.py code.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+from google.protobuf import descriptor_pb2
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+_BINPB = _REPO / "keto_tpu" / "api" / "protos" / "keto_descriptors.binpb"
+
+_STR = descriptor_pb2.FieldDescriptorProto.TYPE_STRING
+_MSG = descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE
+_OPT = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+_REP = descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED
+
+_TUPLE = ".ory.keto.relation_tuples.v1alpha2.RelationTuple"
+
+
+def _message(fd, name: str, fields):
+    m = fd.message_type.add()
+    m.name = name
+    for number, (fname, ftype, label, type_name) in enumerate(fields, 1):
+        f = m.field.add()
+        f.name = fname
+        f.number = number
+        f.type = ftype
+        f.label = label
+        if type_name:
+            f.type_name = type_name
+    return m
+
+
+def build_file() -> descriptor_pb2.FileDescriptorProto:
+    fd = descriptor_pb2.FileDescriptorProto()
+    fd.name = "keto_tpu_watch.proto"
+    fd.package = "keto_tpu.watch.v1"
+    fd.syntax = "proto3"
+    fd.dependency.append("keto.proto")
+    _message(fd, "WatchRequest", [
+        ("snaptoken", _STR, _OPT, None),
+        ("namespace", _STR, _OPT, None),
+    ])
+    _message(fd, "WatchChange", [
+        ("action", _STR, _OPT, None),
+        ("relation_tuple", _MSG, _OPT, _TUPLE),
+    ])
+    _message(fd, "WatchResponse", [
+        ("event_type", _STR, _OPT, None),
+        ("snaptoken", _STR, _OPT, None),
+        ("changes", _MSG, _REP, ".keto_tpu.watch.v1.WatchChange"),
+    ])
+    svc = fd.service.add()
+    svc.name = "WatchService"
+    m = svc.method.add()
+    m.name = "Watch"
+    m.input_type = ".keto_tpu.watch.v1.WatchRequest"
+    m.output_type = ".keto_tpu.watch.v1.WatchResponse"
+    m.server_streaming = True
+    return fd
+
+
+def main() -> int:
+    fds = descriptor_pb2.FileDescriptorSet()
+    fds.ParseFromString(_BINPB.read_bytes())
+    new = build_file()
+    kept = [f for f in fds.file if f.name != new.name]
+    del fds.file[:]
+    fds.file.extend(kept)
+    fds.file.append(new)
+    _BINPB.write_bytes(fds.SerializeToString())
+    print(f"wrote {new.name} into {_BINPB} ({len(fds.file)} files)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
