@@ -721,6 +721,27 @@ def bench_grpc_echo_ceiling(seconds: float = 3.0, n_threads: int = 32) -> dict:
         server.stop(0)
 
 
+def _stage_summary(metrics) -> dict:
+    """Mean per-stage serving ms from the check_stage_duration histogram
+    (observability.CHECK_STAGES): the BENCH json's stage-attributable
+    record — future trajectory entries can say WHERE p95 moved (queue
+    wait vs padding vs dispatch vs device wait vs host replay), not just
+    that it moved."""
+    sums: dict = {}
+    counts: dict = {}
+    for fam in metrics.check_stage_duration.collect():
+        for s in fam.samples:
+            if s.name.endswith("_sum"):
+                sums[s.labels["stage"]] = s.value
+            elif s.name.endswith("_count"):
+                counts[s.labels["stage"]] = s.value
+    return {
+        stage: round(1e3 * sums.get(stage, 0.0) / n, 3)
+        for stage, n in counts.items()
+        if n
+    }
+
+
 def bench_served(namespaces, tuples, queries) -> dict:
     """Served path per BASELINE.md: a real daemon (direct gRPC listener +
     batcher + device engine) under concurrent gRPC clients; per-REQUEST
@@ -911,6 +932,8 @@ def bench_served(namespaces, tuples, queries) -> dict:
         batch_phase = batch_load_phase(
             SERVE_BATCH_CLIENTS, SERVE_BATCH_SIZE, SERVE_SECONDS
         )
+        # per-stage serving breakdown accumulated across all phases
+        stage_ms = _stage_summary(daemon.registry.metrics())
     finally:
         daemon.stop()
 
@@ -934,6 +957,8 @@ def bench_served(namespaces, tuples, queries) -> dict:
         aio = {"error": f"{type(e).__name__}: {e}"}
 
     out = {"host_cores": len(_os.sched_getaffinity(0))}
+    if stage_ms:
+        out["served_stage_ms"] = stage_ms
     # each phase reports independently: a wedge between phases must not
     # discard the completed phase's measurement
     if "error" in low:

@@ -29,15 +29,24 @@ planes share one behavior surface.
 from __future__ import annotations
 
 import asyncio
+import contextvars
+import functools
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 
 import grpc
 import grpc.aio
 
+from .batcher import note_queue_wait, submit_takes_telemetry
 from .descriptors import CHECK_SERVICE, pb
 from .grpc_server import _grpc_code, _Services
 from ..errors import KetoError
+from ..observability import (
+    current_request_trace,
+    reset_request_trace,
+    set_request_trace,
+)
 
 
 class AioCheckBatcher:
@@ -52,6 +61,8 @@ class AioCheckBatcher:
         max_batch: int = 1024,
         window_s: float = 0.002,
         pipeline_depth: int = 4,
+        metrics=None,
+        tracer=None,
     ):
         self._resolve_engine = engine_resolver
         self.max_batch = max_batch
@@ -67,6 +78,16 @@ class AioCheckBatcher:
         self._inflight = asyncio.Semaphore(max(2 * pipeline_depth, 4))
         self._collector: asyncio.Task | None = None
         self._closed = False
+        # observability: queue-wait attribution + gauges, mirroring the
+        # threaded batcher (api/batcher.py); own plane label — both
+        # batchers can serve at once
+        self.metrics = metrics
+        self.tracer = tracer
+        self._depth_gauge = (
+            metrics.batcher_queue_depth.labels("aio")
+            if metrics is not None else None
+        )
+        self._submit_takes_telemetry: dict[type, bool] = {}
 
     def start(self) -> None:
         self._collector = asyncio.get_running_loop().create_task(self._run())
@@ -78,11 +99,15 @@ class AioCheckBatcher:
             await self._collector
         self._executor.shutdown(wait=True)
 
-    async def check(self, tuple, max_depth: int = 0, nid=None):
+    async def check(self, tuple, max_depth: int = 0, nid=None, rt=None):
         if self._closed:
             raise RuntimeError("AioCheckBatcher is closed")
         fut = asyncio.get_running_loop().create_future()
-        self._queue.put_nowait((tuple, max_depth, nid, fut))
+        self._queue.put_nowait(
+            (tuple, max_depth, nid, fut, rt, time.perf_counter())
+        )
+        if self._depth_gauge is not None:
+            self._depth_gauge.set(self._queue.qsize())
         return await fut
 
     async def _drain(self, first) -> list:
@@ -107,6 +132,19 @@ class AioCheckBatcher:
             batch.append(item)
         return batch
 
+    def _submit_fn(self, engine, submit, group, depth):
+        """Bind the submit call, passing per-request telemetry when the
+        engine's signature takes it (stubbed engines keep working;
+        detection shared with the threaded batcher)."""
+        tuples = [p[0] for p in group]
+        if submit_takes_telemetry(
+            self._submit_takes_telemetry, engine, submit
+        ):
+            return functools.partial(
+                submit, tuples, depth, telemetry=[p[4] for p in group]
+            )
+        return functools.partial(submit, tuples, depth)
+
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
@@ -118,7 +156,13 @@ class AioCheckBatcher:
             for p in batch:
                 by_key.setdefault((p[1], p[2]), []).append(p)
             for (depth, nid), group in by_key.items():
+                note_queue_wait(
+                    ((p[4], p[5]) for p in group), self._queue.qsize(),
+                    self.metrics, self.tracer, self._depth_gauge,
+                )
                 await self._inflight.acquire()
+                if self.metrics is not None:
+                    self.metrics.inflight_launches.inc()
                 try:
                     engine = self._resolve_engine(nid)
                     submit = getattr(engine, "check_batch_submit", None)
@@ -131,10 +175,11 @@ class AioCheckBatcher:
                         )
                         continue
                     handle = await loop.run_in_executor(
-                        self._executor, submit, [p[0] for p in group], depth
+                        self._executor,
+                        self._submit_fn(engine, submit, group, depth),
                     )
                 except Exception as e:
-                    self._inflight.release()
+                    self._release_inflight()
                     for p in group:
                         if not p[3].done():
                             p[3].set_exception(e)
@@ -142,6 +187,11 @@ class AioCheckBatcher:
                 # resolve concurrently: the collector goes back to
                 # draining while the device round-trip completes
                 loop.create_task(self._finish(engine, handle, group))
+
+    def _release_inflight(self) -> None:
+        self._inflight.release()
+        if self.metrics is not None:
+            self.metrics.inflight_launches.dec()
 
     async def _evaluate(self, engine, group, depth) -> None:
         loop = asyncio.get_running_loop()
@@ -158,7 +208,7 @@ class AioCheckBatcher:
                     p[3].set_exception(e)
             return
         finally:
-            self._inflight.release()
+            self._release_inflight()
         for p, res in zip(group, results):
             if not p[3].done():
                 p[3].set_result(res)
@@ -175,7 +225,7 @@ class AioCheckBatcher:
                     p[3].set_exception(e)
             return
         finally:
-            self._inflight.release()
+            self._release_inflight()
         for p, res in zip(group, results):
             if not p[3].done():
                 p[3].set_result(res)
@@ -205,19 +255,35 @@ class _AioReadServices:
         )
 
     async def _observed(self, method, coro_fn, req, context):
-        with self._svc.metrics.observe_request("grpc", method) as outcome:
-            try:
-                with self._svc.registry.tracer().span(f"grpc.{method}"):
-                    return await coro_fn(req, context)
-            except KetoError as e:
-                outcome["code"] = _grpc_code(e).name
-                await context.abort(_grpc_code(e), e.message)
-            except grpc.aio.AbortError:
-                raise  # context.abort signalling, already coded
-            except Exception as e:  # noqa: BLE001 — RPC boundary; same
-                # generic->INTERNAL mapping as the threaded plane
-                outcome["code"] = "INTERNAL"
-                await context.abort(grpc.StatusCode.INTERNAL, str(e))
+        # same trace ingestion as the threaded plane: traceparent from
+        # the invocation metadata, stage/log bookkeeping on the way out
+        rt = self._svc._begin_trace(context)
+        token = set_request_trace(rt)
+        t0 = time.perf_counter()
+        outcome = None
+        try:
+            with self._svc.metrics.observe_request("grpc", method) as outcome:
+                try:
+                    with self._svc.registry.tracer().span(
+                        f"grpc.{method}", ctx=rt.ctx
+                    ):
+                        return await coro_fn(req, context)
+                except KetoError as e:
+                    outcome["code"] = _grpc_code(e).name
+                    await context.abort(_grpc_code(e), e.message)
+                except grpc.aio.AbortError:
+                    raise  # context.abort signalling, already coded
+                except Exception as e:  # noqa: BLE001 — RPC boundary; same
+                    # generic->INTERNAL mapping as the threaded plane
+                    outcome["code"] = "INTERNAL"
+                    await context.abort(grpc.StatusCode.INTERNAL, str(e))
+        finally:
+            reset_request_trace(token)
+            self._svc._finish_trace(
+                method, rt,
+                outcome.code if outcome is not None else "INTERNAL",
+                time.perf_counter() - t0,
+            )
 
     async def check(self, req, context):
         async def body(req, context):
@@ -230,7 +296,9 @@ class _AioReadServices:
             # reads — fine in-loop (no device or SQL round-trip on the
             # memory manager; sqlite's counter SELECT is ~10 us)
             version = self._svc._enforce_snaptoken(req.snaptoken, nid)
-            res = await self._batcher.check(t, int(req.max_depth), nid=nid)
+            res = await self._batcher.check(
+                t, int(req.max_depth), nid=nid, rt=current_request_trace()
+            )
             if res.error is not None:
                 raise res.error
             return pb.CheckResponse(
@@ -242,8 +310,11 @@ class _AioReadServices:
     def _delegated(self, name, sync_fn):
         async def body(req, context):
             loop = asyncio.get_running_loop()
+            # carry the request's contextvars (CURRENT_TRACE) onto the
+            # executor thread so traced store ops correlate
+            cvctx = contextvars.copy_context()
             return await loop.run_in_executor(
-                self._blocking, sync_fn, req, context
+                self._blocking, lambda: cvctx.run(sync_fn, req, context)
             )
 
         async def handler(req, context):
@@ -472,6 +543,8 @@ class AioReadServer:
             self.registry.check_engine,
             pipeline_depth=self._pipeline_depth,
             window_s=self._window_s,
+            metrics=self.registry.metrics(),
+            tracer=self.registry.tracer(),
         )
         self.batcher.start()
         self._services = _AioReadServices(services, self.batcher)

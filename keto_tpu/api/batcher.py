@@ -23,11 +23,54 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 
 
+def note_queue_wait(riders, queue_size: int, metrics, tracer, depth_gauge) -> None:
+    """Shared queue-wait attribution for BOTH batching planes (threaded
+    CheckBatcher here, AioCheckBatcher in aio_server.py): each rider's
+    wait lands on its RequestTrace (slow-query breakdown) and as a
+    batcher.queue span when tracing; the stage histogram gets one
+    group-mean sample. `riders` iterates (RequestTrace|None, enqueue_t)
+    pairs; `depth_gauge` is the plane's batcher_queue_depth label child
+    (per-plane so the two batchers never overwrite each other)."""
+    now = time.perf_counter()
+    spans = tracer is not None and getattr(tracer, "active", False)
+    total = 0.0
+    n = 0
+    for rt, enq_t in riders:
+        w = now - enq_t
+        total += w
+        n += 1
+        if rt is not None:
+            rt.add_stage("queue", w)
+            if spans:
+                tracer.record("batcher.queue", ctx=rt.ctx, duration_s=w)
+    if metrics is not None and n:
+        metrics.observe_stage("queue", total / n)
+        depth_gauge.set(queue_size)
+
+
+def submit_takes_telemetry(cache: dict, engine, submit) -> bool:
+    """check_batch_submit grew a `telemetry` kwarg; engines stubbed with
+    the bare two-arg signature (tests, embedders) keep working. The
+    signature inspection is cached per engine type in `cache`."""
+    takes = cache.get(type(engine))
+    if takes is None:
+        import inspect
+
+        try:
+            takes = "telemetry" in inspect.signature(submit).parameters
+        except (TypeError, ValueError):
+            takes = False
+        cache[type(engine)] = takes
+    return takes
+
+
 @dataclass
 class _Pending:
     tuple: object
     max_depth: int
     nid: object = None  # None = the registry's default network
+    rt: object = None  # observability.RequestTrace | None
+    enq_t: float = 0.0
     future: Future = field(default_factory=Future)
 
 
@@ -39,6 +82,8 @@ class CheckBatcher:
         window_s: float = 0.002,
         pipeline_depth: int = 2,
         engine_resolver=None,
+        metrics=None,
+        tracer=None,
     ):
         # per-request tenancy: batches are grouped by nid and dispatched
         # to that tenant's engine (ref: ketoctx Contextualizer,
@@ -73,17 +118,34 @@ class CheckBatcher:
         # tunnel and holds a full engine state per handle)
         self.max_inflight = max(2 * pipeline_depth, 4)
         self._inflight = threading.BoundedSemaphore(self.max_inflight)
+        # observability (both optional): queue-depth/inflight gauges,
+        # per-request queue-wait stage attribution, batcher.queue spans
+        self.metrics = metrics
+        self.tracer = tracer
+        self._depth_gauge = (
+            metrics.batcher_queue_depth.labels("threaded")
+            if metrics is not None else None
+        )
+        # engine type -> whether check_batch_submit accepts `telemetry`
+        # (feature-detected once; tests stub engines with the bare
+        # two-arg signature)
+        self._submit_takes_telemetry: dict[type, bool] = {}
         self._closed = False
         self._thread.start()
 
     # -- caller side ----------------------------------------------------------
 
-    def check(self, tuple, max_depth: int = 0, nid=None):
-        """Blocking single check; returns a CheckResult."""
+    def check(self, tuple, max_depth: int = 0, nid=None, rt=None):
+        """Blocking single check; returns a CheckResult. `rt` is the
+        caller's RequestTrace: the batcher adds the queue-wait stage and
+        the engine adds its stages, so the transport that created it can
+        log/span the full pipeline breakdown."""
         if self._closed:
             raise RuntimeError("CheckBatcher is closed")
-        p = _Pending(tuple, max_depth, nid)
+        p = _Pending(tuple, max_depth, nid, rt, time.perf_counter())
         self._queue.put(p)
+        if self._depth_gauge is not None:
+            self._depth_gauge.set(self._queue.qsize())
         return p.future.result()
 
     def close(self) -> None:
@@ -143,9 +205,19 @@ class CheckBatcher:
                 p.future.set_exception(e)
             return
         finally:
-            self._inflight.release()
+            self._release_inflight()
         for p, res in zip(group, results):
             p.future.set_result(res)
+
+    def _acquire_inflight(self) -> None:
+        self._inflight.acquire()
+        if self.metrics is not None:
+            self.metrics.inflight_launches.inc()
+
+    def _release_inflight(self) -> None:
+        self._inflight.release()
+        if self.metrics is not None:
+            self.metrics.inflight_launches.dec()
 
     def _launch(self, group: list[_Pending], depth: int, nid=None) -> None:
         """Split-phase dispatch (runs on the launch thread): LAUNCH the
@@ -155,6 +227,10 @@ class CheckBatcher:
         tunnel costs ~70 ms per synchronized round-trip; pipelining
         hides it). The in-flight semaphore bounds launched-but-
         unresolved batches."""
+        note_queue_wait(
+            ((p.rt, p.enq_t) for p in group), self._queue.qsize(),
+            self.metrics, self.tracer, self._depth_gauge,
+        )
         try:
             engine = self._resolve(nid)
         except Exception as e:
@@ -165,11 +241,19 @@ class CheckBatcher:
         if submit is None:
             self._pool.submit(self._evaluate, group, depth, nid)
             return
-        self._inflight.acquire()
+        self._acquire_inflight()
         try:
-            handle = submit([p.tuple for p in group], depth)
+            if submit_takes_telemetry(
+                self._submit_takes_telemetry, engine, submit
+            ):
+                handle = submit(
+                    [p.tuple for p in group], depth,
+                    telemetry=[p.rt for p in group],
+                )
+            else:
+                handle = submit([p.tuple for p in group], depth)
         except Exception as e:
-            self._inflight.release()
+            self._release_inflight()
             for p in group:
                 p.future.set_exception(e)
             return

@@ -65,7 +65,10 @@ class _BaseClient:
         self.channel = channel
         self._callables: dict = {}
 
-    def _rpc(self, service: str, method: str, req, resp_cls, timeout=None):
+    def _rpc(
+        self, service: str, method: str, req, resp_cls, timeout=None,
+        metadata=None,
+    ):
         # multicallables are cached per method: creating one allocates a
         # channel-level call handle (~0.1 ms) and was paid per REQUEST on
         # the serve bench's client side
@@ -77,7 +80,14 @@ class _BaseClient:
                 request_serializer=lambda m: m.SerializeToString(),
                 response_deserializer=resp_cls.FromString,
             )
-        return callable_(req, timeout=timeout)
+        return callable_(req, timeout=timeout, metadata=metadata)
+
+    @staticmethod
+    def _trace_metadata(traceparent: str):
+        """gRPC metadata carrying a W3C trace context; the server joins
+        the caller's trace so one trace_id follows the request across
+        process boundaries (the metadata twin of the REST header)."""
+        return ((("traceparent", traceparent),) if traceparent else None)
 
     def get_version(self, timeout=None) -> str:
         resp = self._rpc(
@@ -113,23 +123,28 @@ class ReadClient(_BaseClient):
 
     def check(
         self, t: RelationTuple, max_depth: int = 0, timeout=None,
-        snaptoken: str = "",
+        snaptoken: str = "", traceparent: str = "",
     ) -> bool:
         return self.check_with_token(
-            t, max_depth, timeout=timeout, snaptoken=snaptoken
+            t, max_depth, timeout=timeout, snaptoken=snaptoken,
+            traceparent=traceparent,
         )[0]
 
     def check_with_token(
         self, t: RelationTuple, max_depth: int = 0, timeout=None,
-        snaptoken: str = "",
+        snaptoken: str = "", traceparent: str = "",
     ) -> tuple[bool, str]:
         """(allowed, response snaptoken): the token pins this read to at
         least the snapshot it encodes (read-your-writes against a token
         from WriteClient.transact); the returned token chains further
-        bounded-staleness reads."""
+        bounded-staleness reads. `traceparent` (W3C) joins this RPC to
+        the caller's distributed trace."""
         req = pb.CheckRequest(max_depth=max_depth, snaptoken=snaptoken)
         req.tuple.CopyFrom(tuple_to_proto(t))
-        resp = self._rpc(CHECK_SERVICE, "Check", req, pb.CheckResponse, timeout)
+        resp = self._rpc(
+            CHECK_SERVICE, "Check", req, pb.CheckResponse, timeout,
+            metadata=self._trace_metadata(traceparent),
+        )
         return resp.allowed, resp.snaptoken
 
     def check_batch(
@@ -138,6 +153,7 @@ class ReadClient(_BaseClient):
         max_depth: int = 0,
         timeout=None,
         snaptoken: str = "",
+        traceparent: str = "",
     ) -> list[tuple[bool, str]]:
         """keto_tpu batch extension (BatchCheckService): one RPC per
         batch. Returns [(allowed, error_message)] in request order,
@@ -150,6 +166,7 @@ class ReadClient(_BaseClient):
         resp = self._rpc(
             BATCH_CHECK_SERVICE, "BatchCheck", req,
             pb.BatchCheckResponse, timeout,
+            metadata=self._trace_metadata(traceparent),
         )
         return [(r.allowed, r.error) for r in resp.results]
 

@@ -217,6 +217,8 @@ class Daemon:
             engine_resolver=registry.check_engine,
             pipeline_depth=int(cfg.get("check.pipeline_depth", 2)),
             window_s=float(cfg.get("check.batch_window_ms", 2.0)) / 1e3,
+            metrics=registry.metrics(),
+            tracer=registry.tracer(),
         )
         self._grpc_read = None
         self._grpc_write = None

@@ -27,11 +27,20 @@ Check rides the CheckBatcher so concurrent RPCs share device batches.
 
 from __future__ import annotations
 
+import time as _time
 from concurrent import futures as _futures
 
 import grpc
 
 from ..errors import KetoError
+from ..observability import (
+    RequestTrace,
+    current_request_trace,
+    finish_request_telemetry,
+    parse_traceparent,
+    reset_request_trace,
+    set_request_trace,
+)
 from ..ketoapi import RelationQuery, RelationTuple, SubjectSet
 from .descriptors import (
     BATCH_CHECK_SERVICE,
@@ -78,6 +87,18 @@ def _grpc_code(err: Exception) -> grpc.StatusCode:
     return grpc.StatusCode.INTERNAL
 
 
+def _metadata_dict(context) -> dict:
+    """Invocation metadata as a plain dict; tolerant of both the sync
+    plane's Metadatum objects and the aio plane's (key, value) tuples."""
+    out = {}
+    for m in context.invocation_metadata() or ():
+        if isinstance(m, tuple):
+            out[m[0]] = m[1]
+        else:
+            out[m.key] = m.value
+    return out
+
+
 class _Services:
     """The shared handler implementations behind both gRPC servers."""
 
@@ -98,18 +119,50 @@ class _Services:
 
     # -- helpers --------------------------------------------------------------
 
+    def _begin_trace(self, context):
+        """RequestTrace for one RPC: joins the caller's trace when the
+        invocation metadata carries a W3C `traceparent` entry (the gRPC
+        twin of the REST header), else starts a fresh one."""
+        ctx = parse_traceparent(_metadata_dict(context).get("traceparent"))
+        return RequestTrace(ctx.child() if ctx is not None else None)
+
+    def _finish_trace(self, method, rt, code, duration) -> None:
+        """Stage bookkeeping + request/slow-query logs after one RPC
+        (the with-block has already recorded the flat histogram);
+        shared-helper semantics in observability.finish_request_telemetry."""
+        finish_request_telemetry(
+            self.metrics,
+            self.registry.config.get("log.slow_query_ms"),
+            "grpc", method, rt, code, duration,
+        )
+
     def _observed(self, method, context, fn, request):
-        with self.metrics.observe_request("grpc", method) as outcome:
-            try:
-                # span-per-RPC (ref: otelgrpc interceptors, daemon.go:360-380)
-                with self.registry.tracer().span(f"grpc.{method}"):
-                    return fn(request, context)
-            except KetoError as e:
-                outcome["code"] = _grpc_code(e).name
-                context.abort(_grpc_code(e), e.message)
-            except Exception as e:  # noqa: BLE001 — RPC boundary
-                outcome["code"] = "INTERNAL"
-                context.abort(grpc.StatusCode.INTERNAL, str(e))
+        rt = self._begin_trace(context)
+        token = set_request_trace(rt)
+        t0 = _time.perf_counter()
+        outcome = None
+        try:
+            with self.metrics.observe_request("grpc", method) as outcome:
+                try:
+                    # span-per-RPC (ref: otelgrpc interceptors,
+                    # daemon.go:360-380)
+                    with self.registry.tracer().span(
+                        f"grpc.{method}", ctx=rt.ctx
+                    ):
+                        return fn(request, context)
+                except KetoError as e:
+                    outcome["code"] = _grpc_code(e).name
+                    context.abort(_grpc_code(e), e.message)
+                except Exception as e:  # noqa: BLE001 — RPC boundary
+                    outcome["code"] = "INTERNAL"
+                    context.abort(grpc.StatusCode.INTERNAL, str(e))
+        finally:
+            reset_request_trace(token)
+            self._finish_trace(
+                method, rt,
+                outcome.code if outcome is not None else "INTERNAL",
+                _time.perf_counter() - t0,
+            )
 
     def _nid(self, context) -> str:
         """Per-request network id from gRPC invocation metadata (ref:
@@ -118,8 +171,7 @@ class _Services:
         hot path)."""
         if self.registry.contextualizer is None:
             return self.registry.nid
-        md = {m.key: m.value for m in context.invocation_metadata()}
-        return self.registry.nid_for(md)
+        return self.registry.nid_for(_metadata_dict(context))
 
     def _check_tuple(self, req) -> RelationTuple:
         src = req.tuple if req.HasField("tuple") else req
@@ -156,7 +208,9 @@ class _Services:
         nid = self._nid(context)
         version = self._enforce_snaptoken(req.snaptoken, nid)
         if self.batcher is not None:
-            res = self.batcher.check(t, int(req.max_depth), nid=nid)
+            res = self.batcher.check(
+                t, int(req.max_depth), nid=nid, rt=current_request_trace()
+            )
         else:
             res = self.registry.check_engine(nid).check_relation_tuple(
                 t, int(req.max_depth)

@@ -23,10 +23,18 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..errors import KetoError, MalformedInputError, NamespaceNotFoundError
+from ..observability import (
+    RequestTrace,
+    finish_request_telemetry,
+    parse_traceparent,
+    reset_request_trace,
+    set_request_trace,
+)
 from ..ketoapi import (
     GetResponse,
     PatchDelta,
@@ -57,6 +65,11 @@ ALIVE_PATH = "/health/alive"
 READY_PATH = "/health/ready"
 VERSION_PATH = "/version"
 METRICS_PATH = "/metrics/prometheus"
+# on-demand capture admin (metrics listener only — the operator plane):
+# POST starts a cpu/mem/jax capture against the RUNNING serve, POST
+# .../stop writes the artifact; see keto_tpu/profiling.py
+PROFILING_ROUTE = "/admin/profiling"
+PROFILING_STOP_ROUTE = "/admin/profiling/stop"
 SPEC_ROUTE = "/.well-known/openapi.json"
 
 # route -> router kind, the ONE ownership table (consumed by the spec
@@ -77,6 +90,8 @@ ROUTE_KINDS = {
     VERSION_PATH: "shared",
     SPEC_ROUTE: "shared",
     METRICS_PATH: "metrics",
+    PROFILING_ROUTE: "metrics",
+    PROFILING_STOP_ROUTE: "metrics",
 }
 
 
@@ -150,6 +165,7 @@ class _Handler(BaseHTTPRequestHandler):
         self, code: int, body: bytes, content_type="application/json",
         extra_headers: list[tuple[str, str]] | None = None,
     ) -> None:
+        self._last_status = code
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(body)))
@@ -165,6 +181,7 @@ class _Handler(BaseHTTPRequestHandler):
         extra_headers: list[tuple[str, str]] | None = None,
     ) -> None:
         body = json.dumps(obj).encode()
+        self._last_status = code
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         if location is not None:
@@ -204,25 +221,60 @@ class _Handler(BaseHTTPRequestHandler):
         # Prometheus label cardinality); unmatched requests share one label
         resolved = self._resolve(method, path)
         label = f"{method} {resolved[0]}" if resolved else "unmatched"
-        with metrics.observe_request("http", label) as outcome:
-            if resolved is None:
-                outcome["code"] = "404"
-                from ..errors import NotFoundError
+        # W3C trace ingestion: a traceparent header joins the caller's
+        # trace (as a child span); absence starts a fresh one. The
+        # RequestTrace rides the contextvar so the batcher/engine layers
+        # and the traced store ops correlate without signature threading.
+        ctx = parse_traceparent(self.headers.get("traceparent"))
+        rt = RequestTrace(ctx.child() if ctx is not None else None)
+        self._rt = rt
+        self._last_status = 200
+        token = set_request_trace(rt)
+        t0 = time.perf_counter()
+        outcome = None
+        try:
+            with metrics.observe_request("http", label) as outcome:
+                if resolved is None:
+                    outcome["code"] = "404"
+                    from ..errors import NotFoundError
 
-                self._json(404, NotFoundError("route not found").to_dict())
-                return
-            try:
-                # span-per-request (ref: otelx.TraceHandler, daemon.go:131-133)
-                with self.registry.tracer().span(f"http.{label}"):
-                    resolved[1]()
-            except KetoError as e:
-                outcome["code"] = str(e.status)
-                self._error(e)
-            except (BrokenPipeError, ConnectionResetError):
-                raise
-            except Exception as e:  # noqa: BLE001 — HTTP boundary
-                outcome["code"] = "500"
-                self._error(e)
+                    self._json(404, NotFoundError("route not found").to_dict())
+                    return
+                try:
+                    # span-per-request (ref: otelx.TraceHandler,
+                    # daemon.go:131-133)
+                    with self.registry.tracer().span(
+                        f"http.{label}", ctx=rt.ctx
+                    ):
+                        resolved[1]()
+                    # handlers that WRITE an error status directly (503
+                    # ready probe, 404 nil expand, 403 check mirror, 429
+                    # watch cap) must not count as code="OK"
+                    if self._last_status >= 400:
+                        outcome["code"] = str(self._last_status)
+                except KetoError as e:
+                    outcome["code"] = str(e.status)
+                    self._error(e)
+                except (BrokenPipeError, ConnectionResetError):
+                    raise
+                except Exception as e:  # noqa: BLE001 — HTTP boundary
+                    outcome["code"] = "500"
+                    self._error(e)
+        finally:
+            reset_request_trace(token)
+            # SSE watch streams block in the handler for their whole
+            # lifetime by design — a stream's duration is not a slow
+            # query, so it never trips the threshold log
+            finish_request_telemetry(
+                metrics,
+                self.registry.config.get("log.slow_query_ms"),
+                "http", label, rt,
+                outcome.code if outcome is not None else "500",
+                time.perf_counter() - t0,
+                skip_slow=(
+                    resolved is not None and resolved[0] == WATCH_ROUTE
+                ),
+            )
 
     # -- routing --------------------------------------------------------------
 
@@ -265,6 +317,13 @@ class _Handler(BaseHTTPRequestHandler):
                     self.registry.metrics().export(),
                     content_type="text/plain; version=0.0.4; charset=utf-8",
                 )
+            if path == PROFILING_ROUTE:
+                if method == "GET":
+                    return PROFILING_ROUTE, self._profiling_status
+                if method == "POST":
+                    return PROFILING_ROUTE, self._profiling_start
+            if method == "POST" and path == PROFILING_STOP_ROUTE:
+                return PROFILING_STOP_ROUTE, self._profiling_stop
             return None
 
         if self.kind == "read":
@@ -357,7 +416,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(code, {"allowed": False}, extra_headers=token_hdr)
             return
         if self.batcher is not None:
-            res = self.batcher.check(t, max_depth, nid=nid)
+            res = self.batcher.check(
+                t, max_depth, nid=nid, rt=getattr(self, "_rt", None)
+            )
         else:
             res = self.registry.check_engine(nid).check_relation_tuple(t, max_depth)
         if res.error is not None:
@@ -624,6 +685,65 @@ class _Handler(BaseHTTPRequestHandler):
             raise MalformedInputError(
                 debug="a subject_id or subject_set.* subject is required"
             )
+
+    # -- profiling admin (metrics listener) -----------------------------------
+
+    def _profiling_status(self) -> None:
+        self._json(200, self.registry.profiler().status())
+
+    @staticmethod
+    def _confine_profile_path(path: str) -> str:
+        """Client-supplied artifact paths resolve INSIDE the profile
+        directory (KETO_PROFILE_DIR, default the system tempdir) — the
+        admin endpoint must not be an arbitrary-file-write primitive for
+        whoever can reach the metrics port."""
+        import os
+        import tempfile
+
+        base = os.path.realpath(
+            os.environ.get("KETO_PROFILE_DIR") or tempfile.gettempdir()
+        )
+        resolved = os.path.realpath(os.path.join(base, path))
+        if resolved != base and not resolved.startswith(base + os.sep):
+            raise MalformedInputError(
+                debug=f"profiling path must stay inside {base!r} "
+                "(set KETO_PROFILE_DIR to change the allowed directory)"
+            )
+        return resolved
+
+    def _profiling_start(self) -> None:
+        """POST /admin/profiling {"mode": "cpu"|"mem"|"jax", "path"?}
+        (or ?mode= query param): start an on-demand capture against the
+        RUNNING serve. 400 on unknown mode or a path escaping the
+        profile directory, 409 while one is running."""
+        body = self._body_json()
+        params = self._params()
+        mode = ""
+        path = None
+        if isinstance(body, dict):
+            mode = body.get("mode") or ""
+            path = body.get("path") or None
+        mode = mode or params.get("mode", "")
+        path = path or params.get("path") or None
+        if path is not None:
+            path = self._confine_profile_path(path)
+        try:
+            self._json(200, self.registry.profiler().start(mode, path))
+        except ValueError as e:
+            raise MalformedInputError(debug=str(e))
+        except RuntimeError as e:
+            self._json(
+                409,
+                {"error": {"code": 409, "status": "Conflict",
+                           "message": str(e)}},
+            )
+
+    def _profiling_stop(self) -> None:
+        """POST /admin/profiling/stop: end the capture and write its
+        artifact. Idempotent — a stop with nothing running answers
+        {"running": false, "artifact": null} instead of erroring."""
+        artifact = self.registry.profiler().stop()
+        self._json(200, {"running": False, "artifact": artifact})
 
     # -- write handlers -------------------------------------------------------
 

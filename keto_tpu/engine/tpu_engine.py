@@ -65,6 +65,16 @@ _BUCKETS = (16, 64, 256, 1024, 4096, 16384)
 _paginate = paginate_names
 
 
+def _tables_nbytes(tables) -> int:
+    """Device bytes held by a table dict (or the mesh path's
+    (sharded, replicated) tuple of dicts) — the snapshot_hbm_bytes gauge."""
+    if isinstance(tables, tuple):
+        return sum(_tables_nbytes(t) for t in tables)
+    if isinstance(tables, dict):
+        return sum(int(getattr(v, "nbytes", 0) or 0) for v in tables.values())
+    return int(getattr(tables, "nbytes", 0) or 0)
+
+
 @dataclass
 class _EngineState:
     """One consistent device-mirror generation. Immutable except for the
@@ -155,6 +165,7 @@ class TPUCheckEngine:
         self._refresh_mu = threading.Lock()
         self._refresh_event: Optional[threading.Event] = None
         self._refresh_stopped = False
+        self._notify_t = 0.0  # monotonic stamp of the oldest unserved poke
         # device-path observability (served vs host-fallback checks);
         # `metrics` is an optional observability.Metrics mirror of the same.
         # host_cause splits host_checks by kernel CAUSE_* code (VERDICT r2
@@ -198,6 +209,10 @@ class TPUCheckEngine:
                     )
                     self._refresh_event = ev
                     thread.start()
+        if not ev.is_set():
+            # stamp the OLDEST unserved poke: refresh_lag_seconds then
+            # measures hook -> fold completion, including coalesced bursts
+            self._notify_t = time.monotonic()
         ev.set()
 
     def stop_push_refresh(self) -> None:
@@ -221,6 +236,10 @@ class TPUCheckEngine:
                 self.stats["push_refreshes"] = (
                     self.stats.get("push_refreshes", 0) + 1
                 )
+                if self.metrics is not None and self._notify_t:
+                    self.metrics.refresh_lag_seconds.set(
+                        time.monotonic() - self._notify_t
+                    )
             except Exception:  # noqa: BLE001 — background refresh must
                 # never die; the per-request sync path will surface the
                 # error to a caller who can handle it
@@ -435,6 +454,11 @@ class TPUCheckEngine:
         if state.base_decoder is not None and new_state.base_decoder is None:
             new_state.base_decoder = state.base_decoder
             new_state.decoder = state.base_decoder.extended(overlay)
+        if self.metrics is not None:
+            self.metrics.delta_overlay_ops.set(len(ops))
+            self.metrics.compaction_lag_versions.set(
+                store_version - state.base_version
+            )
         return new_state
 
     def _incremental_compact(
@@ -507,10 +531,21 @@ class TPUCheckEngine:
         self.stats["incremental_merges"] = (
             self.stats.get("incremental_merges", 0) + 1
         )
+        self._set_mirror_gauges(new_state.tables)
         # scheduling only (the O(edges) compressed write runs on the
         # timer thread) — safe under the engine lock
         self._maybe_persist(merged)
         return new_state
+
+    def _set_mirror_gauges(self, tables) -> None:
+        """Fresh-base gauges after a rebuild/compaction: empty delta
+        overlay, zero compaction lag, current device-table footprint."""
+        m = self.metrics
+        if m is None:
+            return
+        m.delta_overlay_ops.set(0)
+        m.compaction_lag_versions.set(0)
+        m.snapshot_hbm_bytes.set(_tables_nbytes(tables))
 
     @staticmethod
     def _pack_expand_csr(csr: dict) -> dict:
@@ -733,6 +768,7 @@ class TPUCheckEngine:
                     config_fp=config_fp,
                 )
                 self.stats["snapshot_loads"] = self.stats.get("snapshot_loads", 0) + 1
+                self._set_mirror_gauges(state.tables)
                 return state, None
         build_start = time.perf_counter()
         # columnar fast path: stores exposing all_tuple_columns feed the
@@ -781,6 +817,7 @@ class TPUCheckEngine:
                 self.metrics.snapshot_build_duration.observe(
                     time.perf_counter() - build_start
                 )
+                self._set_mirror_gauges(tables)
             return state, (snap if self.mesh is None else None)
         tuples = self.manager.all_relation_tuples(nid=self.nid)
         sharded = None
@@ -822,6 +859,7 @@ class TPUCheckEngine:
             self.metrics.snapshot_build_duration.observe(
                 time.perf_counter() - build_start
             )
+            self._set_mirror_gauges(tables)
         # mirror checkpoints cover the single-device path only (the
         # sharded build re-derives per-shard tables anyway)
         return state, (snap if self.mesh is None else None)
@@ -1462,7 +1500,8 @@ class TPUCheckEngine:
         return self.check_batch_resolve(self.check_batch_submit(tuples, max_depth))
 
     def check_batch_submit(
-        self, tuples: Sequence[RelationTuple], max_depth: int = 0
+        self, tuples: Sequence[RelationTuple], max_depth: int = 0,
+        telemetry=None,
     ):
         """Launch the device kernel for one batch WITHOUT synchronizing.
 
@@ -1471,10 +1510,17 @@ class TPUCheckEngine:
         caller can keep several batches in flight and the device (or the
         TPU tunnel — measured ~70 ms round-trip on the axon tunnel, which
         made one-batch-at-a-time serving latency-bound) pipelines them.
+
+        `telemetry` is an optional per-tuple list of RequestTrace|None:
+        the engine's stage breakdown (assemble/dispatch at submit,
+        device_wait/host_fallback at resolve) is added to every rider —
+        batch-shared stages, attributed identically to each request in
+        the batch — and emitted as per-request engine spans when tracing.
         """
         n = len(tuples)
         if n == 0:
             return ("empty", [], None)
+        t_submit = time.perf_counter()
         state = self._ensure_state()
         global_max = self.config.max_read_depth()
         depth = max_depth if 0 < max_depth <= global_max else global_max
@@ -1487,7 +1533,12 @@ class TPUCheckEngine:
             return (
                 "multi",
                 [
-                    self.check_batch_submit(tuples[i : i + step], max_depth)
+                    self.check_batch_submit(
+                        tuples[i : i + step], max_depth,
+                        telemetry=(
+                            telemetry[i : i + step] if telemetry else None
+                        ),
+                    )
                     for i in range(0, n, step)
                 ],
                 None,
@@ -1552,6 +1603,7 @@ class TPUCheckEngine:
         # the batch so island-heavy workloads don't immediately overflow
         # to host replay (overflow is safe, just slow)
         island_cap = 2 * B if state.snapshot.island_circuits else 0
+        t_launch = time.perf_counter()
         with self.tracer.span(
             "engine.kernel_launch", batch=B, frontier=launch_cap
         ):
@@ -1593,6 +1645,7 @@ class TPUCheckEngine:
                 )
         # everything past the launch is deferred to resolve: touching the
         # outputs here would block on the device round-trip
+        t_done = time.perf_counter()
         return (
             "batch",
             outputs,
@@ -1604,6 +1657,13 @@ class TPUCheckEngine:
                 "max_depth": max_depth,
                 "q_valid": q_valid,
                 "island_cap": island_cap if self.mesh is None else None,
+                # per-stage seconds accumulated so far; resolve adds
+                # device_wait / host_fallback and finalizes attribution
+                "stage_s": {
+                    "assemble": t_launch - t_submit,
+                    "dispatch": t_done - t_launch,
+                },
+                "telemetry": telemetry,
             },
         )
 
@@ -1619,6 +1679,7 @@ class TPUCheckEngine:
         tuples = meta["tuples"]
         n, B, max_depth = meta["n"], meta["B"], meta["max_depth"]
         q_valid = meta["q_valid"]
+        t_resolve = time.perf_counter()
         if meta.get("island_cap") is not None:
             # packed single-device result: ONE device->host readback
             from .kernel import unpack_results
@@ -1641,6 +1702,7 @@ class TPUCheckEngine:
             )
         else:
             member = ctx_hit[:B]
+        device_wait_s = time.perf_counter() - t_resolve
 
         # fast path: every query ran on device (the steady serving
         # state) — one numpy reduction decides, then results come from a
@@ -1662,10 +1724,12 @@ class TPUCheckEngine:
             if self.metrics is not None:
                 self.metrics.check_batch_size.observe(n)
                 self.metrics.checks_total.labels("device").inc(n)
+            self._finish_check_stages(meta, device_wait_s, 0.0, n, B)
             return results
 
         results = []
         n_host = 0
+        host_s = 0.0
         host_causes: dict[str, int] = {}
         # identical host-replayed queries within one batch evaluate once
         # (an adversarial batch of 4096 same-tuple fallbacks would
@@ -1701,9 +1765,11 @@ class TPUCheckEngine:
                     )
                     res = replay_memo.get(key)
                     if res is None:
+                        t_host = time.perf_counter()
                         res = self.reference.check_relation_tuple(
                             t, max_depth, self.nid
                         )
+                        host_s += time.perf_counter() - t_host
                         replay_memo[key] = res
                     results.append(res)
             sp.set_attribute("host_replays", n_host)
@@ -1720,4 +1786,36 @@ class TPUCheckEngine:
                 self.metrics.checks_total.labels("host").inc(n_host)
             for cause, cnt in host_causes.items():
                 self.metrics.host_fallback_total.labels(cause).inc(cnt)
+        self._finish_check_stages(meta, device_wait_s, host_s, n, B)
         return results
+
+    def _finish_check_stages(
+        self, meta, device_wait_s: float, host_s: float, n: int, B: int
+    ) -> None:
+        """Finalize one batch's stage attribution: per-stage histogram
+        samples (once per batch), the occupancy gauge, each rider's
+        RequestTrace stages, and per-request engine spans when tracing.
+        Batch-shared stages are attributed identically to every rider —
+        the breakdown says where the BATCH spent its time, which is what
+        a tail-latency investigation needs."""
+        stage_s = dict(meta.get("stage_s") or ())
+        stage_s["device_wait"] = device_wait_s
+        if host_s > 0.0:
+            stage_s["host_fallback"] = host_s
+        if self.metrics is not None:
+            for name, dur in stage_s.items():
+                self.metrics.observe_stage(name, dur)
+            self.metrics.batch_occupancy.set(n / B if B else 1.0)
+        telemetry = meta.get("telemetry")
+        if not telemetry:
+            return
+        spans = getattr(self.tracer, "active", False)
+        for rt in telemetry:
+            if rt is None:
+                continue
+            for name, dur in stage_s.items():
+                rt.add_stage(name, dur)
+                if spans:
+                    self.tracer.record(
+                        f"engine.{name}", ctx=rt.ctx, duration_s=dur, batch=B
+                    )

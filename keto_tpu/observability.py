@@ -5,6 +5,15 @@ prometheusx metrics served on the metrics port (registry_default.go:
 131-143, daemon.go:421-436), otelx tracer with spans in every persister/
 handler method, logrusx structured request logging (daemon.go:294).
 
+Beyond parity, this module carries the request-scoped telemetry plane:
+W3C `traceparent` contexts ingested at the transports flow (as a
+`RequestTrace`) through the batcher into the engine, so one Check yields
+correlated spans for transport handling, batcher queue wait, batch
+assembly/padding, device dispatch, device wait, and host-fallback replay
+— and the same stage breakdown lands in the `check_stage_duration`
+histogram, the structured request log, and the threshold-configurable
+slow-query log (`log.slow_query_ms`).
+
 Everything here degrades gracefully: metrics use a dedicated
 CollectorRegistry (so embedders/tests never hit duplicate-collector
 errors), and tracing is a no-op unless `tracing.enabled` is set.
@@ -13,12 +22,113 @@ errors), and tracing is a no-op unless `tracing.enabled` is set.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import logging
+import secrets
 import time
+from typing import Optional
 
 import prometheus_client as prom
 
 logger = logging.getLogger("keto_tpu")
+
+# the canonical stage vocabulary, transport to silicon; every stage name
+# used with Metrics.observe_stage / RequestTrace.add_stage comes from
+# here so the docs table and the bench summary can enumerate them
+CHECK_STAGES = (
+    "transport",      # handler time outside the batcher/engine stages
+    "queue",          # batcher queue wait (enqueue -> group dispatch)
+    "assemble",       # state refresh + batch encoding + bucket padding
+    "dispatch",       # device launch (H2D upload + async kernel dispatch)
+    "device_wait",    # block-until-ready + readback + unpack
+    "host_fallback",  # exact host replay of cause-flagged queries
+)
+
+
+# -- W3C trace context --------------------------------------------------------
+
+
+class SpanContext:
+    """One W3C trace-context vertex: (trace_id, span_id). `child()` mints
+    a new span id under the same trace — the propagation primitive."""
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: str, sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = sampled
+
+    def child(self) -> "SpanContext":
+        return SpanContext(self.trace_id, secrets.token_hex(8), self.sampled)
+
+    def to_traceparent(self) -> str:
+        return (
+            f"00-{self.trace_id}-{self.span_id}-"
+            f"{'01' if self.sampled else '00'}"
+        )
+
+
+def new_trace() -> SpanContext:
+    return SpanContext(secrets.token_hex(16), secrets.token_hex(8))
+
+
+def parse_traceparent(value: Optional[str]) -> Optional[SpanContext]:
+    """Parse a W3C `traceparent` header/metadata value; None for absent
+    or malformed input (a bad header must never fail the request — the
+    spec says restart the trace)."""
+    if not value:
+        return None
+    parts = value.strip().lower().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id, flags = parts[0], parts[1], parts[2], parts[3]
+    if len(version) != 2 or version == "ff":
+        return None
+    if len(trace_id) != 32 or len(span_id) != 16 or len(flags) != 2:
+        return None
+    try:
+        if int(trace_id, 16) == 0 or int(span_id, 16) == 0:
+            return None
+        sampled = bool(int(flags, 16) & 1)
+    except ValueError:
+        return None
+    return SpanContext(trace_id, span_id, sampled)
+
+
+class RequestTrace:
+    """Per-request telemetry carrier: the span context plus accumulated
+    per-stage seconds. Created at the transport, handed through the
+    batcher into the engine; every layer adds its stage durations."""
+
+    __slots__ = ("ctx", "stages")
+
+    def __init__(self, ctx: Optional[SpanContext] = None):
+        self.ctx = ctx if ctx is not None else new_trace()
+        self.stages: dict[str, float] = {}
+
+    def add_stage(self, name: str, seconds: float) -> None:
+        self.stages[name] = self.stages.get(name, 0.0) + seconds
+
+
+# current request telemetry for the executing handler; transports set it
+# so nested layers (traced store ops, engine spans on the same thread)
+# correlate without threading an argument through every signature
+CURRENT_TRACE: contextvars.ContextVar[Optional[RequestTrace]] = (
+    contextvars.ContextVar("keto_tpu_request_trace", default=None)
+)
+
+
+def set_request_trace(rt: Optional[RequestTrace]):
+    return CURRENT_TRACE.set(rt)
+
+
+def reset_request_trace(token) -> None:
+    CURRENT_TRACE.reset(token)
+
+
+def current_request_trace() -> Optional[RequestTrace]:
+    return CURRENT_TRACE.get()
 
 
 class Metrics:
@@ -104,12 +214,90 @@ class Metrics:
             "and its fan-out to subscribers (watch hub tail lag)",
             registry=self.registry,
         )
+        # request-scoped telemetry plane: the per-stage Check breakdown
+        # (CHECK_STAGES) — one observation per stage per device batch
+        # (batch-shared stages are observed once, not per rider), so a
+        # p95 regression attributes to queue wait vs padding vs dispatch
+        # vs device wait vs host replay instead of one flat duration
+        self.check_stage_duration = prom.Histogram(
+            "keto_tpu_check_stage_duration_seconds",
+            "Check serving time per pipeline stage (transport | queue | "
+            "assemble | dispatch | device_wait | host_fallback); "
+            "batch-level stages observe once per device batch",
+            ["stage"],
+            registry=self.registry,
+            buckets=(
+                0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 1.0,
+            ),
+        )
+        self.batcher_queue_depth = prom.Gauge(
+            "keto_tpu_batcher_queue_depth",
+            "Requests waiting in a check-batcher queue, sampled at "
+            "enqueue/drain; `plane` separates the threaded batcher from "
+            "the aio one (both can serve simultaneously — an unlabeled "
+            "gauge would be last-writer-wins between them)",
+            ["plane"],  # threaded | aio
+            registry=self.registry,
+        )
+        self.inflight_launches = prom.Gauge(
+            "keto_tpu_inflight_launches",
+            "Launched-but-unresolved device batches (bounded by the "
+            "batcher's in-flight semaphore)",
+            registry=self.registry,
+        )
+        self.batch_occupancy = prom.Gauge(
+            "keto_tpu_batch_occupancy",
+            "Real rows / padded bucket rows of the most recent device "
+            "batch (1.0 = no padding waste)",
+            registry=self.registry,
+        )
+        self.delta_overlay_ops = prom.Gauge(
+            "keto_tpu_delta_overlay_ops",
+            "Pending store ops compiled into the current delta overlay "
+            "(0 after a compaction/rebuild; compaction forces at "
+            "DELTA_COMPACT_THRESHOLD)",
+            registry=self.registry,
+        )
+        self.snapshot_hbm_bytes = prom.Gauge(
+            "keto_tpu_snapshot_hbm_bytes",
+            "Device bytes held by the current check-table mirror "
+            "(packed edge/rewrite/delta tables; expand/reverse extras "
+            "not included)",
+            registry=self.registry,
+        )
+        self.compaction_lag_versions = prom.Gauge(
+            "keto_tpu_compaction_lag_versions",
+            "Store commits folded into the delta overlay since the base "
+            "snapshot (covered_version - base_version): distance toward "
+            "the next compaction",
+            registry=self.registry,
+        )
+        self.refresh_lag_seconds = prom.Gauge(
+            "keto_tpu_refresh_lag_seconds",
+            "Push-refresher lag: seconds from the triggering commit's "
+            "write hook to delta-overlay fold completion (last refresh)",
+            registry=self.registry,
+        )
         # hot-path cache: (transport, method) -> (duration child,
         # {code: counter child})
         self._observe_cache: dict = {}
+        # stage -> histogram child (stage names are the CHECK_STAGES
+        # constants, so this cache is bounded by construction)
+        self._stage_cache: dict = {}
 
     def export(self) -> bytes:
         return prom.generate_latest(self.registry)
+
+    def observe_stage(self, stage: str, seconds: float) -> None:
+        """One per-stage sample (cached label child; see observe_request
+        for why `.labels()` is avoided on the serve hot path)."""
+        child = self._stage_cache.get(stage)
+        if child is None:
+            child = self._stage_cache[stage] = (
+                self.check_stage_duration.labels(stage)
+            )
+        child.observe(seconds)
 
     def observe_request(self, transport: str, method: str):
         """Times a request and counts its outcome code.
@@ -186,9 +374,15 @@ _NOOP_SPAN = _NoopSpan()
 
 
 class _NoopTracer:
-    def span(self, name: str, **attrs):
+    # False lets hot paths skip per-request span bookkeeping entirely
+    active = False
+
+    def span(self, name: str, ctx=None, **attrs):
         # singleton CM: no generator frame per call on the serve path
         return _NOOP_SPAN
+
+    def record(self, name: str, ctx=None, duration_s=None, **attrs):
+        pass
 
 
 class RecordedSpan:
@@ -208,34 +402,94 @@ class RecordedSpan:
 class RecordingTracer:
     """In-memory span recorder (`tracing.provider: memory`): the test/
     debug exporter — this image ships only the OTel API, not the SDK, so
-    span visibility needs a built-in sink. Thread-safe append-only."""
+    span visibility needs a built-in sink. Thread-safe append-only.
+
+    Spans carry trace correlation: an explicit `ctx` (SpanContext) or,
+    when absent, the executing request's CURRENT_TRACE — so persistence
+    spans recorded deep in a handler share the request's trace_id
+    without any signature changes."""
+
+    active = True
 
     def __init__(self, cap: int = 4096):
         import collections
 
         self.spans = collections.deque(maxlen=cap)
 
+    @staticmethod
+    def _trace_attrs(ctx, attrs: dict) -> dict:
+        if ctx is None:
+            rt = CURRENT_TRACE.get()
+            ctx = rt.ctx if rt is not None else None
+        if ctx is not None:
+            attrs["trace_id"] = ctx.trace_id
+            attrs["parent_span_id"] = ctx.span_id
+            attrs["span_id"] = secrets.token_hex(8)
+        return attrs
+
     @contextlib.contextmanager
-    def span(self, name: str, **attrs):
-        s = RecordedSpan(name, dict(attrs))
+    def span(self, name: str, ctx=None, **attrs):
+        s = RecordedSpan(name, self._trace_attrs(ctx, dict(attrs)))
         self.spans.append(s)
-        yield s
+        start = time.perf_counter()
+        try:
+            yield s
+        finally:
+            s.attrs["duration_ms"] = round(
+                (time.perf_counter() - start) * 1e3, 3
+            )
+
+    def record(self, name: str, ctx=None, duration_s=None, **attrs):
+        """Retroactive span: stages measured after the fact (batcher
+        queue wait, batch-shared engine stages) become spans without a
+        live context manager around the work."""
+        attrs = self._trace_attrs(ctx, dict(attrs))
+        if duration_s is not None:
+            attrs["duration_ms"] = round(duration_s * 1e3, 3)
+        self.spans.append(RecordedSpan(name, attrs))
 
     def span_names(self) -> list:
         return [s.name for s in self.spans]
+
+    def spans_for_trace(self, trace_id: str) -> list:
+        return [s for s in self.spans if s.attrs.get("trace_id") == trace_id]
 
 
 class TracedManager:
     """Span-per-store-op proxy around any Manager implementation — the
     analog of the reference's otel spans in every persister method
     (internal/persistence/sql/relationtuples.go:203-205 etc.) without
-    touching the store classes."""
+    touching the store classes.
+
+    Every public Manager method is either in _TRACED or in _EXEMPT (with
+    the reason); tests/test_observability.py asserts the union covers
+    the real store classes, so a new store op cannot silently bypass the
+    span proxy again (the PR-2 watch ops did)."""
 
     _TRACED = (
         "get_relation_tuples", "write_relation_tuples",
         "delete_relation_tuples", "delete_all_relation_tuples",
         "transact_relation_tuples", "relation_tuple_exists",
         "all_relation_tuples",
+        # watch-era store ops (PR 2): the changelog reads feeding the
+        # delta overlay and the watch hub's versioned tail
+        "changes_since", "changelog_since",
+        # scale/ingest ops: O(edges) reads/writes are exactly the spans
+        # an operator wants to see
+        "all_tuple_columns", "bulk_load",
+        # migration runners (operator-invoked, slow, worth a span)
+        "migrate_up", "migrate_down",
+        "map_strings_to_uuids", "map_uuids_to_strings",
+    )
+    # public methods deliberately NOT traced, with the reason — the
+    # coverage test fails on any public store method in neither tuple
+    _EXEMPT = (
+        "version",             # per-batch staleness counter read (hot path)
+        "add_write_listener",  # one-time hook registration, not an op
+        "set_trim_guard",      # registration; guard runs inside store locks
+        "migration_status",    # trivial metadata read (CLI status verb)
+        "legacy_row_count",    # trivial metadata read (migration gate)
+        "close",               # teardown; tracer may already be gone
     )
 
     def __init__(self, inner, tracer):
@@ -256,17 +510,29 @@ class TracedManager:
 
 
 class _OtelTracer:
+    active = True
+
     def __init__(self, service_name: str):
         from opentelemetry import trace
 
         self._tracer = trace.get_tracer(service_name)
 
     @contextlib.contextmanager
-    def span(self, name: str, **attrs):
+    def span(self, name: str, ctx=None, **attrs):
         with self._tracer.start_as_current_span(name) as s:
+            if ctx is not None:
+                s.set_attribute("keto.trace_id", ctx.trace_id)
             for k, v in attrs.items():
                 s.set_attribute(k, v)
             yield s
+
+    def record(self, name: str, ctx=None, duration_s=None, **attrs):
+        # the OTel API (no SDK) has no retroactive-span surface; emit a
+        # zero-length span carrying the duration as an attribute
+        if duration_s is not None:
+            attrs["duration_ms"] = round(duration_s * 1e3, 3)
+        with self.span(name, ctx=ctx, **attrs):
+            pass
 
 
 def build_tracer(config):
@@ -282,14 +548,96 @@ def build_tracer(config):
     return _NoopTracer()
 
 
-def request_log(transport: str, method: str, code: str, duration_s: float) -> None:
-    """Structured per-request log line (ref: reqlog middleware daemon.go:294)."""
-    logger.info(
-        "request handled",
-        extra={
-            "transport": transport,
-            "method": method,
-            "code": code,
-            "duration_ms": round(duration_s * 1e3, 3),
-        },
+def _stages_ms(stages: Optional[dict]) -> dict[str, float]:
+    return {k: round(v * 1e3, 3) for k, v in (stages or {}).items()}
+
+
+def request_log(
+    transport: str,
+    method: str,
+    code: str,
+    duration_s: float,
+    trace_id: str = "",
+    stages: Optional[dict] = None,
+) -> None:
+    """Structured per-request log line (ref: reqlog middleware
+    daemon.go:294), now carrying the trace id and the per-stage ms
+    breakdown. The isEnabledFor gate inside logger.info keeps this free
+    on the serve hot path at the default WARNING level."""
+    if not logger.isEnabledFor(logging.INFO):
+        return
+    extra = {
+        "transport": transport,
+        "method": method,
+        "code": code,
+        "duration_ms": round(duration_s * 1e3, 3),
+    }
+    if trace_id:
+        extra["trace_id"] = trace_id
+    if stages:
+        extra["stages_ms"] = _stages_ms(stages)
+    logger.info("request handled", extra=extra)
+
+
+def slow_query_log(
+    threshold_ms,
+    transport: str,
+    method: str,
+    code: str,
+    duration_s: float,
+    trace_id: str = "",
+    stages: Optional[dict] = None,
+) -> None:
+    """Threshold-configurable slow-query line (`log.slow_query_ms`):
+    one structured WARNING with the trace id and per-stage ms, so a
+    single slow request is attributable without turning on full request
+    logging. None threshold = disabled; fires at duration >= threshold."""
+    if threshold_ms is None:
+        return
+    duration_ms = duration_s * 1e3
+    if duration_ms < float(threshold_ms):
+        return
+    logger.warning(
+        "slow request trace_id=%s transport=%s method=%r code=%s "
+        "duration_ms=%.3f stages_ms=%s",
+        trace_id or "-",
+        transport,
+        method,
+        code,
+        duration_ms,
+        _stages_ms(stages),
     )
+
+
+def finish_request_telemetry(
+    metrics,
+    threshold_ms,
+    transport: str,
+    method: str,
+    rt: RequestTrace,
+    code: str,
+    duration_s: float,
+    skip_slow: bool = False,
+) -> None:
+    """Shared end-of-request bookkeeping for every transport (REST
+    _route, sync-gRPC _observed, aio _observed): computes the transport
+    residual stage, feeds the stage histogram ONLY for requests that
+    rode the check pipeline (scrapes/lists/writes have no breakdown and
+    would pollute the Check attribution), then emits the request and
+    slow-query logs. `skip_slow` exempts by-design-long requests (SSE
+    watch streams)."""
+    rode_pipeline = bool(rt.stages)
+    rt.add_stage(
+        "transport", max(0.0, duration_s - sum(rt.stages.values()))
+    )
+    if rode_pipeline and metrics is not None:
+        metrics.observe_stage("transport", rt.stages["transport"])
+    request_log(
+        transport, method, code, duration_s,
+        trace_id=rt.ctx.trace_id, stages=rt.stages,
+    )
+    if not skip_slow:
+        slow_query_log(
+            threshold_ms, transport, method, code, duration_s,
+            trace_id=rt.ctx.trace_id, stages=rt.stages,
+        )

@@ -1,26 +1,151 @@
-"""Env/config-driven serve profiling.
+"""Env/config-driven serve profiling + live-toggleable capture sessions.
 
 The reference wraps its entire process in `profilex.Profile()`
 (/root/reference/main.go:24): the PROFILING env var ("cpu" | "mem")
 turns on a profiler whose report is written when the process stops, so
-an operator can profile a production serve without code changes. The
-Python analog:
+an operator can profile a production serve without code changes. This
+module keeps that contract (`profiled`, the `profiling` config key /
+KETO_PROFILING env var) and extends it to LIVE capture: a `Profiler`
+can be started and stopped while the serve is running — surfaced on the
+metrics listener as `POST /admin/profiling` / `POST
+/admin/profiling/stop` (api/rest_server.py) — so a latency incident can
+be captured in situ instead of requiring a restart.
 
-  - "cpu": cProfile around the serve loop; a pstats dump is written on
-    stop (readable with `python -m pstats <file>`)
-  - "mem": tracemalloc; the top-25 allocation sites by size are written
-    as text on stop
+Modes:
+  - "cpu": cProfile; a pstats dump on stop (readable with
+    `python -m pstats <file>`)
+  - "mem": tracemalloc; the top-25 allocation sites by size on stop
+  - "jax": `jax.profiler.start_trace` / `stop_trace` — the device-level
+    trace (XLA ops, transfers) written as a TensorBoard trace directory
 
-Source of truth: the `profiling` config key (embedx parity —
-config_schema.json) with the KETO_PROFILING env var taking precedence,
-mirroring profilex's env-only contract. Output path: KETO_PROFILE_PATH
-or ./keto_<mode>.pprof-like defaults.
+Output path: explicit `path`, else KETO_PROFILE_PATH, else a
+mode-specific default in the working directory.
 """
 
 from __future__ import annotations
 
 import os
+import threading
 from contextlib import contextmanager
+from typing import Optional
+
+MODES = ("cpu", "mem", "jax")
+
+_DEFAULT_PATHS = {
+    "cpu": "keto_cpu.pstats",
+    "mem": "keto_mem.txt",
+    "jax": "keto_jax_trace",
+}
+
+
+def _default_path(mode: str) -> str:
+    return os.environ.get("KETO_PROFILE_PATH") or _DEFAULT_PATHS[mode]
+
+
+class Profiler:
+    """One live capture session at a time. start() is a 409-style error
+    while running (the REST layer maps RuntimeError); stop() is
+    IDEMPOTENT — a second stop reports not-running instead of erroring,
+    so an operator script can always converge on 'stopped'."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.mode: Optional[str] = None
+        self.path: Optional[str] = None
+        self.last_artifact: Optional[str] = None
+        self._cprofile = None
+
+    # -- queries --------------------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self.mode is not None
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "running": self.mode is not None,
+                "mode": self.mode,
+                "path": self.path,
+                "last_artifact": self.last_artifact,
+            }
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self, mode: str, path: Optional[str] = None) -> dict:
+        mode = (mode or "").strip().lower()
+        if mode not in MODES:
+            raise ValueError(
+                f"unknown profiling mode {mode!r} (expected one of {MODES})"
+            )
+        with self._lock:
+            if self.mode is not None:
+                raise RuntimeError(
+                    f"a {self.mode!r} capture is already running; stop it first"
+                )
+            out = path or _default_path(mode)
+            # cProfile/tracemalloc are PROCESS-GLOBAL: an env-driven
+            # `profiled()` capture may already own them. Detect the
+            # collision and refuse (409-style), leaving this instance
+            # clean — never hijack or corrupt the other capture.
+            if mode == "cpu":
+                import cProfile
+
+                prof = cProfile.Profile()
+                try:
+                    prof.enable()
+                except ValueError as e:  # another profiler is active
+                    raise RuntimeError(
+                        f"another CPU profiler is already active: {e}"
+                    )
+                self._cprofile = prof
+            elif mode == "mem":
+                import tracemalloc
+
+                if tracemalloc.is_tracing():
+                    raise RuntimeError(
+                        "tracemalloc is already tracing (another capture "
+                        "owns it); stop that capture first"
+                    )
+                tracemalloc.start(25)
+            else:  # jax
+                import jax
+
+                jax.profiler.start_trace(out)
+            self.mode = mode
+            self.path = out
+            return {"running": True, "mode": mode, "path": out}
+
+    def stop(self) -> Optional[str]:
+        """Ends the capture and writes the artifact; returns its path,
+        or None when no capture was running (idempotent double-stop)."""
+        with self._lock:
+            mode, self.mode = self.mode, None
+            path, self.path = self.path, None
+            if mode is None:
+                return None
+            if mode == "cpu":
+                prof, self._cprofile = self._cprofile, None
+                prof.disable()
+                prof.dump_stats(path)
+            elif mode == "mem":
+                import tracemalloc
+
+                if not tracemalloc.is_tracing():
+                    # another actor stopped the global tracer under us;
+                    # converge on 'stopped' instead of crashing shutdown
+                    return None
+                snap = tracemalloc.take_snapshot()
+                tracemalloc.stop()
+                stats = snap.statistics("lineno")[:25]
+                with open(path, "w") as f:
+                    f.write("\n".join(str(s) for s in stats) + "\n")
+            else:  # jax
+                import jax
+
+                jax.profiler.stop_trace()
+            self.last_artifact = path
+            return path
 
 
 @contextmanager
@@ -29,29 +154,24 @@ def profiled(mode: str | None, path: str | None = None):
     profiler; no-op for falsy/unknown modes (same forgiving contract as
     profilex: an operator typo must not stop the server)."""
     mode = (os.environ.get("KETO_PROFILING") or mode or "").strip().lower()
-    if mode == "cpu":
-        import cProfile
-
-        out = path or os.environ.get("KETO_PROFILE_PATH") or "keto_cpu.pstats"
-        prof = cProfile.Profile()
-        prof.enable()
-        try:
-            yield
-        finally:
-            prof.disable()
-            prof.dump_stats(out)
-    elif mode == "mem":
-        import tracemalloc
-
-        out = path or os.environ.get("KETO_PROFILE_PATH") or "keto_mem.txt"
-        tracemalloc.start(25)
-        try:
-            yield
-        finally:
-            snap = tracemalloc.take_snapshot()
-            tracemalloc.stop()
-            stats = snap.statistics("lineno")[:25]
-            with open(out, "w") as f:
-                f.write("\n".join(str(s) for s in stats) + "\n")
-    else:
+    if mode not in ("cpu", "mem"):
         yield
+        return
+    p = Profiler()
+    try:
+        p.start(mode, path)
+    except RuntimeError as e:
+        # another actor already owns the process-global profiler (e.g.
+        # PYTHONTRACEMALLOC, an embedder's cProfile): serve WITHOUT the
+        # capture rather than failing startup
+        import logging
+
+        logging.getLogger("keto_tpu").warning(
+            "profiling disabled: %s", e
+        )
+        yield
+        return
+    try:
+        yield
+    finally:
+        p.stop()

@@ -132,6 +132,7 @@ class Registry:
         )
         self._metrics = None
         self._tracer = None
+        self._profiler = None
         self._watch_hub = None
         # health: flipped by the daemon around serving
         # (ref: registry_default.go:98-112 healthx readiness checkers)
@@ -352,6 +353,17 @@ class Registry:
 
                 self._tracer = build_tracer(self.config)
             return self._tracer
+
+    def profiler(self):
+        """The process-wide on-demand capture session (profiling.py),
+        toggled live through the metrics listener's /admin/profiling
+        endpoint — no restart to profile a running serve."""
+        with self._lock:
+            if self._profiler is None:
+                from .profiling import Profiler
+
+                self._profiler = Profiler()
+            return self._profiler
 
 
 class _HostEngineFacade:

@@ -1,13 +1,19 @@
-"""Tracing instrumentation + config schema validation (VERDICT round-1
-item 8): spans visible in a test exporter; bad config rejected at load
-with a pointer to the offending key."""
+"""Observability plane: config schema validation, tracing spans, W3C
+traceparent propagation parity across REST/gRPC/aio, per-stage Check
+metrics, request + slow-query logs, the traced-manager coverage
+contract, and the on-demand profiler endpoint."""
 
 import json
+import logging
+import subprocess
+import sys
+import urllib.error
 import urllib.request
 
 import pytest
 
 from keto_tpu.config import Config, ConfigError
+from keto_tpu.api import ReadClient, open_channel
 from keto_tpu.api.daemon import Daemon
 from keto_tpu.ketoapi import RelationTuple
 from keto_tpu.namespace import Namespace
@@ -48,6 +54,42 @@ class TestConfigSchema:
             "tracing": {"enabled": True, "provider": "memory"},
             "tenancy": {"header": "x-keto-network"},
         })
+
+    def test_slow_query_threshold_validates(self):
+        Config({"log": {"slow_query_ms": 10.5}})
+        with pytest.raises(ConfigError):
+            Config({"log": {"slow_query_ms": -1}})
+
+
+class TestTraceContext:
+    def test_parse_roundtrip(self):
+        from keto_tpu.observability import new_trace, parse_traceparent
+
+        ctx = new_trace()
+        back = parse_traceparent(ctx.to_traceparent())
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == ctx.span_id
+        assert back.sampled is True
+
+    @pytest.mark.parametrize("bad", [
+        None, "", "garbage", "00-abc-def-01",
+        "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",  # forbidden version
+        "00-" + "0" * 32 + "-" + "b" * 16 + "-01",  # zero trace id
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",  # zero span id
+        "00-" + "z" * 32 + "-" + "b" * 16 + "-01",  # non-hex
+    ])
+    def test_malformed_is_none(self, bad):
+        from keto_tpu.observability import parse_traceparent
+
+        assert parse_traceparent(bad) is None
+
+    def test_child_keeps_trace_id(self):
+        from keto_tpu.observability import new_trace
+
+        ctx = new_trace()
+        child = ctx.child()
+        assert child.trace_id == ctx.trace_id
+        assert child.span_id != ctx.span_id
 
 
 class TestTracing:
@@ -94,3 +136,393 @@ class TestTracing:
         with t.span("anything") as s:
             s.set_attribute("k", "v")
         assert not hasattr(t, "spans")
+        assert t.active is False
+
+
+# ---------------------------------------------------------------------------
+# the request-scoped telemetry plane (PR 3 tentpole)
+# ---------------------------------------------------------------------------
+
+NAMESPACES = [Namespace(name="files")]
+TUPLE = "files:doc#owner@alice"
+
+# engine stages a device-served single check must attribute (the
+# acceptance bar: >= 3 engine stages sharing the request's trace_id)
+ENGINE_STAGES = {"engine.assemble", "engine.dispatch", "engine.device_wait"}
+
+
+@pytest.fixture(scope="module")
+def daemon():
+    cfg = Config({
+        "dsn": "memory",
+        "check": {"engine": "tpu"},
+        "tracing": {"enabled": True, "provider": "memory"},
+        "serve": {
+            "read": {
+                "host": "127.0.0.1", "port": 0,
+                # direct aio listener beside the muxed (threaded) port:
+                # one daemon exercises all three planes
+                "grpc": {"host": "127.0.0.1", "port": 0, "aio": True},
+            },
+            "write": {"host": "127.0.0.1", "port": 0},
+            "metrics": {"host": "127.0.0.1", "port": 0},
+        },
+    })
+    cfg.set_namespaces(NAMESPACES)
+    reg = Registry(cfg)
+    reg.relation_tuple_manager().write_relation_tuples(
+        [RelationTuple.from_string(TUPLE)]
+    )
+    d = Daemon(reg)
+    d.start()
+    yield d
+    d.stop()
+
+
+def _span_names_for(reg, trace_id: str) -> set:
+    return {s.name for s in reg.tracer().spans_for_trace(trace_id)}
+
+
+def _assert_full_pipeline(names: set, transport_prefix: str):
+    assert any(n.startswith(transport_prefix) for n in names), names
+    assert "batcher.queue" in names, names
+    assert ENGINE_STAGES <= names, names
+
+
+class TestTraceparentParity:
+    """One Check with a traceparent yields correlated spans for the
+    transport, the batcher queue, and >= 3 engine stages — identically
+    through REST, threaded gRPC, and the aio plane."""
+
+    def test_rest_header(self, daemon):
+        tid = "11" * 16
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{daemon.read_port}"
+            "/relation-tuples/check/openapi"
+            "?namespace=files&object=doc&relation=owner&subject_id=alice",
+            headers={"traceparent": f"00-{tid}-{'22' * 8}-01"},
+        )
+        assert json.load(urllib.request.urlopen(req))["allowed"] is True
+        _assert_full_pipeline(
+            _span_names_for(daemon.registry, tid), "http."
+        )
+
+    def test_grpc_metadata(self, daemon):
+        tid = "33" * 16
+        client = ReadClient(open_channel(f"127.0.0.1:{daemon.read_port}"))
+        try:
+            assert client.check(
+                RelationTuple.from_string(TUPLE),
+                traceparent=f"00-{tid}-{'44' * 8}-01",
+            ) is True
+        finally:
+            client.close()
+        _assert_full_pipeline(
+            _span_names_for(daemon.registry, tid), "grpc."
+        )
+
+    def test_aio_metadata(self, daemon):
+        tid = "55" * 16
+        client = ReadClient(
+            open_channel(f"127.0.0.1:{daemon.read_grpc_port}")
+        )
+        try:
+            assert client.check(
+                RelationTuple.from_string(TUPLE),
+                traceparent=f"00-{tid}-{'66' * 8}-01",
+            ) is True
+        finally:
+            client.close()
+        _assert_full_pipeline(
+            _span_names_for(daemon.registry, tid), "grpc."
+        )
+
+    def test_malformed_header_starts_fresh_trace(self, daemon):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{daemon.read_port}"
+            "/relation-tuples/check/openapi"
+            "?namespace=files&object=doc&relation=owner&subject_id=alice",
+            headers={"traceparent": "not-a-traceparent"},
+        )
+        assert json.load(urllib.request.urlopen(req))["allowed"] is True
+
+
+class TestStageMetrics:
+    def test_stage_histograms_in_prometheus_export(self, daemon):
+        # a served check has already run (TestTraceparentParity order is
+        # not guaranteed — serve one more to be self-sufficient)
+        client = ReadClient(open_channel(f"127.0.0.1:{daemon.read_port}"))
+        try:
+            client.check(RelationTuple.from_string(TUPLE))
+        finally:
+            client.close()
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{daemon.metrics_port}/metrics/prometheus"
+        ).read().decode()
+        for stage in ("transport", "queue", "assemble", "dispatch",
+                      "device_wait"):
+            needle = (
+                'keto_tpu_check_stage_duration_seconds_count'
+                f'{{stage="{stage}"}}'
+            )
+            assert needle in text, f"missing stage sample: {stage}"
+        # the new pipeline gauges export too
+        for gauge in (
+            "keto_tpu_batcher_queue_depth", "keto_tpu_inflight_launches",
+            "keto_tpu_batch_occupancy", "keto_tpu_snapshot_hbm_bytes",
+            "keto_tpu_delta_overlay_ops",
+            "keto_tpu_compaction_lag_versions",
+        ):
+            assert gauge in text, f"missing gauge: {gauge}"
+
+    def test_snapshot_hbm_bytes_nonzero(self, daemon):
+        m = daemon.registry.metrics()
+        assert m.snapshot_hbm_bytes._value.get() > 0
+
+    def test_error_status_mirrored_into_request_counter(self, daemon):
+        # bare check route mirrors deny as 403 — the outcome label must
+        # say 403, not OK (the satellite fix: no error response counts
+        # as code="OK")
+        url = (
+            f"http://127.0.0.1:{daemon.read_port}/relation-tuples/check"
+            "?namespace=files&object=doc&relation=owner&subject_id=nobody"
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(url)
+        assert e.value.code == 403
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{daemon.metrics_port}/metrics/prometheus"
+        ).read().decode()
+        assert 'code="403"' in text
+
+
+class TestRequestAndSlowQueryLogs:
+    def test_request_log_wired_into_transports(self, daemon, caplog):
+        with caplog.at_level(logging.INFO, logger="keto_tpu"):
+            client = ReadClient(
+                open_channel(f"127.0.0.1:{daemon.read_port}")
+            )
+            try:
+                client.check(RelationTuple.from_string(TUPLE))
+            finally:
+                client.close()
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{daemon.read_port}"
+                "/relation-tuples/check/openapi"
+                "?namespace=files&object=doc&relation=owner&subject_id=alice"
+            )
+        handled = [
+            r for r in caplog.records if r.getMessage() == "request handled"
+        ]
+        transports = {getattr(r, "transport", None) for r in handled}
+        assert "grpc" in transports and "http" in transports
+        for r in handled:
+            if getattr(r, "method", "") in ("Check",):
+                assert getattr(r, "trace_id", "")
+                assert "queue" in getattr(r, "stages_ms", {})
+
+    def test_slow_query_log_fires_above_threshold(self, daemon, caplog):
+        daemon.registry.config.set("log.slow_query_ms", 0)
+        try:
+            with caplog.at_level(logging.WARNING, logger="keto_tpu"):
+                client = ReadClient(
+                    open_channel(f"127.0.0.1:{daemon.read_port}")
+                )
+                try:
+                    client.check(RelationTuple.from_string(TUPLE))
+                finally:
+                    client.close()
+            slow = [
+                r for r in caplog.records
+                if r.getMessage().startswith("slow request")
+            ]
+            assert slow, "threshold 0 must fire on every request"
+            msg = slow[0].getMessage()
+            assert "trace_id=" in msg and "stages_ms=" in msg
+        finally:
+            daemon.registry.config.set("log.slow_query_ms", None)
+
+    def test_slow_query_log_silent_below_threshold(self, daemon, caplog):
+        daemon.registry.config.set("log.slow_query_ms", 60_000.0)
+        try:
+            with caplog.at_level(logging.WARNING, logger="keto_tpu"):
+                client = ReadClient(
+                    open_channel(f"127.0.0.1:{daemon.read_port}")
+                )
+                try:
+                    client.check(RelationTuple.from_string(TUPLE))
+                finally:
+                    client.close()
+            assert not any(
+                r.getMessage().startswith("slow request")
+                for r in caplog.records
+            )
+        finally:
+            daemon.registry.config.set("log.slow_query_ms", None)
+
+
+class TestProfilerEndpoint:
+    def _post(self, daemon, path, body=None):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{daemon.metrics_port}{path}",
+            data=json.dumps(body).encode() if body is not None else b"",
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        return json.load(urllib.request.urlopen(req))
+
+    def test_live_cycle_writes_artifact(self, daemon, tmp_path):
+        out = str(tmp_path / "serve.pstats")
+        started = self._post(
+            daemon, "/admin/profiling", {"mode": "cpu", "path": out}
+        )
+        assert started["running"] is True and started["mode"] == "cpu"
+        # capture real serve work without restarting the daemon
+        client = ReadClient(open_channel(f"127.0.0.1:{daemon.read_port}"))
+        try:
+            client.check(RelationTuple.from_string(TUPLE))
+        finally:
+            client.close()
+        stopped = self._post(daemon, "/admin/profiling/stop")
+        assert stopped["artifact"] == out
+        assert (tmp_path / "serve.pstats").exists()
+        # pstats must actually load (a truncated dump would too-late-fail
+        # the operator)
+        import pstats
+
+        pstats.Stats(out)
+
+    def test_double_stop_is_idempotent(self, daemon):
+        first = self._post(daemon, "/admin/profiling/stop")
+        second = self._post(daemon, "/admin/profiling/stop")
+        assert second == {"running": False, "artifact": None}
+        assert first["running"] is False
+
+    def test_double_start_conflicts(self, daemon, tmp_path):
+        self._post(
+            daemon, "/admin/profiling",
+            {"mode": "mem", "path": str(tmp_path / "m.txt")},
+        )
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                self._post(daemon, "/admin/profiling", {"mode": "cpu"})
+            assert e.value.code == 409
+        finally:
+            self._post(daemon, "/admin/profiling/stop")
+
+    def test_unknown_mode_is_400(self, daemon):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._post(daemon, "/admin/profiling", {"mode": "gpu"})
+        assert e.value.code == 400
+
+    def test_path_escaping_profile_dir_is_400(self, daemon):
+        # the admin endpoint must not be an arbitrary-file-write
+        # primitive: artifact paths are confined to KETO_PROFILE_DIR
+        # (default: the system tempdir)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._post(
+                daemon, "/admin/profiling",
+                {"mode": "cpu", "path": "/etc/keto-pwned"},
+            )
+        assert e.value.code == 400
+        # traversal out of the base dir is caught after normalization
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._post(
+                daemon, "/admin/profiling",
+                {"mode": "cpu", "path": "../../etc/keto-pwned"},
+            )
+        assert e.value.code == 400
+
+    def test_status_reports_idle(self, daemon):
+        status = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{daemon.metrics_port}/admin/profiling"
+        ))
+        assert status["running"] is False
+
+
+class TestTracedManagerCoverage:
+    """Every public store-manager method is either span-traced or
+    explicitly exempted — the PR-2 watch ops bypassed the proxy because
+    nothing enforced the list; this does."""
+
+    def _public_methods(self, cls) -> set:
+        import inspect
+
+        return {
+            name
+            for name, member in inspect.getmembers(
+                cls, predicate=inspect.isfunction
+            )
+            if not name.startswith("_")
+        }
+
+    @pytest.mark.parametrize("cls_path", [
+        ("keto_tpu.storage.memory", "MemoryManager"),
+        ("keto_tpu.storage.sqlite", "SQLPersister"),
+        ("keto_tpu.storage.columnar", "ColumnarStore"),
+    ])
+    def test_every_public_method_covered(self, cls_path):
+        import importlib
+
+        from keto_tpu.observability import TracedManager
+
+        mod, cls_name = cls_path
+        cls = getattr(importlib.import_module(mod), cls_name)
+        covered = set(TracedManager._TRACED) | set(TracedManager._EXEMPT)
+        missing = self._public_methods(cls) - covered
+        assert not missing, (
+            f"{cls_name} public methods neither traced nor exempted: "
+            f"{sorted(missing)} — add to TracedManager._TRACED or "
+            f"_EXEMPT (with the reason)"
+        )
+
+    def test_traced_and_exempt_disjoint(self):
+        from keto_tpu.observability import TracedManager
+
+        both = set(TracedManager._TRACED) & set(TracedManager._EXEMPT)
+        assert not both
+
+    def test_traced_names_exist_somewhere(self):
+        # a stale _TRACED entry (renamed store op) would silently trace
+        # nothing; every name must exist on at least one store class
+        import importlib
+
+        from keto_tpu.observability import TracedManager
+
+        classes = [
+            getattr(importlib.import_module(m), c)
+            for m, c in (
+                ("keto_tpu.storage.memory", "MemoryManager"),
+                ("keto_tpu.storage.sqlite", "SQLPersister"),
+                ("keto_tpu.storage.columnar", "ColumnarStore"),
+            )
+        ]
+        for name in TracedManager._TRACED:
+            assert any(hasattr(cls, name) for cls in classes), (
+                f"_TRACED entry {name!r} matches no store class method"
+            )
+
+    def test_watch_era_ops_are_traced(self):
+        from keto_tpu.observability import RecordingTracer, TracedManager
+        from keto_tpu.storage.memory import MemoryManager
+
+        tracer = RecordingTracer()
+        mgr = TracedManager(MemoryManager(), tracer)
+        mgr.write_relation_tuples([RelationTuple.from_string(TUPLE)])
+        mgr.changes_since(0)
+        mgr.changelog_since(0)
+        names = tracer.span_names()
+        assert "persistence.changes_since" in names
+        assert "persistence.changelog_since" in names
+
+
+class TestMetricsDocsGolden:
+    def test_docs_table_in_sync(self):
+        import os
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "tools", "check_metrics_docs.py")],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Metrics-golden check: every Counter/Gauge/Histogram registered in
+keto_tpu/observability.py must appear in the docs metrics table
+(docs/architecture.md §5d). Run by the CI test job and by
+tests/test_observability.py, so a new metric cannot land undocumented —
+the table is the operator contract for dashboards and alerts.
+
+Exit 1 lists the missing names; documented-but-unregistered names are
+reported too (a stale table misleads the same dashboards).
+
+No imports of keto_tpu: the check is pure source inspection, so it runs
+before deps are installed and cannot be skewed by runtime registration.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+OBSERVABILITY = REPO / "keto_tpu" / "observability.py"
+DOCS = REPO / "docs" / "architecture.md"
+
+# prom.Counter( \n "metric_name"  — the registration shape used in
+# observability.Metrics (name is always the first string literal)
+_REGISTRATION = re.compile(
+    r"prom\.(?:Counter|Gauge|Histogram)\(\s*\"(keto_tpu_[a-z0-9_]+)\"",
+)
+# docs table rows cite metrics as `keto_tpu_...` code spans
+_DOCUMENTED = re.compile(r"`(keto_tpu_[a-z0-9_]+)`")
+
+
+def registered_metrics() -> set[str]:
+    return set(_REGISTRATION.findall(OBSERVABILITY.read_text()))
+
+
+def documented_metrics() -> set[str]:
+    return set(_DOCUMENTED.findall(DOCS.read_text()))
+
+
+def main() -> int:
+    registered = registered_metrics()
+    if not registered:
+        print(f"ERROR: no metric registrations found in {OBSERVABILITY}")
+        return 1
+    documented = documented_metrics()
+    missing = sorted(registered - documented)
+    stale = sorted(documented - registered)
+    rc = 0
+    if missing:
+        rc = 1
+        print(
+            f"ERROR: {len(missing)} metric(s) registered in "
+            f"{OBSERVABILITY.name} but missing from the "
+            f"{DOCS.name} metrics table:"
+        )
+        for name in missing:
+            print(f"  - {name}")
+    if stale:
+        rc = 1
+        print(
+            f"ERROR: {len(stale)} metric name(s) documented in "
+            f"{DOCS.name} but not registered in {OBSERVABILITY.name}:"
+        )
+        for name in stale:
+            print(f"  - {name}")
+    if rc == 0:
+        print(f"ok: {len(registered)} metrics registered and documented")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
