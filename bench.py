@@ -796,7 +796,11 @@ def bench_served(namespaces, tuples, queries) -> dict:
         warm.check(queries[0], timeout=300)
         warm.close()
 
-        def load_phase(n_threads: int, seconds: float) -> dict:
+        def load_phase(n_threads: int, seconds: float, qs=None) -> dict:
+            # `qs` narrows the key set: the repeated-key (hot) leg passes
+            # a handful of queries so the serve-side check cache's hit
+            # path is what gets measured
+            qs = queries if qs is None else qs
             stop_at = time.monotonic() + seconds
             lock = threading.Lock()
             all_lat: list[float] = []
@@ -811,7 +815,7 @@ def bench_served(namespaces, tuples, queries) -> dict:
                 done = 0.0
                 try:
                     while time.monotonic() < stop_at:
-                        q = queries[rng.randrange(len(queries))]
+                        q = qs[rng.randrange(len(qs))]
                         s = time.perf_counter()
                         try:
                             client.check(q, timeout=30)
@@ -927,6 +931,24 @@ def bench_served(namespaces, tuples, queries) -> dict:
         # phase at full closed-loop concurrency
         low = load_phase(8, SERVE_SECONDS / 2)
         high = load_phase(SERVE_THREADS, SERVE_SECONDS)
+        # repeated-key (hot) phase: a handful of keys hammered by every
+        # client — the serve-side check cache's operating point (Zanzibar
+        # §3 hot spots). cache_hit_ratio is measured over exactly this
+        # window so the cold phases don't dilute it.
+        cache = daemon.registry.check_cache()
+        cache_before = cache.stats() if cache is not None else None
+        hot = load_phase(SERVE_THREADS, SERVE_SECONDS / 2, qs=queries[:4])
+        hot_hit_ratio = None
+        if cache_before is not None:
+            after = cache.stats()
+            hits = after["hit"] - cache_before["hit"]
+            lookups = (
+                hits
+                + after["miss"] - cache_before["miss"]
+                + after["stale"] - cache_before["stale"]
+            )
+            if lookups:
+                hot_hit_ratio = round(hits / lookups, 4)
         # batch-RPC phase: warm the batch bucket first
         engine.check_batch(queries[:SERVE_BATCH_SIZE])
         batch_phase = batch_load_phase(
@@ -969,15 +991,24 @@ def bench_served(namespaces, tuples, queries) -> dict:
         out["served_c8_errors"] = low["errors"]
     if "error" in high:
         out["served_error"] = high["error"]
-        return out
-    out.update({
-        "served_qps": high["qps"],
-        "served_clients": SERVE_THREADS,
-        "served_p50_ms": high["p50_ms"],
-        "served_p95_ms": high["p95_ms"],
-        "served_p99_ms": high["p99_ms"],
-        "served_errors": high["errors"],
-    })
+    else:
+        out.update({
+            "served_qps": high["qps"],
+            "served_clients": SERVE_THREADS,
+            "served_p50_ms": high["p50_ms"],
+            "served_p95_ms": high["p95_ms"],
+            "served_p99_ms": high["p99_ms"],
+            "served_errors": high["errors"],
+        })
+    # repeated-key leg: the check-cache hit path under load
+    if "error" in hot:
+        out["served_hot_error"] = hot["error"]
+    else:
+        out["served_hot_qps"] = hot["qps"]
+        out["served_hot_p95_ms"] = hot["p95_ms"]
+        out["served_hot_errors"] = hot["errors"]
+    if hot_hit_ratio is not None:
+        out["cache_hit_ratio"] = hot_hit_ratio
     if "error" in batch_phase:
         out["served_batch_error"] = batch_phase["error"]
     else:
@@ -995,11 +1026,21 @@ def bench_served(namespaces, tuples, queries) -> dict:
         else:
             out["served_aio_qps"] = aio["qps"]
             out["served_aio_p95_ms"] = aio["p95_ms"]
+    # the echo ceiling runs even when a served phase wedged: every leg
+    # that DID complete gets its served_vs_echo_ceiling ratio (before
+    # PR 4 only the full-concurrency leg of an all-green run carried it)
     out.update(bench_grpc_echo_ceiling())
-    if out.get("echo_ceiling_qps"):
-        out["served_vs_echo_ceiling"] = round(
-            out["served_qps"] / out["echo_ceiling_qps"], 3
-        )
+    ceiling = out.get("echo_ceiling_qps")
+    if ceiling:
+        for leg, ratio_key in (
+            ("served_qps", "served_vs_echo_ceiling"),
+            ("served_c8_qps", "served_c8_vs_echo_ceiling"),
+            ("served_hot_qps", "served_hot_vs_echo_ceiling"),
+            ("served_aio_qps", "served_aio_vs_echo_ceiling"),
+            ("served_batch_qps", "served_batch_vs_echo_ceiling"),
+        ):
+            if out.get(leg):
+                out[ratio_key] = round(out[leg] / ceiling, 3)
     return out
 
 

@@ -6,9 +6,10 @@ daemon.go; proto package ory.keto.relation_tuples.v1alpha2.
 """
 
 from .batcher import CheckBatcher
+from .check_cache import CheckCache
 from .client import ReadClient, WatchStreamEvent, WriteClient, open_channel
 
 __all__ = [
-    "CheckBatcher", "ReadClient", "WatchStreamEvent", "WriteClient",
-    "open_channel",
+    "CheckBatcher", "CheckCache", "ReadClient", "WatchStreamEvent",
+    "WriteClient", "open_channel",
 ]

@@ -38,7 +38,12 @@ from concurrent.futures import ThreadPoolExecutor
 import grpc
 import grpc.aio
 
-from .batcher import note_queue_wait, submit_takes_telemetry
+from .batcher import (
+    coalesce_pending,
+    note_queue_wait,
+    resolve_max_inflight,
+    submit_takes_telemetry,
+)
 from .descriptors import CHECK_SERVICE, pb
 from .grpc_server import _grpc_code, _Services
 from ..errors import KetoError
@@ -63,6 +68,7 @@ class AioCheckBatcher:
         pipeline_depth: int = 4,
         metrics=None,
         tracer=None,
+        max_inflight: int | None = None,
     ):
         self._resolve_engine = engine_resolver
         self.max_batch = max_batch
@@ -70,12 +76,14 @@ class AioCheckBatcher:
         self._queue: asyncio.Queue = asyncio.Queue()
         # device dispatch is blocking (jax launch + readback): a small
         # executor keeps it off the loop; in-flight launches are bounded
-        # (wedge discipline, see api/batcher.py)
+        # (wedge discipline, see api/batcher.py; config:
+        # serve.check.max_inflight)
         self._executor = ThreadPoolExecutor(
             max_workers=max(pipeline_depth, 2),
             thread_name_prefix="keto-aio-dispatch",
         )
-        self._inflight = asyncio.Semaphore(max(2 * pipeline_depth, 4))
+        self.max_inflight = resolve_max_inflight(max_inflight, pipeline_depth)
+        self._inflight = asyncio.Semaphore(self.max_inflight)
         self._collector: asyncio.Task | None = None
         self._closed = False
         # observability: queue-wait attribution + gauges, mirroring the
@@ -100,6 +108,12 @@ class AioCheckBatcher:
         self._executor.shutdown(wait=True)
 
     async def check(self, tuple, max_depth: int = 0, nid=None, rt=None):
+        res, _ = await self.check_versioned(tuple, max_depth, nid=nid, rt=rt)
+        return res
+
+    async def check_versioned(self, tuple, max_depth: int = 0, nid=None, rt=None):
+        """(CheckResult, version | None) — same contract as the threaded
+        CheckBatcher.check_versioned (the check cache's store input)."""
         if self._closed:
             raise RuntimeError("AioCheckBatcher is closed")
         fut = asyncio.get_running_loop().create_future()
@@ -132,16 +146,17 @@ class AioCheckBatcher:
             batch.append(item)
         return batch
 
-    def _submit_fn(self, engine, submit, group, depth):
-        """Bind the submit call, passing per-request telemetry when the
-        engine's signature takes it (stubbed engines keep working;
-        detection shared with the threaded batcher)."""
-        tuples = [p[0] for p in group]
+    def _submit_fn(self, engine, submit, slots, depth):
+        """Bind the submit call for the coalesced slots, passing
+        per-request telemetry when the engine's signature takes it
+        (stubbed engines keep working; detection shared with the
+        threaded batcher). Each slot's leader carries the telemetry."""
+        tuples = [s[0][0] for s in slots]
         if submit_takes_telemetry(
             self._submit_takes_telemetry, engine, submit
         ):
             return functools.partial(
-                submit, tuples, depth, telemetry=[p[4] for p in group]
+                submit, tuples, depth, telemetry=[s[0][4] for s in slots]
             )
         return functools.partial(submit, tuples, depth)
 
@@ -160,6 +175,11 @@ class AioCheckBatcher:
                     ((p[4], p[5]) for p in group), self._queue.qsize(),
                     self.metrics, self.tracer, self._depth_gauge,
                 )
+                # singleflight: identical pendings share one batch slot
+                # (shared with the threaded batcher)
+                slots = coalesce_pending(
+                    group, lambda p: p[0], self.metrics
+                )
                 await self._inflight.acquire()
                 if self.metrics is not None:
                     self.metrics.inflight_launches.inc()
@@ -171,12 +191,12 @@ class AioCheckBatcher:
                         # evaluate the whole batch on the executor (same
                         # contract as the threaded batcher's _evaluate)
                         loop.create_task(
-                            self._evaluate(engine, group, depth)
+                            self._evaluate(engine, slots, depth)
                         )
                         continue
                     handle = await loop.run_in_executor(
                         self._executor,
-                        self._submit_fn(engine, submit, group, depth),
+                        self._submit_fn(engine, submit, slots, depth),
                     )
                 except Exception as e:
                     self._release_inflight()
@@ -186,49 +206,65 @@ class AioCheckBatcher:
                     continue
                 # resolve concurrently: the collector goes back to
                 # draining while the device round-trip completes
-                loop.create_task(self._finish(engine, handle, group))
+                loop.create_task(self._finish(engine, handle, slots))
 
     def _release_inflight(self) -> None:
         self._inflight.release()
         if self.metrics is not None:
             self.metrics.inflight_launches.dec()
 
-    async def _evaluate(self, engine, group, depth) -> None:
+    async def _evaluate(self, engine, slots, depth) -> None:
         loop = asyncio.get_running_loop()
         try:
             results = await loop.run_in_executor(
                 self._executor,
                 engine.check_batch,
-                [p[0] for p in group],
+                [s[0][0] for s in slots],
                 depth,
             )
         except Exception as e:
-            for p in group:
-                if not p[3].done():
-                    p[3].set_exception(e)
+            for slot in slots:
+                for p in slot:
+                    if not p[3].done():
+                        p[3].set_exception(e)
             return
         finally:
             self._release_inflight()
-        for p, res in zip(group, results):
-            if not p[3].done():
-                p[3].set_result(res)
+        for slot, res in zip(slots, results):
+            for p in slot:
+                if not p[3].done():
+                    p[3].set_result((res, None))
 
-    async def _finish(self, engine, handle, group) -> None:
+    async def _finish(self, engine, handle, slots) -> None:
         loop = asyncio.get_running_loop()
         try:
-            results = await loop.run_in_executor(
-                self._executor, engine.check_batch_resolve, handle
-            )
+            # version plumb-through (check_batch_resolve_v): pins each
+            # answer to its evaluated state's covered store version —
+            # the check cache's store contract
+            resolve_v = getattr(engine, "check_batch_resolve_v", None)
+            if resolve_v is not None:
+                results, versions = await loop.run_in_executor(
+                    self._executor, resolve_v, handle
+                )
+            else:
+                results = await loop.run_in_executor(
+                    self._executor, engine.check_batch_resolve, handle
+                )
+                versions = [None] * len(results)
         except Exception as e:
-            for p in group:
-                if not p[3].done():
-                    p[3].set_exception(e)
+            for slot in slots:
+                for p in slot:
+                    if not p[3].done():
+                        p[3].set_exception(e)
             return
         finally:
             self._release_inflight()
-        for p, res in zip(group, results):
-            if not p[3].done():
-                p[3].set_result(res)
+        for slot, res, ver in zip(slots, results, versions):
+            # singleflight fan-out: every coalesced rider gets the
+            # slot's result
+            for p in slot:
+                if not p[3].done():
+                    p[3].set_result((res, ver))
 
 
 class _AioReadServices:
@@ -296,8 +332,16 @@ class _AioReadServices:
             # reads — fine in-loop (no device or SQL round-trip on the
             # memory manager; sqlite's counter SELECT is ~10 us)
             version = self._svc._enforce_snaptoken(req.snaptoken, nid)
-            res = await self._batcher.check(
-                t, int(req.max_depth), nid=nid, rt=current_request_trace()
+            max_depth = int(req.max_depth)
+            # serve fast path (api/check_cache.py): a hit answers
+            # in-loop before the batcher — no executor hop, no
+            # assemble/dispatch/device stages; the lookup is one lock +
+            # two dict ops, loop-safe like the version read above
+            from .check_cache import cached_check_async
+
+            res = await cached_check_async(
+                self._svc.registry, self._batcher, nid, t, max_depth,
+                version, current_request_trace(),
             )
             if res.error is not None:
                 raise res.error
@@ -545,6 +589,7 @@ class AioReadServer:
             window_s=self._window_s,
             metrics=self.registry.metrics(),
             tracer=self.registry.tracer(),
+            max_inflight=self.registry.config.get("serve.check.max_inflight"),
         )
         self.batcher.start()
         self._services = _AioReadServices(services, self.batcher)

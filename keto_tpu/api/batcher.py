@@ -12,6 +12,11 @@ Under no concurrency a request pays ~0 extra latency (the collector pops
 it immediately and the window only applies while topping up an in-flight
 batch); under load, batches approach `max_batch` and throughput rides the
 kernel's batch curve instead of thread count.
+
+Concurrent IDENTICAL checks additionally collapse onto one batch slot
+(singleflight — Zanzibar's hot-spot lock table, paper §3) and the slot's
+result fans back out to every rider, so a hot key costs one device slot
+per batch no matter how many clients hammer it.
 """
 
 from __future__ import annotations
@@ -46,6 +51,30 @@ def note_queue_wait(riders, queue_size: int, metrics, tracer, depth_gauge) -> No
     if metrics is not None and n:
         metrics.observe_stage("queue", total / n)
         depth_gauge.set(queue_size)
+
+
+def resolve_max_inflight(max_inflight, pipeline_depth: int) -> int:
+    """One formula for both batching planes: the configured
+    serve.check.max_inflight, or 2x pipeline depth (min 4)."""
+    return int(max_inflight) if max_inflight else max(2 * pipeline_depth, 4)
+
+
+def coalesce_pending(group, key_fn, metrics):
+    """Singleflight dedupe (Zanzibar's hot-spot lock table, paper §3):
+    concurrent identical pending checks collapse onto ONE batch slot and
+    the result fans back out to every rider. Shared by BOTH batching
+    planes; `group` is one (depth, nid) dispatch group, `key_fn` maps a
+    pending to its identity (the RelationTuple — depth/nid are already
+    the group key). Returns a list of slots (lists of pendings, leader
+    first) in arrival order."""
+    slots: dict = {}
+    for p in group:
+        slots.setdefault(key_fn(p), []).append(p)
+    out = list(slots.values())
+    coalesced = len(group) - len(out)
+    if coalesced and metrics is not None:
+        metrics.check_coalesced_total.inc(coalesced)
+    return out
 
 
 def submit_takes_telemetry(cache: dict, engine, submit) -> bool:
@@ -84,6 +113,7 @@ class CheckBatcher:
         engine_resolver=None,
         metrics=None,
         tracer=None,
+        max_inflight: int | None = None,
     ):
         # per-request tenancy: batches are grouped by nid and dispatched
         # to that tenant's engine (ref: ketoctx Contextualizer,
@@ -115,8 +145,10 @@ class CheckBatcher:
         )
         # backpressure: at most max_inflight launched-but-unresolved
         # device batches (an unbounded launch queue can wedge the TPU
-        # tunnel and holds a full engine state per handle)
-        self.max_inflight = max(2 * pipeline_depth, 4)
+        # tunnel and holds a full engine state per handle); operators
+        # tune it via serve.check.max_inflight (schema-validated),
+        # default 2x pipeline depth
+        self.max_inflight = resolve_max_inflight(max_inflight, pipeline_depth)
         self._inflight = threading.BoundedSemaphore(self.max_inflight)
         # observability (both optional): queue-depth/inflight gauges,
         # per-request queue-wait stage attribution, batcher.queue spans
@@ -140,6 +172,14 @@ class CheckBatcher:
         caller's RequestTrace: the batcher adds the queue-wait stage and
         the engine adds its stages, so the transport that created it can
         log/span the full pipeline breakdown."""
+        return self.check_versioned(tuple, max_depth, nid=nid, rt=rt)[0]
+
+    def check_versioned(self, tuple, max_depth: int = 0, nid=None, rt=None):
+        """(CheckResult, version | None): the version is the store
+        version the answer is authoritative at (the evaluated engine
+        state's covered_version, plumbed through check_batch_resolve_v)
+        or None when the evaluation path cannot pin one (host engine,
+        host-replayed rider) — the check cache's store contract."""
         if self._closed:
             raise RuntimeError("CheckBatcher is closed")
         p = _Pending(tuple, max_depth, nid, rt, time.perf_counter())
@@ -186,28 +226,42 @@ class CheckBatcher:
             batch.append(item)
         return batch
 
-    def _evaluate(self, group: list[_Pending], depth: int, nid=None) -> None:
+    def _evaluate(self, slots: list[list[_Pending]], depth: int, nid=None) -> None:
         try:
             engine = self._resolve(nid)
-            results = engine.check_batch([p.tuple for p in group], depth)
+            results = engine.check_batch([s[0].tuple for s in slots], depth)
         except Exception as e:  # engine-level failure fails the batch
-            for p in group:
-                p.future.set_exception(e)
+            for slot in slots:
+                for p in slot:
+                    p.future.set_exception(e)
             return
-        for p, res in zip(group, results):
-            p.future.set_result(res)
+        for slot, res in zip(slots, results):
+            for p in slot:
+                p.future.set_result((res, None))
 
-    def _resolve_inflight(self, engine, handle, group: list[_Pending]) -> None:
+    def _resolve_inflight(self, engine, handle, slots: list[list[_Pending]]) -> None:
         try:
-            results = engine.check_batch_resolve(handle)
+            # version plumb-through: engines exposing the versioned
+            # resolve surface pin each answer to the store version its
+            # evaluated state covered (the check cache's store contract)
+            resolve_v = getattr(engine, "check_batch_resolve_v", None)
+            if resolve_v is not None:
+                results, versions = resolve_v(handle)
+            else:
+                results = engine.check_batch_resolve(handle)
+                versions = [None] * len(results)
         except Exception as e:
-            for p in group:
-                p.future.set_exception(e)
+            for slot in slots:
+                for p in slot:
+                    p.future.set_exception(e)
             return
         finally:
             self._release_inflight()
-        for p, res in zip(group, results):
-            p.future.set_result(res)
+        for slot, res, ver in zip(slots, results, versions):
+            # singleflight fan-out: every coalesced rider gets the slot's
+            # result (CheckResults are shared immutable singletons)
+            for p in slot:
+                p.future.set_result((res, ver))
 
     def _acquire_inflight(self) -> None:
         self._inflight.acquire()
@@ -231,6 +285,10 @@ class CheckBatcher:
             ((p.rt, p.enq_t) for p in group), self._queue.qsize(),
             self.metrics, self.tracer, self._depth_gauge,
         )
+        # singleflight: identical pendings share one batch slot; engine
+        # stage telemetry is attributed to each slot's leader (followers
+        # keep their queue/transport stages)
+        slots = coalesce_pending(group, lambda p: p.tuple, self.metrics)
         try:
             engine = self._resolve(nid)
         except Exception as e:
@@ -239,7 +297,7 @@ class CheckBatcher:
             return
         submit = getattr(engine, "check_batch_submit", None)
         if submit is None:
-            self._pool.submit(self._evaluate, group, depth, nid)
+            self._pool.submit(self._evaluate, slots, depth, nid)
             return
         self._acquire_inflight()
         try:
@@ -247,17 +305,17 @@ class CheckBatcher:
                 self._submit_takes_telemetry, engine, submit
             ):
                 handle = submit(
-                    [p.tuple for p in group], depth,
-                    telemetry=[p.rt for p in group],
+                    [s[0].tuple for s in slots], depth,
+                    telemetry=[s[0].rt for s in slots],
                 )
             else:
-                handle = submit([p.tuple for p in group], depth)
+                handle = submit([s[0].tuple for s in slots], depth)
         except Exception as e:
             self._release_inflight()
             for p in group:
                 p.future.set_exception(e)
             return
-        self._pool.submit(self._resolve_inflight, engine, handle, group)
+        self._pool.submit(self._resolve_inflight, engine, handle, slots)
 
     def _run(self) -> None:
         while True:

@@ -1,0 +1,462 @@
+"""Snaptoken-consistent serve-side check cache.
+
+Zanzibar attributes its production latency profile to a result/subproblem
+cache keyed by evaluation snapshot plus a "lock table" coalescing
+concurrent identical checks (paper §3); the reference never shipped
+either. This module is the result-cache half (the lock table is the
+singleflight dedupe in api/batcher.py): positive AND negative Check
+verdicts cached at the store version they were computed at, served by the
+transports BEFORE the batcher so a hit skips assemble/dispatch/device
+entirely.
+
+Correctness contract — a hit is *provably* as fresh as an uncached ride
+at the same snaptoken:
+
+  - Every entry records the store version its answer is authoritative
+    at. Device-path answers carry the evaluated engine state's
+    `covered_version` (plumbed through `check_batch_resolve_v`); answers
+    without a plumbed version (host engine, host-replayed riders) are
+    stored only when a re-read of the store version equals the
+    enforce-time version — i.e. no write raced the evaluation, so the
+    answer is exactly the enforce-version answer.
+  - A lookup provides the request's enforce-time store version (the
+    value the response snaptoken is minted from — the transports already
+    read it per request in `enforce_snaptoken`). A hit requires
+    `entry.version == version`: the served bytes, snaptoken included,
+    are identical to what a cache-miss evaluation at that version
+    returns. No time-travel, no stale reads — any write bumps the store
+    version and version-mismatched entries stop hitting at once, with
+    no dependence on invalidation delivery latency.
+  - A namespace-config change alters answers WITHOUT a store-version
+    bump, so entries are additionally gated on the namespace manager's
+    `config_generation` (bumped on set/hot-reload); a generation change
+    flushes the cache.
+
+Invalidation (hygiene + memory, never load-bearing for correctness):
+WatchHub commit events poke `notify_commit(nid)`; a background thread
+reads the store changelog since the last pass and precisely deletes the
+entries a changed tuple can directly flip — the entry for the changed
+node row (namespace, object, relation) and every entry whose subject
+matches the changed tuple's subject, the same two key families the delta
+overlay's reverse-dirty (rd_*) table tracks for the reverse kernel.
+Entries invalidated only transitively (an interior edge two hops up) are
+not enumerable without a reverse closure; they die to the version gate
+and age out of the LRU.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+DEFAULT_MAX_ENTRIES = 65536
+
+
+def _fastpath_begin(cache, nid, t, max_depth, version, rt):
+    """Shared pre-evaluation half of the serve fast path: (cached
+    result | None, captured config generation | None). The generation
+    is captured BEFORE evaluating a miss — like the enforce-time store
+    version, it pins what the verdict was computed under; a hot-reload
+    racing the evaluation then makes store() skip instead of caching an
+    old-config answer under the new generation."""
+    if cache is None:
+        return None, None
+    res = cache.lookup(nid, t, max_depth, version, rt=rt)
+    if res is not None:
+        return res, None
+    return None, cache.generation()
+
+
+def cached_check(registry, batcher, nid, t, max_depth, version, rt):
+    """The transports' shared serve fast path: consult the cache, ride
+    the batcher (or the bare engine) on a miss, store the verdict.
+    Returns the CheckResult (error still attached — the transport maps
+    it). REST and sync-gRPC call this; the aio plane awaits
+    cached_check_async — both halves of the gate live here."""
+    cache = registry.check_cache()
+    res, gen = _fastpath_begin(cache, nid, t, max_depth, version, rt)
+    if res is not None:
+        return res
+    if batcher is not None:
+        res, computed_v = batcher.check_versioned(t, max_depth, nid=nid, rt=rt)
+    else:
+        res = registry.check_engine(nid).check_relation_tuple(t, max_depth)
+        computed_v = None
+    if cache is not None:
+        cache.store(nid, t, max_depth, res, computed_v, version, gen=gen)
+    return res
+
+
+async def cached_check_async(registry, batcher, nid, t, max_depth, version, rt):
+    """cached_check's aio twin (the batcher call is awaited; everything
+    else is the same gate, shared via _fastpath_begin/store)."""
+    cache = registry.check_cache()
+    res, gen = _fastpath_begin(cache, nid, t, max_depth, version, rt)
+    if res is not None:
+        return res
+    res, computed_v = await batcher.check_versioned(t, max_depth, nid=nid, rt=rt)
+    if cache is not None:
+        cache.store(nid, t, max_depth, res, computed_v, version, gen=gen)
+    return res
+
+
+class _Entry:
+    __slots__ = ("result", "version", "expires")
+
+    def __init__(self, result, version: int, expires: float):
+        self.result = result
+        self.version = version
+        self.expires = expires
+
+
+def _key_for(nid: str, t, max_depth: int) -> tuple:
+    # field-structured (not the display string, which is not injective);
+    # same shape as the engine's host-replay memo key
+    return (
+        nid, t.namespace, t.object, t.relation,
+        t.subject_id, t.subject_set, max_depth,
+    )
+
+
+class CheckCache:
+    """Versioned (nid, object, relation, subject, max_depth) -> verdict
+    LRU with precise commit-driven invalidation. Thread-safe; the hot
+    path is one lock + two dict operations."""
+
+    def __init__(
+        self,
+        manager,
+        config,
+        max_entries: int = DEFAULT_MAX_ENTRIES,
+        ttl_s: float = 0.0,
+        metrics=None,
+    ):
+        self._manager = manager
+        self._config = config
+        self.max_entries = max(int(max_entries), 1)
+        self.ttl_s = float(ttl_s or 0.0)
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, _Entry]" = OrderedDict()
+        # precise-invalidation indexes: the two key families a changed
+        # tuple can directly flip (the rd_* derivation in engine/delta.py)
+        self._by_node: dict[tuple, set] = {}
+        self._by_subject: dict[tuple, set] = {}
+        self._by_nid: dict[str, set] = {}
+        self._cfg_gen = None
+        # invalidation plane (lazy thread, engine push-refresh pattern)
+        self._inval_mu = threading.Lock()
+        self._inval_event: Optional[threading.Event] = None
+        self._inval_versions: dict[str, int] = {}
+        self._pending_nids: set[str] = set()
+        self._closed = False
+        # local mirrors of the metric counters (bench/tools read these
+        # without scraping; also keeps the module usable metrics-less)
+        self.counts = {"hit": 0, "miss": 0, "stale": 0, "invalidation": 0}
+        if metrics is not None:
+            ops = metrics.check_cache_ops
+            self._c = {op: ops.labels(op) for op in self.counts}
+            self._entries_gauge = metrics.check_cache_entries
+        else:
+            self._c = None
+            self._entries_gauge = None
+
+    # -- bookkeeping -----------------------------------------------------------
+
+    def _count(self, op: str, n: int = 1) -> None:
+        self.counts[op] += n
+        if self._c is not None:
+            self._c[op].inc(n)
+
+    def _set_gauge_locked(self) -> None:
+        if self._entries_gauge is not None:
+            self._entries_gauge.set(len(self._entries))
+
+    def _generation(self):
+        nm = self._config.namespace_manager()
+        gen = getattr(nm, "config_generation", None)
+        return gen if gen is not None else id(nm)
+
+    def generation(self):
+        """The current namespace-config generation token — capture it
+        BEFORE evaluating a miss and hand it to store(), so a config
+        hot-reload racing the evaluation cannot cache an old-config
+        verdict under the new generation."""
+        return self._generation()
+
+    def _check_generation_locked(self, gen) -> None:
+        if gen != self._cfg_gen:
+            self._entries.clear()
+            self._by_node.clear()
+            self._by_subject.clear()
+            self._by_nid.clear()
+            self._cfg_gen = gen
+
+    # -- hot path --------------------------------------------------------------
+
+    def lookup(self, nid: str, t, max_depth: int, version: int, rt=None):
+        """The fast-path probe: the cached CheckResult iff an entry for
+        this exact query is authoritative at exactly `version` (the
+        request's enforce-time store version — the value its response
+        snaptoken is minted from). On a hit the lookup duration lands on
+        the request's trace as the `cache` stage; a hit request records
+        NO assemble/dispatch/device_wait time because those stages never
+        run."""
+        t0 = time.perf_counter()
+        key = _key_for(nid, t, max_depth)
+        gen = self._generation()
+        with self._lock:
+            self._check_generation_locked(gen)
+            e = self._entries.get(key)
+            if e is not None and self.ttl_s and time.monotonic() > e.expires:
+                self._drop_locked(key)
+                self._set_gauge_locked()
+                e = None
+            if e is None:
+                self._count("miss")
+                return None
+            if e.version != version:
+                if e.version < version:
+                    # provably dead: the store moved past it
+                    self._drop_locked(key)
+                    self._set_gauge_locked()
+                    self._count("stale")
+                else:
+                    # entry NEWER than the request's enforce version (a
+                    # write + re-store raced this lookup): not stale by
+                    # the metric's definition — there is simply no entry
+                    # at the demanded version
+                    self._count("miss")
+                return None
+            self._entries.move_to_end(key)
+            # counted under the lock: self.counts is a plain dict and
+            # concurrent hot-key hits would lose increments otherwise
+            self._count("hit")
+        dur = time.perf_counter() - t0
+        if rt is not None:
+            rt.add_stage("cache", dur)
+        if self.metrics is not None:
+            self.metrics.observe_stage("cache", dur)
+        return e.result
+
+    def store(
+        self,
+        nid: str,
+        t,
+        max_depth: int,
+        result,
+        computed_version: Optional[int],
+        enforce_version: int,
+        gen=None,
+    ) -> None:
+        """Record one evaluated verdict. `computed_version` is the store
+        version the engine pinned the answer to (state.covered_version,
+        via check_batch_resolve_v) or None when the evaluation path
+        cannot pin one (host engine, host-replayed rider): then the
+        answer is cacheable only if the store version has not moved
+        since enforce time — one re-read decides, and a raced write
+        simply skips the store (the next identical miss re-populates).
+        `gen` is the config generation captured BEFORE evaluation
+        (generation()); a mismatch with the current generation means a
+        namespace hot-reload raced the evaluation, so the verdict —
+        computed under the OLD config — must not enter the flushed
+        cache."""
+        if result is None or getattr(result, "error", None) is not None:
+            return
+        version = computed_version
+        if version is None:
+            if self._manager.version(nid=nid) != enforce_version:
+                return
+            version = enforce_version
+        key = _key_for(nid, t, max_depth)
+        current_gen = self._generation()
+        if gen is not None and gen != current_gen:
+            return
+        gen = current_gen
+        expires = time.monotonic() + self.ttl_s if self.ttl_s else 0.0
+        node_k = (nid, t.namespace, t.object, t.relation)
+        subj_k = (nid, t.subject_id, t.subject_set)
+        with self._lock:
+            self._check_generation_locked(gen)
+            old = self._entries.get(key)
+            if old is not None:
+                if old.version > version:
+                    return  # never downgrade a fresher entry
+                if old.version == version:
+                    # singleflight fan-out: every rider re-stores the
+                    # identical verdict — recency bump only, skip the
+                    # redundant index writes
+                    self._entries.move_to_end(key)
+                    return
+            self._entries[key] = _Entry(result, version, expires)
+            self._entries.move_to_end(key)
+            self._by_node.setdefault(node_k, set()).add(key)
+            self._by_subject.setdefault(subj_k, set()).add(key)
+            self._by_nid.setdefault(nid, set()).add(key)
+            while len(self._entries) > self.max_entries:
+                evicted, _ = self._entries.popitem(last=False)
+                self._unindex_locked(evicted)
+            self._set_gauge_locked()
+
+    # -- entry removal (caller holds self._lock) -------------------------------
+
+    def _unindex_locked(self, key: tuple) -> None:
+        nid, ns, obj, rel, sid, sset, _depth = key
+        for index, k in (
+            (self._by_node, (nid, ns, obj, rel)),
+            (self._by_subject, (nid, sid, sset)),
+            (self._by_nid, nid),
+        ):
+            s = index.get(k)
+            if s is not None:
+                s.discard(key)
+                if not s:
+                    del index[k]
+
+    def _drop_locked(self, key: tuple) -> None:
+        if self._entries.pop(key, None) is not None:
+            self._unindex_locked(key)
+
+    # -- invalidation plane ----------------------------------------------------
+
+    def notify_commit(self, nid: str) -> None:
+        """WatchHub commit listener (via the registry): runs on the
+        writer thread, so it only flags the nid and wakes the
+        invalidation thread — bursts of writes coalesce into one pass.
+        Correctness never waits on this: the version gate in lookup()
+        already stopped serving pre-commit entries the moment the store
+        version moved."""
+        if self._closed:
+            return
+        ev = self._inval_event
+        if ev is None:
+            with self._inval_mu:
+                ev = self._inval_event
+                if ev is None:
+                    ev = threading.Event()
+                    thread = threading.Thread(
+                        target=self._invalidate_loop,
+                        args=(ev,),
+                        name="keto-check-cache-invalidate",
+                        daemon=True,
+                    )
+                    self._inval_event = ev
+                    thread.start()
+        with self._inval_mu:
+            self._pending_nids.add(nid)
+        ev.set()
+
+    def _invalidate_loop(self, ev: threading.Event) -> None:
+        while True:
+            ev.wait()
+            if self._closed:
+                return
+            ev.clear()
+            with self._inval_mu:
+                nids, self._pending_nids = self._pending_nids, set()
+            for nid in nids:
+                try:
+                    self._invalidate_nid(nid)
+                except Exception:  # noqa: BLE001 — hygiene thread must
+                    # never die; the version gate carries correctness
+                    import logging
+
+                    logging.getLogger("keto_tpu").debug(
+                        "check-cache invalidation pass failed", exc_info=True
+                    )
+
+    # drop batch size per lock hold: invalidation passes must not stall
+    # concurrent lookups (the aio plane runs lookup in-loop) for the
+    # length of a 65536-entry sweep
+    _DROP_CHUNK = 256
+
+    def _drop_chunked(self, keys, keep=None) -> int:
+        """Drop `keys` in small locked chunks so hot-path lookups
+        interleave with a long invalidation sweep; `keep(entry)` retains
+        matching entries. Returns the number removed."""
+        removed = 0
+        keys = list(keys)
+        for i in range(0, len(keys), self._DROP_CHUNK):
+            with self._lock:
+                for key in keys[i : i + self._DROP_CHUNK]:
+                    e = self._entries.get(key)
+                    if e is None or (keep is not None and keep(e)):
+                        continue
+                    self._drop_locked(key)
+                    removed += 1
+                self._set_gauge_locked()
+        return removed
+
+    def _invalidate_nid(self, nid: str) -> None:
+        since = self._inval_versions.get(nid)
+        current = self._manager.version(nid=nid)
+        removed = 0
+        if since is None:
+            # first pass for this nid: no changelog floor yet — sweep
+            # entries the store has provably moved past
+            with self._lock:
+                keys = list(self._by_nid.get(nid, ()))
+            removed = self._drop_chunked(
+                keys, keep=lambda e: e.version >= current
+            )
+        else:
+            changelog = getattr(self._manager, "changelog_since", None)
+            ops = changelog(since, nid=nid) if changelog is not None else None
+            if ops is None:
+                # unreachable gap (trimmed log / bulk load): conservative
+                # whole-nid drop
+                with self._lock:
+                    keys = list(self._by_nid.get(nid, ()))
+                removed = self._drop_chunked(keys)
+            else:
+                # precise pass: collect the directly-flippable keys (the
+                # rd_* families) under short lock holds — the ops list
+                # can be a whole migration's worth, so the scan is
+                # chunked like the drops
+                doomed: set = set()
+                ops = list(ops)
+                for i in range(0, len(ops), self._DROP_CHUNK):
+                    with self._lock:
+                        for _v, _op, t in ops[i : i + self._DROP_CHUNK]:
+                            doomed.update(
+                                self._by_node.get(
+                                    (nid, t.namespace, t.object, t.relation),
+                                    (),
+                                )
+                            )
+                            doomed.update(
+                                self._by_subject.get(
+                                    (nid, t.subject_id, t.subject_set), ()
+                                )
+                            )
+                removed = self._drop_chunked(doomed)
+        self._inval_versions[nid] = current
+        if removed:
+            with self._lock:
+                self._count("invalidation", removed)
+
+    # -- lifecycle / introspection ---------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self.counts)
+            out["entries"] = len(self._entries)
+        total = out["hit"] + out["miss"] + out["stale"]
+        out["hit_ratio"] = round(out["hit"] / total, 4) if total else 0.0
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._by_node.clear()
+            self._by_subject.clear()
+            self._by_nid.clear()
+            self._set_gauge_locked()
+
+    def close(self) -> None:
+        self._closed = True
+        ev = self._inval_event
+        if ev is not None:
+            ev.set()

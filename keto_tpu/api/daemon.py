@@ -219,6 +219,7 @@ class Daemon:
             window_s=float(cfg.get("check.batch_window_ms", 2.0)) / 1e3,
             metrics=registry.metrics(),
             tracer=registry.tracer(),
+            max_inflight=cfg.get("serve.check.max_inflight"),
         )
         self._grpc_read = None
         self._grpc_write = None
@@ -373,6 +374,9 @@ class Daemon:
         for s in self._rest.values():
             s.stop()
         self.batcher.close()
+        # end the check cache's invalidation thread (daemon thread, but
+        # a clean stop keeps test teardowns quiet)
+        self.registry.close_check_cache()
         # persist any pending device-mirror checkpoints (default network
         # AND all tenant engines) before exiting so the next start
         # warm-restarts from the latest compaction

@@ -207,14 +207,17 @@ class _Services:
         self.registry.validate_namespaces(t)
         nid = self._nid(context)
         version = self._enforce_snaptoken(req.snaptoken, nid)
-        if self.batcher is not None:
-            res = self.batcher.check(
-                t, int(req.max_depth), nid=nid, rt=current_request_trace()
-            )
-        else:
-            res = self.registry.check_engine(nid).check_relation_tuple(
-                t, int(req.max_depth)
-            )
+        max_depth = int(req.max_depth)
+        # serve fast path (api/check_cache.py): a hit returns before the
+        # batcher — no assemble/dispatch/device stages run, and the
+        # response (snaptoken included) is byte-identical to a miss at
+        # the same store version
+        from .check_cache import cached_check
+
+        res = cached_check(
+            self.registry, self.batcher, nid, t, max_depth, version,
+            current_request_trace(),
+        )
         if res.error is not None:
             raise res.error
         return pb.CheckResponse(

@@ -415,12 +415,16 @@ class _Handler(BaseHTTPRequestHandler):
             code = 403 if mirror_status else 200
             self._json(code, {"allowed": False}, extra_headers=token_hdr)
             return
-        if self.batcher is not None:
-            res = self.batcher.check(
-                t, max_depth, nid=nid, rt=getattr(self, "_rt", None)
-            )
-        else:
-            res = self.registry.check_engine(nid).check_relation_tuple(t, max_depth)
+        # serve fast path (api/check_cache.py): a hit returns before the
+        # batcher — no assemble/dispatch/device stages run, and the
+        # response (snaptoken included) is byte-identical to a miss at
+        # the same store version
+        from .check_cache import cached_check
+
+        res = cached_check(
+            self.registry, self.batcher, nid, t, max_depth, version,
+            getattr(self, "_rt", None),
+        )
         if res.error is not None:
             raise res.error
         code = 403 if (mirror_status and not res.allowed) else 200

@@ -67,10 +67,13 @@ class NamespaceFileManager:
     ref: internal/driver/config/namespace_watcher.go:118-239"""
 
     def __init__(self, location: str):
+        from .namespace.definitions import next_config_generation
+
         self.location = location.removeprefix("file://")
         self._namespaces: dict[str, Namespace] = {}
         self._mtimes: dict[str, float] = {}
         self.last_error: Optional[Exception] = None
+        self.config_generation = next_config_generation()
         self._load(initial=True)
 
     # -- loading --------------------------------------------------------------
@@ -159,9 +162,15 @@ class NamespaceFileManager:
                 logger.warning("namespace reload failed, keeping previous set: %s", e)
             self.last_error = e
             return
+        from .namespace.definitions import next_config_generation
+
         self._namespaces = new
         self._mtimes = mtimes
         self.last_error = None
+        # a successful (re)load is a new namespace-config generation:
+        # caches keyed on check semantics (api/check_cache.py) flush —
+        # a config change alters answers without a store-version bump
+        self.config_generation = next_config_generation()
 
     def _maybe_reload(self) -> None:
         try:

@@ -1670,11 +1670,28 @@ class TPUCheckEngine:
     def check_batch_resolve(self, handle) -> list[CheckResult]:
         """Synchronize one in-flight batch and produce its CheckResults
         (device readback + island combine + exact host replays)."""
+        return self.check_batch_resolve_v(handle)[0]
+
+    def check_batch_resolve_v(self, handle):
+        """check_batch_resolve with version plumb-through: returns
+        (results, versions) where versions[i] is the store version the
+        answer is authoritative at — the evaluated state's
+        covered_version for device-path answers — or None for
+        host-replayed items (the replay reads the LIVE store, so its
+        answer is not pinned to any particular version). The serve-side
+        check cache (api/check_cache.py) stores verdicts at exactly
+        these versions; None falls back to its raced-write re-check."""
         kind, outputs, meta = handle
         if kind == "empty":
-            return []
+            return [], []
         if kind == "multi":
-            return [r for h in outputs for r in self.check_batch_resolve(h)]
+            results: list[CheckResult] = []
+            versions: list = []
+            for h in outputs:
+                r, v = self.check_batch_resolve_v(h)
+                results.extend(r)
+                versions.extend(v)
+            return results, versions
         state = meta["state"]
         tuples = meta["tuples"]
         n, B, max_depth = meta["n"], meta["B"], meta["max_depth"]
@@ -1725,9 +1742,11 @@ class TPUCheckEngine:
                 self.metrics.check_batch_size.observe(n)
                 self.metrics.checks_total.labels("device").inc(n)
             self._finish_check_stages(meta, device_wait_s, 0.0, n, B)
-            return results
+            return results, [state.covered_version] * n
 
         results = []
+        versions: list = []
+        covered = state.covered_version
         n_host = 0
         host_s = 0.0
         host_causes: dict[str, int] = {}
@@ -1744,6 +1763,7 @@ class TPUCheckEngine:
                     results.append(
                         RESULT_IS_MEMBER if member[i] else RESULT_NOT_MEMBER
                     )
+                    versions.append(covered)
                 else:
                     n_host += 1
                     # cause bookkeeping: the kernel reports a CAUSE_* code
@@ -1772,6 +1792,7 @@ class TPUCheckEngine:
                         host_s += time.perf_counter() - t_host
                         replay_memo[key] = res
                     results.append(res)
+                    versions.append(None)
             sp.set_attribute("host_replays", n_host)
         self.stats["device_checks"] += n - n_host
         self.stats["host_checks"] += n_host
@@ -1787,7 +1808,7 @@ class TPUCheckEngine:
             for cause, cnt in host_causes.items():
                 self.metrics.host_fallback_total.labels(cause).inc(cnt)
         self._finish_check_stages(meta, device_wait_s, host_s, n, B)
-        return results
+        return results, versions
 
     def _finish_check_stages(
         self, meta, device_wait_s: float, host_s: float, n: int, B: int

@@ -11,11 +11,26 @@ into the serve path (SURVEY.md §2.6 gap) — our config layer populates
 
 from __future__ import annotations
 
+import itertools
+
 from dataclasses import dataclass, field
 from typing import Iterable, Mapping, Optional, Protocol
 
 from ..errors import NamespaceNotFoundError
 from .ast import Relation, relation_from_dict
+
+# process-wide namespace-config generation counter: every distinct
+# namespace SET a manager serves (a new MemoryNamespaceManager, each
+# successful file-manager hot reload) draws a unique value. Consumers
+# that cache config-dependent results (api/check_cache.py) compare the
+# current manager's `config_generation` against the one they computed
+# under — a namespace change alters Check answers WITHOUT a store
+# version bump, so version gating alone cannot catch it.
+_config_generation = itertools.count(1)
+
+
+def next_config_generation() -> int:
+    return next(_config_generation)
 
 
 @dataclass
@@ -66,6 +81,7 @@ class MemoryNamespaceManager:
     def __init__(self, namespaces: Iterable[Namespace] = ()):  # noqa: D401
         self._by_name: dict[str, Namespace] = {}
         self._by_id: dict[int, Namespace] = {}
+        self.config_generation = next_config_generation()
         for ns in namespaces:
             self.add(ns)
 
@@ -73,6 +89,8 @@ class MemoryNamespaceManager:
         self._by_name[ns.name] = ns
         if ns.id is not None:
             self._by_id[ns.id] = ns
+        # the served set changed: config-keyed caches must not cross it
+        self.config_generation = next_config_generation()
 
     def get_namespace_by_name(self, name: str) -> Namespace:
         try:

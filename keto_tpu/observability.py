@@ -37,6 +37,9 @@ logger = logging.getLogger("keto_tpu")
 # here so the docs table and the bench summary can enumerate them
 CHECK_STAGES = (
     "transport",      # handler time outside the batcher/engine stages
+    "cache",          # check-cache fast-path lookup (hits only: a hit
+                      # request records NO assemble/dispatch/device_wait
+                      # because those stages never run)
     "queue",          # batcher queue wait (enqueue -> group dispatch)
     "assemble",       # state refresh + batch encoding + bucket padding
     "dispatch",       # device launch (H2D upload + async kernel dispatch)
@@ -221,9 +224,11 @@ class Metrics:
         # vs device wait vs host replay instead of one flat duration
         self.check_stage_duration = prom.Histogram(
             "keto_tpu_check_stage_duration_seconds",
-            "Check serving time per pipeline stage (transport | queue | "
-            "assemble | dispatch | device_wait | host_fallback); "
-            "batch-level stages observe once per device batch",
+            "Check serving time per pipeline stage (transport | cache | "
+            "queue | assemble | dispatch | device_wait | host_fallback); "
+            "batch-level stages observe once per device batch; `cache` "
+            "observes per cache hit (hit requests record no "
+            "assemble/dispatch/device_wait time)",
             ["stage"],
             registry=self.registry,
             buckets=(
@@ -277,6 +282,30 @@ class Metrics:
             "keto_tpu_refresh_lag_seconds",
             "Push-refresher lag: seconds from the triggering commit's "
             "write hook to delta-overlay fold completion (last refresh)",
+            registry=self.registry,
+        )
+        # snaptoken-consistent serve-side check cache (api/check_cache.py)
+        self.check_cache_ops = prom.Counter(
+            "keto_tpu_check_cache_ops_total",
+            "Check-cache outcomes: hit (served before the batcher — no "
+            "assemble/dispatch/device stages run), miss (no entry), "
+            "stale (entry pinned to an older store version than the "
+            "request's), invalidation (entries removed by commit-driven "
+            "precise invalidation)",
+            ["op"],  # hit | miss | stale | invalidation
+            registry=self.registry,
+        )
+        self.check_cache_entries = prom.Gauge(
+            "keto_tpu_check_cache_entries",
+            "Entries currently held by the serve-side check cache "
+            "(bounded by check.cache.max_entries, LRU-evicted)",
+            registry=self.registry,
+        )
+        self.check_coalesced_total = prom.Counter(
+            "keto_tpu_check_coalesced_total",
+            "Concurrent identical pending checks collapsed onto one "
+            "in-flight batch slot and fanned back out (singleflight "
+            "dedupe, Zanzibar's hot-spot lock table)",
             registry=self.registry,
         )
         # hot-path cache: (transport, method) -> (duration child,

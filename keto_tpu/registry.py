@@ -134,6 +134,8 @@ class Registry:
         self._tracer = None
         self._profiler = None
         self._watch_hub = None
+        self._check_cache = None
+        self._check_cache_built = False
         # health: flipped by the daemon around serving
         # (ref: registry_default.go:98-112 healthx readiness checkers)
         self.ready = ReadyState()
@@ -300,16 +302,58 @@ class Registry:
     def _push_invalidate(self, nid: str) -> None:
         """Hub commit listener: poke the ALREADY-BUILT engine for `nid`
         (never builds one — a tenant nobody queries must not get a device
-        mirror just because someone wrote to it)."""
+        mirror just because someone wrote to it) and the serve-side
+        check cache's invalidation thread."""
         with self._lock:
             engine = (
                 self._engine if nid == self.nid else self._nid_engines.get(nid)
             )
+            cache = self._check_cache
+        if cache is not None:
+            cache.notify_commit(nid)
         if engine is None:
             return
         poke = getattr(engine, "notify_write", None)
         if poke is not None:
             poke()
+
+    def check_cache(self):
+        """The serve-side snaptoken-consistent check cache
+        (api/check_cache.py), or None when `check.cache.enabled` is
+        false. Consulted by all three transports before the batcher;
+        invalidated through the watch hub's commit listeners (wired in
+        _push_invalidate) — correctness, however, rides the per-request
+        store-version gate, never invalidation delivery.
+
+        Lock-free after the first call (every check consults this): the
+        built flag is written LAST under the lock, so a reader seeing it
+        set also sees the cache reference."""
+        if self._check_cache_built:
+            return self._check_cache
+        with self._lock:
+            if not self._check_cache_built:
+                if bool(self.config.get("check.cache.enabled", True)):
+                    from .api.check_cache import CheckCache
+
+                    self._check_cache = CheckCache(
+                        self.relation_tuple_manager(),
+                        self.config,
+                        max_entries=int(
+                            self.config.get("check.cache.max_entries", 65536)
+                        ),
+                        ttl_s=float(self.config.get("check.cache.ttl_s", 0.0)),
+                        metrics=self.metrics(),
+                    )
+                self._check_cache_built = True
+            return self._check_cache
+
+    def close_check_cache(self) -> None:
+        """End the check cache's invalidation thread (daemon shutdown);
+        safe when the cache was never built or is disabled."""
+        with self._lock:
+            cache = self._check_cache
+        if cache is not None:
+            cache.close()
 
     def namespace_manager(self):
         return self.config.namespace_manager()

@@ -7,6 +7,7 @@ import json
 import logging
 import subprocess
 import sys
+import time
 import urllib.error
 import urllib.request
 
@@ -155,7 +156,11 @@ ENGINE_STAGES = {"engine.assemble", "engine.dispatch", "engine.device_wait"}
 def daemon():
     cfg = Config({
         "dsn": "memory",
-        "check": {"engine": "tpu"},
+        # cache off: this module asserts the batcher/engine pipeline
+        # internals (queue/assemble/dispatch spans, stage histograms) on
+        # repeated identical checks — with the serve-side check cache on,
+        # repeats would (correctly) skip the pipeline under test
+        "check": {"engine": "tpu", "cache": {"enabled": False}},
         "tracing": {"enabled": True, "provider": "memory"},
         "serve": {
             "read": {
@@ -311,6 +316,18 @@ class TestRequestAndSlowQueryLogs:
                 "/relation-tuples/check/openapi"
                 "?namespace=files&object=doc&relation=owner&subject_id=alice"
             )
+            # the REST plane logs AFTER the response bytes reach the
+            # client — wait (inside the raised-level block, or the late
+            # record is filtered at WARNING) for the handler thread
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if {
+                    getattr(r, "transport", None)
+                    for r in caplog.records
+                    if r.getMessage() == "request handled"
+                } >= {"grpc", "http"}:
+                    break
+                time.sleep(0.01)
         handled = [
             r for r in caplog.records if r.getMessage() == "request handled"
         ]

@@ -1,0 +1,325 @@
+#!/usr/bin/env python
+"""Check-cache correctness smoke: CPU-runnable, CI-wired.
+
+Drives a real daemon (memory store, TPU-engine code path pinned to the
+CPU platform) and asserts the two load-bearing properties of the
+snaptoken-consistent serve cache (api/check_cache.py):
+
+  1. HIT PATH IS DEVICE-FREE: after priming a key, a burst of identical
+     checks answers entirely from the cache — the engine's device/host
+     check counters do not move, and the in-flight launch gauge
+     (keto_tpu_inflight_launches) stays at zero for the whole window
+     (sampled continuously; a single launch would be caught).
+
+  2. ZERO STALE ANSWERS UNDER INTERLEAVED WRITES: writer threads toggle
+     direct and indirect (subject-set) edges through the write API while
+     reader threads check through the read API, recording each answer
+     with its response snaptoken. Every answer must equal the host
+     oracle (engine/reference.py) at SOME store version within that
+     request's evaluation window [its response token, the same reader's
+     next token] — a cached answer served from before the token (a stale
+     read) or an answer no store version in the window ever had
+     (time-travel) both fail. The window is needed because an UNCACHED
+     ride may legitimately evaluate a few commits ahead of its token
+     (tokens are freshness lower bounds); a STALE cache hit is behind
+     the token, which the window's lower edge catches.
+
+Exit 0 prints one JSON summary line; any violation exits 1 with the
+offending observations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def build_daemon():
+    from keto_tpu.api.daemon import Daemon
+    from keto_tpu.config import Config
+    from keto_tpu.namespace import Namespace
+    from keto_tpu.registry import Registry
+
+    cfg = Config({
+        "dsn": "memory",
+        "check": {"engine": "tpu"},
+        "limit": {"max_read_depth": 5},
+        "serve": {
+            "read": {"host": "127.0.0.1", "port": 0},
+            "write": {"host": "127.0.0.1", "port": 0},
+            "metrics": {"host": "127.0.0.1", "port": 0},
+        },
+    })
+    cfg.set_namespaces([Namespace(name="files"), Namespace(name="groups")])
+    reg = Registry(cfg)
+    d = Daemon(reg)
+    d.start()
+    return d
+
+
+def hot_path_phase(d, n_checks: int) -> dict:
+    """Property 1: a primed key serves from cache with zero device
+    dispatches (engine counters frozen, inflight gauge pinned at 0)."""
+    from keto_tpu.api import ReadClient, WriteClient, open_channel
+    from keto_tpu.ketoapi import RelationTuple
+
+    t = RelationTuple.from_string("files:hot#owner@alice")
+    wc = WriteClient(open_channel(f"127.0.0.1:{d.write_port}"))
+    wc.transact(insert=[t])
+    wc.close()
+    rc = ReadClient(open_channel(f"127.0.0.1:{d.read_port}"))
+    assert rc.check(t) is True  # prime (miss -> store)
+
+    eng = d.registry.check_engine()
+    cache = d.registry.check_cache()
+    assert cache is not None, "check.cache.enabled must default on"
+    stats0 = dict(eng.stats)
+    cache0 = cache.stats()
+    gauge = d.registry.metrics().inflight_launches
+    gauge_max = [0.0]
+    stop = threading.Event()
+
+    def sample():
+        # continuous launch-gauge sampling: any device launch during the
+        # hit window raises the observed max above zero
+        while not stop.is_set():
+            gauge_max[0] = max(gauge_max[0], gauge._value.get())
+            time.sleep(0.0005)
+
+    sampler = threading.Thread(target=sample, daemon=True)
+    sampler.start()
+    try:
+        for _ in range(n_checks):
+            assert rc.check(t) is True
+    finally:
+        stop.set()
+        sampler.join(timeout=2)
+        rc.close()
+
+    cache1 = cache.stats()
+    out = {
+        "hot_checks": n_checks,
+        "hot_cache_hits": cache1["hit"] - cache0["hit"],
+        "hot_device_checks": eng.stats["device_checks"] - stats0["device_checks"],
+        "hot_host_checks": eng.stats["host_checks"] - stats0["host_checks"],
+        "hot_inflight_gauge_max": gauge_max[0],
+    }
+    ok = (
+        out["hot_cache_hits"] >= n_checks
+        and out["hot_device_checks"] == 0
+        and out["hot_host_checks"] == 0
+        and out["hot_inflight_gauge_max"] == 0
+    )
+    out["hot_path_ok"] = ok
+    return out
+
+
+class _Oracle:
+    """Host-oracle answers at historical store versions, replayed from
+    the memory store's changelog."""
+
+    def __init__(self, registry):
+        from keto_tpu.engine.reference import ReferenceEngine
+        from keto_tpu.storage.definitions import DEFAULT_NETWORK
+        from keto_tpu.storage.memory import MemoryManager
+
+        self._ref_cls = ReferenceEngine
+        self._mgr_cls = MemoryManager
+        self._nid = DEFAULT_NETWORK
+        self._config = registry.config
+        manager = registry.relation_tuple_manager()
+        ops = manager.changelog_since(0, nid=self._nid)
+        if ops is None:
+            raise RuntimeError("changelog truncated; shorten the run")
+        self.final_version = manager.version(nid=self._nid)
+        # version -> cumulative tuple set (string form keeps it hashable)
+        self._history: dict[int, frozenset] = {0: frozenset()}
+        current: set = set()
+        last_v = 0
+        for v, op, t in ops:
+            if v != last_v:
+                self._history[last_v] = frozenset(current)
+                last_v = v
+            if op == "insert":
+                current.add(str(t))
+            else:
+                current.discard(str(t))
+        self._history[last_v] = frozenset(current)
+        self._versions = sorted(self._history)
+        self._memo: dict[tuple, bool] = {}
+
+    def _state_at(self, version: int) -> frozenset:
+        import bisect
+
+        i = bisect.bisect_right(self._versions, version) - 1
+        return self._history[self._versions[i]]
+
+    def allowed(self, version: int, query: str) -> bool:
+        from keto_tpu.ketoapi import RelationTuple
+
+        state = self._state_at(version)
+        key = (state, query)
+        memo = self._memo.get(key)
+        if memo is not None:
+            return memo
+        mgr = self._mgr_cls()
+        mgr.write_relation_tuples(
+            [RelationTuple.from_string(s) for s in state], nid=self._nid
+        )
+        ref = self._ref_cls(mgr, self._config)
+        res = ref.check_relation_tuple(
+            RelationTuple.from_string(query), 0, self._nid
+        )
+        out = bool(res.allowed)
+        self._memo[key] = out
+        return out
+
+
+def staleness_phase(d, seconds: float, n_readers: int, n_writers: int) -> dict:
+    """Property 2: interleaved writes + cached reads, zero stale
+    answers. Readers record (query, answer, token version); the oracle
+    window check runs afterwards against the changelog replay."""
+    from keto_tpu.api import ReadClient, WriteClient, open_channel
+    from keto_tpu.engine.snaptoken import parse_snaptoken
+    from keto_tpu.ketoapi import RelationTuple
+    from keto_tpu.storage.definitions import DEFAULT_NETWORK
+
+    # fixed indirection: files:doc#view@(groups:g{i}#member); writers
+    # toggle the groups membership, so the doc#view answers flip without
+    # the checked tuple itself ever being written — the transitive case
+    # precise invalidation cannot enumerate (the version gate must)
+    wc = WriteClient(open_channel(f"127.0.0.1:{d.write_port}"))
+    static = [
+        RelationTuple.from_string(f"files:doc#view@(groups:g{i}#member)")
+        for i in range(n_writers)
+    ]
+    wc.transact(insert=static)
+    wc.close()
+
+    queries = [f"groups:g{i}#member@u{i}" for i in range(n_writers)]
+    queries += [f"files:doc#view@u{i}" for i in range(n_writers)]
+    stop_at = time.monotonic() + seconds
+    observations: dict[int, list[tuple[str, bool, int]]] = {}
+    errors: list[str] = []
+    lock = threading.Lock()
+
+    def writer(i: int) -> None:
+        w = WriteClient(open_channel(f"127.0.0.1:{d.write_port}"))
+        t = RelationTuple.from_string(f"groups:g{i}#member@u{i}")
+        present = False
+        try:
+            while time.monotonic() < stop_at:
+                if present:
+                    w.transact(delete=[t])
+                else:
+                    w.transact(insert=[t])
+                present = not present
+                time.sleep(0.01)
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                errors.append(f"writer {i}: {e}")
+        finally:
+            w.close()
+
+    def reader(i: int) -> None:
+        import random
+
+        rng = random.Random(i)
+        rc = ReadClient(open_channel(f"127.0.0.1:{d.read_port}"))
+        mine: list[tuple[str, bool, int]] = []
+        try:
+            while time.monotonic() < stop_at:
+                q = queries[rng.randrange(len(queries))]
+                allowed, token = rc.check_with_token(
+                    RelationTuple.from_string(q)
+                )
+                v = parse_snaptoken(token, DEFAULT_NETWORK)
+                mine.append((q, allowed, v))
+        except Exception as e:  # noqa: BLE001
+            with lock:
+                errors.append(f"reader {i}: {e}")
+        finally:
+            rc.close()
+            with lock:
+                observations[i] = mine
+
+    threads = [
+        threading.Thread(target=writer, args=(i,), daemon=True)
+        for i in range(n_writers)
+    ] + [
+        threading.Thread(target=reader, args=(i,), daemon=True)
+        for i in range(n_readers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=seconds + 30)
+
+    oracle = _Oracle(d.registry)
+    checked = 0
+    stale: list[dict] = []
+    for _rid, mine in observations.items():
+        for j, (q, allowed, v) in enumerate(mine):
+            # evaluation window: this request's token .. the same
+            # reader's next token (requests are sequential per reader);
+            # the final request's window closes at the store's final
+            # version
+            hi = mine[j + 1][2] if j + 1 < len(mine) else oracle.final_version
+            ok = any(
+                oracle.allowed(w, q) == allowed for w in range(v, hi + 1)
+            )
+            checked += 1
+            if not ok:
+                stale.append({
+                    "query": q, "answer": allowed,
+                    "token_version": v, "window_hi": hi,
+                    "oracle_at_token": oracle.allowed(v, q),
+                })
+    cache = d.registry.check_cache().stats()
+    return {
+        "staleness_observations": checked,
+        "stale_answers": stale[:10],
+        "stale_count": len(stale),
+        "transport_errors": errors,
+        "staleness_ok": not stale and not errors and checked > 0,
+        "cache_stats": cache,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--hot-checks", type=int, default=300)
+    ap.add_argument("--seconds", type=float, default=4.0,
+                    help="staleness-phase duration")
+    ap.add_argument("--readers", type=int, default=4)
+    ap.add_argument("--writers", type=int, default=2)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    d = build_daemon()
+    try:
+        out = hot_path_phase(d, args.hot_checks)
+        out.update(
+            staleness_phase(d, args.seconds, args.readers, args.writers)
+        )
+    finally:
+        d.stop()
+    out["ok"] = bool(out["hot_path_ok"] and out["staleness_ok"])
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
