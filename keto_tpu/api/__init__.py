@@ -8,8 +8,9 @@ daemon.go; proto package ory.keto.relation_tuples.v1alpha2.
 from .batcher import CheckBatcher
 from .check_cache import CheckCache
 from .client import ReadClient, WatchStreamEvent, WriteClient, open_channel
+from ..resilience import RetryPolicy
 
 __all__ = [
-    "CheckBatcher", "CheckCache", "ReadClient", "WatchStreamEvent",
-    "WriteClient", "open_channel",
+    "CheckBatcher", "CheckCache", "ReadClient", "RetryPolicy",
+    "WatchStreamEvent", "WriteClient", "open_channel",
 ]

@@ -39,14 +39,17 @@ import grpc
 import grpc.aio
 
 from .batcher import (
+    _LaunchGuard,
+    classify_engine_error,
     coalesce_pending,
+    host_check_batch,
     note_queue_wait,
     resolve_max_inflight,
     submit_takes_telemetry,
 )
 from .descriptors import CHECK_SERVICE, pb
 from .grpc_server import _grpc_code, _Services
-from ..errors import KetoError
+from ..errors import DeadlineExceededError, KetoError, OverloadedError
 from ..observability import (
     current_request_trace,
     reset_request_trace,
@@ -69,6 +72,9 @@ class AioCheckBatcher:
         metrics=None,
         tracer=None,
         max_inflight: int | None = None,
+        max_queue: int | None = None,
+        device_timeout_ms: float | None = None,
+        breaker=None,
     ):
         self._resolve_engine = engine_resolver
         self.max_batch = max_batch
@@ -82,10 +88,29 @@ class AioCheckBatcher:
             max_workers=max(pipeline_depth, 2),
             thread_name_prefix="keto-aio-dispatch",
         )
+        # degraded-serving executor: host-oracle evaluation never shares
+        # threads with device submit/resolve — a wedged device blocks
+        # dispatch workers unrecoverably, and degraded serving queued
+        # behind them would never run (same split as the threaded
+        # batcher's _host_pool). Threads spawn on first use.
+        self._host_executor = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="keto-aio-hostserve"
+        )
         self.max_inflight = resolve_max_inflight(max_inflight, pipeline_depth)
         self._inflight = asyncio.Semaphore(self.max_inflight)
         self._collector: asyncio.Task | None = None
         self._closed = False
+        # admission bound + device-path resilience (same contract as the
+        # threaded batcher: serve.check.{max_queue,device_timeout_ms},
+        # shared breaker so device health is judged from all traffic).
+        # The pending counter needs no lock — admission and completion
+        # both run on the loop thread.
+        self.max_queue = int(max_queue) if max_queue else 0
+        self._pending = 0
+        self.device_timeout_s = (
+            float(device_timeout_ms) / 1e3 if device_timeout_ms else None
+        )
+        self.breaker = breaker
         # observability: queue-wait attribution + gauges, mirroring the
         # threaded batcher (api/batcher.py); own plane label — both
         # batchers can serve at once
@@ -95,6 +120,8 @@ class AioCheckBatcher:
             metrics.batcher_queue_depth.labels("aio")
             if metrics is not None else None
         )
+        if metrics is not None:
+            metrics.batcher_queue_limit.labels("aio").set(self.max_queue)
         self._submit_takes_telemetry: dict[type, bool] = {}
 
     def start(self) -> None:
@@ -106,6 +133,37 @@ class AioCheckBatcher:
             await self._queue.put(None)
             await self._collector
         self._executor.shutdown(wait=True)
+        self._host_executor.shutdown(wait=True)
+
+    def _queue_delay_estimate_s(self, pending: int) -> float:
+        batches = pending // max(self.max_batch, 1) + 1
+        return max(batches * max(self.window_s, 0.001), 0.05)
+
+    def admit(self, deadline=None) -> None:
+        """Queue-delay-aware admission gate, the aio twin of
+        CheckBatcher.admit. Runs in-loop, so the pending count it reads
+        is exact — no racer can push past max_queue."""
+        if self._closed:
+            raise OverloadedError("check batcher is closed", retry_after_s=1.0)
+        if self.max_queue and self._pending >= self.max_queue:
+            if self.metrics is not None:
+                self.metrics.requests_shed_total.labels("queue_full").inc()
+            raise OverloadedError(
+                "check queue is full",
+                retry_after_s=self._queue_delay_estimate_s(self._pending),
+            )
+        if deadline is not None and deadline.expired():
+            if self.metrics is not None:
+                self.metrics.deadline_exceeded_total.labels("admission").inc()
+            raise DeadlineExceededError(
+                "request deadline expired before admission"
+            )
+
+    def idle(self) -> bool:
+        return self._pending == 0
+
+    def _dec_pending(self, _f=None) -> None:
+        self._pending -= 1
 
     async def check(self, tuple, max_depth: int = 0, nid=None, rt=None):
         res, _ = await self.check_versioned(tuple, max_depth, nid=nid, rt=rt)
@@ -113,16 +171,39 @@ class AioCheckBatcher:
 
     async def check_versioned(self, tuple, max_depth: int = 0, nid=None, rt=None):
         """(CheckResult, version | None) — same contract as the threaded
-        CheckBatcher.check_versioned (the check cache's store input)."""
+        CheckBatcher.check_versioned (the check cache's store input);
+        `rt.deadline` bounds the wait with the typed 504."""
         if self._closed:
             raise RuntimeError("AioCheckBatcher is closed")
+        if self.max_queue and self._pending >= self.max_queue:
+            # enqueue-time bound (exact: this coroutine runs in-loop)
+            if self.metrics is not None:
+                self.metrics.requests_shed_total.labels("queue_full").inc()
+            raise OverloadedError(
+                "check queue is full",
+                retry_after_s=self._queue_delay_estimate_s(self._pending),
+            )
+        self._pending += 1
         fut = asyncio.get_running_loop().create_future()
+        fut.add_done_callback(self._dec_pending)
         self._queue.put_nowait(
             (tuple, max_depth, nid, fut, rt, time.perf_counter())
         )
         if self._depth_gauge is not None:
             self._depth_gauge.set(self._queue.qsize())
-        return await fut
+        deadline = rt.deadline if rt is not None else None
+        if deadline is None:
+            return await fut
+        try:
+            return await asyncio.wait_for(
+                fut, timeout=max(deadline.remaining_s(), 1e-4)
+            )
+        except asyncio.TimeoutError:
+            if self.metrics is not None:
+                self.metrics.deadline_exceeded_total.labels("wait").inc()
+            raise DeadlineExceededError(
+                "request deadline expired waiting for the check batch"
+            )
 
     async def _drain(self, first) -> list:
         batch = [first]
@@ -160,6 +241,28 @@ class AioCheckBatcher:
             )
         return functools.partial(submit, tuples, depth)
 
+    def _expire(self, group: list) -> list:
+        """Drop riders whose deadline expired while queued (the typed
+        504, no batch slot occupied) — the aio twin of
+        CheckBatcher._expire."""
+        live = []
+        for p in group:
+            dl = p[4].deadline if p[4] is not None else None
+            if dl is not None and dl.expired():
+                if not p[3].done():
+                    # a done (cancelled) future means the caller's
+                    # wait_for already counted this expiry as "wait"
+                    if self.metrics is not None:
+                        self.metrics.deadline_exceeded_total.labels(
+                            "queue"
+                        ).inc()
+                    p[3].set_exception(DeadlineExceededError(
+                        "request deadline expired in the check queue"
+                    ))
+            else:
+                live.append(p)
+        return live
+
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
@@ -175,43 +278,138 @@ class AioCheckBatcher:
                     ((p[4], p[5]) for p in group), self._queue.qsize(),
                     self.metrics, self.tracer, self._depth_gauge,
                 )
+                group = self._expire(group)
+                if not group:
+                    continue
                 # singleflight: identical pendings share one batch slot
                 # (shared with the threaded batcher)
                 slots = coalesce_pending(
                     group, lambda p: p[0], self.metrics
                 )
-                await self._inflight.acquire()
-                if self.metrics is not None:
-                    self.metrics.inflight_launches.inc()
-                try:
-                    engine = self._resolve_engine(nid)
-                    submit = getattr(engine, "check_batch_submit", None)
-                    if submit is None:
-                        # host-engine fallback: no split-phase surface —
-                        # evaluate the whole batch on the executor (same
-                        # contract as the threaded batcher's _evaluate)
-                        loop.create_task(
-                            self._evaluate(engine, slots, depth)
-                        )
-                        continue
-                    handle = await loop.run_in_executor(
-                        self._executor,
-                        self._submit_fn(engine, submit, slots, depth),
-                    )
-                except Exception as e:
-                    self._release_inflight()
-                    for p in group:
-                        if not p[3].done():
-                            p[3].set_exception(e)
-                    continue
-                # resolve concurrently: the collector goes back to
-                # draining while the device round-trip completes
-                loop.create_task(self._finish(engine, handle, slots))
+                # breaker routing in the collector (same reasoning as the
+                # threaded plane: a stalled device submit must not block
+                # degraded host serving); each group becomes ONE task so
+                # the collector keeps draining either way
+                if self.breaker is not None and not self.breaker.allow():
+                    loop.create_task(self._host_serve(slots, depth, nid))
+                else:
+                    loop.create_task(self._device_serve(slots, depth, nid))
 
     def _release_inflight(self) -> None:
         self._inflight.release()
         if self.metrics is not None:
             self.metrics.inflight_launches.dec()
+
+    def _record_device_failure(self, cause: str) -> None:
+        if self.breaker is not None:
+            self.breaker.record_failure()
+        if self.metrics is not None:
+            self.metrics.check_batch_failed_total.labels(cause).inc()
+
+    @staticmethod
+    def _fail_slots(slots, err) -> None:
+        for slot in slots:
+            for p in slot:
+                if not p[3].done():
+                    p[3].set_exception(err)
+
+    async def _host_fallback(self, engine, slots, depth) -> None:
+        """Exact-host-oracle answers for the riders after a device-path
+        failure or while the breaker is open (graceful degradation:
+        correct answers, host_fallback-stage latency)."""
+        loop = asyncio.get_running_loop()
+        t0 = time.perf_counter()
+        try:
+            results = await loop.run_in_executor(
+                self._host_executor, host_check_batch, engine,
+                [s[0][0] for s in slots], depth,
+            )
+        except Exception as e:
+            self._fail_slots(
+                slots, classify_engine_error(e, self.metrics, "host")
+            )
+            return
+        dur = time.perf_counter() - t0
+        if self.metrics is not None:
+            self.metrics.observe_stage("host_fallback", dur)
+        for slot, res in zip(slots, results):
+            for p in slot:
+                if p[4] is not None:
+                    p[4].add_stage("host_fallback", dur)
+                if not p[3].done():
+                    p[3].set_result((res, None))
+
+    async def _host_serve(self, slots, depth, nid) -> None:
+        try:
+            engine = self._resolve_engine(nid)
+        except Exception as e:
+            self._fail_slots(
+                slots, classify_engine_error(e, self.metrics, "engine")
+            )
+            return
+        await self._host_fallback(engine, slots, depth)
+
+    def _watchdog_fire(self, guard, engine, slots, depth) -> None:
+        """loop.call_later callback (runs in-loop): abandon a launch that
+        outlived serve.check.device_timeout_ms — release its in-flight
+        slot, trip the breaker, host-serve the riders."""
+        if not guard.claim():
+            return
+        self._release_inflight()
+        self._record_device_failure("device_timeout")
+        asyncio.get_running_loop().create_task(
+            self._host_fallback(engine, slots, depth)
+        )
+
+    async def _device_serve(self, slots, depth, nid) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            engine = self._resolve_engine(nid)
+        except Exception as e:
+            self._fail_slots(
+                slots, classify_engine_error(e, self.metrics, "engine")
+            )
+            return
+        await self._inflight.acquire()
+        if self.metrics is not None:
+            self.metrics.inflight_launches.inc()
+        # the semaphore wait can outlive every rider's budget: re-check
+        # the deadline boundary so a fully-expired batch never launches
+        live = self._expire([p for slot in slots for p in slot])
+        if not live:
+            self._release_inflight()
+            return
+        if len(live) != sum(len(s) for s in slots):
+            slots = coalesce_pending(live, lambda p: p[0], None)
+        submit = getattr(engine, "check_batch_submit", None)
+        if submit is None:
+            # host-engine fallback: no split-phase surface — evaluate the
+            # whole batch on the executor (same contract as the threaded
+            # batcher's _evaluate); releases the in-flight slot itself
+            await self._evaluate(engine, slots, depth)
+            return
+        guard = _LaunchGuard()
+        watchdog = (
+            loop.call_later(
+                self.device_timeout_s, self._watchdog_fire,
+                guard, engine, slots, depth,
+            )
+            if self.device_timeout_s else None
+        )
+        try:
+            handle = await loop.run_in_executor(
+                self._executor,
+                self._submit_fn(engine, submit, slots, depth),
+            )
+        except Exception:
+            if guard.claim():
+                if watchdog is not None:
+                    watchdog.cancel()
+                self._release_inflight()
+                self._record_device_failure("device")
+                await self._host_fallback(engine, slots, depth)
+            return
+        await self._finish(engine, handle, slots, depth, guard, watchdog)
 
     async def _evaluate(self, engine, slots, depth) -> None:
         loop = asyncio.get_running_loop()
@@ -223,10 +421,9 @@ class AioCheckBatcher:
                 depth,
             )
         except Exception as e:
-            for slot in slots:
-                for p in slot:
-                    if not p[3].done():
-                        p[3].set_exception(e)
+            self._fail_slots(
+                slots, classify_engine_error(e, self.metrics, "engine")
+            )
             return
         finally:
             self._release_inflight()
@@ -235,8 +432,12 @@ class AioCheckBatcher:
                 if not p[3].done():
                     p[3].set_result((res, None))
 
-    async def _finish(self, engine, handle, slots) -> None:
+    async def _finish(
+        self, engine, handle, slots, depth, guard=None, watchdog=None
+    ) -> None:
         loop = asyncio.get_running_loop()
+        if guard is not None and guard.peek():
+            return  # the watchdog already abandoned this launch
         try:
             # version plumb-through (check_batch_resolve_v): pins each
             # answer to its evaluated state's covered store version —
@@ -251,14 +452,21 @@ class AioCheckBatcher:
                     self._executor, engine.check_batch_resolve, handle
                 )
                 versions = [None] * len(results)
-        except Exception as e:
-            for slot in slots:
-                for p in slot:
-                    if not p[3].done():
-                        p[3].set_exception(e)
+        except Exception:
+            if guard is None or guard.claim():
+                if watchdog is not None:
+                    watchdog.cancel()
+                self._release_inflight()
+                self._record_device_failure("device")
+                await self._host_fallback(engine, slots, depth)
             return
-        finally:
-            self._release_inflight()
+        if guard is not None and not guard.claim():
+            return  # the watchdog won the race mid-resolve
+        if watchdog is not None:
+            watchdog.cancel()
+        self._release_inflight()
+        if self.breaker is not None:
+            self.breaker.record_success()
         for slot, res, ver in zip(slots, results, versions):
             # singleflight fan-out: every coalesced rider gets the
             # slot's result
@@ -306,6 +514,9 @@ class _AioReadServices:
                         return await coro_fn(req, context)
                 except KetoError as e:
                     outcome["code"] = _grpc_code(e).name
+                    from .grpc_server import _attach_retry_after
+
+                    _attach_retry_after(context, e)
                     await context.abort(_grpc_code(e), e.message)
                 except grpc.aio.AbortError:
                     raise  # context.abort signalling, already coded
@@ -324,7 +535,14 @@ class _AioReadServices:
     async def check(self, req, context):
         async def body(req, context):
             from ..engine.snaptoken import encode_snaptoken
+            from ..resilience import admit_check
 
+            # admission gate BEFORE any work (typed 429/504, identical
+            # mapping to the threaded planes); the aio batcher's pending
+            # count is loop-local, so the bound check is exact
+            admit_check(
+                self._svc.registry, self._batcher, current_request_trace()
+            )
             t = self._svc._check_tuple(req)
             self._svc.registry.validate_namespaces(t)
             nid = self._svc._nid(context)
@@ -583,13 +801,19 @@ class AioReadServer:
 
     async def _start_server(self) -> None:
         services = _Services(self.registry)
+        cfg = self.registry.config
         self.batcher = AioCheckBatcher(
             self.registry.check_engine,
             pipeline_depth=self._pipeline_depth,
             window_s=self._window_s,
             metrics=self.registry.metrics(),
             tracer=self.registry.tracer(),
-            max_inflight=self.registry.config.get("serve.check.max_inflight"),
+            max_inflight=cfg.get("serve.check.max_inflight"),
+            max_queue=cfg.get("serve.check.max_queue"),
+            device_timeout_ms=cfg.get("serve.check.device_timeout_ms"),
+            # ONE process-wide breaker shared with the threaded plane:
+            # device health is judged from all traffic
+            breaker=self.registry.circuit_breaker(),
         )
         self.batcher.start()
         self._services = _AioReadServices(services, self.batcher)

@@ -25,7 +25,15 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from dataclasses import dataclass, field
+
+from ..errors import (
+    CheckBatchFailedError,
+    DeadlineExceededError,
+    KetoError,
+    OverloadedError,
+)
 
 
 def note_queue_wait(riders, queue_size: int, metrics, tracer, depth_gauge) -> None:
@@ -77,6 +85,62 @@ def coalesce_pending(group, key_fn, metrics):
     return out
 
 
+def classify_engine_error(e: Exception, metrics, cause: str) -> KetoError:
+    """Engine-batch failures reach riders as typed KetoErrors, never the
+    raw exception (the transports map KetoError.status / grpc code; a
+    bare ValueError was a 500 with an unhelpful body). Shared by BOTH
+    batching planes; counts keto_tpu_check_batch_failed_total{cause}.
+    `cause` is one of the fixed label values (engine | host — device
+    failures are counted by the recovery paths directly)."""
+    if isinstance(e, KetoError):
+        cause = "keto"
+        err = e
+    else:
+        err = CheckBatchFailedError(
+            f"check batch failed: {type(e).__name__}: {e}"
+        )
+    if metrics is not None:
+        metrics.check_batch_failed_total.labels(cause).inc()
+    return err
+
+
+def host_check_batch(engine, tuples, max_depth: int):
+    """The exact-host-oracle evaluation of one batch — the breaker's
+    graceful-degradation path and the launch watchdog's recovery path.
+    TPU engines expose `check_batch_host` (reference replay, zero device
+    contact); host facades and stub engines fall back to their only
+    surface, `check_batch`."""
+    fn = getattr(engine, "check_batch_host", None)
+    if fn is not None:
+        return fn(tuples, max_depth)
+    return engine.check_batch(tuples, max_depth)
+
+
+class _LaunchGuard:
+    """Exactly one of {resolver, launch watchdog} finishes a device
+    launch: the winner releases the in-flight slot and answers the
+    riders; the loser becomes a no-op (a stalled resolve returning after
+    the watchdog already host-served its riders must not double-release
+    the semaphore or double-resolve the futures)."""
+
+    __slots__ = ("_lock", "_done")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._done = False
+
+    def claim(self) -> bool:
+        with self._lock:
+            if self._done:
+                return False
+            self._done = True
+            return True
+
+    def peek(self) -> bool:
+        with self._lock:
+            return self._done
+
+
 def submit_takes_telemetry(cache: dict, engine, submit) -> bool:
     """check_batch_submit grew a `telemetry` kwarg; engines stubbed with
     the bare two-arg signature (tests, embedders) keep working. The
@@ -101,6 +165,9 @@ class _Pending:
     rt: object = None  # observability.RequestTrace | None
     enq_t: float = 0.0
     future: Future = field(default_factory=Future)
+    # caller already counted this request's deadline expiry (the "wait"
+    # stage): the collector's later queue-drop must not count it twice
+    dl_counted: bool = False
 
 
 class CheckBatcher:
@@ -114,6 +181,9 @@ class CheckBatcher:
         metrics=None,
         tracer=None,
         max_inflight: int | None = None,
+        max_queue: int | None = None,
+        device_timeout_ms: float | None = None,
+        breaker=None,
     ):
         # per-request tenancy: batches are grouped by nid and dispatched
         # to that tenant's engine (ref: ketoctx Contextualizer,
@@ -143,6 +213,15 @@ class CheckBatcher:
         self._launcher = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="keto-check-launch"
         )
+        # degraded-serving pool: breaker-open host groups run HERE, never
+        # on `_pool` — a wedged device blocks pool workers inside
+        # check_batch_resolve (only the watchdog's semaphore release is
+        # possible; the blocked thread is not recoverable), and degraded
+        # serving queued behind them would never run. Threads spawn on
+        # first use, so unbroken deployments pay nothing.
+        self._host_pool = ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="keto-check-hostserve"
+        )
         # backpressure: at most max_inflight launched-but-unresolved
         # device batches (an unbounded launch queue can wedge the TPU
         # tunnel and holds a full engine state per handle); operators
@@ -150,6 +229,24 @@ class CheckBatcher:
         # default 2x pipeline depth
         self.max_inflight = resolve_max_inflight(max_inflight, pipeline_depth)
         self._inflight = threading.BoundedSemaphore(self.max_inflight)
+        # admission control (serve.check.max_queue): a hard bound on
+        # admitted-but-unresolved checks — queued items, batched groups,
+        # and in-flight device waits all count, so memory stays bounded
+        # under a wedged device instead of queueing without limit.
+        # 0/None = unbounded (reference parity).
+        self.max_queue = int(max_queue) if max_queue else 0
+        self._pending = 0
+        self._pending_mu = threading.Lock()
+        # device-path resilience: launch watchdog budget + shared breaker
+        # (serve.check.device_timeout_ms / serve.check.breaker.*)
+        self.device_timeout_s = (
+            float(device_timeout_ms) / 1e3 if device_timeout_ms else None
+        )
+        self.breaker = breaker
+        # True while a _launch executes (benign unlocked flag): the
+        # collector arms the routing watchdog only when the launcher is
+        # occupied, so the healthy fast path creates no timer thread
+        self._launcher_busy = False
         # observability (both optional): queue-depth/inflight gauges,
         # per-request queue-wait stage attribution, batcher.queue spans
         self.metrics = metrics
@@ -158,6 +255,8 @@ class CheckBatcher:
             metrics.batcher_queue_depth.labels("threaded")
             if metrics is not None else None
         )
+        if metrics is not None:
+            metrics.batcher_queue_limit.labels("threaded").set(self.max_queue)
         # engine type -> whether check_batch_submit accepts `telemetry`
         # (feature-detected once; tests stub engines with the bare
         # two-arg signature)
@@ -167,11 +266,58 @@ class CheckBatcher:
 
     # -- caller side ----------------------------------------------------------
 
+    def _queue_delay_estimate_s(self, pending: int) -> float:
+        """Retry-after hint for a shed request: how long the currently
+        admitted work plausibly takes to drain (batches of max_batch, one
+        window each) — a heuristic floor, never a promise."""
+        batches = pending // max(self.max_batch, 1) + 1
+        return max(batches * max(self.window_s, 0.001), 0.05)
+
+    def admit(self, deadline=None) -> None:
+        """Queue-delay-aware admission gate (transports call this BEFORE
+        any check work): typed OverloadedError when the admitted-but-
+        unresolved count is at serve.check.max_queue, typed
+        DeadlineExceededError when the request's budget is already
+        spent. The check here is advisory (no slot is reserved); the
+        atomic bound is enforced again at enqueue."""
+        if self._closed:
+            raise OverloadedError("check batcher is closed", retry_after_s=1.0)
+        if self.max_queue:
+            with self._pending_mu:
+                pending = self._pending
+            if pending >= self.max_queue:
+                self._count_shed()
+                raise OverloadedError(
+                    "check queue is full",
+                    retry_after_s=self._queue_delay_estimate_s(pending),
+                )
+        if deadline is not None and deadline.expired():
+            if self.metrics is not None:
+                self.metrics.deadline_exceeded_total.labels("admission").inc()
+            raise DeadlineExceededError(
+                "request deadline expired before admission"
+            )
+
+    def _count_shed(self) -> None:
+        if self.metrics is not None:
+            self.metrics.requests_shed_total.labels("queue_full").inc()
+
+    def _dec_pending(self, _f=None) -> None:
+        with self._pending_mu:
+            self._pending -= 1
+
+    def idle(self) -> bool:
+        """True when nothing is admitted-but-unresolved (the daemon's
+        drain loop polls this during the shutdown grace window)."""
+        with self._pending_mu:
+            return self._pending == 0
+
     def check(self, tuple, max_depth: int = 0, nid=None, rt=None):
         """Blocking single check; returns a CheckResult. `rt` is the
         caller's RequestTrace: the batcher adds the queue-wait stage and
         the engine adds its stages, so the transport that created it can
-        log/span the full pipeline breakdown."""
+        log/span the full pipeline breakdown; `rt.deadline` (if any)
+        bounds the wait end-to-end."""
         return self.check_versioned(tuple, max_depth, nid=nid, rt=rt)[0]
 
     def check_versioned(self, tuple, max_depth: int = 0, nid=None, rt=None):
@@ -182,11 +328,38 @@ class CheckBatcher:
         host-replayed rider) — the check cache's store contract."""
         if self._closed:
             raise RuntimeError("CheckBatcher is closed")
+        # atomic admission bound: check-and-increment under one lock so
+        # concurrent callers can never push past max_queue (the
+        # acceptance property "queue never grows past max_queue")
+        with self._pending_mu:
+            if self.max_queue and self._pending >= self.max_queue:
+                self._count_shed()
+                raise OverloadedError(
+                    "check queue is full",
+                    retry_after_s=self._queue_delay_estimate_s(self._pending),
+                )
+            self._pending += 1
         p = _Pending(tuple, max_depth, nid, rt, time.perf_counter())
+        p.future.add_done_callback(self._dec_pending)
         self._queue.put(p)
         if self._depth_gauge is not None:
             self._depth_gauge.set(self._queue.qsize())
-        return p.future.result()
+        deadline = rt.deadline if rt is not None else None
+        if deadline is None:
+            return p.future.result()
+        try:
+            return p.future.result(timeout=max(deadline.remaining_s(), 1e-4))
+        except FutureTimeoutError:
+            # the pending stays queued; the collector drops it as expired
+            # at its launch boundary (no batch slot occupied), and the
+            # caller fails fast with the typed 504 — Zanzibar's
+            # deadline-scoped evaluation
+            p.dl_counted = True
+            if self.metrics is not None:
+                self.metrics.deadline_exceeded_total.labels("wait").inc()
+            raise DeadlineExceededError(
+                "request deadline expired waiting for the check batch"
+            )
 
     def close(self) -> None:
         self._closed = True
@@ -226,20 +399,123 @@ class CheckBatcher:
             batch.append(item)
         return batch
 
+    @staticmethod
+    def _fail_slots(slots: list[list[_Pending]], err: Exception) -> None:
+        for slot in slots:
+            for p in slot:
+                if not p.future.done():
+                    p.future.set_exception(err)
+
+    def _expire(self, group: list[_Pending]) -> list[_Pending]:
+        """Drop riders whose deadline expired while queued: they fail
+        with the typed 504 WITHOUT occupying a batch slot (their caller
+        has usually already timed out in check_versioned; this is the
+        slot-reclamation half of the contract)."""
+        live: list[_Pending] = []
+        for p in group:
+            dl = p.rt.deadline if p.rt is not None else None
+            if dl is not None and dl.expired():
+                if self.metrics is not None and not p.dl_counted:
+                    self.metrics.deadline_exceeded_total.labels("queue").inc()
+                if not p.future.done():
+                    p.future.set_exception(DeadlineExceededError(
+                        "request deadline expired in the check queue"
+                    ))
+            else:
+                live.append(p)
+        return live
+
     def _evaluate(self, slots: list[list[_Pending]], depth: int, nid=None) -> None:
         try:
             engine = self._resolve(nid)
             results = engine.check_batch([s[0].tuple for s in slots], depth)
-        except Exception as e:  # engine-level failure fails the batch
-            for slot in slots:
-                for p in slot:
-                    p.future.set_exception(e)
+        except Exception as e:  # engine-level failure fails the batch —
+            # with a typed KetoError, never the raw exception
+            self._fail_slots(
+                slots, classify_engine_error(e, self.metrics, "engine")
+            )
             return
         for slot, res in zip(slots, results):
             for p in slot:
-                p.future.set_result((res, None))
+                if not p.future.done():
+                    p.future.set_result((res, None))
 
-    def _resolve_inflight(self, engine, handle, slots: list[list[_Pending]]) -> None:
+    def _record_device_failure(self, cause: str) -> None:
+        if self.breaker is not None:
+            self.breaker.record_failure()
+        if self.metrics is not None:
+            self.metrics.check_batch_failed_total.labels(cause).inc()
+
+    def _host_fallback_slots(
+        self, engine, slots: list[list[_Pending]], depth: int
+    ) -> None:
+        """Graceful degradation: answer the riders from the exact host
+        oracle after a device-path failure (submit/resolve raised, or
+        the launch watchdog fired). Answers stay correct; the latency
+        lands in the host_fallback stage."""
+        t0 = time.perf_counter()
+        try:
+            results = host_check_batch(
+                engine, [s[0].tuple for s in slots], depth
+            )
+        except Exception as e:
+            self._fail_slots(
+                slots, classify_engine_error(e, self.metrics, "host")
+            )
+            return
+        dur = time.perf_counter() - t0
+        if self.metrics is not None:
+            self.metrics.observe_stage("host_fallback", dur)
+        for slot, res in zip(slots, results):
+            for p in slot:
+                if p.rt is not None:
+                    p.rt.add_stage("host_fallback", dur)
+                if not p.future.done():
+                    # host answers read the LIVE store: no pinned version
+                    p.future.set_result((res, None))
+
+    def _host_serve(self, group: list[_Pending], depth: int, nid=None) -> None:
+        """Breaker-open route (runs on the dispatch pool, NOT the launch
+        thread — a wedged launch thread must not block degraded serving):
+        the whole group is answered by the exact host oracle."""
+        note_queue_wait(
+            ((p.rt, p.enq_t) for p in group), self._queue.qsize(),
+            self.metrics, self.tracer, self._depth_gauge,
+        )
+        group = self._expire(group)
+        if not group:
+            return
+        slots = coalesce_pending(group, lambda p: p.tuple, self.metrics)
+        try:
+            engine = self._resolve(nid)
+        except Exception as e:
+            self._fail_slots(
+                slots, classify_engine_error(e, self.metrics, "engine")
+            )
+            return
+        self._host_fallback_slots(engine, slots, depth)
+
+    def _device_timed_out(self, guard, engine, slots, depth: int) -> None:
+        """Launch watchdog (serve.check.device_timeout_ms): a batch that
+        has not resolved within the budget is abandoned — the in-flight
+        slot is RELEASED (a wedged device must not pin the semaphore and
+        starve every later batch), the breaker records the failure, and
+        the riders are answered by the exact host oracle. If the stalled
+        resolve eventually returns, the guard makes it a no-op."""
+        if not guard.claim():
+            return
+        self._release_inflight()
+        self._record_device_failure("device_timeout")
+        self._host_fallback_slots(engine, slots, depth)
+
+    def _resolve_inflight(
+        self, engine, handle, slots: list[list[_Pending]], depth: int = 0,
+        guard=None, watchdog=None,
+    ) -> None:
+        if guard is not None and guard.peek():
+            # the watchdog already abandoned this launch and host-served
+            # its riders; don't block a pool thread on the wedged handle
+            return
         try:
             # version plumb-through: engines exposing the versioned
             # resolve surface pin each answer to the store version its
@@ -250,18 +526,27 @@ class CheckBatcher:
             else:
                 results = engine.check_batch_resolve(handle)
                 versions = [None] * len(results)
-        except Exception as e:
-            for slot in slots:
-                for p in slot:
-                    p.future.set_exception(e)
+        except Exception:
+            if guard is None or guard.claim():
+                if watchdog is not None:
+                    watchdog.cancel()
+                self._release_inflight()
+                self._record_device_failure("device")
+                self._host_fallback_slots(engine, slots, depth)
             return
-        finally:
-            self._release_inflight()
+        if guard is not None and not guard.claim():
+            return  # the watchdog won the race mid-resolve
+        if watchdog is not None:
+            watchdog.cancel()
+        self._release_inflight()
+        if self.breaker is not None:
+            self.breaker.record_success()
         for slot, res, ver in zip(slots, results, versions):
             # singleflight fan-out: every coalesced rider gets the slot's
             # result (CheckResults are shared immutable singletons)
             for p in slot:
-                p.future.set_result((res, ver))
+                if not p.future.done():
+                    p.future.set_result((res, ver))
 
     def _acquire_inflight(self) -> None:
         self._inflight.acquire()
@@ -273,7 +558,30 @@ class CheckBatcher:
         if self.metrics is not None:
             self.metrics.inflight_launches.dec()
 
-    def _launch(self, group: list[_Pending], depth: int, nid=None) -> None:
+    def _stuck_in_launcher(
+        self, route_guard, group: list[_Pending], depth: int, nid
+    ) -> None:
+        """Routing watchdog: a group still WAITING on the (single)
+        launch thread after device_timeout_ms — the launcher is wedged
+        inside an earlier group's stalled submit, so the per-launch
+        watchdog never armed for this one. Host-serve it from the timer
+        thread; the guard makes the eventual _launch a no-op. NO breaker
+        failure is recorded here: a long launcher wait is backpressure
+        evidence, not a device-health verdict (a healthy-but-saturated
+        device must not trip the breaker open) — the per-launch watchdog
+        on the wedged group itself carries the breaker signal."""
+        if not route_guard.claim():
+            return
+        if self.metrics is not None:
+            self.metrics.check_batch_failed_total.labels(
+                "device_timeout"
+            ).inc()
+        self._host_serve(group, depth, nid)
+
+    def _launch(
+        self, group: list[_Pending], depth: int, nid=None,
+        route_guard=None, route_wd=None,
+    ) -> None:
         """Split-phase dispatch (runs on the launch thread): LAUNCH the
         device batch — async jax dispatch, returns before the device
         finishes — and hand only the readback to the pool. Batch N+1's
@@ -281,10 +589,27 @@ class CheckBatcher:
         tunnel costs ~70 ms per synchronized round-trip; pipelining
         hides it). The in-flight semaphore bounds launched-but-
         unresolved batches."""
+        if route_guard is not None:
+            if not route_guard.claim():
+                return  # the routing watchdog already host-served this group
+            if route_wd is not None:
+                route_wd.cancel()
+        self._launcher_busy = True
+        try:
+            self._launch_inner(group, depth, nid)
+        finally:
+            self._launcher_busy = False
+
+    def _launch_inner(self, group: list[_Pending], depth: int, nid) -> None:
         note_queue_wait(
             ((p.rt, p.enq_t) for p in group), self._queue.qsize(),
             self.metrics, self.tracer, self._depth_gauge,
         )
+        # deadline boundary: riders that expired while queued fail fast
+        # here instead of occupying a slot in the device batch
+        group = self._expire(group)
+        if not group:
+            return
         # singleflight: identical pendings share one batch slot; engine
         # stage telemetry is attributed to each slot's leader (followers
         # keep their queue/transport stages)
@@ -292,14 +617,37 @@ class CheckBatcher:
         try:
             engine = self._resolve(nid)
         except Exception as e:
-            for p in group:
-                p.future.set_exception(e)
+            self._fail_slots(
+                slots, classify_engine_error(e, self.metrics, "engine")
+            )
             return
         submit = getattr(engine, "check_batch_submit", None)
         if submit is None:
             self._pool.submit(self._evaluate, slots, depth, nid)
             return
         self._acquire_inflight()
+        # the semaphore wait can outlive every rider's budget: re-check
+        # the deadline boundary so a fully-expired batch never launches
+        # (the slot goes back to live work; partial expiry still rides)
+        live = self._expire([p for slot in slots for p in slot])
+        if not live:
+            self._release_inflight()
+            return
+        if len(live) != sum(len(s) for s in slots):
+            # rebuild without re-counting coalesce metrics
+            slots = coalesce_pending(live, lambda p: p.tuple, None)
+        # launch watchdog: armed BEFORE the submit so a stalled launch
+        # (not just a stalled resolve) is bounded too; exactly one of
+        # {watchdog, resolver} finishes this launch (the guard)
+        guard = _LaunchGuard()
+        watchdog = None
+        if self.device_timeout_s:
+            watchdog = threading.Timer(
+                self.device_timeout_s, self._device_timed_out,
+                args=(guard, engine, slots, depth),
+            )
+            watchdog.daemon = True
+            watchdog.start()
         try:
             if submit_takes_telemetry(
                 self._submit_takes_telemetry, engine, submit
@@ -310,12 +658,20 @@ class CheckBatcher:
                 )
             else:
                 handle = submit([s[0].tuple for s in slots], depth)
-        except Exception as e:
-            self._release_inflight()
-            for p in group:
-                p.future.set_exception(e)
+        except Exception:
+            if guard.claim():
+                if watchdog is not None:
+                    watchdog.cancel()
+                self._release_inflight()
+                self._record_device_failure("device")
+                # graceful degradation: the riders are answered by the
+                # exact host oracle instead of failing
+                self._host_fallback_slots(engine, slots, depth)
             return
-        self._pool.submit(self._resolve_inflight, engine, handle, slots)
+        self._pool.submit(
+            self._resolve_inflight, engine, handle, slots, depth,
+            guard, watchdog,
+        )
 
     def _run(self) -> None:
         while True:
@@ -323,10 +679,40 @@ class CheckBatcher:
             if item is None:
                 self._launcher.shutdown(wait=True)
                 self._pool.shutdown(wait=True)
+                self._host_pool.shutdown(wait=True)
                 return
             batch = self._drain(item)
             by_key: dict[tuple, list[_Pending]] = {}
             for p in batch:
                 by_key.setdefault((p.max_depth, p.nid), []).append(p)
             for (depth, nid), group in by_key.items():
-                self._launcher.submit(self._launch, group, depth, nid)
+                # breaker routing happens HERE (the collector), not in
+                # _launch: while the breaker is open, groups bypass the
+                # launch thread entirely — a launch thread wedged on a
+                # stalled device must not block degraded host serving
+                if self.breaker is not None and not self.breaker.allow():
+                    self._host_pool.submit(self._host_serve, group, depth, nid)
+                else:
+                    # routing watchdog (device route only): bounds the
+                    # WAIT for the single launch thread, which an earlier
+                    # group's wedged submit can hold for arbitrarily long
+                    # — without it, queued groups sat unprotected until
+                    # the launcher freed (the per-launch watchdog only
+                    # arms once _launch runs). Armed ONLY when the
+                    # launcher is already occupied: an idle launcher
+                    # starts _launch immediately and its own watchdog
+                    # covers everything — the healthy fast path pays no
+                    # timer thread here.
+                    route_guard = route_wd = None
+                    if self.device_timeout_s and self._launcher_busy:
+                        route_guard = _LaunchGuard()
+                        route_wd = threading.Timer(
+                            self.device_timeout_s, self._stuck_in_launcher,
+                            args=(route_guard, group, depth, nid),
+                        )
+                        route_wd.daemon = True
+                        route_wd.start()
+                    self._launcher.submit(
+                        self._launch, group, depth, nid,
+                        route_guard, route_wd,
+                    )

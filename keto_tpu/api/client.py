@@ -64,6 +64,10 @@ class _BaseClient:
     def __init__(self, channel: grpc.Channel):
         self.channel = channel
         self._callables: dict = {}
+        # retry policy (resilience.RetryPolicy): set ONLY by ReadClient —
+        # retried writes could double-apply, so WriteClient never wires
+        # one and _rpc stays single-shot for it
+        self._retry = None
 
     def _rpc(
         self, service: str, method: str, req, resp_cls, timeout=None,
@@ -80,7 +84,14 @@ class _BaseClient:
                 request_serializer=lambda m: m.SerializeToString(),
                 response_deserializer=resp_cls.FromString,
             )
-        return callable_(req, timeout=timeout, metadata=metadata)
+        if self._retry is None:
+            return callable_(req, timeout=timeout, metadata=metadata)
+        # deadline-budget-aware retries: `timeout` is the TOTAL budget
+        # across attempts; each attempt gets the remaining slice
+        return self._retry.call(
+            lambda remaining: callable_(req, timeout=remaining, metadata=metadata),
+            timeout,
+        )
 
     @staticmethod
     def _trace_metadata(traceparent: str):
@@ -119,7 +130,18 @@ class WatchStreamEvent(NamedTuple):
 
 
 class ReadClient(_BaseClient):
-    """CheckService + ExpandService + ReadService client."""
+    """CheckService + ExpandService + ReadService client.
+
+    `retry_policy` (resilience.RetryPolicy | None) retries IDEMPOTENT
+    reads — check/check_batch/expand/list_* and the health/version
+    probes, everything riding `_rpc` — on UNAVAILABLE /
+    RESOURCE_EXHAUSTED with exponential backoff + full jitter, staying
+    inside the caller's `timeout=` budget. Streams (watch) are never
+    retried (a blind re-subscribe would replay delivered events)."""
+
+    def __init__(self, channel: grpc.Channel, retry_policy=None):
+        super().__init__(channel)
+        self._retry = retry_policy
 
     def check(
         self, t: RelationTuple, max_depth: int = 0, timeout=None,

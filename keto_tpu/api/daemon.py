@@ -220,6 +220,12 @@ class Daemon:
             metrics=registry.metrics(),
             tracer=registry.tracer(),
             max_inflight=cfg.get("serve.check.max_inflight"),
+            # resilience plane: bounded admission, launch watchdog, and
+            # the process-wide device-path breaker (shared with the aio
+            # plane so device health is judged from all traffic)
+            max_queue=cfg.get("serve.check.max_queue"),
+            device_timeout_ms=cfg.get("serve.check.device_timeout_ms"),
+            breaker=registry.circuit_breaker(),
         )
         self._grpc_read = None
         self._grpc_write = None
@@ -301,6 +307,7 @@ class Daemon:
         # so the store write hooks and engine push-invalidation are live
         # from the first request
         reg.watch_hub()
+        reg.draining.clear()
         reg.ready.set()
         self._started = True
         logger.info(
@@ -357,8 +364,24 @@ class Daemon:
         return self._rest["metrics"].port
 
     def stop(self, grace: float = 5.0) -> None:
-        """Graceful drain: readiness off, stop accepting, stop servers."""
+        """Graceful drain (ref: daemon.go:233-273 ordering, plus an
+        explicit admission grace window): readiness flips first, then
+        new check admissions are shed with a typed OverloadedError while
+        in-flight checks complete — only then do the listeners close, so
+        a request admitted before the drain never sees a torn-down
+        pipeline."""
+        import time as _time
+
         self.registry.ready.clear()
+        # admission gate: resilience.admit_check sheds new checks with a
+        # typed 429 the moment this flips — readiness is already off, so
+        # balancers stop routing while stragglers get a clear signal
+        self.registry.draining.set()
+        # grace window: let admitted-but-unresolved checks finish (the
+        # batcher's pending count reaches zero) before closing listeners
+        deadline = _time.monotonic() + grace
+        while _time.monotonic() < deadline and not self.batcher.idle():
+            _time.sleep(0.02)
         # end watch streams first so draining servers aren't pinned by
         # parked subscriber threads
         if self.registry._watch_hub is not None:

@@ -76,8 +76,11 @@ _CODE_BY_STATUS = {
     403: grpc.StatusCode.PERMISSION_DENIED,
     404: grpc.StatusCode.NOT_FOUND,
     409: grpc.StatusCode.FAILED_PRECONDITION,  # unsatisfiable snaptoken
+    429: grpc.StatusCode.RESOURCE_EXHAUSTED,  # shed by admission control
     500: grpc.StatusCode.INTERNAL,
     501: grpc.StatusCode.UNIMPLEMENTED,
+    503: grpc.StatusCode.UNAVAILABLE,
+    504: grpc.StatusCode.DEADLINE_EXCEEDED,  # end-to-end deadline expired
 }
 
 
@@ -85,6 +88,23 @@ def _grpc_code(err: Exception) -> grpc.StatusCode:
     if isinstance(err, KetoError):
         return _CODE_BY_STATUS.get(err.status, grpc.StatusCode.INTERNAL)
     return grpc.StatusCode.INTERNAL
+
+
+def _attach_retry_after(context, err) -> None:
+    """Shed responses carry the retry hint as trailing metadata — the
+    gRPC twin of the REST Retry-After header (same OverloadedError
+    field, so the hint is plane-identical)."""
+    ra = getattr(err, "retry_after_s", None)
+    if ra is None:
+        return
+    from ..resilience import retry_after_header_value
+
+    try:
+        context.set_trailing_metadata(
+            (("retry-after", retry_after_header_value(ra)),)
+        )
+    except Exception:  # noqa: BLE001 — metadata is best-effort decoration
+        pass
 
 
 def _metadata_dict(context) -> dict:
@@ -122,9 +142,22 @@ class _Services:
     def _begin_trace(self, context):
         """RequestTrace for one RPC: joins the caller's trace when the
         invocation metadata carries a W3C `traceparent` entry (the gRPC
-        twin of the REST header), else starts a fresh one."""
+        twin of the REST header), else starts a fresh one. The native
+        gRPC deadline (context.time_remaining) becomes the request's
+        end-to-end Deadline, clamped/defaulted by serve.check.*_deadline_ms
+        — so the server fails fast and frees the batch slot instead of
+        computing an answer the cancelled client will never read."""
+        from ..resilience import ingest_deadline
+
         ctx = parse_traceparent(_metadata_dict(context).get("traceparent"))
-        return RequestTrace(ctx.child() if ctx is not None else None)
+        try:
+            native_s = context.time_remaining()
+        except Exception:  # noqa: BLE001 — stub contexts in tests
+            native_s = None
+        return RequestTrace(
+            ctx.child() if ctx is not None else None,
+            deadline=ingest_deadline(self.registry.config, native_s=native_s),
+        )
 
     def _finish_trace(self, method, rt, code, duration) -> None:
         """Stage bookkeeping + request/slow-query logs after one RPC
@@ -152,6 +185,7 @@ class _Services:
                         return fn(request, context)
                 except KetoError as e:
                     outcome["code"] = _grpc_code(e).name
+                    _attach_retry_after(context, e)
                     context.abort(_grpc_code(e), e.message)
                 except Exception as e:  # noqa: BLE001 — RPC boundary
                     outcome["code"] = "INTERNAL"
@@ -202,7 +236,11 @@ class _Services:
 
     def check(self, req, context):
         from ..engine.snaptoken import encode_snaptoken
+        from ..resilience import admit_check
 
+        # admission gate BEFORE any work (typed 429/504; see
+        # resilience.admit_check): shed/expired requests cost nothing
+        admit_check(self.registry, self.batcher, current_request_trace())
         t = self._check_tuple(req)
         self.registry.validate_namespaces(t)
         nid = self._nid(context)
@@ -233,7 +271,11 @@ class _Services:
         engine errors, unknown names via host replay) come back as
         per-result error strings; one bad item never fails the batch."""
         from ..engine.snaptoken import encode_snaptoken
+        from ..resilience import admit_check
 
+        # draining/expired gate (no queue bound: the batch rides one
+        # direct engine launch, not the batcher queue)
+        admit_check(self.registry, None, current_request_trace())
         nid = self._nid(context)
         version = self._enforce_snaptoken(req.snaptoken, nid)
         idx: list[int] = []

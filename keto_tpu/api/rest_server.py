@@ -196,7 +196,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _error(self, err: Exception) -> None:
         if isinstance(err, KetoError):
-            self._json(err.status, err.to_dict())
+            extra = None
+            ra = getattr(err, "retry_after_s", None)
+            if ra is not None:
+                # shed responses (OverloadedError) carry the retry hint
+                # the way HTTP specifies it; the gRPC planes mirror it as
+                # trailing metadata from the same field
+                from ..resilience import retry_after_header_value
+
+                extra = [("Retry-After", retry_after_header_value(ra))]
+            self._json(err.status, err.to_dict(), extra_headers=extra)
         else:
             e = KetoError(str(err))
             self._json(500, e.to_dict())
@@ -393,6 +402,24 @@ class _Handler(BaseHTTPRequestHandler):
 
         return enforce_snaptoken(self.registry, token, nid)
 
+    def _ingest_deadline(self):
+        """The request's end-to-end Deadline from the
+        `x-request-timeout-ms` header (or serve.check.default_deadline_ms,
+        clamped to max_deadline_ms), attached to the RequestTrace so the
+        cache -> batcher -> device pipeline fails fast at every stage
+        boundary once the budget is spent. Returns the rt (or None)."""
+        from ..resilience import ingest_deadline, parse_timeout_ms
+
+        rt = getattr(self, "_rt", None)
+        if rt is not None:
+            rt.deadline = ingest_deadline(
+                self.registry.config,
+                request_ms=parse_timeout_ms(
+                    self.headers.get("x-request-timeout-ms")
+                ),
+            )
+        return rt
+
     def _check(self, method: str, mirror_status: bool) -> None:
         """ref: check/handler.go getCheck/postCheck + 403 mirroring.
         Snaptokens (keto_tpu extension; the reference REST check has no
@@ -401,7 +428,12 @@ class _Handler(BaseHTTPRequestHandler):
         X-Keto-Snaptoken header — a header, so the parity JSON body
         stays byte-identical to the reference's {"allowed": ...}."""
         from ..engine.snaptoken import encode_snaptoken
+        from ..resilience import admit_check
 
+        # deadline ingestion + admission gate BEFORE any work: shed
+        # requests answer a typed 429 (Retry-After attached), expired
+        # ones a typed 504 — the same error surface the gRPC planes map
+        admit_check(self.registry, self.batcher, self._ingest_deadline())
         params = self._params()
         max_depth = _get_max_depth(params)
         t = self._check_tuple_from_request(method)
@@ -436,6 +468,11 @@ class _Handler(BaseHTTPRequestHandler):
         false, "error": str}, ...]} in request order. The whole batch
         rides ONE engine.check_batch launch; per-item problems (bad
         subject, unknown names via host replay) never fail the batch."""
+        from ..resilience import admit_check
+
+        # draining/expired gate (no queue bound: the batch rides one
+        # direct engine launch, not the batcher queue)
+        admit_check(self.registry, None, self._ingest_deadline())
         params = self._params()
         body = self._body_json()
         if isinstance(body, dict):

@@ -560,8 +560,17 @@ def cmd_watch(args) -> int:
 
 
 def cmd_status(args) -> int:
-    """ref: cmd/status/root.go — health polling, --block retries."""
+    """ref: cmd/status/root.go — health polling, --block retries.
+
+    The retry cadence is jittered capped exponential backoff
+    (resilience.backoff_delays) instead of a fixed 1s sleep: a fleet of
+    health-waiters restarting together must not synchronize their probes
+    against a recovering server. Without --block, a failed probe exits
+    with the actual error on stderr instead of a bare NOT_SERVING."""
+    from ..resilience import backoff_delays
+
     make = _write_client if args.endpoint == "write" else _read_client
+    delays = backoff_delays(base_s=0.25, cap_s=2.0)
     while True:
         try:
             client = make(args)
@@ -575,8 +584,9 @@ def cmd_status(args) -> int:
         except Exception as e:  # noqa: BLE001 — retry loop
             if not args.block:
                 print("NOT_SERVING")
+                print(f"health check failed: {e}", file=sys.stderr)
                 return 1
-        time.sleep(1)
+        time.sleep(next(delays))
 
 
 def cmd_clidoc(args) -> int:

@@ -33,6 +33,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from .. import faults as _faults
 from ..config import Config
 from ..ketoapi import RelationTuple, Subject, Tree
 from ..storage.definitions import DEFAULT_NETWORK, Manager
@@ -1499,6 +1500,24 @@ class TPUCheckEngine:
         """Batched membership checks (no proof trees)."""
         return self.check_batch_resolve(self.check_batch_submit(tuples, max_depth))
 
+    def check_batch_host(
+        self, tuples: Sequence[RelationTuple], max_depth: int = 0
+    ) -> list[CheckResult]:
+        """Exact host-oracle evaluation of a whole batch with ZERO device
+        contact (no state build, no launch) — the circuit breaker's
+        graceful-degradation route and the launch watchdog's recovery
+        path (api/batcher.py host_check_batch): answers stay correct
+        while the device path is unhealthy, latency degrades."""
+        results = [
+            self.reference.check_relation_tuple(t, max_depth, self.nid)
+            for t in tuples
+        ]
+        self.stats["host_checks"] += len(tuples)
+        if self.metrics is not None and tuples:
+            self.metrics.check_batch_size.observe(len(tuples))
+            self.metrics.checks_total.labels("host").inc(len(tuples))
+        return results
+
     def check_batch_submit(
         self, tuples: Sequence[RelationTuple], max_depth: int = 0,
         telemetry=None,
@@ -1520,6 +1539,11 @@ class TPUCheckEngine:
         n = len(tuples)
         if n == 0:
             return ("empty", [], None)
+        # fault-injection point (keto_tpu/faults.py): a stall here models
+        # a wedged device/tunnel launch, an error a dying device — BEFORE
+        # any state build, so the batcher's watchdog/breaker see exactly
+        # what a real launch failure looks like. Disarmed: one dict miss.
+        _faults.inject("device_launch")
         t_submit = time.perf_counter()
         state = self._ensure_state()
         global_max = self.config.max_read_depth()
@@ -1710,6 +1734,12 @@ class TPUCheckEngine:
             ctx_hit = np.asarray(ctx_hit).copy()
             needs_host = np.asarray(needs_host)
             n_isl = int(n_isl)
+        if _faults.get("batch_corrupt") is not None:
+            # fault-injection point: poison every slot's device verdict
+            # so each query takes the exact-host-replay escape hatch the
+            # capacity overflows use — answers must stay byte-correct
+            _faults.inject("batch_corrupt")
+            needs_host = np.maximum(np.asarray(needs_host), 1)
         if n_isl:
             from .islands import combine_islands
 

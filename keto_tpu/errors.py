@@ -131,3 +131,49 @@ class NotImplementedYetError(KetoError):
     status = 501
     code = "not_implemented"
     default_message = "not yet implemented"
+
+
+class DeadlineExceededError(KetoError):
+    # Resilience plane (keto_tpu/resilience.py): the request's end-to-end
+    # deadline (REST x-request-timeout-ms / native gRPC deadline /
+    # serve.check.default_deadline_ms) expired before an answer was
+    # produced. 504 on REST, DEADLINE_EXCEEDED on gRPC — Zanzibar's
+    # deadline-scoped evaluation (paper §2.4.1) fails fast instead of
+    # occupying a batch slot.
+    status = 504
+    code = "deadline_exceeded"
+    default_message = "request deadline exceeded"
+
+
+class OverloadedError(KetoError):
+    # Admission control / load shedding: the request was rejected BEFORE
+    # any work was done (bounded batcher queue at serve.check.max_queue,
+    # or the daemon's shutdown drain window). 429 on REST (with a
+    # Retry-After header from `retry_after_s`), RESOURCE_EXHAUSTED on
+    # gRPC. Shedding with a typed error is the graceful-degradation
+    # contract: memory stays bounded and clients get a clear retry signal
+    # instead of an unbounded queue wait.
+    status = 429
+    code = "too_many_requests"
+    default_message = "server is overloaded, retry later"
+
+    def __init__(
+        self,
+        message: str | None = None,
+        *,
+        debug: str | None = None,
+        retry_after_s: float | None = None,
+    ):
+        super().__init__(message, debug=debug)
+        self.retry_after_s = retry_after_s
+
+
+class CheckBatchFailedError(KetoError, RuntimeError):
+    # Engine-batch failure classified into the typed error surface
+    # (api/batcher.py classify_engine_error) instead of leaking the raw
+    # exception to every rider. Also a RuntimeError so embedders'
+    # `except RuntimeError` handlers around CheckBatcher.check keep
+    # working.
+    status = 500
+    code = "internal_server_error"
+    default_message = "check batch evaluation failed"

@@ -1,0 +1,124 @@
+"""Fault-injection harness for the resilience plane.
+
+Named injection points compiled into the serving stack (a dict probe on
+an empty dict when nothing is armed — nanoseconds on the hot path):
+
+  - ``device_launch``   — runs at the top of
+    `TPUCheckEngine.check_batch_submit`, BEFORE any state build or
+    kernel launch: `stall` holds the launch thread (a wedged device /
+    TPU tunnel), `error` raises (a dying device). Exercises the
+    caller-side deadline, the launch watchdog, and the circuit breaker.
+  - ``store_read``      — runs in every store's `get_relation_tuples`
+    (memory / sqlite / columnar): `stall` models a slow persistence
+    layer, `error` a failing one. Exercises host-oracle latency and the
+    typed engine-error classification.
+  - ``batch_corrupt``   — marker fault: `check_batch_resolve_v` poisons
+    every slot's device verdict so each query replays on the EXACT host
+    oracle — the same cause-coded escape hatch capacity overflows use,
+    now drivable on demand. Answers must stay byte-correct.
+
+Armed per-process, either programmatically (`set_fault` / `clear`, the
+tests' and smoke harness's path) or via the ``KETO_FAULTS`` environment
+variable parsed at import::
+
+    KETO_FAULTS="device_launch=stall:0.25,store_read=error:disk gone"
+    KETO_FAULTS="batch_corrupt=on"
+
+Never armed in production images by default: an empty spec table makes
+every injection point a single dict miss.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+
+class FaultInjected(RuntimeError):
+    """The error an ``error:``-mode injection point raises."""
+
+
+class FaultSpec:
+    __slots__ = ("stall_s", "error", "hits")
+
+    def __init__(self, stall_s: float = 0.0, error: Optional[str] = None):
+        self.stall_s = float(stall_s or 0.0)
+        self.error = error
+        self.hits = 0  # injections served (test/smoke observable)
+
+
+POINTS = ("device_launch", "store_read", "batch_corrupt")
+
+_SPECS: dict[str, FaultSpec] = {}
+_mu = threading.Lock()
+
+
+def set_fault(
+    point: str, stall_s: float = 0.0, error: Optional[str] = None
+) -> FaultSpec:
+    """Arm one injection point; returns its spec (hits counter included).
+    A spec with neither stall nor error is a pure marker (batch_corrupt)."""
+    if point not in POINTS:
+        raise ValueError(
+            f"unknown fault point {point!r}; known: {', '.join(POINTS)}"
+        )
+    spec = FaultSpec(stall_s=stall_s, error=error)
+    with _mu:
+        _SPECS[point] = spec
+    return spec
+
+
+def clear(point: Optional[str] = None) -> None:
+    with _mu:
+        if point is None:
+            _SPECS.clear()
+        else:
+            _SPECS.pop(point, None)
+
+
+def get(point: str) -> Optional[FaultSpec]:
+    return _SPECS.get(point)
+
+
+def inject(point: str) -> None:
+    """Serve one injection: sleep the stall, then raise the error (both
+    optional). A disarmed point is one dict miss."""
+    spec = _SPECS.get(point)
+    if spec is None:
+        return
+    spec.hits += 1
+    if spec.stall_s:
+        time.sleep(spec.stall_s)
+    if spec.error is not None:
+        raise FaultInjected(spec.error)
+
+
+def configure(text: str) -> None:
+    """Parse the KETO_FAULTS format: comma-separated
+    ``point=stall:<seconds>`` / ``point=error:<message>`` / ``point=on``
+    entries. Replaces the whole armed set."""
+    clear()
+    for entry in (text or "").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, _, spec = entry.partition("=")
+        mode, _, value = spec.partition(":")
+        name, mode = name.strip(), mode.strip()
+        if mode == "stall":
+            set_fault(name, stall_s=float(value))
+        elif mode == "error":
+            set_fault(name, error=value or "injected fault")
+        elif mode == "on":
+            set_fault(name)
+        else:
+            raise ValueError(
+                f"unknown fault mode {mode!r} in {entry!r} "
+                "(use stall:<s>, error:<msg>, or on)"
+            )
+
+
+if os.environ.get("KETO_FAULTS"):
+    configure(os.environ["KETO_FAULTS"])

@@ -102,13 +102,17 @@ def parse_traceparent(value: Optional[str]) -> Optional[SpanContext]:
 class RequestTrace:
     """Per-request telemetry carrier: the span context plus accumulated
     per-stage seconds. Created at the transport, handed through the
-    batcher into the engine; every layer adds its stage durations."""
+    batcher into the engine; every layer adds its stage durations.
+    `deadline` (resilience.Deadline | None) rides the same handoff so
+    every stage boundary can fail the request fast once the end-to-end
+    budget is spent — the Zanzibar deadline-scoped-evaluation carrier."""
 
-    __slots__ = ("ctx", "stages")
+    __slots__ = ("ctx", "stages", "deadline")
 
-    def __init__(self, ctx: Optional[SpanContext] = None):
+    def __init__(self, ctx: Optional[SpanContext] = None, deadline=None):
         self.ctx = ctx if ctx is not None else new_trace()
         self.stages: dict[str, float] = {}
+        self.deadline = deadline
 
     def add_stage(self, name: str, seconds: float) -> None:
         self.stages[name] = self.stages.get(name, 0.0) + seconds
@@ -306,6 +310,76 @@ class Metrics:
             "Concurrent identical pending checks collapsed onto one "
             "in-flight batch slot and fanned back out (singleflight "
             "dedupe, Zanzibar's hot-spot lock table)",
+            registry=self.registry,
+        )
+        # overload & failure resilience plane (keto_tpu/resilience.py):
+        # deadlines, admission control, device-path circuit breaker
+        self.deadline_exceeded_total = prom.Counter(
+            "keto_tpu_deadline_exceeded_total",
+            "Checks failed with a typed DEADLINE_EXCEEDED (REST 504), by "
+            "the pipeline stage that detected expiry: admission (gate "
+            "before any work), queue (expired while batched — dropped "
+            "without occupying a device slot), wait (the caller's "
+            "remaining budget ran out waiting on the batch result)",
+            ["stage"],
+            registry=self.registry,
+        )
+        self.requests_shed_total = prom.Counter(
+            "keto_tpu_requests_shed_total",
+            "Check admissions rejected with a typed OverloadedError "
+            "(429 / RESOURCE_EXHAUSTED, Retry-After attached) before any "
+            "work was done, by reason: queue_full (admitted-but-"
+            "unresolved checks at serve.check.max_queue), draining (the "
+            "daemon's shutdown grace window)",
+            ["reason"],
+            registry=self.registry,
+        )
+        self.batcher_queue_limit = prom.Gauge(
+            "keto_tpu_batcher_queue_limit",
+            "Configured admission bound on admitted-but-unresolved "
+            "checks per batching plane (serve.check.max_queue; 0 = "
+            "unbounded). Compare with keto_tpu_batcher_queue_depth for "
+            "rejection headroom",
+            ["plane"],  # threaded | aio
+            registry=self.registry,
+        )
+        self.breaker_state = prom.Gauge(
+            "keto_tpu_breaker_state",
+            "Device-path circuit breaker state: 0 closed (device "
+            "serving), 1 open (every check degraded to the exact host "
+            "oracle — correct answers, degraded latency), 2 half-open "
+            "(one probe batch deciding recovery)",
+            registry=self.registry,
+        )
+        self.breaker_transitions_total = prom.Counter(
+            "keto_tpu_breaker_transitions_total",
+            "Circuit-breaker state transitions, labeled by the state "
+            "entered (closed | open | half_open) — the closed -> open -> "
+            "half-open -> closed recovery cycle is countable from scrapes "
+            "alone",
+            ["to"],
+            registry=self.registry,
+        )
+        self.check_batch_failed_total = prom.Counter(
+            "keto_tpu_check_batch_failed_total",
+            "Engine batch evaluations that failed, by cause: device "
+            "(submit/resolve raised; riders re-answered by the host "
+            "oracle), device_timeout (launch watchdog abandoned a batch "
+            "past serve.check.device_timeout_ms; riders re-answered by "
+            "the host oracle), engine (a non-split-phase engine raised; "
+            "riders fail with a typed KetoError), host (the host-oracle "
+            "fallback itself raised), keto (a typed KetoError propagated "
+            "as-is)",
+            ["cause"],
+            registry=self.registry,
+        )
+        self.client_retries_total = prom.Counter(
+            "keto_tpu_client_retries_total",
+            "In-process ReadClient retries (resilience.RetryPolicy: "
+            "exponential backoff + full jitter, UNAVAILABLE/"
+            "RESOURCE_EXHAUSTED only, idempotent reads only, deadline-"
+            "budget-aware) — fed when a RetryPolicy is constructed with "
+            "this counter (embedders, bench, load tools)",
             registry=self.registry,
         )
         # hot-path cache: (transport, method) -> (duration child,

@@ -136,9 +136,14 @@ class Registry:
         self._watch_hub = None
         self._check_cache = None
         self._check_cache_built = False
+        self._breaker = None
         # health: flipped by the daemon around serving
         # (ref: registry_default.go:98-112 healthx readiness checkers)
         self.ready = ReadyState()
+        # drain flag: set by Daemon.stop for the shutdown grace window —
+        # the admission gate (resilience.admit_check) sheds new checks
+        # with a typed 429 while in-flight work completes
+        self.draining = threading.Event()
 
     # -- storage --------------------------------------------------------------
 
@@ -397,6 +402,27 @@ class Registry:
 
                 self._tracer = build_tracer(self.config)
             return self._tracer
+
+    def circuit_breaker(self):
+        """The process-wide device-path circuit breaker
+        (resilience.CircuitBreaker), shared by both batching planes so
+        device health is judged from all traffic. Always built (the
+        defaults are harmless when the device is healthy); tuned via
+        serve.check.breaker.{threshold,cooldown_s}."""
+        with self._lock:
+            if self._breaker is None:
+                from .resilience import CircuitBreaker
+
+                self._breaker = CircuitBreaker(
+                    threshold=int(
+                        self.config.get("serve.check.breaker.threshold", 5)
+                    ),
+                    cooldown_s=float(
+                        self.config.get("serve.check.breaker.cooldown_s", 5.0)
+                    ),
+                    metrics=self.metrics(),
+                )
+            return self._breaker
 
     def profiler(self):
         """The process-wide on-demand capture session (profiling.py),

@@ -1,0 +1,370 @@
+"""Overload & failure resilience plane: deadlines, admission, breaker, retry.
+
+Zanzibar keeps its tail latency bounded under overload by bounding the
+work any one request can consume — deadline-scoped evaluation, request
+hedging, and graceful degradation (paper §2.4.1/§4) — and the Go
+reference gets request cancellation for free via `context.Context`. This
+module is the Python equivalent for the serve plane, four primitives the
+transports and batchers share:
+
+  - `Deadline` — one end-to-end budget per request, ingested at the
+    transport (REST `x-request-timeout-ms`, native gRPC deadlines,
+    `serve.check.default_deadline_ms`, clamped to
+    `serve.check.max_deadline_ms`), carried on the RequestTrace handoff,
+    and checked at every stage boundary (admission -> queue -> device
+    wait) so an expired request fails fast with a typed
+    `DeadlineExceededError` instead of occupying a batch slot.
+  - `admit_check` — the admission gate all three transports run BEFORE
+    any work: rejects with a typed `OverloadedError` while the daemon
+    drains or when the batcher's admitted-but-unresolved count is at
+    `serve.check.max_queue` (queue-delay-aware: the retry-after hint is
+    the estimated queue delay).
+  - `CircuitBreaker` — the device-path breaker: consecutive device-batch
+    failures or launch timeouts trip closed -> open; while open every
+    check routes to the exact host oracle (answers stay correct, latency
+    degrades); after `cooldown_s` one probe batch half-opens it and its
+    outcome closes or re-opens.
+  - `RetryPolicy` — client-side exponential backoff with FULL jitter for
+    idempotent reads only (`ReadClient`), retrying UNAVAILABLE /
+    RESOURCE_EXHAUSTED within the caller's deadline budget.
+
+Everything is dependency-light (no grpc/jax imports at module level) so
+the CLI and tools can use the backoff helpers standalone.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import threading
+import time
+from typing import Iterator, Optional
+
+from .errors import DeadlineExceededError, MalformedInputError, OverloadedError
+
+# -- deadlines ----------------------------------------------------------------
+
+
+class Deadline:
+    """One request's end-to-end budget, pinned to the monotonic clock at
+    ingestion. Cheap by design: the hot path asks only remaining_s() /
+    expired() (two clock reads per stage boundary)."""
+
+    __slots__ = ("expires_at", "budget_s")
+
+    def __init__(self, budget_s: float):
+        self.budget_s = float(budget_s)
+        self.expires_at = time.monotonic() + self.budget_s
+
+    @classmethod
+    def after_ms(cls, ms: float) -> "Deadline":
+        return cls(float(ms) / 1e3)
+
+    def remaining_s(self) -> float:
+        return max(0.0, self.expires_at - time.monotonic())
+
+    def expired(self) -> bool:
+        return time.monotonic() >= self.expires_at
+
+
+def parse_timeout_ms(value: Optional[str]) -> Optional[float]:
+    """The REST `x-request-timeout-ms` header value as milliseconds; a
+    malformed or non-positive value is the client's error (400), never a
+    silent no-deadline."""
+    if not value:
+        return None
+    try:
+        ms = float(value)
+    except ValueError:
+        raise MalformedInputError(
+            debug=f"invalid x-request-timeout-ms {value!r}"
+        )
+    if ms <= 0:
+        raise MalformedInputError(
+            debug=f"x-request-timeout-ms must be positive, got {value!r}"
+        )
+    return ms
+
+
+def ingest_deadline(
+    config, request_ms: Optional[float] = None,
+    native_s: Optional[float] = None,
+) -> Optional[Deadline]:
+    """Build one request's Deadline from (in precedence order) the
+    explicit request budget (REST header ms / native gRPC seconds) and
+    the `serve.check.default_deadline_ms` schema key, clamped to
+    `serve.check.max_deadline_ms`. None = no deadline (parity with the
+    reference, whose REST plane has none either)."""
+    budget_ms = request_ms
+    if budget_ms is None and native_s is not None:
+        if native_s <= 0:
+            # the client's deadline already expired in transit: an
+            # ALREADY-EXPIRED deadline (admit_check 504s before any
+            # work), not "no deadline"
+            return Deadline(0.0)
+        # some grpc versions answer time_remaining() with a sentinel-huge
+        # float instead of None when the client set no deadline —
+        # anything past a day is "no deadline", not a budget (and would
+        # overflow the C-level wait timeouts downstream)
+        if native_s < 86400.0:
+            budget_ms = native_s * 1e3
+    if budget_ms is None:
+        default_ms = config.get("serve.check.default_deadline_ms")
+        if default_ms:
+            budget_ms = float(default_ms)
+    if budget_ms is None:
+        return None
+    max_ms = config.get("serve.check.max_deadline_ms")
+    if max_ms:
+        budget_ms = min(budget_ms, float(max_ms))
+    # absolute cap (one day): an absurd client budget must not overflow
+    # the C-level wait timeouts the remaining_s value feeds
+    return Deadline.after_ms(min(budget_ms, 86400.0 * 1e3))
+
+
+def admit_check(registry, batcher, rt=None) -> None:
+    """The shared admission gate, run by all three transports BEFORE any
+    check work (cache lookup included): typed rejection while the daemon
+    drains, when the request arrived already expired, or when the
+    batcher is at its admission bound. Raises OverloadedError (429 /
+    RESOURCE_EXHAUSTED) or DeadlineExceededError (504 /
+    DEADLINE_EXCEEDED); byte-identical bodies across REST/gRPC/aio
+    because all planes map the same KetoError."""
+    metrics = registry.metrics()
+    if registry.draining.is_set():
+        metrics.requests_shed_total.labels("draining").inc()
+        raise OverloadedError(
+            "server is draining", retry_after_s=1.0
+        )
+    dl = getattr(rt, "deadline", None) if rt is not None else None
+    if dl is not None and dl.expired():
+        metrics.deadline_exceeded_total.labels("admission").inc()
+        raise DeadlineExceededError(
+            "request deadline expired before admission"
+        )
+    if batcher is not None:
+        batcher.admit(dl)
+
+
+def retry_after_header_value(retry_after_s: Optional[float]) -> str:
+    """Retry-After is specified in whole seconds; round up so the hint
+    never invites an immediately-reshed retry."""
+    if not retry_after_s or retry_after_s <= 0:
+        return "1"
+    return str(max(1, int(math.ceil(retry_after_s))))
+
+
+# -- backoff / client retry ---------------------------------------------------
+
+
+def backoff_delays(
+    base_s: float = 0.25,
+    cap_s: float = 5.0,
+    rng: Optional[random.Random] = None,
+) -> Iterator[float]:
+    """Infinite exponential backoff with FULL jitter (delay ~ U[0, min(
+    cap, base * 2^attempt)]) — the AWS-architecture-blog shape: under a
+    thundering herd, full jitter spreads retries across the whole window
+    instead of synchronizing them at the cap."""
+    rng = rng or random.Random()
+    attempt = 0
+    while True:
+        yield rng.uniform(0.0, min(cap_s, base_s * (2.0 ** attempt)))
+        if base_s * (2.0 ** attempt) < cap_s:
+            attempt += 1
+
+
+class RetryPolicy:
+    """Client-side retry for IDEMPOTENT reads only (ReadClient wires it;
+    WriteClient never does — a retried transact could double-apply).
+
+    Retries gRPC UNAVAILABLE / RESOURCE_EXHAUSTED (the two codes this
+    server sheds with) with full-jitter exponential backoff, staying
+    inside the caller's deadline budget: a retry whose backoff sleep
+    would outlive the remaining budget gives up and re-raises instead of
+    burning the budget asleep. `counter` is an optional metrics counter
+    (e.g. Metrics.client_retries_total) incremented per retry; `stats`
+    mirrors it process-locally."""
+
+    RETRYABLE_CODES = ("UNAVAILABLE", "RESOURCE_EXHAUSTED")
+
+    def __init__(
+        self,
+        max_attempts: int = 3,
+        base_s: float = 0.05,
+        cap_s: float = 2.0,
+        codes=None,
+        counter=None,
+        sleep=time.sleep,
+        rng: Optional[random.Random] = None,
+    ):
+        self.max_attempts = max(int(max_attempts), 1)
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.codes = tuple(codes) if codes is not None else self.RETRYABLE_CODES
+        self.counter = counter
+        self._sleep = sleep
+        self._rng = rng or random.Random()
+        self.stats = {"attempts": 0, "retries": 0, "giveups": 0}
+
+    def _delay(self, attempt: int) -> float:
+        return self._rng.uniform(
+            0.0, min(self.cap_s, self.base_s * (2.0 ** attempt))
+        )
+
+    def _retryable(self, err) -> bool:
+        code = getattr(err, "code", None)
+        if not callable(code):
+            return False
+        try:
+            name = code().name
+        except Exception:  # noqa: BLE001 — malformed RpcError: don't retry
+            return False
+        return name in self.codes
+
+    def call(self, fn, budget_s: Optional[float] = None):
+        """Run `fn(remaining_timeout_s)` with retries. The budget is the
+        TOTAL deadline across all attempts (the caller's `timeout=`);
+        each attempt gets the remaining slice, so retries never extend
+        the caller-visible deadline."""
+        start = time.monotonic()
+        attempt = 0
+        while True:
+            self.stats["attempts"] += 1
+            remaining = (
+                None if budget_s is None
+                else budget_s - (time.monotonic() - start)
+            )
+            try:
+                return fn(remaining)
+            except Exception as e:  # noqa: BLE001 — classified just below
+                if not self._retryable(e) or attempt + 1 >= self.max_attempts:
+                    raise
+                delay = self._delay(attempt)
+                if remaining is not None and delay >= max(remaining, 0.0):
+                    # budget-aware: sleeping would outlive the deadline
+                    self.stats["giveups"] += 1
+                    raise
+                self.stats["retries"] += 1
+                if self.counter is not None:
+                    self.counter.inc()
+                self._sleep(delay)
+                attempt += 1
+
+
+# -- circuit breaker ----------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Device-path circuit breaker: closed -> open -> half-open.
+
+    `record_failure()` on consecutive device-batch failures (submit /
+    resolve exceptions, launch watchdog timeouts); at `threshold` the
+    breaker OPENS and `allow()` answers False — the batchers then route
+    every check group to the exact host oracle (engine/reference.py):
+    answers stay correct, latency degrades, the device is left alone to
+    recover. After `cooldown_s` the next `allow()` admits exactly ONE
+    probe group (half-open); its `record_success()` closes the breaker,
+    its `record_failure()` re-opens it for another cooldown.
+
+    Thread-safe (one lock, a handful of fields) and shared by both
+    batching planes so the device's health is judged from all traffic.
+    State is exported as `keto_tpu_breaker_state` (0 closed / 1 open /
+    2 half-open) plus a transitions counter, so the closed -> open ->
+    half-open -> closed recovery is observable from /metrics/prometheus.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+    _STATE_CODE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+    def __init__(
+        self,
+        threshold: int = 5,
+        cooldown_s: float = 5.0,
+        metrics=None,
+        clock=time.monotonic,
+    ):
+        self.threshold = max(int(threshold), 1)
+        self.cooldown_s = float(cooldown_s)
+        self.metrics = metrics
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._open_until = 0.0
+        self._probe_inflight = False
+        self._probe_started = 0.0
+        # bounded transition trail (tests/smoke observability; a
+        # persistently flapping breaker must not grow a list forever —
+        # long-horizon counting is breaker_transitions_total's job)
+        import collections
+
+        self.transitions: "collections.deque[str]" = collections.deque(
+            maxlen=64
+        )
+        if metrics is not None:
+            metrics.breaker_state.set(0)
+
+    # -- internals (caller holds self._lock) ----------------------------------
+
+    def _transition(self, to: str) -> None:
+        self._state = to
+        self.transitions.append(to)
+        if self.metrics is not None:
+            self.metrics.breaker_state.set(self._STATE_CODE[to])
+            self.metrics.breaker_transitions_total.labels(to).inc()
+
+    # -- batcher surface ------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def allow(self) -> bool:
+        """May this check group take the device path? Consumes the
+        half-open probe slot when it grants one — call once per group.
+        A probe that never reports an outcome (its riders all expired at
+        the launch boundary, or the engine failed before any device
+        contact) is RECLAIMED after one cooldown, so a lost probe can
+        stall recovery by at most cooldown_s — never forever."""
+        with self._lock:
+            if self._state == self.CLOSED:
+                return True
+            now = self._clock()
+            if self._state == self.OPEN:
+                if now < self._open_until:
+                    return False
+                self._transition(self.HALF_OPEN)
+                self._probe_inflight = True
+                self._probe_started = now
+                return True
+            # half-open: exactly one probe at a time (stale probes
+            # reclaimed after a cooldown, see docstring)
+            if (
+                self._probe_inflight
+                and now - self._probe_started < self.cooldown_s
+            ):
+                return False
+            self._probe_inflight = True
+            self._probe_started = now
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probe_inflight = False
+            if self._state != self.CLOSED:
+                self._transition(self.CLOSED)
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._probe_inflight = False
+            if self._state == self.HALF_OPEN:
+                self._open_until = self._clock() + self.cooldown_s
+                self._transition(self.OPEN)
+                return
+            self._failures += 1
+            if self._state == self.CLOSED and self._failures >= self.threshold:
+                self._open_until = self._clock() + self.cooldown_s
+                self._transition(self.OPEN)

@@ -462,6 +462,11 @@ class ColumnarStore(WriteHookMixin):
         agree (tree child order is observable behavior). Tokens are
         opaque "ck1."-prefixed strings; other backends keep UUID shard
         tokens (the wire contract only requires opaque tokens)."""
+        # fault-injection point (keto_tpu/faults.py store_read): slow or
+        # failing persistence, drivable per-process; disarmed = dict miss
+        from .. import faults as _faults
+
+        _faults.inject("store_read")
         token_key = _decode_token(page_token)
         if page_size <= 0:
             page_size = DEFAULT_PAGE_SIZE

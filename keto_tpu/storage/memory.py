@@ -95,6 +95,11 @@ class MemoryManager(WriteHookMixin):
         page_size: int = DEFAULT_PAGE_SIZE,
         nid: str = DEFAULT_NETWORK,
     ) -> tuple[list[RelationTuple], str]:
+        # fault-injection point (keto_tpu/faults.py store_read): slow or
+        # failing persistence, drivable per-process; disarmed = dict miss
+        from .. import faults as _faults
+
+        _faults.inject("store_read")
         token = validate_page_token(page_token)
         if page_size <= 0:
             page_size = DEFAULT_PAGE_SIZE

@@ -1,0 +1,856 @@
+"""Overload & failure resilience plane (PR 5): end-to-end deadlines,
+admission control / load shedding, the device-path circuit breaker with
+host-oracle degradation, client retry/backoff, the fault-injection
+harness, and tri-plane (REST/gRPC/aio) typed-error parity."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import grpc
+import pytest
+
+from keto_tpu import faults
+from keto_tpu.api import ReadClient, RetryPolicy, open_channel
+from keto_tpu.api.batcher import CheckBatcher
+from keto_tpu.api.daemon import Daemon
+from keto_tpu.config import Config, ConfigError
+from keto_tpu.engine.definitions import RESULT_IS_MEMBER, Membership
+from keto_tpu.errors import (
+    CheckBatchFailedError,
+    DeadlineExceededError,
+    KetoError,
+    OverloadedError,
+)
+from keto_tpu.ketoapi import RelationTuple
+from keto_tpu.namespace import Namespace
+from keto_tpu.observability import Metrics, RequestTrace
+from keto_tpu.registry import Registry
+from keto_tpu.resilience import (
+    CircuitBreaker,
+    Deadline,
+    backoff_delays,
+    ingest_deadline,
+    parse_timeout_ms,
+    retry_after_header_value,
+)
+
+NS = [Namespace(name="files"), Namespace(name="groups")]
+
+
+def t(s: str) -> RelationTuple:
+    return RelationTuple.from_string(s)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# unit: Deadline / ingestion
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlineUnit:
+    def test_budget_and_expiry(self):
+        dl = Deadline.after_ms(50)
+        assert not dl.expired()
+        assert 0 < dl.remaining_s() <= 0.05
+        time.sleep(0.06)
+        assert dl.expired()
+        assert dl.remaining_s() == 0.0
+
+    def test_parse_timeout_ms(self):
+        assert parse_timeout_ms(None) is None
+        assert parse_timeout_ms("") is None
+        assert parse_timeout_ms("250") == 250.0
+        from keto_tpu.errors import MalformedInputError
+
+        with pytest.raises(MalformedInputError):
+            parse_timeout_ms("soon")
+        with pytest.raises(MalformedInputError):
+            parse_timeout_ms("-5")
+
+    def test_precedence_and_clamp(self):
+        cfg = Config({"serve": {"check": {
+            "default_deadline_ms": 1000, "max_deadline_ms": 2000,
+        }}})
+        # explicit request budget wins over the default
+        assert ingest_deadline(cfg, request_ms=100).budget_s == pytest.approx(0.1)
+        # native gRPC deadline used when no header
+        assert ingest_deadline(cfg, native_s=0.5).budget_s == pytest.approx(0.5)
+        # default applies when neither
+        assert ingest_deadline(cfg).budget_s == pytest.approx(1.0)
+        # max clamps everything
+        assert ingest_deadline(cfg, request_ms=60000).budget_s == pytest.approx(2.0)
+
+    def test_no_config_no_deadline_and_sentinel_native(self):
+        cfg = Config({})
+        assert ingest_deadline(cfg) is None
+        # grpc's "no deadline" sentinel-huge time_remaining is NOT a budget
+        assert ingest_deadline(cfg, native_s=1e15) is None
+
+    def test_expired_native_deadline_is_expired_not_absent(self):
+        # a client deadline that expired in transit must 504 at
+        # admission, not silently become "no deadline"
+        dl = ingest_deadline(Config({}), native_s=-0.01)
+        assert dl is not None and dl.expired()
+
+    def test_retry_after_header_value(self):
+        assert retry_after_header_value(None) == "1"
+        assert retry_after_header_value(0.05) == "1"
+        assert retry_after_header_value(3.2) == "4"
+
+
+# ---------------------------------------------------------------------------
+# unit: faults
+# ---------------------------------------------------------------------------
+
+
+class TestFaultsUnit:
+    def test_configure_parses_all_modes(self):
+        faults.configure(
+            "device_launch=stall:0.01, store_read=error:boom, batch_corrupt=on"
+        )
+        assert faults.get("device_launch").stall_s == 0.01
+        assert faults.get("store_read").error == "boom"
+        assert faults.get("batch_corrupt") is not None
+        faults.clear("store_read")
+        assert faults.get("store_read") is None
+        faults.clear()
+        assert faults.get("device_launch") is None
+
+    def test_inject_stall_and_error(self):
+        faults.set_fault("device_launch", stall_s=0.03)
+        t0 = time.perf_counter()
+        faults.inject("device_launch")
+        assert time.perf_counter() - t0 >= 0.03
+        assert faults.get("device_launch").hits == 1
+        faults.set_fault("store_read", error="disk gone")
+        with pytest.raises(faults.FaultInjected, match="disk gone"):
+            faults.inject("store_read")
+
+    def test_unknown_point_rejected(self):
+        with pytest.raises(ValueError):
+            faults.set_fault("warp_core")
+        with pytest.raises(ValueError):
+            faults.configure("device_launch=explode:1")
+
+    def test_disarmed_inject_is_noop(self):
+        faults.inject("device_launch")  # no spec: returns silently
+
+
+# ---------------------------------------------------------------------------
+# unit: backoff + RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+class _FakeRpcError(grpc.RpcError):
+    def __init__(self, name):
+        self._name = name
+
+    def code(self):
+        class _C:
+            pass
+
+        c = _C()
+        c.name = self._name
+        return c
+
+
+class TestBackoffAndRetry:
+    def test_full_jitter_bounded_by_cap(self):
+        import random
+
+        delays = backoff_delays(base_s=0.1, cap_s=0.4, rng=random.Random(7))
+        seen = [next(delays) for _ in range(20)]
+        assert all(0 <= d <= 0.4 for d in seen)
+
+    def test_retries_then_succeeds(self):
+        sleeps = []
+        pol = RetryPolicy(max_attempts=4, base_s=0.01, sleep=sleeps.append)
+        calls = []
+
+        def fn(remaining):
+            calls.append(remaining)
+            if len(calls) < 3:
+                raise _FakeRpcError("UNAVAILABLE")
+            return "ok"
+
+        assert pol.call(fn) == "ok"
+        assert len(calls) == 3
+        assert pol.stats["retries"] == 2
+        assert len(sleeps) == 2
+
+    def test_non_retryable_raises_immediately(self):
+        pol = RetryPolicy(max_attempts=4, sleep=lambda s: None)
+        with pytest.raises(_FakeRpcError):
+            pol.call(lambda r: (_ for _ in ()).throw(
+                _FakeRpcError("INVALID_ARGUMENT")
+            ))
+        assert pol.stats["retries"] == 0
+
+    def test_budget_aware_giveup(self):
+        import random
+
+        # base delay far larger than the remaining budget: the policy
+        # must re-raise instead of sleeping past the deadline
+        slept = []
+        pol = RetryPolicy(
+            max_attempts=5, base_s=10.0, cap_s=10.0,
+            sleep=slept.append, rng=random.Random(1),
+        )
+        with pytest.raises(_FakeRpcError):
+            pol.call(
+                lambda r: (_ for _ in ()).throw(_FakeRpcError("UNAVAILABLE")),
+                budget_s=0.05,
+            )
+        assert not slept
+        assert pol.stats["giveups"] == 1
+
+    def test_counter_wired(self):
+        m = Metrics()
+        pol = RetryPolicy(
+            max_attempts=2, base_s=0.0, counter=m.client_retries_total,
+            sleep=lambda s: None,
+        )
+        calls = []
+
+        def fn(remaining):
+            calls.append(1)
+            if len(calls) < 2:
+                raise _FakeRpcError("RESOURCE_EXHAUSTED")
+            return "ok"
+
+        assert pol.call(fn) == "ok"
+        assert m.client_retries_total._value.get() == 1
+
+    def test_read_client_wires_policy_write_client_never(self):
+        from keto_tpu.api.client import WriteClient
+
+        ch = grpc.insecure_channel("127.0.0.1:1")  # never dialed
+        rc = ReadClient(ch, retry_policy=RetryPolicy())
+        wc = WriteClient(ch)
+        assert rc._retry is not None
+        assert wc._retry is None
+        ch.close()
+
+
+# ---------------------------------------------------------------------------
+# unit: CircuitBreaker
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreakerUnit:
+    def test_full_cycle(self):
+        clock = [0.0]
+        br = CircuitBreaker(threshold=2, cooldown_s=5.0, clock=lambda: clock[0])
+        assert br.allow() and br.state == "closed"
+        br.record_failure()
+        assert br.state == "closed"  # one short of the threshold
+        br.record_failure()
+        assert br.state == "open"
+        assert not br.allow()  # cooling down
+        clock[0] = 5.1
+        assert br.allow()  # the half-open probe
+        assert br.state == "half_open"
+        assert not br.allow()  # only ONE probe at a time
+        br.record_success()
+        assert br.state == "closed"
+        assert list(br.transitions) == ["open", "half_open", "closed"]
+
+    def test_half_open_failure_reopens(self):
+        clock = [0.0]
+        br = CircuitBreaker(threshold=1, cooldown_s=2.0, clock=lambda: clock[0])
+        br.record_failure()
+        assert br.state == "open"
+        clock[0] = 2.1
+        assert br.allow()
+        br.record_failure()
+        assert br.state == "open"
+        assert not br.allow()  # new cooldown started
+        clock[0] = 4.2
+        assert br.allow()
+
+    def test_lost_probe_reclaimed_after_cooldown(self):
+        # a probe group that never reports an outcome (riders expired at
+        # the launch boundary, engine failed pre-device) must not wedge
+        # the breaker half-open forever: after one cooldown the probe
+        # slot is reclaimed
+        clock = [0.0]
+        br = CircuitBreaker(threshold=1, cooldown_s=2.0, clock=lambda: clock[0])
+        br.record_failure()
+        clock[0] = 2.1
+        assert br.allow()  # probe granted... and then lost
+        assert not br.allow()
+        clock[0] = 4.2  # a cooldown later: reclaimed
+        assert br.allow()
+        br.record_success()
+        assert br.state == "closed"
+
+    def test_success_resets_failure_streak(self):
+        br = CircuitBreaker(threshold=2)
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        assert br.state == "closed"  # streak broken: 2 consecutive needed
+
+    def test_metrics_gauge_and_transitions(self):
+        m = Metrics()
+        clock = [0.0]
+        br = CircuitBreaker(
+            threshold=1, cooldown_s=1.0, metrics=m, clock=lambda: clock[0]
+        )
+        br.record_failure()
+        assert m.breaker_state._value.get() == 1
+        clock[0] = 1.1
+        br.allow()
+        assert m.breaker_state._value.get() == 2
+        br.record_success()
+        assert m.breaker_state._value.get() == 0
+
+
+# ---------------------------------------------------------------------------
+# batcher resilience (threaded plane; the aio twin is covered through the
+# tri-plane daemon below)
+# ---------------------------------------------------------------------------
+
+
+class _GatedEngine:
+    def __init__(self):
+        self.gate = threading.Event()
+        self.batches = []
+
+    def check_batch(self, tuples, max_depth=0):
+        self.batches.append(list(tuples))
+        assert self.gate.wait(timeout=30)
+        return [RESULT_IS_MEMBER for _ in tuples]
+
+
+class TestBatcherAdmission:
+    def test_admission_bound_is_atomic(self):
+        eng = _GatedEngine()
+        b = CheckBatcher(eng, window_s=0.0, max_queue=1)
+        try:
+            res = {}
+            th = threading.Thread(
+                target=lambda: res.update(ok=b.check(t("files:x#owner@u"))),
+                daemon=True,
+            )
+            th.start()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and b._pending < 1:
+                time.sleep(0.002)
+            assert b._pending == 1
+            # the bound holds at admit() AND at enqueue
+            with pytest.raises(OverloadedError):
+                b.admit()
+            with pytest.raises(OverloadedError) as ei:
+                b.check(t("files:y#owner@u"))
+            assert ei.value.status == 429
+            assert ei.value.retry_after_s > 0
+            eng.gate.set()
+            th.join(timeout=10)
+            assert res["ok"] is RESULT_IS_MEMBER
+            # slot released: admission open again
+            b.admit()
+        finally:
+            eng.gate.set()
+            b.close()
+
+    def test_shed_counter_increments(self):
+        m = Metrics()
+        eng = _GatedEngine()
+        b = CheckBatcher(eng, window_s=0.0, max_queue=1, metrics=m)
+        try:
+            th = threading.Thread(
+                target=lambda: b.check(t("files:x#owner@u")), daemon=True
+            )
+            th.start()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and b._pending < 1:
+                time.sleep(0.002)
+            with pytest.raises(OverloadedError):
+                b.check(t("files:y#owner@u"))
+            assert (
+                m.requests_shed_total.labels("queue_full")._value.get() >= 1
+            )
+            eng.gate.set()
+            th.join(timeout=10)
+        finally:
+            eng.gate.set()
+            b.close()
+
+
+class TestBatcherDeadline:
+    def test_caller_fails_fast_on_gated_engine(self):
+        m = Metrics()
+        eng = _GatedEngine()
+        b = CheckBatcher(eng, window_s=0.0, metrics=m)
+        try:
+            rt = RequestTrace(deadline=Deadline(0.08))
+            t0 = time.perf_counter()
+            with pytest.raises(DeadlineExceededError) as ei:
+                b.check(t("files:x#owner@u"), rt=rt)
+            elapsed = time.perf_counter() - t0
+            assert elapsed < 2 * 0.08 + 0.25  # fails at ~1x the budget
+            assert ei.value.status == 504
+            assert (
+                m.deadline_exceeded_total.labels("wait")._value.get() == 1
+            )
+        finally:
+            eng.gate.set()
+            b.close()
+
+    def test_expired_rider_never_occupies_a_batch_slot(self):
+        eng = _GatedEngine()
+        eng.gate.set()
+        b = CheckBatcher(eng, window_s=0.05)
+        try:
+            rt = RequestTrace(deadline=Deadline(0.001))
+            time.sleep(0.01)  # expire while "queued"
+            with pytest.raises(DeadlineExceededError):
+                b.check(t("files:x#owner@u"), rt=rt)
+            # give the collector a beat: the expired rider must be
+            # dropped at the launch boundary, not evaluated
+            time.sleep(0.2)
+            assert all(
+                t("files:x#owner@u") not in batch for batch in eng.batches
+            )
+        finally:
+            b.close()
+
+
+class TestEngineErrorClassification:
+    def test_raw_exception_becomes_typed_keto_error(self):
+        class Boom:
+            def check_batch(self, tuples, depth):
+                raise ValueError("bad graph row")
+
+        m = Metrics()
+        b = CheckBatcher(Boom(), window_s=0.0, metrics=m)
+        try:
+            with pytest.raises(KetoError) as ei:
+                b.check(t("files:x#owner@u"))
+            assert isinstance(ei.value, CheckBatchFailedError)
+            assert ei.value.status == 500
+            assert "bad graph row" in ei.value.message
+            assert (
+                m.check_batch_failed_total.labels("engine")._value.get() == 1
+            )
+        finally:
+            b.close()
+
+    def test_typed_error_passes_through_unwrapped(self):
+        from keto_tpu.errors import NamespaceNotFoundError
+
+        class Boom:
+            def check_batch(self, tuples, depth):
+                raise NamespaceNotFoundError("nope")
+
+        b = CheckBatcher(Boom(), window_s=0.0)
+        try:
+            with pytest.raises(NamespaceNotFoundError):
+                b.check(t("files:x#owner@u"))
+        finally:
+            b.close()
+
+    def test_still_a_runtime_error_for_embedders(self):
+        class Boom:
+            def check_batch(self, tuples, depth):
+                raise RuntimeError("kernel exploded")
+
+        b = CheckBatcher(Boom(), window_s=0.001)
+        try:
+            with pytest.raises(RuntimeError, match="kernel exploded"):
+                b.check(t("files:x#owner@u"))
+        finally:
+            b.close()
+
+
+class _FailingDeviceEngine:
+    """Split-phase engine whose device path always raises; the host
+    surface answers correctly — the breaker-degradation observable."""
+
+    def __init__(self):
+        self.submits = 0
+        self.host_batches = 0
+
+    def check_batch_submit(self, tuples, depth=0):
+        self.submits += 1
+        raise RuntimeError("device wedge")
+
+    def check_batch_host(self, tuples, depth=0):
+        self.host_batches += 1
+        return [RESULT_IS_MEMBER for _ in tuples]
+
+
+class TestBreakerInBatcher:
+    def test_device_failures_degrade_to_host_then_trip(self):
+        eng = _FailingDeviceEngine()
+        br = CircuitBreaker(threshold=2, cooldown_s=60.0)
+        b = CheckBatcher(eng, window_s=0.0, breaker=br)
+        try:
+            # failures 1 and 2: device raises, riders are HOST-ANSWERED
+            # (graceful degradation), breaker trips on the second
+            for _ in range(2):
+                res = b.check(t("files:x#owner@u"))
+                assert res.membership == Membership.IS_MEMBER
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and br.state != "open":
+                time.sleep(0.005)
+            assert br.state == "open"
+            submits_at_open = eng.submits
+            # open: host path only, the device is left alone
+            for _ in range(3):
+                assert b.check(t("files:x#owner@u")) is RESULT_IS_MEMBER
+            assert eng.submits == submits_at_open
+            assert eng.host_batches >= 5
+        finally:
+            b.close()
+
+    def test_half_open_probe_closes_on_success(self):
+        class Recovering(_FailingDeviceEngine):
+            def __init__(self):
+                super().__init__()
+                self.healthy = False
+
+            def check_batch_submit(self, tuples, depth=0):
+                self.submits += 1
+                if not self.healthy:
+                    raise RuntimeError("device wedge")
+                return list(tuples)
+
+            def check_batch_resolve(self, handle):
+                return [RESULT_IS_MEMBER for _ in handle]
+
+        clock = [0.0]
+        eng = Recovering()
+        br = CircuitBreaker(threshold=1, cooldown_s=1.0, clock=lambda: clock[0])
+        b = CheckBatcher(eng, window_s=0.0, breaker=br)
+        try:
+            b.check(t("files:x#owner@u"))  # trips (host-answered)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and br.state != "open":
+                time.sleep(0.005)
+            eng.healthy = True
+            clock[0] = 1.1  # cooldown over: next group is the probe
+            assert b.check(t("files:x#owner@u")) is RESULT_IS_MEMBER
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and br.state != "closed":
+                time.sleep(0.005)
+            assert br.state == "closed"
+            assert list(br.transitions) == ["open", "half_open", "closed"]
+        finally:
+            b.close()
+
+
+class TestLaunchWatchdog:
+    def test_stalled_launch_recovers_via_host_within_budget(self):
+        class Stalling(_FailingDeviceEngine):
+            def check_batch_submit(self, tuples, depth=0):
+                self.submits += 1
+                time.sleep(0.8)
+                return list(tuples)
+
+            def check_batch_resolve(self, handle):
+                return [RESULT_IS_MEMBER for _ in handle]
+
+        m = Metrics()
+        eng = Stalling()
+        br = CircuitBreaker(threshold=100)  # observe failures, don't trip
+        b = CheckBatcher(
+            eng, window_s=0.0, device_timeout_ms=80, breaker=br, metrics=m,
+        )
+        try:
+            t0 = time.perf_counter()
+            res = b.check(t("files:x#owner@u"))
+            elapsed = time.perf_counter() - t0
+            assert res.membership == Membership.IS_MEMBER
+            assert elapsed < 0.6  # host-served at ~the watchdog budget
+            assert eng.host_batches == 1
+            assert (
+                m.check_batch_failed_total.labels("device_timeout")
+                ._value.get() == 1
+            )
+            # the abandoned launch's slot was released: a second check
+            # still goes through (semaphore not pinned by the wedge)
+            assert b.check(t("files:y#owner@u")).allowed is True
+            time.sleep(0.9)  # let the stalled submits retire cleanly
+        finally:
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# config schema + wiring
+# ---------------------------------------------------------------------------
+
+
+class TestConfigAndWiring:
+    def test_schema_accepts_resilience_keys(self):
+        Config({"serve": {"check": {
+            "max_queue": 128,
+            "default_deadline_ms": 500,
+            "max_deadline_ms": 2000,
+            "device_timeout_ms": 250,
+            "breaker": {"threshold": 3, "cooldown_s": 1.5},
+        }}})
+
+    def test_schema_rejects_bad_values(self):
+        with pytest.raises(ConfigError):
+            Config({"serve": {"check": {"max_queue": 0}}})
+        with pytest.raises(ConfigError):
+            Config({"serve": {"check": {"breaker": {"threshold": 0}}}})
+        with pytest.raises(ConfigError):
+            Config({"serve": {"check": {"deadline_ms": 5}}})  # typo
+
+    def test_registry_breaker_reads_config(self):
+        cfg = Config({"serve": {"check": {
+            "breaker": {"threshold": 9, "cooldown_s": 2.5},
+        }}})
+        reg = Registry(cfg)
+        br = reg.circuit_breaker()
+        assert br.threshold == 9
+        assert br.cooldown_s == 2.5
+        assert reg.circuit_breaker() is br  # singleton
+
+    def test_daemon_wires_batcher_resilience(self):
+        cfg = Config({
+            "dsn": "memory",
+            "serve": {
+                "check": {"max_queue": 7, "device_timeout_ms": 123},
+                "read": {"host": "127.0.0.1", "port": 0},
+                "write": {"host": "127.0.0.1", "port": 0},
+                "metrics": {"host": "127.0.0.1", "port": 0},
+            },
+        })
+        cfg.set_namespaces(list(NS))
+        reg = Registry(cfg)
+        d = Daemon(reg)
+        try:
+            assert d.batcher.max_queue == 7
+            assert d.batcher.device_timeout_s == pytest.approx(0.123)
+            assert d.batcher.breaker is reg.circuit_breaker()
+        finally:
+            d.batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# tri-plane typed-error parity (satellite: deadline-exceeded and shed
+# responses byte-identical across REST/gRPC/aio, mirroring the cache
+# parity tests)
+# ---------------------------------------------------------------------------
+
+
+def _tri_plane_daemon(serve_check: dict):
+    cfg = Config({
+        "dsn": "memory",
+        # parity is about the batcher pipeline's errors: cache off so
+        # every check rides it
+        "check": {"engine": "tpu", "cache": {"enabled": False}},
+        "serve": {
+            "read": {
+                "host": "127.0.0.1", "port": 0,
+                "grpc": {"host": "127.0.0.1", "port": 0, "aio": True},
+            },
+            "write": {"host": "127.0.0.1", "port": 0},
+            "metrics": {"host": "127.0.0.1", "port": 0},
+            "check": serve_check,
+        },
+    })
+    cfg.set_namespaces(list(NS))
+    reg = Registry(cfg)
+    reg.relation_tuple_manager().write_relation_tuples(
+        [t("files:doc#owner@alice")]
+    )
+    # warm the engine (XLA compile) before deadlines/stalls apply
+    reg.check_engine().check_batch([t("files:doc#owner@alice")])
+    d = Daemon(reg)
+    d.start()
+    return d
+
+
+def _rest_check_error(d, subject, headers=None):
+    url = (
+        f"http://127.0.0.1:{d.read_port}/relation-tuples/check/openapi"
+        f"?namespace=files&object=doc&relation=owner&subject_id={subject}"
+    )
+    req = urllib.request.Request(url, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, r.read(), {}
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+def _grpc_check_error(port, subject, timeout=30):
+    from keto_tpu.api.descriptors import CHECK_SERVICE, pb
+
+    ch = grpc.insecure_channel(f"127.0.0.1:{port}")
+    try:
+        stub = ch.unary_unary(
+            f"/{CHECK_SERVICE}/Check",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.CheckResponse.FromString,
+        )
+        req = pb.CheckRequest()
+        req.tuple.namespace = "files"
+        req.tuple.object = "doc"
+        req.tuple.relation = "owner"
+        req.tuple.subject.id = subject
+        try:
+            stub(req, timeout=timeout)
+            return None, None
+        except grpc.RpcError as e:
+            return e.code(), e.details()
+    finally:
+        ch.close()
+
+
+class TestTriPlaneDeadlineParity:
+    def test_504_body_and_grpc_code_parity(self):
+        # max_deadline_ms clamps the gRPC clients' generous native
+        # deadlines down to the server's bound, so the 504s below are
+        # deterministically SERVER-side (no client-cancel race)
+        d = _tri_plane_daemon(
+            {"default_deadline_ms": 150, "max_deadline_ms": 150}
+        )
+        try:
+            faults.set_fault("device_launch", stall_s=0.8)
+            t0 = time.perf_counter()
+            code, body, _ = _rest_check_error(d, "r1")
+            rest_elapsed = time.perf_counter() - t0
+            assert code == 504
+            parsed = json.loads(body)
+            assert parsed["error"]["code"] == 504
+            assert parsed["error"]["status"] == "deadline_exceeded"
+            assert rest_elapsed < 2 * 0.15 + 0.5
+            # no client deadline on the gRPC calls: the 504s below are
+            # SERVER-side (the default deadline), so the details string
+            # is the server's typed message on both planes
+            sync_code, sync_details = _grpc_check_error(d.read_port, "r2")
+            aio_code, aio_details = _grpc_check_error(d.read_grpc_port, "r3")
+            assert sync_code == grpc.StatusCode.DEADLINE_EXCEEDED
+            assert aio_code == grpc.StatusCode.DEADLINE_EXCEEDED
+            assert sync_details == aio_details
+            assert sync_details == parsed["error"]["message"]
+            faults.clear()
+            time.sleep(0.9)  # let the stalled launches retire
+            # recovery: same daemon answers correctly again
+            code, body, _ = _rest_check_error(d, "alice")
+            assert code == 200 and json.loads(body) == {"allowed": True}
+        finally:
+            faults.clear()
+            d.stop()
+
+
+class TestTriPlaneShedParity:
+    def test_429_body_and_grpc_code_parity(self):
+        d = _tri_plane_daemon({"max_queue": 1})
+        try:
+            faults.set_fault("device_launch", stall_s=1.2)
+            # occupy BOTH planes' single admission slot (the threaded
+            # batcher serves REST + muxed gRPC; the aio listener has its
+            # own batcher)
+            occupiers = [
+                threading.Thread(
+                    target=lambda: _rest_check_error(
+                        d, "alice", headers={"x-request-timeout-ms": "20000"}
+                    ),
+                    daemon=True,
+                ),
+                threading.Thread(
+                    target=lambda: _grpc_check_error(
+                        d.read_grpc_port, "alice", timeout=20
+                    ),
+                    daemon=True,
+                ),
+            ]
+            for th in occupiers:
+                th.start()
+            deadline = time.monotonic() + 5
+            aio_batcher = d._aio_read.batcher
+            while time.monotonic() < deadline and (
+                d.batcher._pending < 1 or aio_batcher._pending < 1
+            ):
+                time.sleep(0.005)
+            assert d.batcher._pending >= 1
+            assert aio_batcher._pending >= 1
+            # REST: two shed responses are byte-identical typed bodies
+            code1, body1, hdrs1 = _rest_check_error(d, "s1")
+            code2, body2, _ = _rest_check_error(d, "s1")
+            assert code1 == code2 == 429
+            assert body1 == body2
+            parsed = json.loads(body1)
+            assert parsed["error"]["status"] == "too_many_requests"
+            assert hdrs1.get("Retry-After")
+            # gRPC planes agree on code AND details
+            sync_code, sync_details = _grpc_check_error(d.read_port, "s2")
+            aio_code, aio_details = _grpc_check_error(d.read_grpc_port, "s3")
+            assert sync_code == grpc.StatusCode.RESOURCE_EXHAUSTED
+            assert aio_code == grpc.StatusCode.RESOURCE_EXHAUSTED
+            assert sync_details == aio_details == parsed["error"]["message"]
+            # the bound held the whole time
+            assert d.batcher._pending <= 1
+            assert aio_batcher._pending <= 1
+            faults.clear()
+            for th in occupiers:
+                th.join(timeout=30)
+        finally:
+            faults.clear()
+            d.stop()
+
+
+# ---------------------------------------------------------------------------
+# faults through the real engine (device corruption -> exact host replay)
+# ---------------------------------------------------------------------------
+
+
+class TestEngineFaultPoints:
+    def _engine(self):
+        from keto_tpu.engine.tpu_engine import TPUCheckEngine
+        from keto_tpu.storage.memory import MemoryManager
+
+        cfg = Config({"dsn": "memory"})
+        cfg.set_namespaces(list(NS))
+        m = MemoryManager()
+        m.write_relation_tuples([t("files:doc#owner@alice")])
+        return TPUCheckEngine(m, cfg)
+
+    def test_batch_corrupt_forces_exact_host_replay(self):
+        eng = self._engine()
+        base = eng.check_batch(
+            [t("files:doc#owner@alice"), t("files:doc#owner@bob")]
+        )
+        hosts0 = eng.stats["host_checks"]
+        faults.set_fault("batch_corrupt")
+        res = eng.check_batch(
+            [t("files:doc#owner@alice"), t("files:doc#owner@bob")]
+        )
+        assert [r.allowed for r in res] == [r.allowed for r in base] == [
+            True, False,
+        ]
+        assert eng.stats["host_checks"] - hosts0 == 2  # all slots replayed
+
+    def test_check_batch_host_is_device_free(self):
+        eng = self._engine()
+        res = eng.check_batch_host(
+            [t("files:doc#owner@alice"), t("files:doc#owner@bob")]
+        )
+        assert [r.allowed for r in res] == [True, False]
+        assert eng.stats["device_checks"] == 0
+        assert eng._state is None  # no mirror was ever built
+
+    def test_store_read_fault_reaches_reference_path(self):
+        eng = self._engine()
+        faults.set_fault("store_read", error="disk gone")
+        # a non-direct-hit query must page through get_relation_tuples
+        # (a direct hit short-circuits via relation_tuple_exists)
+        res = eng.check_batch_host([t("files:doc#owner@bob")])
+        assert res[0].error is not None
+        assert "disk gone" in str(res[0].error)

@@ -279,6 +279,107 @@ class TestSubmitResolvePipeline:
         assert all(r.membership == Membership.IS_MEMBER for r in res)
 
 
+class TestDrainShutdown:
+    """Drain-aware daemon.stop (resilience plane): readiness flips off
+    first, new admissions are shed with a typed OverloadedError during
+    the grace window, and in-flight checks complete before the
+    listeners close."""
+
+    def test_drain_rejects_new_admissions_and_finishes_inflight(self):
+        import json
+        import threading
+        import time
+        import urllib.error
+        import urllib.request
+
+        from keto_tpu import faults
+
+        cfg = Config({
+            "dsn": "memory",
+            # cache off so the in-flight check really occupies the
+            # batcher pipeline for the stall duration
+            "check": {"engine": "tpu", "cache": {"enabled": False}},
+            "serve": {
+                "read": {"host": "127.0.0.1", "port": 0},
+                "write": {"host": "127.0.0.1", "port": 0},
+                "metrics": {"host": "127.0.0.1", "port": 0},
+            },
+        })
+        cfg.set_namespaces([Namespace(name="files")])
+        reg = Registry(cfg)
+        reg.relation_tuple_manager().write_relation_tuples(
+            [RelationTuple.from_string("files:doc#owner@alice")]
+        )
+        # warm the engine so the XLA compile isn't inside the stall window
+        reg.check_engine().check_batch(
+            [RelationTuple.from_string("files:doc#owner@alice")]
+        )
+        d = Daemon(reg)
+        d.start()
+        base = f"http://127.0.0.1:{d.read_port}"
+        url = (
+            base + "/relation-tuples/check/openapi"
+            "?namespace=files&object=doc&relation=owner&subject_id=alice"
+        )
+        stopper = None
+        try:
+            faults.set_fault("device_launch", stall_s=0.8)
+            inflight = {}
+
+            def bg():
+                try:
+                    with urllib.request.urlopen(url, timeout=30) as r:
+                        inflight["resp"] = (r.status, json.load(r))
+                except Exception as e:  # noqa: BLE001 — recorded for assert
+                    inflight["resp"] = ("error", repr(e))
+
+            th = threading.Thread(target=bg, daemon=True)
+            th.start()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and d.batcher._pending < 1:
+                time.sleep(0.005)
+            assert d.batcher._pending >= 1  # the in-flight check is admitted
+
+            stopper = threading.Thread(target=d.stop, daemon=True)
+            stopper.start()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and not reg.draining.is_set():
+                time.sleep(0.002)
+            assert reg.draining.is_set()
+
+            # during the grace window (listeners still up, batcher busy):
+            # readiness is already off...
+            try:
+                urllib.request.urlopen(base + "/health/ready", timeout=5)
+                ready_code = 200
+            except urllib.error.HTTPError as e:
+                ready_code = e.code
+            assert ready_code == 503
+            # ...and a new check is shed with the typed 429, not queued
+            try:
+                urllib.request.urlopen(url, timeout=5)
+                shed = None
+            except urllib.error.HTTPError as e:
+                shed = (e.code, json.load(e))
+            assert shed is not None
+            assert shed[0] == 429
+            assert shed[1]["error"]["status"] == "too_many_requests"
+            assert "draining" in shed[1]["error"]["message"]
+
+            # the in-flight check completes with the correct answer —
+            # admitted-before-drain work never sees a torn-down pipeline
+            th.join(timeout=30)
+            assert inflight["resp"] == (200, {"allowed": True})
+            stopper.join(timeout=30)
+            assert not stopper.is_alive()
+        finally:
+            faults.clear()
+            if stopper is None:
+                d.stop()
+            elif stopper.is_alive():
+                stopper.join(timeout=30)
+
+
 class TestPlatformPin:
     def test_check_platform_updates_jax_config(self):
         import jax

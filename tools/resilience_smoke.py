@@ -1,0 +1,364 @@
+#!/usr/bin/env python
+"""Resilience smoke: CPU-runnable, CI-wired fault-injection harness.
+
+Drives a real daemon (memory store, TPU-engine code path pinned to CPU)
+through each injected fault (keto_tpu/faults.py) and asserts the
+resilience plane's load-bearing properties:
+
+  1. DEADLINES — with a stalled device launch, every deadline-carrying
+     check answers a typed 504/`deadline_exceeded` within 2x its budget
+     (the caller-side wait bound, not the stall length), and the server
+     recovers to correct answers once the fault clears.
+  2. ADMISSION / LOAD SHEDDING — with `serve.check.max_queue: 1` and a
+     stalled device, exactly the admitted check is in flight: every
+     further check sheds with a typed 429/`too_many_requests`
+     (Retry-After attached; RESOURCE_EXHAUSTED on gRPC), the
+     admitted-but-unresolved count NEVER exceeds the bound (memory stays
+     bounded), and the admitted check still answers correctly.
+  3. CIRCUIT BREAKER — consecutive device-launch failures trip the
+     breaker closed -> open; while open, checks are answered CORRECTLY
+     by the exact host oracle with zero device submit attempts; after
+     the cooldown one probe batch half-opens and closes it. The whole
+     closed -> open -> half-open -> closed cycle is asserted from
+     /metrics/prometheus.
+  4. STORE LATENCY / BATCH CORRUPTION — with a slow store and with
+     poisoned device verdicts (forced exact-host replay), every answer
+     still matches the host oracle, inside a bounded tail.
+
+Every served answer in every scenario is compared against the host
+oracle (engine/reference.py) evaluated on the live store — zero wrong
+answers is the pass bar, matching tools/check_cache_correctness.py's
+contract. Exit 0 prints one JSON summary line; any violation exits 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+FIXTURE = [
+    "files:doc0#owner@u0",
+    "files:doc1#owner@u1",
+    "files:doc2#owner@u2",
+    "files:doc#view@(groups:g#member)",
+    "groups:g#member@alice",
+]
+# (query, none) pairs evaluated against the live host oracle per check
+QUERIES = [
+    "files:doc0#owner@u0",      # direct hit
+    "files:doc1#owner@u0",      # miss
+    "files:doc#view@alice",     # subject-set indirection hit
+    "files:doc#view@u2",        # indirection miss
+]
+
+
+def build_daemon(serve_check: dict):
+    from keto_tpu.api.daemon import Daemon
+    from keto_tpu.config import Config
+    from keto_tpu.ketoapi import RelationTuple
+    from keto_tpu.namespace import Namespace
+    from keto_tpu.registry import Registry
+
+    cfg = Config({
+        "dsn": "memory",
+        # the resilience plane is under test, not the cache: checks must
+        # ride the batcher/engine pipeline every time
+        "check": {"engine": "tpu", "cache": {"enabled": False}},
+        "limit": {"max_read_depth": 5},
+        "serve": {
+            "read": {"host": "127.0.0.1", "port": 0},
+            "write": {"host": "127.0.0.1", "port": 0},
+            "metrics": {"host": "127.0.0.1", "port": 0},
+            "check": serve_check,
+        },
+    })
+    cfg.set_namespaces([Namespace(name="files"), Namespace(name="groups")])
+    reg = Registry(cfg)
+    reg.relation_tuple_manager().write_relation_tuples(
+        [RelationTuple.from_string(s) for s in FIXTURE]
+    )
+    # warm the engine (XLA compile of the check kernel) BEFORE deadlines
+    # apply — a cold compile is minutes on some hosts and is not the
+    # serving-path latency under test
+    reg.check_engine().check_batch(
+        [RelationTuple.from_string(QUERIES[0])]
+    )
+    d = Daemon(reg)
+    d.start()
+    return d
+
+
+def oracle_allowed(d, query: str) -> bool:
+    from keto_tpu.engine.reference import ReferenceEngine
+    from keto_tpu.ketoapi import RelationTuple
+    from keto_tpu.storage.definitions import DEFAULT_NETWORK
+
+    ref = ReferenceEngine(d.registry.relation_tuple_manager(), d.registry.config)
+    return bool(
+        ref.check_relation_tuple(
+            RelationTuple.from_string(query), 0, DEFAULT_NETWORK
+        ).allowed
+    )
+
+
+def rest_check(d, query: str, timeout_ms=None, total_timeout=30.0):
+    """(http_status, body_dict, elapsed_s, retry_after) for one REST
+    check of a `ns:obj#rel@subject_id` query string."""
+    from keto_tpu.ketoapi import RelationTuple
+
+    t = RelationTuple.from_string(query)
+    url = (
+        f"http://127.0.0.1:{d.read_port}/relation-tuples/check/openapi"
+        f"?namespace={t.namespace}&object={t.object}&relation={t.relation}"
+        f"&subject_id={t.subject_id}"
+    )
+    headers = {}
+    if timeout_ms is not None:
+        headers["x-request-timeout-ms"] = str(timeout_ms)
+    req = urllib.request.Request(url, headers=headers)
+    t0 = time.perf_counter()
+    try:
+        with urllib.request.urlopen(req, timeout=total_timeout) as r:
+            return r.status, json.load(r), time.perf_counter() - t0, None
+    except urllib.error.HTTPError as e:
+        return (
+            e.code, json.load(e), time.perf_counter() - t0,
+            e.headers.get("Retry-After"),
+        )
+
+
+def scrape(d) -> str:
+    return urllib.request.urlopen(
+        f"http://127.0.0.1:{d.metrics_port}/metrics/prometheus", timeout=10
+    ).read().decode()
+
+
+def check_answers_match_oracle(d, out: dict, tag: str, n_rounds: int = 3):
+    """Run every QUERIES entry n_rounds times and compare to the oracle."""
+    wrong = []
+    latencies = []
+    for _ in range(n_rounds):
+        for q in QUERIES:
+            code, body, dur, _ = rest_check(d, q)
+            latencies.append(dur)
+            expected = oracle_allowed(d, q)
+            # the bare /check mirrors deny as 403; /openapi always 200
+            if code != 200 or body.get("allowed") != expected:
+                wrong.append({"query": q, "code": code, "body": body,
+                              "expected": expected})
+    out[f"{tag}_wrong_answers"] = wrong
+    out[f"{tag}_p_max_s"] = round(max(latencies), 4)
+    return not wrong
+
+
+def scenario_deadline(out: dict) -> bool:
+    """Stalled device + 250 ms deadlines -> typed 504 within 2x."""
+    from keto_tpu import faults
+
+    d = build_daemon({"default_deadline_ms": 20000})
+    try:
+        deadline_ms = 250
+        faults.set_fault("device_launch", stall_s=1.2)
+        results = []
+        for q in QUERIES:
+            code, body, dur, _ = rest_check(d, q, timeout_ms=deadline_ms)
+            results.append({
+                "code": code, "status": body.get("error", {}).get("status"),
+                "elapsed_s": round(dur, 4),
+            })
+        faults.clear()
+        time.sleep(1.3)  # let the stalled launches retire
+        out["deadline_responses"] = results
+        typed = all(
+            r["code"] == 504 and r["status"] == "deadline_exceeded"
+            for r in results
+        )
+        bounded = all(r["elapsed_s"] <= 2 * deadline_ms / 1e3 for r in results)
+        recovered = check_answers_match_oracle(d, out, "deadline_recovery")
+        out["deadline_ok"] = typed and bounded and recovered
+        return out["deadline_ok"]
+    finally:
+        faults.clear()
+        d.stop()
+
+
+def scenario_shed(out: dict) -> bool:
+    """max_queue=1 + stalled device: bounded admission, typed 429s."""
+    from keto_tpu import faults
+
+    d = build_daemon({"max_queue": 1})
+    try:
+        faults.set_fault("device_launch", stall_s=1.5)
+        admitted = {}
+
+        def bg():
+            admitted["result"] = rest_check(d, QUERIES[0], total_timeout=30)
+
+        th = threading.Thread(target=bg, daemon=True)
+        th.start()
+        stop_at = time.monotonic() + 5
+        while time.monotonic() < stop_at and d.batcher._pending < 1:
+            time.sleep(0.002)
+        # the admitted check occupies the single slot for the stall
+        # duration; everything else must shed — and the bound must hold
+        sheds = []
+        pending_max = 0
+        qsize_max = 0
+        for _ in range(8):
+            code, body, _, retry_after = rest_check(d, QUERIES[1])
+            sheds.append({
+                "code": code,
+                "status": body.get("error", {}).get("status"),
+                "retry_after": retry_after,
+            })
+            pending_max = max(pending_max, d.batcher._pending)
+            qsize_max = max(qsize_max, d.batcher._queue.qsize())
+        # gRPC plane sheds with RESOURCE_EXHAUSTED off the same gate
+        import grpc
+
+        from keto_tpu.api.descriptors import CHECK_SERVICE, pb
+
+        ch = grpc.insecure_channel(f"127.0.0.1:{d.read_port}")
+        stub = ch.unary_unary(
+            f"/{CHECK_SERVICE}/Check",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=pb.CheckResponse.FromString,
+        )
+        req = pb.CheckRequest()
+        req.tuple.namespace = "files"
+        req.tuple.object = "doc1"
+        req.tuple.relation = "owner"
+        req.tuple.subject.id = "u0"
+        try:
+            stub(req, timeout=10)
+            grpc_shed = None
+        except grpc.RpcError as e:
+            grpc_shed = e.code().name
+        ch.close()
+        faults.clear()
+        th.join(timeout=30)
+        out["shed_responses"] = sheds
+        out["shed_grpc_code"] = grpc_shed
+        out["shed_pending_max"] = pending_max
+        out["shed_qsize_max"] = qsize_max
+        out["shed_admitted_result"] = admitted.get("result", (None,))[:2]
+        code, body, _, _ = admitted.get("result", (None, {}, 0, None))
+        admitted_ok = code == 200 and body.get("allowed") == oracle_allowed(
+            d, QUERIES[0]
+        )
+        out["shed_ok"] = (
+            all(
+                s["code"] == 429 and s["status"] == "too_many_requests"
+                and s["retry_after"]
+                for s in sheds
+            )
+            and grpc_shed == "RESOURCE_EXHAUSTED"
+            and pending_max <= 1
+            and qsize_max <= 1
+            and admitted_ok
+        )
+        return out["shed_ok"]
+    finally:
+        faults.clear()
+        d.stop()
+
+
+def scenario_breaker(out: dict) -> bool:
+    """Device raises -> breaker trips; open = correct host-served
+    answers with zero device submits; cooldown -> half-open -> closed."""
+    from keto_tpu import faults
+
+    d = build_daemon({"breaker": {"threshold": 2, "cooldown_s": 0.6}})
+    try:
+        br = d.registry.circuit_breaker()
+        spec = faults.set_fault("device_launch", error="device died")
+        # trip it: answers must stay correct the whole way (host fallback)
+        if not check_answers_match_oracle(d, out, "breaker_trip", n_rounds=1):
+            out["breaker_ok"] = False
+            return False
+        tripped = br.state == "open"
+        hits_at_open = spec.hits
+        # open: still correct, and the device is left alone
+        open_ok = check_answers_match_oracle(d, out, "breaker_open", n_rounds=2)
+        submits_while_open = spec.hits - hits_at_open
+        # recover: clear the fault, wait out the cooldown, probe closes
+        faults.clear()
+        time.sleep(0.7)
+        recovered_ok = check_answers_match_oracle(
+            d, out, "breaker_recovery", n_rounds=1
+        )
+        text = scrape(d)
+        cycle = all(
+            f'keto_tpu_breaker_transitions_total{{to="{s}"}}' in text
+            for s in ("open", "half_open", "closed")
+        )
+        closed_now = "keto_tpu_breaker_state 0.0" in text
+        out["breaker_tripped"] = tripped
+        out["breaker_submits_while_open"] = submits_while_open
+        out["breaker_transitions"] = list(br.transitions)
+        out["breaker_ok"] = (
+            tripped and open_ok and submits_while_open == 0
+            and recovered_ok and cycle and closed_now
+        )
+        return out["breaker_ok"]
+    finally:
+        faults.clear()
+        d.stop()
+
+
+def scenario_degraded_paths(out: dict) -> bool:
+    """Store latency and batch corruption: correct answers, bounded tail."""
+    from keto_tpu import faults
+
+    d = build_daemon({})
+    try:
+        faults.set_fault("store_read", stall_s=0.01)
+        store_ok = check_answers_match_oracle(d, out, "store_latency")
+        store_bounded = out["store_latency_p_max_s"] < 5.0
+        faults.clear()
+        spec = faults.set_fault("batch_corrupt")
+        corrupt_ok = check_answers_match_oracle(d, out, "batch_corrupt")
+        corrupted = spec.hits > 0
+        faults.clear()
+        out["degraded_ok"] = (
+            store_ok and store_bounded and corrupt_ok and corrupted
+        )
+        return out["degraded_ok"]
+    finally:
+        faults.clear()
+        d.stop()
+
+
+def main() -> int:
+    argparse.ArgumentParser(description=__doc__).parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    out: dict = {}
+    ok = True
+    for scenario in (
+        scenario_deadline, scenario_shed, scenario_breaker,
+        scenario_degraded_paths,
+    ):
+        ok = scenario(out) and ok
+    out["ok"] = ok
+    print(json.dumps(out))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
