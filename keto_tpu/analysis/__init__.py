@@ -1,0 +1,38 @@
+"""Analysis plane: the `go vet` / `golangci-lint` / `go test -race` tier.
+
+The reference gates every merge behind vet, lint, and a dedicated race
+job (ref CI .github/workflows/ci.yaml); this package is that tier for
+the port, built as two halves:
+
+  lint (ketolint)  — a stdlib-`ast` invariant checker encoding the rules
+                     the codebase already lives by (lock discipline,
+                     typed transport errors, config-key coverage, clock
+                     discipline, host-sync purity). Pure source
+                     inspection, zero third-party imports, so it runs
+                     before deps are installed: `python -m
+                     keto_tpu.analysis.lint`.
+  lockwatch        — a runtime lock-order / blocking-under-lock detector
+                     (the Python stand-in for `go test -race`): wraps
+                     threading.Lock/RLock/Condition creation, tracks
+                     per-thread held-lock sets, builds the global
+                     acquisition-order graph, and fails the test run on
+                     order-graph cycles (potential deadlock) or
+                     blocking-while-holding events, with creation-site
+                     stacks in the report. Enabled per-run with
+                     KETO_LOCKWATCH=1 (tests/conftest.py wires the
+                     pytest hooks).
+  source_scan      — the one shared source-scanning helper under both
+                     ketolint's config-key pass and
+                     tools/check_metrics_docs.py (previously two ad-hoc
+                     regex walkers).
+
+Suppression contract (docs/architecture.md §5g): a finding is silenced
+only by an in-code `# ketolint: allow[<rule>] reason=...` on (or
+directly above) the offending line; an allow without a reason, or one
+that suppresses nothing, is itself an error — annotations can never rot
+into unreviewed noise.
+
+This package must stay importable with NOTHING but the standard library
+installed (CI runs it before `pip install`), so no keto_tpu runtime
+modules and no third-party imports at module scope.
+"""

@@ -1,0 +1,613 @@
+"""lockwatch — runtime lock-order / blocking-under-lock detector.
+
+The Python stand-in for `go test -race`, shaped for this codebase's
+failure modes (a Zanzibar-class serving path is mostly concurrency
+correctness): it cannot see data races on plain attributes, but it CAN
+see the two classes of bug the repo's locking conventions exist to
+prevent —
+
+  order cycles          Every acquisition of a tracked lock while other
+                        tracked locks are held adds edges to a global
+                        acquisition-order graph. A cycle (A taken under
+                        B somewhere, B taken under A elsewhere — on any
+                        threads, at any time) is a potential deadlock
+                        even if the run never interleaved badly. This is
+                        the graph formulation used by mutrace/lockdep:
+                        potential deadlocks are found on EVERY run, not
+                        just the unlucky one.
+  blocking under a lock Condition/Event waits, semaphore waits,
+                        `Future.result`, blocking `queue.get` (they all
+                        park on a Condition internally) and `time.sleep`
+                        while holding a DIFFERENT tracked lock. Waiting
+                        on a condition releases only ITS lock; anything
+                        else held starves every other taker for the
+                        duration — the exact bug class the hub's
+                        "listeners fire outside store locks" and the trim
+                        guard's lock-free contract exist to prevent.
+
+Tracking scope: only locks whose creation site is inside this repository
+(keto_tpu/ or tests/) are tracked — stdlib objects created ON BEHALF of
+repo code (queue.Queue's mutex, Future's condition, semaphores built by
+our batchers) count as ours, while jax/grpc/prometheus internals stay
+untracked so third-party locking idioms cannot produce findings we
+don't own. Reports carry the CREATION-SITE stack of every lock involved
+plus the acquisition stack of each offending edge.
+
+Two ways in:
+
+  - `LockWatch()` used directly (tests wrap specific locks), or
+  - `install()` / `uninstall()` patching `threading.Lock/RLock/
+    Condition` and `time.sleep` process-wide; `KETO_LOCKWATCH=1` makes
+    tests/conftest.py install around the pytest session and the
+    per-test hook fail ANY test whose execution produced a violation —
+    the CI `lockwatch` leg runs the concurrency-heavy suites this way.
+
+Suppression: `with lockwatch.allow_blocking("reason"):` scopes an
+intentional blocking-under-lock (none are needed in-repo today; the
+escape hatch exists so a future justified case is visible and
+greppable, like ketolint's allow[] contract).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+_REPO = Path(__file__).resolve().parent.parent.parent
+_TRACK_PREFIXES = (str(_REPO / "keto_tpu"), str(_REPO / "tests")) + tuple(
+    # extra tracked roots (os.pathsep-separated) — the plugin test
+    # points this at a tmp dir so its fixture test file is "repo code"
+    p for p in os.environ.get("KETO_LOCKWATCH_TRACK", "").split(os.pathsep)
+    if p
+)
+# stdlib modules that create locks on behalf of their caller — skipped
+# when attributing a creation site, so a Queue made by the batcher is
+# tracked as the batcher's
+_TRANSPARENT = (
+    "threading.py", "queue.py", "dataclasses.py", "functools.py",
+    "contextlib.py", os.path.join("concurrent", "futures"),
+    os.path.join("asyncio", ""), "socketserver.py", "_pyio.py",
+)
+_SELF = str(Path(__file__).resolve())
+
+# the real allocators, captured at import so uninstall() and internal
+# bookkeeping never recurse through a patched factory
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+_REAL_SLEEP = time.sleep
+
+
+def _creation_site(limit: int = 12):
+    """(should_track, stack) — stack is the trimmed creation traceback;
+    tracking is decided by the innermost frame that is neither lockwatch
+    nor a transparent stdlib module. Walks raw frames first (cheap) and
+    extracts a traceback only for locks that will be tracked — this runs
+    on every Lock/Future/Queue creation while installed."""
+    import sys
+
+    f = sys._getframe(2)  # skip _creation_site + the factory
+    probe = f
+    track = False
+    while probe is not None:
+        fn = probe.f_code.co_filename
+        if fn == _SELF or any(t in fn for t in _TRANSPARENT):
+            probe = probe.f_back
+            continue
+        track = fn.startswith(_TRACK_PREFIXES)
+        break
+    if not track:
+        return False, []
+    # extract from the attributed frame so the innermost entry IS the
+    # real creation site, not a lockwatch/stdlib wrapper
+    return True, traceback.extract_stack(probe, limit=limit)
+
+
+def _fmt_stack(stack) -> str:
+    return "".join(traceback.format_list(stack)).rstrip()
+
+
+@dataclass
+class Violation:
+    kind: str  # "order-cycle" | "blocking-under-lock"
+    message: str
+    detail: str
+
+    def render(self) -> str:
+        return f"[lockwatch:{self.kind}] {self.message}\n{self.detail}"
+
+
+@dataclass
+class _LockInfo:
+    token: int
+    name: str
+    stack: list = field(default_factory=list)
+
+    def site(self) -> str:
+        if not self.stack:
+            return "<unknown>"
+        f = self.stack[-1]
+        return f"{f.filename}:{f.lineno} in {f.name}"
+
+
+class _Held:
+    __slots__ = ("info", "count")
+
+    def __init__(self, info: _LockInfo):
+        self.info = info
+        self.count = 1
+
+
+class LockWatch:
+    """One detector instance: graph, held-sets, violations."""
+
+    def __init__(self):
+        # guards graph/violations; reentrant because _report_cycle runs
+        # inside note_acquire's critical section and records through
+        # _record (never tracked — allocated from the saved real factory)
+        self._mu = _REAL_RLOCK()
+        self._graph: dict[int, set[int]] = {}
+        self._edges: dict[tuple[int, int], str] = {}  # first-seen stack
+        self._infos: dict[int, _LockInfo] = {}
+        self._next_token = iter(range(1, 1 << 62)).__next__
+        self.violations: list[Violation] = []
+        self._tls = threading.local()
+        self._cycles_seen: set[frozenset] = set()
+
+    # -- thread-local held set -------------------------------------------------
+
+    def _held(self) -> list[_Held]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _allow_depth(self) -> int:
+        return getattr(self._tls, "allow", 0)
+
+    def allow_blocking(self, reason: str):
+        """Scoped, reasoned escape hatch for an intentional
+        blocking-under-lock (the runtime twin of ketolint's
+        `allow[...] reason=...` contract)."""
+        watch = self
+
+        class _Allow:
+            def __enter__(self):
+                watch._tls.allow = watch._allow_depth() + 1
+
+            def __exit__(self, *exc):
+                watch._tls.allow = watch._allow_depth() - 1
+                return False
+
+        if not reason:
+            raise ValueError("allow_blocking requires a reason")
+        return _Allow()
+
+    # -- registration ----------------------------------------------------------
+
+    def _register(self, name: str, stack) -> _LockInfo:
+        with self._mu:
+            info = _LockInfo(self._next_token(), name, list(stack))
+            self._infos[info.token] = info
+        return info
+
+    # -- events ----------------------------------------------------------------
+
+    def note_acquire(self, info: _LockInfo) -> None:
+        """Called BEFORE the real acquire: records order edges (held ->
+        acquiring) and checks the global graph for a new cycle."""
+        held = self._held()
+        for h in held:
+            if h.info.token == info.token:
+                h.count += 1  # reentrant RLock acquire: no new edges
+                return
+        new_edges = []
+        for h in held:
+            edge = (h.info.token, info.token)
+            if edge[0] != edge[1] and edge not in self._edges:
+                new_edges.append(edge)
+        if new_edges:
+            stack_s = _fmt_stack(traceback.extract_stack()[:-2][-8:])
+            with self._mu:
+                for edge in new_edges:
+                    if edge in self._edges:
+                        continue
+                    self._edges[edge] = (
+                        f"thread {threading.current_thread().name}:\n"
+                        f"{stack_s}"
+                    )
+                    self._graph.setdefault(edge[0], set()).add(edge[1])
+                    cycle = self._find_cycle(edge[1], edge[0])
+                    if cycle is not None:
+                        # path ends at edge[0]; drop it — the ring is
+                        # closed by the renderer
+                        self._report_cycle([edge[0]] + cycle[:-1])
+        held.append(_Held(info))
+
+    def note_release(self, info: _LockInfo) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].info.token == info.token:
+                held[i].count -= 1
+                if held[i].count == 0:
+                    del held[i]
+                return
+
+    def note_blocking(self, what: str, releasing: Optional[_LockInfo] = None):
+        """A blocking operation is about to run; `releasing` is the lock
+        the wait atomically releases (a Condition's own lock), which
+        therefore doesn't count as held-across-the-wait."""
+        if self._allow_depth():
+            return
+        held = [
+            h.info
+            for h in self._held()
+            if releasing is None or h.info.token != releasing.token
+        ]
+        if not held:
+            return
+        # Thread.start's started-Event handshake is a bounded spawn
+        # barrier the stdlib itself runs under executor locks
+        # (ThreadPoolExecutor.submit holds _shutdown_lock across
+        # _adjust_thread_count -> Thread.start) — not a repo hazard
+        import sys
+
+        f = sys._getframe(1)
+        for _ in range(8):
+            if f is None:
+                break
+            if f.f_code.co_name == "start" and f.f_code.co_filename.endswith(
+                "threading.py"
+            ):
+                return
+            f = f.f_back
+        stack_s = _fmt_stack(traceback.extract_stack()[:-2][-8:])
+        locks = "\n".join(
+            f"  holds {i.name} (created at {i.site()})" for i in held
+        )
+        self._record(
+            Violation(
+                "blocking-under-lock",
+                f"{what} while holding {len(held)} tracked lock(s) "
+                f"on thread {threading.current_thread().name}",
+                f"{locks}\nblocking call:\n{stack_s}\n"
+                + "\n".join(
+                    f"lock {i.name} created at:\n{_fmt_stack(i.stack)}"
+                    for i in held
+                ),
+            )
+        )
+
+    # -- graph -----------------------------------------------------------------
+
+    def _find_cycle(self, start: int, target: int) -> Optional[list[int]]:
+        """Path start -> ... -> target in the edge graph (caller holds
+        self._mu); adding target->start then closes the cycle."""
+        stack = [(start, [start])]
+        seen = {start}
+        while stack:
+            node, path = stack.pop()
+            if node == target:
+                return path
+            for nxt in self._graph.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    def _report_cycle(self, cycle: list[int]) -> None:
+        key = frozenset(cycle)
+        if key in self._cycles_seen:
+            return
+        self._cycles_seen.add(key)
+        names = " -> ".join(
+            self._infos[t].name for t in cycle + [cycle[0]]
+        )
+        parts = []
+        ring = cycle + [cycle[0]]
+        for a, b in zip(ring, ring[1:]):
+            info = self._edges.get((a, b), "<edge>")
+            parts.append(
+                f"edge {self._infos[a].name} -> {self._infos[b].name} "
+                f"first acquired by {info}"
+            )
+        for t in cycle:
+            i = self._infos[t]
+            parts.append(
+                f"lock {i.name} created at:\n{_fmt_stack(i.stack)}"
+            )
+        self._record(
+            Violation(
+                "order-cycle",
+                f"lock acquisition order cycle: {names} "
+                "(potential deadlock)",
+                "\n".join(parts),
+            )
+        )
+
+    def _record(self, v: Violation) -> None:
+        with self._mu:
+            self.violations.append(v)
+
+    # -- factories (used directly by tests, and by install()) ------------------
+
+    def Lock(self, name: Optional[str] = None):
+        tracked, stack = _creation_site()
+        inner = _REAL_LOCK()
+        if not tracked and name is None:
+            return inner
+        return _TrackedLock(
+            self, inner, self._register(name or _name_from(stack), stack)
+        )
+
+    def RLock(self, name: Optional[str] = None):
+        tracked, stack = _creation_site()
+        inner = _REAL_RLOCK()
+        if not tracked and name is None:
+            return inner
+        return _TrackedLock(
+            self, inner, self._register(name or _name_from(stack), stack)
+        )
+
+    def Condition(self, lock=None, name: Optional[str] = None):
+        tracked_site, stack = _creation_site()
+        if isinstance(lock, _TrackedLock):
+            # the condition shares the tracked lock's identity: waiting
+            # on it releases THAT lock
+            return _TrackedCondition(
+                self, _REAL_CONDITION(lock._inner), lock._info
+            )
+        if lock is None:
+            # allocate the backing lock from the REAL factory: letting
+            # Condition() call the patched threading.RLock would track
+            # the inner lock as a second, distinct lock of the same
+            # object and every wait would misreport holding it
+            lock = _REAL_RLOCK()
+        if not tracked_site and name is None:
+            return _REAL_CONDITION(lock)
+        inner = _REAL_CONDITION(lock)
+        return _TrackedCondition(
+            self, inner, self._register(name or _name_from(stack), stack)
+        )
+
+    def report(self) -> str:
+        with self._mu:
+            vs = list(self.violations)
+        if not vs:
+            return "lockwatch: clean"
+        out = [f"lockwatch: {len(vs)} violation(s)"]
+        out.extend(v.render() for v in vs)
+        return "\n\n".join(out)
+
+
+def _name_from(stack) -> str:
+    if not stack:
+        return "lock"
+    f = stack[-1]
+    return f"{Path(f.filename).name}:{f.lineno}({f.name})"
+
+
+class _TrackedLock:
+    """Proxy over a real lock; order/blocking bookkeeping around every
+    acquire. Supports the full Lock/RLock surface the repo and the
+    stdlib (Condition, Queue, Future) use."""
+
+    def __init__(self, watch: LockWatch, inner, info: _LockInfo):
+        self._watch = watch
+        self._inner = inner
+        self._info = info
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if blocking:
+            self._watch.note_acquire(self._info)
+            got = self._inner.acquire(True, timeout)
+            if not got:
+                self._watch.note_release(self._info)
+            return got
+        got = self._inner.acquire(False)
+        if got:
+            self._watch.note_acquire(self._info)
+        return got
+
+    # Condition(lock) calls these internal names on the lock it wraps
+    def _acquire_restore(self, state):
+        self._watch.note_acquire(self._info)
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(state)
+        else:
+            self._inner.acquire()
+
+    def _release_save(self):
+        self._watch.note_release(self._info)
+        if hasattr(self._inner, "_release_save"):
+            return self._inner._release_save()
+        self._inner.release()
+        return None
+
+    def _is_owned(self):
+        if hasattr(self._inner, "_is_owned"):
+            return self._inner._is_owned()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def release(self):
+        self._inner.release()
+        self._watch.note_release(self._info)
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<tracked {self._inner!r} as {self._info.name}>"
+
+
+class _TrackedCondition:
+    """Condition proxy: shares a tracked lock's identity (waiting
+    releases that lock); flags waits that happen while OTHER tracked
+    locks are held."""
+
+    def __init__(self, watch: LockWatch, inner, info: _LockInfo):
+        self._watch = watch
+        self._inner = inner
+        self._info = info
+
+    def acquire(self, *args, **kw):
+        self._watch.note_acquire(self._info)
+        return self._inner.acquire(*args, **kw)
+
+    def release(self):
+        self._inner.release()
+        self._watch.note_release(self._info)
+
+    def __enter__(self):
+        self._watch.note_acquire(self._info)
+        self._inner.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._inner.__exit__(*exc)
+        self._watch.note_release(self._info)
+        return False
+
+    def wait(self, timeout: Optional[float] = None):
+        # a zero-timeout wait is a non-blocking poll (Semaphore's
+        # acquire(timeout=0) idiom inside ThreadPoolExecutor), not a
+        # blocking event
+        if timeout is None or timeout > 0:
+            self._watch.note_blocking(
+                f"Condition.wait on {self._info.name}", releasing=self._info
+            )
+        return self._inner.wait(timeout)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        if timeout is None or timeout > 0:
+            self._watch.note_blocking(
+                f"Condition.wait_for on {self._info.name}",
+                releasing=self._info,
+            )
+        return self._inner.wait_for(predicate, timeout)
+
+    def notify(self, n: int = 1):
+        self._inner.notify(n)
+
+    def notify_all(self):
+        self._inner.notify_all()
+
+
+# -- global install ------------------------------------------------------------
+
+_GLOBAL: Optional[LockWatch] = None
+
+
+def current() -> Optional[LockWatch]:
+    return _GLOBAL
+
+
+def install() -> LockWatch:
+    """Patch threading.Lock/RLock/Condition + time.sleep so every lock
+    subsequently created by repo code is tracked. Returns the watcher;
+    idempotent."""
+    global _GLOBAL
+    if _GLOBAL is not None:
+        return _GLOBAL
+    watch = LockWatch()
+    _GLOBAL = watch
+
+    def _lock():
+        return watch.Lock()
+
+    def _rlock():
+        return watch.RLock()
+
+    def _condition(lock=None):
+        return watch.Condition(lock)
+
+    def _sleep(seconds):
+        watch.note_blocking(f"time.sleep({seconds!r})")
+        return _REAL_SLEEP(seconds)
+
+    threading.Lock = _lock
+    threading.RLock = _rlock
+    threading.Condition = _condition
+    time.sleep = _sleep
+    return watch
+
+
+def uninstall() -> Optional[LockWatch]:
+    """Restore the real factories. Locks already created keep working —
+    their proxies reference the watcher directly."""
+    global _GLOBAL
+    watch, _GLOBAL = _GLOBAL, None
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    threading.Condition = _REAL_CONDITION
+    time.sleep = _REAL_SLEEP
+    return watch
+
+
+def allow_blocking(reason: str):
+    """Module-level convenience for the installed watcher; a no-op
+    context manager when lockwatch is not installed."""
+    watch = _GLOBAL
+    if watch is None:
+        import contextlib
+
+        return contextlib.nullcontext()
+    return watch.allow_blocking(reason)
+
+
+# -- pytest integration (tests/conftest.py delegates when KETO_LOCKWATCH=1) ----
+
+
+def enabled_by_env() -> bool:
+    return os.environ.get("KETO_LOCKWATCH") == "1"
+
+
+def pytest_session_start() -> Optional[LockWatch]:
+    if not enabled_by_env():
+        return None
+    return install()
+
+
+def check_test(item_name: str, seen: int | None = None) -> int:
+    """Called from the per-test teardown hook: raises (failing the test
+    loudly, with creation-site stacks) when new violations appeared
+    during `item_name`; returns the new high-water mark. The mark is
+    kept ON the watcher and advanced BEFORE raising — callers assigning
+    the return value never run that assignment when this raises, and a
+    stale mark would re-blame every later test for the same violation.
+    `seen` overrides the stored mark (tests drive this directly)."""
+    watch = _GLOBAL
+    if watch is None:
+        return 0
+    with watch._mu:
+        vs = list(watch.violations)
+        if seen is None:
+            seen = getattr(watch, "_reported", 0)
+        watch._reported = len(vs)
+    if len(vs) > seen:
+        fresh = vs[seen:]
+        raise LockwatchError(
+            f"{len(fresh)} lockwatch violation(s) during {item_name}:\n\n"
+            + "\n\n".join(v.render() for v in fresh)
+        )
+    return len(vs)
+
+
+class LockwatchError(AssertionError):
+    pass

@@ -1,0 +1,183 @@
+"""Shared source-scanning helpers for the analysis plane.
+
+One walker serves both consumers that inspect the repo's source text
+without importing it: ketolint's config-key pass (lint.py) and the
+metrics-golden check (tools/check_metrics_docs.py). Pure stdlib, pure
+text/AST — nothing here imports keto_tpu runtime modules, so the
+scanners run before dependencies are installed and cannot be skewed by
+runtime state.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterable, Iterator
+
+
+def repo_root() -> Path:
+    """The repository root (the directory holding keto_tpu/)."""
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def package_root() -> Path:
+    return repo_root() / "keto_tpu"
+
+
+def iter_py_files(root: Path) -> list[Path]:
+    """Every .py file under `root`, sorted, excluding caches."""
+    return sorted(
+        p
+        for p in root.rglob("*.py")
+        if "__pycache__" not in p.parts
+    )
+
+
+def read_text(path: Path) -> str:
+    return path.read_text(encoding="utf-8")
+
+
+def parse_file(path: Path) -> ast.AST:
+    return ast.parse(read_text(path), filename=str(path))
+
+
+def scan_pattern(pattern: "re.Pattern[str] | str", paths: Iterable[Path]) -> set[str]:
+    """All group-1 matches of `pattern` across `paths` — the shape both
+    the metrics-golden check and the docs-table scan use (registration
+    regex over source, code-span regex over markdown)."""
+    if isinstance(pattern, str):
+        pattern = re.compile(pattern)
+    found: set[str] = set()
+    for path in paths:
+        found.update(pattern.findall(read_text(path)))
+    return found
+
+
+# -- config-key read sites -----------------------------------------------------
+
+# a dotted config key literal: "limit.max_read_depth", "serve.check.max_queue"
+_DOTTED_KEY = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+# top-level schema keys are single-segment ("dsn", "namespaces"); only
+# treat them as config reads when the receiver is config-like
+_SINGLE_KEY = re.compile(r"^[a-z][a-z0-9_]*$")
+# receivers that denote the Config provider for SINGLE-segment keys
+# (dotted keys are unambiguous — the dotted-path convention exists only
+# for the provider — but bare keys like "enabled" appear on plain dicts
+# everywhere, so they count only on an unambiguous `config` receiver)
+_CONFIG_RECEIVER = re.compile(r"^_?config$")
+
+
+def _receiver_name(func: ast.Attribute) -> str | None:
+    v = func.value
+    if isinstance(v, ast.Name):
+        return v.id
+    if isinstance(v, ast.Attribute):
+        return v.attr
+    return None
+
+
+def _fstring_key_pattern(node: ast.JoinedStr) -> str | None:
+    """A dotted key pattern from an f-string read like
+    `config.get(f"serve.{kind}.tls")` — each interpolation becomes a
+    single `*` segment. None when the shape isn't a dotted key."""
+    parts: list[str] = []
+    for v in node.values:
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            parts.append(v.value)
+        elif isinstance(v, ast.FormattedValue):
+            parts.append("*")
+        else:
+            return None
+    key = "".join(parts)
+    if _DOTTED_KEY.match(key.replace("*", "x")):
+        return key
+    return None
+
+
+def config_key_reads(
+    tree: ast.AST, *, self_is_config: bool = False
+) -> Iterator[tuple[str, int]]:
+    """(dotted_key, lineno) for every literal `*.get("a.b.c")` read whose
+    receiver looks like the Config provider. `self_is_config` widens the
+    receiver match to bare `self` (config.py's own typed accessors call
+    `self.get("dsn", ...)`). Keys read through f-strings yield wildcard
+    patterns — `serve.*.tls` — where each interpolation is one segment.
+    """
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+            and node.args
+        ):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.JoinedStr):
+            pattern = _fstring_key_pattern(arg)
+            if pattern is not None:
+                yield pattern, node.lineno
+            continue
+        if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+            continue
+        key = arg.value
+        recv = _receiver_name(node.func)
+        config_recv = recv is not None and (
+            _CONFIG_RECEIVER.match(recv) is not None
+            or (self_is_config and recv == "self")
+        )
+        if _DOTTED_KEY.match(key):
+            # a dotted literal is a config key wherever it appears (the
+            # dotted-path convention exists only for the provider)
+            yield key, node.lineno
+        elif config_recv and _SINGLE_KEY.match(key):
+            yield key, node.lineno
+
+
+def key_matches(pattern: str, path: str) -> bool:
+    """True when `pattern` (dotted, `*` = exactly one segment) matches
+    `path` exactly."""
+    pp = pattern.split(".")
+    kp = path.split(".")
+    return len(pp) == len(kp) and all(
+        a == "*" or a == b for a, b in zip(pp, kp)
+    )
+
+
+# -- config schema key tree ----------------------------------------------------
+
+
+def schema_key_tree(schema: dict) -> tuple[set[str], set[str]]:
+    """(all_paths, leaf_paths) of dotted key paths declared in a JSON
+    config schema, resolving local `#/definitions/...` refs. A node with
+    no `properties` (after resolution) is a leaf."""
+    defs = schema.get("definitions", {})
+
+    def resolve(node: dict) -> dict:
+        ref = node.get("$ref")
+        if isinstance(ref, str) and ref.startswith("#/definitions/"):
+            return defs.get(ref.rsplit("/", 1)[-1], {})
+        return node
+
+    all_paths: set[str] = set()
+    leaves: set[str] = set()
+
+    def walk(node: dict, prefix: str) -> None:
+        node = resolve(node)
+        props = node.get("properties")
+        if not isinstance(props, dict):
+            if prefix:
+                leaves.add(prefix)
+            return
+        if prefix:
+            all_paths.add(prefix)
+        for name, child in props.items():
+            path = f"{prefix}.{name}" if prefix else name
+            all_paths.add(path)
+            if isinstance(child, dict):
+                walk(child, path)
+            else:
+                leaves.add(path)
+
+    walk(schema, "")
+    return all_paths, leaves

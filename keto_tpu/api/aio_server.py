@@ -49,7 +49,12 @@ from .batcher import (
 )
 from .descriptors import CHECK_SERVICE, pb
 from .grpc_server import _grpc_code, _Services
-from ..errors import DeadlineExceededError, KetoError, OverloadedError
+from ..errors import (
+    BatcherClosedError,
+    DeadlineExceededError,
+    KetoError,
+    OverloadedError,
+)
 from ..observability import (
     current_request_trace,
     reset_request_trace,
@@ -174,7 +179,9 @@ class AioCheckBatcher:
         CheckBatcher.check_versioned (the check cache's store input);
         `rt.deadline` bounds the wait with the typed 504."""
         if self._closed:
-            raise RuntimeError("AioCheckBatcher is closed")
+            # typed drain shed + embedder `except RuntimeError` compat
+            # (same dual contract as the threaded plane)
+            raise BatcherClosedError(retry_after_s=1.0)
         if self.max_queue and self._pending >= self.max_queue:
             # enqueue-time bound (exact: this coroutine runs in-loop)
             if self.metrics is not None:
@@ -783,6 +790,7 @@ class AioReadServer:
         )
         self._thread.start()
         if not self._started.wait(timeout=30) or self.bound_port is None:
+            # ketolint: allow[typed-error] reason=startup path: raises to the embedding process before any listener exists, so no client ever sees it — KetoError's HTTP/gRPC mapping has nothing to map to
             raise RuntimeError("aio read server failed to start")
         return self.bound_port
 

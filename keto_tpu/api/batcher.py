@@ -29,6 +29,7 @@ from concurrent.futures import TimeoutError as FutureTimeoutError
 from dataclasses import dataclass, field
 
 from ..errors import (
+    BatcherClosedError,
     CheckBatchFailedError,
     DeadlineExceededError,
     KetoError,
@@ -327,7 +328,9 @@ class CheckBatcher:
         or None when the evaluation path cannot pin one (host engine,
         host-replayed rider) — the check cache's store contract."""
         if self._closed:
-            raise RuntimeError("CheckBatcher is closed")
+            # typed drain shed + embedder `except RuntimeError` compat
+            # (tri-plane parity with AioCheckBatcher.check_versioned)
+            raise BatcherClosedError(retry_after_s=1.0)
         # atomic admission bound: check-and-increment under one lock so
         # concurrent callers can never push past max_queue (the
         # acceptance property "queue never grows past max_queue")
@@ -373,7 +376,7 @@ class CheckBatcher:
             except queue.Empty:
                 break
             if p is not None and not p.future.done():
-                p.future.set_exception(RuntimeError("CheckBatcher is closed"))
+                p.future.set_exception(BatcherClosedError(retry_after_s=1.0))
 
     # -- collector ------------------------------------------------------------
 

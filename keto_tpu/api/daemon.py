@@ -239,6 +239,11 @@ class Daemon:
 
     def start(self) -> None:
         reg = self.registry
+        # operator logging contract (log.level / log.format) applies
+        # before the first listener can emit a line
+        from ..observability import configure_logging
+
+        configure_logging(reg.config)
         # internal loopback backends (ephemeral ports)
         self._grpc_read = build_grpc_server(reg, write=False, batcher=self.batcher)
         self._grpc_write = build_grpc_server(reg, write=True)

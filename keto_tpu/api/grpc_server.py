@@ -103,7 +103,8 @@ def _attach_retry_after(context, err) -> None:
         context.set_trailing_metadata(
             (("retry-after", retry_after_header_value(ra)),)
         )
-    except Exception:  # noqa: BLE001 — metadata is best-effort decoration
+    # ketolint: allow[typed-error] reason=trailing metadata is best-effort decoration on an ALREADY-typed error response; a metadata failure must never replace the typed 429 the client is about to receive
+    except Exception:
         pass
 
 

@@ -29,7 +29,6 @@ from ..ketoapi import (
     RelationQuery,
     RelationTuple,
     SubjectSet,
-    Tree,
 )
 
 FORMAT_DEFAULT = "default"

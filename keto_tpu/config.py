@@ -237,6 +237,15 @@ class Config:
         self._values: dict[str, Any] = dict(values or {})
         if validate and self._values:
             self.validate(self._values)
+        # `version` is the reference's config schema version marker: it
+        # is accepted for drop-in compatibility, and a malformed marker
+        # gets one warning instead of silently meaning nothing
+        marker = self.get("version")
+        if marker is not None and not str(marker).startswith("v"):
+            logger.warning(
+                "unrecognized config version marker %r (the reference "
+                "writes 'v<semver>'); continuing", marker,
+            )
         self._namespace_manager = None
 
     @staticmethod

@@ -54,7 +54,7 @@ profile; the host-side merge (this module) is the part that was minutes.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Sequence
 
 import numpy as np
 

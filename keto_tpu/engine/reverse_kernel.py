@@ -58,7 +58,7 @@ batch — the axon-tunnel transfer-count floor, see check_kernel_packed).
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional, Sequence
+from typing import NamedTuple, Sequence
 
 import jax
 import jax.numpy as jnp

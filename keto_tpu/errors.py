@@ -168,6 +168,16 @@ class OverloadedError(KetoError):
         self.retry_after_s = retry_after_s
 
 
+class BatcherClosedError(OverloadedError, RuntimeError):
+    # A check racing batcher shutdown: typed like the admission gate's
+    # drain shed (429 + Retry-After — retryable against a live replica),
+    # and ALSO a RuntimeError so embedders' `except RuntimeError`
+    # handlers around CheckBatcher.check keep working (this raise site
+    # was a bare RuntimeError before the typed-error boundary existed;
+    # same dual-inheritance compat contract as CheckBatchFailedError).
+    default_message = "check batcher is closed"
+
+
 class CheckBatchFailedError(KetoError, RuntimeError):
     # Engine-batch failure classified into the typed error surface
     # (api/batcher.py classify_engine_error) instead of leaking the raw

@@ -655,6 +655,62 @@ def _stages_ms(stages: Optional[dict]) -> dict[str, float]:
     return {k: round(v * 1e3, 3) for k, v in (stages or {}).items()}
 
 
+class _JsonLogFormatter(logging.Formatter):
+    """One JSON object per line (`log.format: json`), carrying the
+    structured extras request_log/slow_query_log attach — machine-
+    ingestable parity with the reference's logrusx JSON mode."""
+
+    _STD = frozenset(
+        logging.LogRecord("", 0, "", 0, "", (), None).__dict__
+    ) | {"message", "asctime", "taskName"}
+
+    def format(self, record: logging.LogRecord) -> str:
+        import json as _json
+
+        out = {
+            "time": self.formatTime(record),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        for k, v in record.__dict__.items():
+            if k not in self._STD:
+                out[k] = v
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return _json.dumps(out, default=str)
+
+
+def configure_logging(config) -> None:
+    """Apply `log.level` / `log.format` from the config to the keto_tpu
+    logger tree (ref: logrusx setup in driver registry). Called by
+    Daemon.start so an operator's config controls serve logging without
+    code; idempotent — repeated starts just re-apply."""
+    level = config.get("log.level")
+    if level:
+        logger.setLevel(getattr(logging, str(level).upper(), logging.INFO))
+    fmt = config.get("log.format")
+    json_handlers = [
+        h for h in logger.handlers if getattr(h, "_keto_json", False)
+    ]
+    if fmt == "json":
+        if not json_handlers:
+            handler = logging.StreamHandler()
+            handler._keto_json = True
+            handler.setFormatter(_JsonLogFormatter())
+            logger.addHandler(handler)
+            # the JSON handler replaces root propagation (double lines
+            # otherwise: one structured, one from the root handler)
+            logger.propagate = False
+    elif json_handlers:
+        # symmetric: a later start with log.format text (or unset) must
+        # UNDO json mode — a stuck handler + propagate=False would hide
+        # keto_tpu records from root/caplog for the process's lifetime
+        for h in json_handlers:
+            logger.removeHandler(h)
+        logger.propagate = True
+
+
 def request_log(
     transport: str,
     method: str,

@@ -131,7 +131,10 @@ def _decode_token(token: str) -> str:
             )
             if key:
                 return key.decode()
-        except Exception:
+        except ValueError:
+            # binascii.Error and UnicodeDecodeError both subclass
+            # ValueError: a corrupt cursor falls through to the typed
+            # InvalidPageTokenError below; anything else should surface
             pass
     raise InvalidPageTokenError(debug=f"invalid pagination token {token!r}")
 

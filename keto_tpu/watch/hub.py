@@ -445,11 +445,18 @@ class WatchHub:
     def _state(self, nid: str) -> _NidState:
         with self._states_lock:
             state = self._states.get(nid)
-            if state is None:
-                state = self._states[nid] = _NidState(
-                    self.manager.version(nid=nid)
-                )
-            return state
+            if state is not None:
+                return state
+        # store query OUTSIDE the states lock: manager.version takes the
+        # store lock, and holding ours across it would order
+        # _states_lock -> store lock on a path a store-side hook could
+        # one day invert. A write landing between the read and the
+        # insert only leaves tail_version slightly behind; the first
+        # _drain_locked catches the tail up before any subscriber
+        # registers.
+        version = self.manager.version(nid=nid)
+        with self._states_lock:
+            return self._states.setdefault(nid, _NidState(version))
 
     def _changelog(self, version: int, nid: str):
         fn = getattr(self.manager, "changelog_since", None)
@@ -496,6 +503,7 @@ class WatchHub:
     def _drain_locked(self, state: _NidState, nid: str) -> None:
         """Advance the tail to the store's current version, broadcasting
         every committed version since. Caller holds state.lock."""
+        # ketolint: allow[lock-blocking-call] reason=the store read and the broadcast must be one atomic step under the nid state lock: that is exactly what makes the replay->live-tail handoff in subscribe() exactly-once (module docstring, "Locking"); the inverse order store->state-lock never occurs because min_active_version is lock-free by contract
         current = self.manager.version(nid=nid)
         state.dirty = False
         pending_since, state.pending_since = state.pending_since, None

@@ -1,0 +1,788 @@
+"""Analysis-plane tests: ketolint passes, the lockwatch detector, and
+pinning regressions for the findings this tier surfaced and fixed.
+
+Three families:
+  - golden fixture snippets that MUST trip each ketolint pass (and the
+    suppression contract: reasonless + unused allows are errors), plus
+    the CLI exit-code contract;
+  - lockwatch: a seeded AB-BA lock inversion and a sleep-under-lock the
+    detector must catch with creation-site stacks in the report, and a
+    clean-run assertion over a real daemon start/stop cycle;
+  - pinning tests for the real fixes: the watch hub's store read moved
+    outside _states_lock, typed closed-batcher errors on both planes,
+    the columnar page-token except narrowed, log.level/log.format and
+    the `version` marker actually read.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from keto_tpu.analysis import lint, lockwatch
+from keto_tpu.analysis.source_scan import (
+    config_key_reads,
+    key_matches,
+    schema_key_tree,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def run_lint_on(tmp_path, name: str, source: str):
+    """Lint one golden fixture file through the real CLI entrypoint;
+    returns (exit_code, output)."""
+    p = tmp_path / name
+    p.write_text(source)
+    proc = subprocess.run(
+        [sys.executable, "-m", "keto_tpu.analysis.lint", str(p)],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    return proc.returncode, proc.stdout + proc.stderr
+
+
+# -- ketolint golden fixtures --------------------------------------------------
+
+
+class TestKetolintGoldens:
+    def test_lock_blocking_sleep(self, tmp_path):
+        rc, out = run_lint_on(tmp_path, "mod.py", (
+            "import threading, time\n"
+            "class S:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def bad(self):\n"
+            "        with self._lock:\n"
+            "            time.sleep(1)\n"
+        ))
+        assert rc == 1 and "lock-blocking-call" in out and "time.sleep" in out
+
+    def test_lock_blocking_future_result(self, tmp_path):
+        rc, out = run_lint_on(tmp_path, "mod.py", (
+            "class S:\n"
+            "    def bad(self, fut):\n"
+            "        with self._mu:\n"
+            "            return fut.result()\n"
+        ))
+        assert rc == 1 and "Future.result" in out
+
+    def test_lock_blocking_store_call_in_locked_method(self, tmp_path):
+        # the *_locked naming convention marks caller-holds-lock regions
+        rc, out = run_lint_on(tmp_path, "mod.py", (
+            "class S:\n"
+            "    def _sync_locked(self):\n"
+            "        return self.manager.version()\n"
+            "    def ok(self):\n"
+            "        with self._lock:\n"
+            "            self._sync_locked()\n"
+        ))
+        assert rc == 1 and "store/manager call" in out
+
+    def test_lock_blocking_fixpoint_private_helper(self, tmp_path):
+        # a private method called ONLY from locked regions inherits them
+        rc, out = run_lint_on(tmp_path, "mod.py", (
+            "class S:\n"
+            "    def _helper(self):\n"
+            "        return self.manager.version()\n"
+            "    def entry(self):\n"
+            "        with self._lock:\n"
+            "            self._helper()\n"
+        ))
+        assert rc == 1 and "store/manager call" in out
+
+    def test_lock_blocking_listener_fire(self, tmp_path):
+        rc, out = run_lint_on(tmp_path, "mod.py", (
+            "class S:\n"
+            "    def bad(self):\n"
+            "        with self._lock:\n"
+            "            for fn in self._listeners:\n"
+            "                fn()\n"
+        ))
+        assert rc == 1 and "listener/callback fired" in out
+
+    def test_own_condition_wait_is_fine(self, tmp_path):
+        rc, out = run_lint_on(tmp_path, "mod.py", (
+            "class S:\n"
+            "    def ok(self):\n"
+            "        with self._cond:\n"
+            "            self._cond.wait(1.0)\n"
+        ))
+        assert rc == 0, out
+
+    def test_sibling_condition_of_same_object_is_fine(self, tmp_path):
+        # the hub's `with state.lock: state.cond.wait()` pairing
+        rc, out = run_lint_on(tmp_path, "mod.py", (
+            "class S:\n"
+            "    def ok(self, state):\n"
+            "        with state.lock:\n"
+            "            state.cond.wait(0.25)\n"
+        ))
+        assert rc == 0, out
+
+    def test_foreign_wait_under_lock_trips(self, tmp_path):
+        rc, out = run_lint_on(tmp_path, "mod.py", (
+            "class S:\n"
+            "    def bad(self, ev):\n"
+            "        with self._lock:\n"
+            "            self._event.wait()\n"
+        ))
+        assert rc == 1 and ".wait" in out
+
+    def test_typed_error_bare_except(self, tmp_path):
+        rc, out = run_lint_on(tmp_path, "mod.py", (
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except:\n"
+            "        raise\n"
+        ))
+        assert rc == 1 and "bare `except:`" in out
+
+    def test_typed_error_silent_swallow(self, tmp_path):
+        rc, out = run_lint_on(tmp_path, "mod.py", (
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception:\n"
+            "        pass\n"
+        ))
+        assert rc == 1 and "swallows errors silently" in out
+
+    def test_typed_error_untyped_transport_raise(self, tmp_path):
+        # basename decides boundary membership — fixture mimics the
+        # transport module name
+        rc, out = run_lint_on(tmp_path, "rest_server.py", (
+            "def handler():\n"
+            "    raise ValueError('bad input')\n"
+        ))
+        assert rc == 1 and "untyped ValueError" in out
+
+    def test_typed_raise_in_transport_ok(self, tmp_path):
+        rc, out = run_lint_on(tmp_path, "rest_server.py", (
+            "from keto_tpu.errors import KetoError\n"
+            "class MyError(KetoError):\n"
+            "    pass\n"
+            "def handler():\n"
+            "    raise MyError('typed')\n"
+        ))
+        assert rc == 0, out
+
+    def test_clock_discipline(self, tmp_path):
+        rc, out = run_lint_on(tmp_path, "mod.py", (
+            "import time\n"
+            "def deadline():\n"
+            "    return time.time() + 5\n"
+        ))
+        assert rc == 1 and "clock-monotonic" in out
+
+    def test_host_sync_readback(self, tmp_path):
+        # basename decides hot-module membership
+        rc, out = run_lint_on(tmp_path, "kernel.py", (
+            "import numpy as np\n"
+            "def check_batch_resolve(handle):\n"
+            "    return np.asarray(handle)\n"
+        ))
+        assert rc == 1 and "host-sync" in out
+
+    def test_host_sync_fresh_jit(self, tmp_path):
+        rc, out = run_lint_on(tmp_path, "tpu_engine.py", (
+            "import jax\n"
+            "def check_batch_submit(tuples, depth):\n"
+            "    return jax.jit(lambda x: x)(tuples)\n"
+        ))
+        assert rc == 1 and "fresh jax.jit" in out
+
+    def test_suppression_silences_with_reason(self, tmp_path):
+        rc, out = run_lint_on(tmp_path, "mod.py", (
+            "import threading, time\n"
+            "class S:\n"
+            "    def ok(self):\n"
+            "        with self._lock:\n"
+            "            # ketolint: allow[lock-blocking-call] reason=test fixture\n"
+            "            time.sleep(1)\n"
+        ))
+        assert rc == 0, out
+
+    def test_reasonless_suppression_is_error(self, tmp_path):
+        rc, out = run_lint_on(tmp_path, "mod.py", (
+            "import time\n"
+            "class S:\n"
+            "    def f(self):\n"
+            "        with self._lock:\n"
+            "            # ketolint: allow[lock-blocking-call]\n"
+            "            time.sleep(1)\n"
+        ))
+        assert rc == 1 and "no reason=" in out
+
+    def test_unused_suppression_is_error(self, tmp_path):
+        rc, out = run_lint_on(tmp_path, "mod.py", (
+            "# ketolint: allow[clock-monotonic] reason=nothing here\n"
+            "x = 1\n"
+        ))
+        assert rc == 1 and "suppresses nothing" in out
+
+    def test_nested_with_keys_stay_scoped(self, tmp_path):
+        """PINS the sibling-leak fix: a later nested `with cond:` must
+        not exempt an EARLIER foreign wait under the outer lock."""
+        rc, out = run_lint_on(tmp_path, "mod.py", (
+            "class S:\n"
+            "    def bad(self, other, st):\n"
+            "        with other.data_lock:\n"
+            "            st.io_cond.wait()\n"
+            "            with st.io_cond:\n"
+            "                pass\n"
+        ))
+        assert rc == 1 and "io_cond.wait" in out
+
+    def test_blocking_call_in_with_header_trips(self, tmp_path):
+        rc, out = run_lint_on(tmp_path, "mod.py", (
+            "class S:\n"
+            "    def bad(self):\n"
+            "        with self._lock:\n"
+            "            with self.manager.tx():\n"
+            "                pass\n"
+        ))
+        assert rc == 1 and "store/manager call" in out
+
+    def test_same_named_methods_in_two_classes_do_not_collide(self, tmp_path):
+        """PINS the per-class fixpoint fix: class A's _refresh is called
+        with NO lock held, so its store call must not be flagged just
+        because class B's same-named method is lock-only-called."""
+        rc, out = run_lint_on(tmp_path, "mod.py", (
+            "class A:\n"
+            "    def _refresh(self):\n"
+            "        return self.value\n"
+            "    def entry(self):\n"
+            "        self._refresh()\n"
+            "class B:\n"
+            "    def _refresh(self):\n"
+            "        return self.manager.version()\n"
+            "    def entry(self):\n"
+            "        with self._lock:\n"
+            "            self._refresh()\n"
+        ))
+        # exactly ONE finding: B's store call under B's lock; A is clean
+        assert rc == 1, out
+        assert out.count("store/manager call") == 1, out
+
+    def test_module_level_with_lock_is_scanned(self, tmp_path):
+        rc, out = run_lint_on(tmp_path, "mod.py", (
+            "import threading, time\n"
+            "_mu = threading.Lock()\n"
+            "def bad():\n"
+            "    with _mu:\n"
+            "        time.sleep(1)\n"
+        ))
+        assert rc == 1 and "time.sleep" in out
+
+    def test_repo_is_clean(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "keto_tpu.analysis.lint"],
+            capture_output=True, text=True, cwd=str(REPO),
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+class TestConfigKeyPass:
+    def test_schema_tree_resolves_refs(self):
+        import json
+
+        schema = json.loads(
+            (REPO / "keto_tpu" / "config_schema.json").read_text()
+        )
+        all_paths, leaves = schema_key_tree(schema)
+        assert "serve.read.grpc.aio" in leaves
+        assert "serve.check.breaker.threshold" in leaves
+        # metrics listener is host/port ONLY (grpc/cors/tls on the
+        # metrics port do nothing and must not be accepted-but-ignored)
+        assert "serve.metrics.grpc.aio" not in all_paths
+        assert "serve.metrics.cors.enabled" not in all_paths
+        assert "serve.metrics.host" in leaves
+
+    def test_fstring_reads_become_patterns(self):
+        import ast
+
+        tree = ast.parse(
+            "def f(kind):\n"
+            "    return config.get(f\"serve.{kind}.tls\")\n"
+        )
+        keys = [k for k, _ in config_key_reads(tree)]
+        assert keys == ["serve.*.tls"]
+        assert key_matches("serve.*.tls", "serve.read.tls")
+        assert not key_matches("serve.*.tls", "serve.read.grpc")
+        assert not key_matches("serve.*.tls", "serve.read.tls.cert_path")
+
+    def test_unknown_key_fails(self, tmp_path):
+        # cross-file pass: exercised through lint_paths with a schema
+        import ast
+
+        files = [{
+            "path": tmp_path / "m.py",
+            "tree": ast.parse("x = config.get('serve.bogus.key')"),
+            "is_config": False,
+        }]
+        findings = lint.pass_config_keys(
+            files, {"properties": {"serve": {"type": "object"}}}
+        )
+        assert any("serve.bogus.key" in f.msg for f in findings)
+
+    def test_dead_leaf_fails_and_ancestor_read_covers(self):
+        import ast
+
+        schema = {
+            "properties": {
+                "a": {"properties": {"b": {"type": "string"},
+                                      "c": {"type": "string"}}},
+            }
+        }
+        read_b = [{
+            "path": Path("m.py"),
+            "tree": ast.parse("x = config.get('a.b')"),
+            "is_config": False,
+        }]
+        findings = lint.pass_config_keys(read_b, schema)
+        assert any("'a.c'" in f.msg for f in findings)
+        # a read of the parent object covers the whole subtree
+        read_parent = [{
+            "path": Path("m.py"),
+            "tree": ast.parse("x = config.get('a')"),
+            "is_config": False,
+        }]
+        findings = lint.pass_config_keys(read_parent, schema)
+        assert not findings, [f.msg for f in findings]
+
+
+# -- lockwatch -----------------------------------------------------------------
+
+
+class TestLockwatch:
+    def test_seeded_ab_ba_inversion_is_caught(self):
+        """The acceptance-bar case: a real ordering cycle across two
+        threads fails loudly, with creation-site stacks in the output."""
+        w = lockwatch.LockWatch()
+        A = w.Lock(name="lock-A")
+        B = w.Lock(name="lock-B")
+
+        def t1():
+            with A:
+                with B:
+                    pass
+
+        def t2():
+            with B:
+                with A:
+                    pass
+
+        th1 = threading.Thread(target=t1)
+        th1.start()
+        th1.join()
+        th2 = threading.Thread(target=t2)
+        th2.start()
+        th2.join()
+        kinds = [v.kind for v in w.violations]
+        assert "order-cycle" in kinds, w.report()
+        report = w.report()
+        assert "lock-A" in report and "lock-B" in report
+        # creation-site stacks: both locks' construction lines appear
+        assert "test_analysis.py" in report
+        assert "created at" in report
+
+    def test_sleep_under_lock_is_caught(self):
+        w = lockwatch.LockWatch()
+        L = w.Lock(name="held")
+        with L:
+            # exercise the watcher API directly (global install patches
+            # time.sleep to route here)
+            w.note_blocking("time.sleep(0.01)")
+        assert any(
+            v.kind == "blocking-under-lock" for v in w.violations
+        ), w.report()
+        assert "held" in w.report()
+
+    def test_condition_wait_under_other_lock_is_caught(self):
+        w = lockwatch.LockWatch()
+        L = w.Lock(name="outer")
+        C = w.Condition(name="inner-cond")
+
+        def waiter():
+            with L:
+                with C:
+                    C.wait(timeout=0.01)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        t.join()
+        assert any(
+            v.kind == "blocking-under-lock" for v in w.violations
+        ), w.report()
+
+    def test_own_condition_wait_is_clean(self):
+        w = lockwatch.LockWatch()
+        C = w.Condition(name="own")
+        with C:
+            C.wait(timeout=0.01)
+        assert not w.violations, w.report()
+
+    def test_reentrant_rlock_is_clean(self):
+        w = lockwatch.LockWatch()
+        R = w.RLock(name="re")
+        with R:
+            with R:
+                pass
+        assert not w.violations, w.report()
+
+    def test_zero_timeout_wait_is_not_blocking(self):
+        w = lockwatch.LockWatch()
+        L = w.Lock(name="outer")
+        C = w.Condition(name="poll")
+        with L:
+            with C:
+                C.wait(timeout=0)
+        assert not w.violations, w.report()
+
+    def test_allow_blocking_requires_reason_and_scopes(self):
+        w = lockwatch.LockWatch()
+        with pytest.raises(ValueError):
+            w.allow_blocking("")
+        L = w.Lock(name="held")
+        with L:
+            with w.allow_blocking("test: intentional"):
+                w.note_blocking("time.sleep(1)")
+        assert not w.violations, w.report()
+
+    def test_plugin_fails_loudly_in_subprocess(self, tmp_path):
+        """KETO_LOCKWATCH=1 + a test that sleeps under a lock => the
+        pytest run fails with the lockwatch report (the CI leg's
+        failure mode, proven end-to-end)."""
+        test = tmp_path / "test_seeded_violation.py"
+        test.write_text(
+            "import threading, time\n"
+            "def test_sleeps_under_lock():\n"
+            "    L = threading.Lock()\n"
+            "    with L:\n"
+            "        time.sleep(0.01)\n"
+        )
+        conftest = tmp_path / "conftest.py"
+        conftest.write_text(
+            "from keto_tpu.analysis import lockwatch\n"
+            "def pytest_configure(config):\n"
+            "    lockwatch.pytest_session_start()\n"
+            "def pytest_runtest_teardown(item):\n"
+            "    lockwatch.check_test(item.nodeid)\n"
+            "def pytest_unconfigure(config):\n"
+            "    lockwatch.uninstall()\n"
+        )
+        # the tracked-creation filter keys on repo paths: point it at
+        # the tmp dir for the child run
+        import os
+
+        env = dict(os.environ)
+        env["KETO_LOCKWATCH"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["KETO_LOCKWATCH_TRACK"] = str(tmp_path)
+        env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", str(test), "-q",
+             "-p", "no:cacheprovider"],
+            capture_output=True, text=True, cwd=str(tmp_path), env=env,
+        )
+        out = proc.stdout + proc.stderr
+        assert proc.returncode != 0, out
+        assert "blocking-under-lock" in out
+        assert "created at" in out
+
+    def test_fixture_finalizer_violation_fails_last_test(self, tmp_path):
+        """Regression: violations produced in a fixture FINALIZER of the
+        last test used to be dropped (the plain teardown hook ran before
+        fixture finalization, and nothing re-checked before uninstall).
+        The wrapper-style teardown hook + sessionfinish backstop — the
+        same shape tests/conftest.py ships — must fail the run."""
+        test = tmp_path / "test_finalizer_violation.py"
+        test.write_text(
+            "import threading, time\n"
+            "import pytest\n"
+            "@pytest.fixture\n"
+            "def bad_fin():\n"
+            "    yield\n"
+            "    L = threading.Lock()\n"
+            "    with L:\n"
+            "        time.sleep(0.01)\n"
+            "def test_last(bad_fin):\n"
+            "    assert True\n"
+        )
+        conftest = tmp_path / "conftest.py"
+        conftest.write_text(
+            "import pytest\n"
+            "from keto_tpu.analysis import lockwatch\n"
+            "def pytest_configure(config):\n"
+            "    lockwatch.pytest_session_start()\n"
+            "@pytest.hookimpl(wrapper=True)\n"
+            "def pytest_runtest_teardown(item, nextitem):\n"
+            "    yield\n"
+            "    lockwatch.check_test(item.nodeid)\n"
+            "def pytest_sessionfinish(session, exitstatus):\n"
+            "    lockwatch.check_test('session teardown')\n"
+            "def pytest_unconfigure(config):\n"
+            "    lockwatch.uninstall()\n"
+        )
+        import os
+
+        env = dict(os.environ)
+        env["KETO_LOCKWATCH"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["KETO_LOCKWATCH_TRACK"] = str(tmp_path)
+        env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", str(test), "-q",
+             "-p", "no:cacheprovider"],
+            capture_output=True, text=True, cwd=str(tmp_path), env=env,
+        )
+        out = proc.stdout + proc.stderr
+        assert proc.returncode != 0, out
+        assert "blocking-under-lock" in out
+        # blamed on the offending test, not silently dropped at exit
+        assert "test_last" in out
+
+    def test_daemon_start_stop_cycle_is_clean(self):
+        """The clean-run bar: a real daemon (memory store, tri-plane
+        serve, watch hub, cache, batcher) starts, serves a check, and
+        stops without ONE lock-order or blocking-under-lock violation.
+        Runs inside the session watcher when KETO_LOCKWATCH=1, and
+        installs a scoped watcher otherwise — the assertion holds in
+        both modes."""
+        was_installed = lockwatch.current() is not None
+        w = lockwatch.current() or lockwatch.install()
+        before = len(w.violations)
+        try:
+            from keto_tpu.api.daemon import Daemon
+            from keto_tpu.config import Config
+            from keto_tpu.ketoapi import RelationTuple
+            from keto_tpu.namespace import Namespace
+            from keto_tpu.registry import Registry
+
+            cfg = Config({
+                "dsn": "memory",
+                "serve": {
+                    "read": {"host": "127.0.0.1", "port": 0},
+                    "write": {"host": "127.0.0.1", "port": 0},
+                    "metrics": {"host": "127.0.0.1", "port": 0},
+                },
+            })
+            cfg.set_namespaces([Namespace(name="docs")])
+            reg = Registry(cfg)
+            reg.relation_tuple_manager().write_relation_tuples(
+                [RelationTuple.from_string("docs:readme#viewer@alice")]
+            )
+            d = Daemon(reg, host="127.0.0.1")
+            d.start()
+            try:
+                res = d.batcher.check(
+                    RelationTuple.from_string("docs:readme#viewer@alice")
+                )
+                assert res is not None
+                sub = reg.watch_hub().subscribe(reg.nid)
+                reg.relation_tuple_manager().write_relation_tuples(
+                    [RelationTuple.from_string("docs:readme#viewer@bob")]
+                )
+                ev = sub.get(timeout=5)
+                assert ev is not None and not ev.is_reset
+                sub.close()
+            finally:
+                d.stop()
+        finally:
+            if not was_installed:
+                lockwatch.uninstall()
+        fresh = w.violations[before:]
+        assert not fresh, "\n\n".join(v.render() for v in fresh)
+
+
+# -- pinning regressions for the findings this tier fixed ----------------------
+
+
+class TestPinnedFixes:
+    def test_hub_state_creation_reads_store_outside_states_lock(self):
+        """PINS the fix for ketolint's hub.py finding: _state() must not
+        query the store while holding _states_lock (the states lock is a
+        tiny directory lock; a slow store read inside it would stall
+        every subscriber and ordered it against the store lock)."""
+        from keto_tpu.storage.memory import MemoryManager
+        from keto_tpu.watch.hub import WatchHub
+
+        hub = WatchHub(MemoryManager(), poll_interval=0.05)
+
+        calls = []
+        real_version = hub.manager.version
+
+        def instrumented(nid="default"):
+            calls.append(hub._states_lock.locked())
+            return real_version(nid=nid)
+
+        hub.manager.version = instrumented
+        hub._state("default")
+        assert calls, "expected _state to read the store version"
+        assert not any(calls), (
+            "store version read while holding _states_lock"
+        )
+
+    def test_closed_batcher_sheds_typed_on_both_planes(self):
+        """PINS the typed-error fix: a check racing shutdown gets the
+        typed BatcherClosedError — an OverloadedError (429 drain shed)
+        AND a RuntimeError, so embedders' documented `except
+        RuntimeError` handlers around CheckBatcher.check keep working
+        (the CheckBatchFailedError dual-inheritance contract)."""
+        from keto_tpu.api.batcher import CheckBatcher
+        from keto_tpu.errors import BatcherClosedError, OverloadedError
+
+        assert issubclass(BatcherClosedError, OverloadedError)
+        assert issubclass(BatcherClosedError, RuntimeError)
+
+        class _Engine:
+            def check_batch(self, tuples, depth):
+                return [None] * len(tuples)
+
+        b = CheckBatcher(_Engine(), window_s=0.001)
+        b.close()
+        with pytest.raises(RuntimeError):
+            b.check_versioned(object())
+        with pytest.raises(OverloadedError):
+            b.check_versioned(object())
+        with pytest.raises(OverloadedError):
+            b.admit()
+
+    def test_aio_closed_batcher_is_typed(self):
+        import asyncio
+
+        from keto_tpu.api.aio_server import AioCheckBatcher
+        from keto_tpu.errors import BatcherClosedError
+
+        async def run():
+            b = AioCheckBatcher.__new__(AioCheckBatcher)
+            b._closed = True
+            with pytest.raises(BatcherClosedError):
+                await b.check_versioned(object())
+
+        asyncio.run(run())
+
+    def test_lockwatch_watermark_advances_past_a_raise(self):
+        """PINS the cascade fix: check_test advances the high-water mark
+        BEFORE raising, so one violation fails exactly one test and the
+        next check is clean instead of re-blaming the same report."""
+        was_installed = lockwatch.current() is not None
+        w = lockwatch.current() or lockwatch.install()
+        try:
+            with w._mu:
+                base = len(w.violations)
+                w.violations.append(
+                    lockwatch.Violation("blocking-under-lock", "seeded", "x")
+                )
+            with pytest.raises(lockwatch.LockwatchError):
+                lockwatch.check_test("test_seeded")
+            # same watcher, no new violations: must NOT raise again
+            assert lockwatch.check_test("test_next") == base + 1
+        finally:
+            if not was_installed:
+                lockwatch.uninstall()
+
+    def test_log_format_text_undoes_json_mode(self):
+        import logging
+
+        from keto_tpu.config import Config
+        from keto_tpu.observability import configure_logging
+
+        logger = logging.getLogger("keto_tpu")
+        old_level = logger.level
+        try:
+            configure_logging(Config({"log": {"format": "json"}}))
+            assert logger.propagate is False
+            configure_logging(Config({"log": {"format": "text"}}))
+            assert logger.propagate is True
+            assert not [
+                h for h in logger.handlers
+                if getattr(h, "_keto_json", False)
+            ]
+        finally:
+            logger.setLevel(old_level)
+
+    def test_columnar_page_token_rejects_corrupt_base64(self):
+        from keto_tpu.errors import InvalidPageTokenError
+        from keto_tpu.storage.columnar import _decode_token
+
+        with pytest.raises(InvalidPageTokenError):
+            _decode_token("ck1.!!!notbase64!!!")
+
+    def test_log_level_and_format_are_applied(self):
+        import logging
+
+        from keto_tpu.config import Config
+        from keto_tpu.observability import configure_logging
+
+        logger = logging.getLogger("keto_tpu")
+        old_level = logger.level
+        old_propagate = logger.propagate
+        try:
+            configure_logging(
+                Config({"log": {"level": "debug", "format": "json"}})
+            )
+            assert logger.level == logging.DEBUG
+            handlers = [
+                h for h in logger.handlers
+                if getattr(h, "_keto_json", False)
+            ]
+            assert len(handlers) == 1
+            # idempotent: re-applying never stacks a second handler
+            configure_logging(
+                Config({"log": {"level": "debug", "format": "json"}})
+            )
+            assert len([
+                h for h in logger.handlers
+                if getattr(h, "_keto_json", False)
+            ]) == 1
+            record = logging.LogRecord(
+                "keto_tpu", logging.INFO, __file__, 1, "hello", (), None
+            )
+            record.trace_id = "abc123"
+            line = handlers[0].format(record)
+            import json as _json
+
+            parsed = _json.loads(line)
+            assert parsed["msg"] == "hello"
+            assert parsed["trace_id"] == "abc123"
+        finally:
+            logger.setLevel(old_level)
+            logger.propagate = old_propagate
+            for h in list(logger.handlers):
+                if getattr(h, "_keto_json", False):
+                    logger.removeHandler(h)
+
+    def test_version_marker_warns_on_malformed(self, caplog):
+        from keto_tpu.config import Config
+
+        with caplog.at_level("WARNING", logger="keto_tpu.config"):
+            Config({"version": "0.13"})  # missing the 'v' prefix
+        assert any(
+            "version marker" in r.message for r in caplog.records
+        )
+        caplog.clear()
+        with caplog.at_level("WARNING", logger="keto_tpu.config"):
+            Config({"version": "v0.13.0"})
+        assert not any(
+            "version marker" in r.message for r in caplog.records
+        )
+
+
+class TestSharedScanner:
+    def test_metrics_docs_checker_uses_shared_scanner(self):
+        """tools/check_metrics_docs.py and the config-key pass share
+        keto_tpu.analysis.source_scan — no second ad-hoc regex walker."""
+        src = (REPO / "tools" / "check_metrics_docs.py").read_text()
+        assert "source_scan" in src
+        proc = subprocess.run(
+            [sys.executable, "tools/check_metrics_docs.py"],
+            capture_output=True, text=True, cwd=str(REPO),
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -13,8 +13,6 @@ import pytest
 from keto_tpu.config import Config
 from keto_tpu.engine.delta import (
     DELTA_COMPACT_THRESHOLD,
-    DeltaOverflow,
-    build_delta_tables,
 )
 from keto_tpu.engine.reference import ReferenceEngine
 from keto_tpu.engine.tpu_engine import TPUCheckEngine

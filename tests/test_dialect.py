@@ -15,7 +15,6 @@ import re
 import pytest
 
 from keto_tpu.storage.dialect import (
-    DIALECTS,
     CockroachDialect,
     MySQLDialect,
     PostgresDialect,

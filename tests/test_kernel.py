@@ -6,7 +6,6 @@ on TPU."""
 import os
 import random
 
-import numpy as np
 import pytest
 
 from keto_tpu.config import Config
@@ -383,7 +382,7 @@ class TestReviewRegressions:
             ],
         )
         levels = 60
-        tuples = [f"d:f0#parent@(d:f1#...)"]
+        tuples = ["d:f0#parent@(d:f1#...)"]
         for i in range(1, levels):
             tuples.append(f"d:f{i}#parent@(d:f{i + 1}#...)")
         tuples.append(f"d:f{levels}#owner@user")

@@ -1,9 +1,7 @@
 """OPL lexer/parser tests, mirroring internal/schema/{lexer,parser}_test.go
 cases (the full_example fixture, error cases, typechecks)."""
 
-import textwrap
 
-import pytest
 
 from keto_tpu.namespace.ast import (
     ComputedSubjectSet,

@@ -13,7 +13,7 @@ import grpc
 import pytest
 
 from keto_tpu import faults
-from keto_tpu.api import ReadClient, RetryPolicy, open_channel
+from keto_tpu.api import ReadClient, RetryPolicy
 from keto_tpu.api.batcher import CheckBatcher
 from keto_tpu.api.daemon import Daemon
 from keto_tpu.config import Config, ConfigError

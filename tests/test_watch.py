@@ -29,7 +29,7 @@ from keto_tpu.engine.snaptoken import (
     encode_snaptoken,
     parse_snaptoken,
 )
-from keto_tpu.ketoapi import RelationQuery, RelationTuple
+from keto_tpu.ketoapi import RelationTuple
 from keto_tpu.registry import Registry
 from keto_tpu.storage import MemoryManager, SQLitePersister
 from keto_tpu.watch import WatchHub

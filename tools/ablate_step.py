@@ -21,7 +21,6 @@ Prints one JSON line per variant.
 from __future__ import annotations
 
 import argparse
-import functools
 import json
 import os
 import sys
@@ -54,7 +53,6 @@ def main() -> int:
         kernel_static_config,
         probe_phase,
         program_lookup,
-        seed_state,
         snapshot_tables,
     )
     from keto_tpu.engine.snapshot import build_snapshot

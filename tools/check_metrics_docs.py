@@ -8,35 +8,38 @@ the table is the operator contract for dashboards and alerts.
 Exit 1 lists the missing names; documented-but-unregistered names are
 reported too (a stale table misleads the same dashboards).
 
-No imports of keto_tpu: the check is pure source inspection, so it runs
-before deps are installed and cannot be skewed by runtime registration.
+Pure source inspection via the analysis plane's shared scanner
+(keto_tpu/analysis/source_scan.py — the same walker under ketolint's
+config-key pass), so it runs before deps are installed and cannot be
+skewed by runtime registration.
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))  # `python tools/check_metrics_docs.py`
+
+from keto_tpu.analysis.source_scan import scan_pattern  # noqa: E402
+
 OBSERVABILITY = REPO / "keto_tpu" / "observability.py"
 DOCS = REPO / "docs" / "architecture.md"
 
 # prom.Counter( \n "metric_name"  — the registration shape used in
 # observability.Metrics (name is always the first string literal)
-_REGISTRATION = re.compile(
-    r"prom\.(?:Counter|Gauge|Histogram)\(\s*\"(keto_tpu_[a-z0-9_]+)\"",
-)
+_REGISTRATION = r"prom\.(?:Counter|Gauge|Histogram)\(\s*\"(keto_tpu_[a-z0-9_]+)\""
 # docs table rows cite metrics as `keto_tpu_...` code spans
-_DOCUMENTED = re.compile(r"`(keto_tpu_[a-z0-9_]+)`")
+_DOCUMENTED = r"`(keto_tpu_[a-z0-9_]+)`"
 
 
 def registered_metrics() -> set[str]:
-    return set(_REGISTRATION.findall(OBSERVABILITY.read_text()))
+    return scan_pattern(_REGISTRATION, [OBSERVABILITY])
 
 
 def documented_metrics() -> set[str]:
-    return set(_DOCUMENTED.findall(DOCS.read_text()))
+    return scan_pattern(_DOCUMENTED, [DOCS])
 
 
 def main() -> int:

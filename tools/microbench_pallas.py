@@ -12,7 +12,6 @@ irregular primitives against their XLA counterparts:
 
 from __future__ import annotations
 
-import functools
 import json
 import os
 import sys

@@ -70,7 +70,6 @@ def main() -> int:
 
     import bench
     from keto_tpu.config import Config
-    from keto_tpu.engine import kernel as kmod
     from keto_tpu.engine.snapshot import build_snapshot
     from keto_tpu.engine.kernel import (
         check_kernel,
@@ -81,7 +80,6 @@ def main() -> int:
         probe_phase,
         seed_state,
         snapshot_tables,
-        Expansion,
     )
 
     namespaces, tuples, queries = bench.build_dataset()

@@ -115,8 +115,8 @@ def main() -> int:
     tuples.append(f"deep:f{depth}#owner@alice")
     e = engine_for(namespaces, tuples, max_depth=2 * depth)
     cases = [
-        (f"deep:f0#viewer@alice", True),
-        (f"deep:f0#viewer@bob", False),
+        ("deep:f0#viewer@alice", True),
+        ("deep:f0#viewer@bob", False),
         (f"deep:f{depth}#owner@alice", True),
     ]
     got = e.check_batch(
