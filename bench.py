@@ -238,6 +238,8 @@ def bench_kernel(namespaces, tuples, queries) -> dict:
     from keto_tpu.engine.tpu_engine import TPUCheckEngine
     from keto_tpu.storage import MemoryManager
 
+    from keto_tpu.observability import FlightRecorder, summarize_launches
+
     cfg = Config({"limit": {"max_read_depth": 5}})
     cfg.set_namespaces(namespaces)
     manager = MemoryManager()
@@ -245,7 +247,10 @@ def bench_kernel(namespaces, tuples, queries) -> dict:
     # frontier cap 2×batch: smallest cap that keeps this workload fully
     # on-device (overflow would flag host replay); per-step cost scales
     # with the cap, so oversizing it halves throughput
-    engine = TPUCheckEngine(manager, cfg, frontier_cap=2 * BATCH)
+    flightrec = FlightRecorder(capacity=4 * ROUNDS)
+    engine = TPUCheckEngine(
+        manager, cfg, frontier_cap=2 * BATCH, flightrec=flightrec
+    )
 
     warm0 = time.perf_counter()
     engine.check_batch(queries)
@@ -296,6 +301,10 @@ def bench_kernel(namespaces, tuples, queries) -> dict:
         "single_check_p50_ms": round(
             float(np.percentile(np.array(single) * 1e3, 50)), 2
         ),
+        # per-launch device introspection aggregates (flight recorder):
+        # mean/p95 iterations, gather bytes/check, padding waste — the
+        # droop-hypothesis evidence captured with every BENCH record
+        "launch_telemetry": summarize_launches(flightrec.entries()),
     }
 
 
@@ -646,11 +655,16 @@ def bench_config4_deep() -> dict:
         c = rng.randrange(n_chains)
         sub = owners[c] if i % 2 == 0 else f"u{rng.randrange(n_users)}"
         queries.append(RelationTuple.from_string(f"deep:c{c}f0#viewer@{sub}"))
+    from keto_tpu.observability import FlightRecorder, summarize_launches
+
     cfg = Config({"limit": {"max_read_depth": depth + 4}})
     cfg.set_namespaces(ns)
     m = MemoryManager()
     m.write_relation_tuples(tuples)
-    engine = TPUCheckEngine(m, cfg, frontier_cap=2 * BATCH)
+    flightrec = FlightRecorder(capacity=64)
+    engine = TPUCheckEngine(
+        m, cfg, frontier_cap=2 * BATCH, flightrec=flightrec
+    )
     engine.check_batch(queries)
     rounds = 5
     t0 = time.perf_counter()
@@ -661,6 +675,131 @@ def bench_config4_deep() -> dict:
     return {
         "deep20_qps": round(rounds * BATCH / wall, 1),
         "deep20_host_checks": engine.stats["host_checks"],
+        # iterations here should sit near the chain depth — the flat
+        # leg's launch_telemetry is the contrast that proves the
+        # counters are non-degenerate
+        "deep20_launch_telemetry": summarize_launches(flightrec.entries()),
+    }
+
+
+def bench_flightrec_ab() -> dict:
+    """Counter-overhead A/B (acceptance leg, CPU-runnable): batched check
+    QPS with the flight recorder ON vs OFF on the SAME engine and
+    compiled kernel (the kernel's stats accumulation is always compiled
+    in — the A/B isolates the host-side recording layer), recorder
+    toggled every call so drift hits both arms. Also proves the counters
+    are
+    non-degenerate: iterations_used differs between the flat flagship
+    workload and the deep-20 chain workload, and gather bytes move with
+    table size/fanout (probe depths and edge rows both track the graph).
+    """
+    from keto_tpu.config import Config
+    from keto_tpu.engine.tpu_engine import TPUCheckEngine
+    from keto_tpu.ketoapi import RelationTuple
+    from keto_tpu.observability import FlightRecorder, summarize_launches
+    from keto_tpu.storage import MemoryManager
+
+    namespaces, tuples, queries = build_dataset()
+    cfg = Config({"limit": {"max_read_depth": 5}})
+    cfg.set_namespaces(namespaces)
+    manager = MemoryManager()
+    manager.write_relation_tuples(tuples)
+    fr_on = FlightRecorder(capacity=1024)
+    engine = TPUCheckEngine(
+        manager, cfg, frontier_cap=2 * BATCH, flightrec=fr_on
+    )
+    for _ in range(6):  # compile + ramp (shared by both arms)
+        engine.check_batch(queries)
+
+    # per-call alternation: the bench box is shared and coarse burst
+    # rates swing 2x, so the arms must interleave at the finest grain —
+    # one synchronous batch per sample, recorder toggled every call, and
+    # the verdict read from MEDIANS over many samples (adjacent samples
+    # see the same ambient load; the median discards the noise spikes).
+    # Sync calls are also the honest sensitivity: pipelining would hide
+    # recording cost behind the next batch's device time.
+    fr_off = FlightRecorder(enabled=False)
+    on_t: list = []
+    off_t: list = []
+    for i in range(120):
+        engine.flightrec = fr_off if i % 2 == 0 else fr_on
+        t0 = time.perf_counter()
+        engine.check_batch(queries)
+        dt = time.perf_counter() - t0
+        (off_t if i % 2 == 0 else on_t).append(dt)
+    med_on = sorted(on_t)[len(on_t) // 2]
+    med_off = sorted(off_t)[len(off_t) // 2]
+    qps_on = BATCH / med_on
+    qps_off = BATCH / med_off
+    on_vs_off = med_off / med_on
+    n_pairs = len(on_t)
+    flat = summarize_launches(fr_on.entries())
+    small_probes = {
+        "dh_probes": engine._ensure_state().snapshot.dh_probes,
+        "rh_probes": engine._ensure_state().snapshot.rh_probes,
+    }
+
+    # deep-20 contrast: iterations must track the chain depth
+    deep = bench_config4_deep().get("deep20_launch_telemetry", {})
+
+    # table-size contrast: the same drive topology at ~1e6 tuples
+    # (vectorized columnar build — the scale tier's ingest path; a
+    # MemoryManager write at this size is minutes of host dict churn).
+    # Probe-chain growth is bucket-quantized (one bucket row = one 256 B
+    # gather regardless of chain occupancy), so small growth is free
+    # until a chain crosses a bucket boundary: measured here, the
+    # direct-probe chain goes ~6 probes (9.7k tuples) -> ~10 (1e6),
+    # crossing the 8-slot bucket — the probe phase physically gathers
+    # one extra bucket row per task-step and the per-check gather-bytes
+    # estimate must move with it
+    from keto_tpu.storage.columnar import ColumnarStore
+    from tools.scale_bench import synth_columns
+
+    cols_l, f_names, owner_names, files_per = synth_columns(
+        1_000_000, N_USERS, seed=7
+    )
+    n_folders = len(f_names)
+    n_files = n_folders * files_per
+    # synth_columns concatenates owner rows first, parent rows after;
+    # the parent rows' objects are the file names
+    file_names = cols_l.obj[n_folders:]
+    store_l = ColumnarStore()
+    store_l.bulk_load(cols_l)
+    cfg_l = Config({"limit": {"max_read_depth": 5}})
+    cfg_l.set_namespaces(namespaces)  # identical namespace config
+    queries_l = [
+        RelationTuple.from_string(
+            f"videos:{file_names[i]}#view@"
+            f"{owner_names[i // files_per] if i % 2 == 0 else 'nobody'}"
+        )
+        for i in np.random.default_rng(11).integers(0, n_files, BATCH)
+    ]
+    fr_l = FlightRecorder(capacity=64)
+    engine_l = TPUCheckEngine(
+        store_l, cfg_l, frontier_cap=2 * BATCH, flightrec=fr_l
+    )
+    engine_l.check_batch(queries_l)
+    engine_l.check_batch(queries_l)
+    large = summarize_launches(fr_l.entries())
+    large_probes = {
+        "dh_probes": engine_l._ensure_state().snapshot.dh_probes,
+        "rh_probes": engine_l._ensure_state().snapshot.rh_probes,
+    }
+
+    return {
+        "metric": "flightrec_ab",
+        "ab_batch": BATCH,
+        "flightrec_on_qps": round(qps_on, 1),
+        "flightrec_off_qps": round(qps_off, 1),
+        "on_vs_off": round(on_vs_off, 4),
+        "ab_samples_per_arm": n_pairs,
+        "small_tuples": len(tuples),
+        "large_tuples": int(n_folders + n_files),
+        "small_probe_depths": small_probes,
+        "large_probe_depths": large_probes,
+        "flat_launch_telemetry": flat,
+        "deep20_launch_telemetry": deep,
+        "large_table_launch_telemetry": large,
     }
 
 
@@ -956,6 +1095,13 @@ def bench_served(namespaces, tuples, queries) -> dict:
         )
         # per-stage serving breakdown accumulated across all phases
         stage_ms = _stage_summary(daemon.registry.metrics())
+        # served-path launch telemetry: the daemon's process-wide flight
+        # recorder saw every device batch the load phases produced
+        from keto_tpu.observability import summarize_launches
+
+        served_launches = summarize_launches(
+            daemon.registry.flight_recorder().entries()
+        )
     finally:
         daemon.stop()
 
@@ -981,6 +1127,8 @@ def bench_served(namespaces, tuples, queries) -> dict:
     out = {"host_cores": len(_os.sched_getaffinity(0))}
     if stage_ms:
         out["served_stage_ms"] = stage_ms
+    if served_launches:
+        out["served_launch_telemetry"] = served_launches
     # each phase reports independently: a wedge between phases must not
     # discard the completed phase's measurement
     if "error" in low:
@@ -1054,6 +1202,12 @@ def main() -> int:
     )
     ap.add_argument("--probe-attempts", type=int, default=2)
     ap.add_argument("--skip-serve", action="store_true")
+    ap.add_argument(
+        "--ab-flightrec", action="store_true",
+        help="run ONLY the flight-recorder counter-overhead A/B leg "
+             "(recorder on vs off QPS + non-degeneracy contrasts) and "
+             "print its JSON record",
+    )
     args = ap.parse_args()
 
     platform = args.platform
@@ -1103,6 +1257,12 @@ def main() -> int:
 
         if platform == "cpu":
             jax.config.update("jax_platforms", "cpu")
+
+        if args.ab_flightrec:
+            ab = bench_flightrec_ab()
+            ab["device"] = str(jax.devices()[0])
+            print(json.dumps(ab))
+            return 0
 
         namespaces, tuples, queries = build_dataset()
         record["tuples"] = len(tuples)

@@ -80,6 +80,7 @@ class AioCheckBatcher:
         max_queue: int | None = None,
         device_timeout_ms: float | None = None,
         breaker=None,
+        flightrec=None,
     ):
         self._resolve_engine = engine_resolver
         self.max_batch = max_batch
@@ -116,6 +117,8 @@ class AioCheckBatcher:
             float(device_timeout_ms) / 1e3 if device_timeout_ms else None
         )
         self.breaker = breaker
+        # flight recorder (shared process-wide ring; see api/batcher.py)
+        self.flightrec = flightrec
         # observability: queue-wait attribution + gauges, mirroring the
         # threaded batcher (api/batcher.py); own plane label — both
         # batchers can serve at once
@@ -312,6 +315,10 @@ class AioCheckBatcher:
             self.breaker.record_failure()
         if self.metrics is not None:
             self.metrics.check_batch_failed_total.labels(cause).inc()
+        if self.flightrec is not None:
+            # auto-dump on batch failure / watchdog abandon (same
+            # contract as the threaded batcher)
+            self.flightrec.dump(cause)
 
     @staticmethod
     def _fail_slots(slots, err) -> None:
@@ -822,6 +829,7 @@ class AioReadServer:
             # ONE process-wide breaker shared with the threaded plane:
             # device health is judged from all traffic
             breaker=self.registry.circuit_breaker(),
+            flightrec=self.registry.flight_recorder(),
         )
         self.batcher.start()
         self._services = _AioReadServices(services, self.batcher)

@@ -92,14 +92,23 @@ def classify_engine_error(e: Exception, metrics, cause: str) -> KetoError:
     bare ValueError was a 500 with an unhelpful body). Shared by BOTH
     batching planes; counts keto_tpu_check_batch_failed_total{cause}.
     `cause` is one of the fixed label values (engine | host — device
-    failures are counted by the recovery paths directly)."""
+    failures are counted by the recovery paths directly).
+
+    The engine stamps `launch_id` onto submit/resolve exceptions
+    (tpu_engine.check_batch_submit); it is carried into the typed error's
+    message and attribute so an operator can join the failure to its
+    flight-recorder entry (`GET /admin/flightrec`)."""
+    launch_id = getattr(e, "launch_id", None)
     if isinstance(e, KetoError):
         cause = "keto"
         err = e
     else:
+        suffix = f" (launch={launch_id})" if launch_id is not None else ""
         err = CheckBatchFailedError(
-            f"check batch failed: {type(e).__name__}: {e}"
+            f"check batch failed: {type(e).__name__}: {e}{suffix}"
         )
+    if launch_id is not None and getattr(err, "launch_id", None) is None:
+        err.launch_id = launch_id
     if metrics is not None:
         metrics.check_batch_failed_total.labels(cause).inc()
     return err
@@ -185,6 +194,7 @@ class CheckBatcher:
         max_queue: int | None = None,
         device_timeout_ms: float | None = None,
         breaker=None,
+        flightrec=None,
     ):
         # per-request tenancy: batches are grouped by nid and dispatched
         # to that tenant's engine (ref: ketoctx Contextualizer,
@@ -244,6 +254,10 @@ class CheckBatcher:
             float(device_timeout_ms) / 1e3 if device_timeout_ms else None
         )
         self.breaker = breaker
+        # flight recorder (observability.FlightRecorder | None): device-
+        # path failures auto-dump the ring tail to the log before the
+        # evidence scrolls out
+        self.flightrec = flightrec
         # True while a _launch executes (benign unlocked flag): the
         # collector arms the routing watchdog only when the launcher is
         # occupied, so the healthy fast path creates no timer thread
@@ -448,6 +462,10 @@ class CheckBatcher:
             self.breaker.record_failure()
         if self.metrics is not None:
             self.metrics.check_batch_failed_total.labels(cause).inc()
+        if self.flightrec is not None:
+            # auto-dump on batch failure / watchdog abandon: the recent
+            # launches' records reach the log while still correlated
+            self.flightrec.dump(cause)
 
     def _host_fallback_slots(
         self, engine, slots: list[list[_Pending]], depth: int

@@ -226,6 +226,7 @@ class Daemon:
             max_queue=cfg.get("serve.check.max_queue"),
             device_timeout_ms=cfg.get("serve.check.device_timeout_ms"),
             breaker=registry.circuit_breaker(),
+            flightrec=registry.flight_recorder(),
         )
         self._grpc_read = None
         self._grpc_write = None

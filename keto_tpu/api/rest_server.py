@@ -70,6 +70,10 @@ METRICS_PATH = "/metrics/prometheus"
 # .../stop writes the artifact; see keto_tpu/profiling.py
 PROFILING_ROUTE = "/admin/profiling"
 PROFILING_STOP_ROUTE = "/admin/profiling/stop"
+# engine flight recorder (metrics listener): the live per-launch ring —
+# device introspection counters, launch ids (join key for slow-query
+# lines and typed batch errors), HBM/staleness accounting per built engine
+FLIGHTREC_ROUTE = "/admin/flightrec"
 SPEC_ROUTE = "/.well-known/openapi.json"
 
 # route -> router kind, the ONE ownership table (consumed by the spec
@@ -92,6 +96,7 @@ ROUTE_KINDS = {
     METRICS_PATH: "metrics",
     PROFILING_ROUTE: "metrics",
     PROFILING_STOP_ROUTE: "metrics",
+    FLIGHTREC_ROUTE: "metrics",
 }
 
 
@@ -333,6 +338,8 @@ class _Handler(BaseHTTPRequestHandler):
                     return PROFILING_ROUTE, self._profiling_start
             if method == "POST" and path == PROFILING_STOP_ROUTE:
                 return PROFILING_STOP_ROUTE, self._profiling_stop
+            if method == "GET" and path == FLIGHTREC_ROUTE:
+                return FLIGHTREC_ROUTE, self._flightrec_dump
             return None
 
         if self.kind == "read":
@@ -785,6 +792,37 @@ class _Handler(BaseHTTPRequestHandler):
         {"running": false, "artifact": null} instead of erroring."""
         artifact = self.registry.profiler().stop()
         self._json(200, {"running": False, "artifact": artifact})
+
+    def _flightrec_dump(self) -> None:
+        """GET /admin/flightrec: the live launch ring plus
+        per-built-engine HBM/staleness snapshots. Entries come back in
+        LAUNCH-ID order (newest last): the ring itself holds resolve
+        order, and with two batching planes sharing one engine a later
+        submit can resolve first — id order is the submission order
+        consumers join on. Entry launch_ids join the slow-query WARNING
+        lines, the request log, and typed CheckBatchFailedError
+        messages; entry ages are derivable from `now_mono` - entry
+        `t_mono` (monotonic stamps — wall clocks are banned repo-wide).
+        Reads only already-built state: no engine or device mirror is
+        instantiated from the admin plane."""
+        import time as _time
+
+        fr = self.registry.flight_recorder()
+        hbm = {}
+        for nid, engine in self.registry.built_engines().items():
+            snap = getattr(engine, "hbm_snapshot", None)
+            if snap is not None:
+                hbm[nid] = snap()
+        entries = sorted(
+            fr.entries(), key=lambda e: e.get("launch_id") or 0
+        )
+        self._json(200, {
+            "enabled": fr.enabled,
+            "capacity": fr.capacity,
+            "now_mono": _time.monotonic(),
+            "entries": entries,
+            "hbm": hbm,
+        })
 
     # -- write handlers -------------------------------------------------------
 

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ketoapi import RelationTuple, SubjectSet, Tree, TreeNodeType
+from .kernel import N_LAUNCH_STATS, empty_launch_stats as _empty_stats
 from .snapshot import EMPTY, GraphSnapshot
 
 
@@ -146,6 +147,7 @@ class _ExpandState(NamedTuple):
     eb_count: jnp.ndarray  # [B]
     needs_host: jnp.ndarray  # [B]
     step: jnp.ndarray
+    stats: jnp.ndarray  # [N_LAUNCH_STATS] launch introspection counters
 
 
 @functools.partial(
@@ -165,7 +167,8 @@ def expand_kernel(
     edge_cap: int,
 ):
     """Returns (eb_pobj, eb_prel, eb_skind, eb_sa, eb_sb  [B*E],
-    eb_count [B], root_has_children [B], needs_host [B])."""
+    eb_count [B], root_has_children [B], needs_host [B],
+    stats [N_LAUNCH_STATS])."""
     B = q_obj.shape[0]
     F = frontier_cap
     E = edge_cap
@@ -288,10 +291,22 @@ def expand_kernel(
         # dedupe reports int32 cause codes (shared with the check kernel);
         # the expand state keeps a boolean flag
         needs_host = needs_host | (overflow_q > 0)
+        from .kernel import update_launch_stats
+
+        # launch counters: edges emitted into the buffer this step stand
+        # in for the check kernel's candidate-row count
+        stats = update_launch_stats(
+            st.stats,
+            st.n_tasks,
+            (live & (depth >= 0)).sum(),
+            jnp.int32(0),
+            (in_range & emit[seg]).sum(),
+            n_new,
+        )
         return _ExpandState(
             nt_q, nt_obj, nt_rel, nt_depth, n_new,
             eb_pobj, eb_prel, eb_skind, eb_sa, eb_sb,
-            eb_count, needs_host, st.step + 1,
+            eb_count, needs_host, st.step + 1, stats,
         )
 
     pad = F - B
@@ -313,6 +328,7 @@ def expand_kernel(
         eb_count=jnp.zeros(B, jnp.int32),
         needs_host=init_needs_host,
         step=jnp.int32(0),
+        stats=_empty_stats(),
     )
 
     def cond_fn(st: _ExpandState):
@@ -326,7 +342,7 @@ def expand_kernel(
     final = bounded_loop(cond_fn, step_fn, init, max_steps)
     return (
         final.eb_pobj, final.eb_prel, final.eb_skind, final.eb_sa, final.eb_sb,
-        final.eb_count, root_has_children, final.needs_host,
+        final.eb_count, root_has_children, final.needs_host, final.stats,
     )
 
 
@@ -357,7 +373,7 @@ def expand_kernel_packed(
     [pool_cap, 5] pool on device and returns ONE int32 vector:
 
         [ offsets (B+1) | root_has_children (B) | needs_host (B)
-          | pool rows (pool_cap * 5, row-major) ]
+          | stats (N_LAUNCH_STATS) | pool rows (pool_cap * 5, row-major) ]
 
     Query i's edge records live at pool rows offsets[i]:offsets[i+1].
     Queries whose span would cross pool_cap are flagged needs_host
@@ -370,7 +386,7 @@ def expand_kernel_packed(
         fh_probes=fh_probes, max_steps=max_steps,
         frontier_cap=frontier_cap, edge_cap=edge_cap,
     )
-    eb_pobj, eb_prel, eb_skind, eb_sa, eb_sb, eb_count, root, needs = eb
+    eb_pobj, eb_prel, eb_skind, eb_sa, eb_sb, eb_count, root, needs, stats = eb
     counts = jnp.clip(eb_count, 0, E)
     offs = jnp.concatenate(
         [jnp.zeros(1, jnp.int32), jnp.cumsum(counts).astype(jnp.int32)]
@@ -399,6 +415,7 @@ def expand_kernel_packed(
         offs.astype(jnp.int32),
         root.astype(jnp.int32),
         needs.astype(jnp.int32),
+        stats.astype(jnp.int32),
         pool.reshape(-1),
     ])
 
@@ -406,14 +423,15 @@ def expand_kernel_packed(
 def unpack_expand_results(flat: np.ndarray, B: int, pool_cap: int):
     """Slice expand_kernel_packed's vector into (offsets[B+1], root[B]
     bool, needs_host[B] bool, pool columns (pobj, prel, skind, sa, sb)
-    each [pool_cap])."""
+    each [pool_cap], stats[N_LAUNCH_STATS])."""
     offs = flat[: B + 1]
     root = flat[B + 1 : 2 * B + 1].astype(bool)
     needs = flat[2 * B + 1 : 3 * B + 1].astype(bool)
-    pool = flat[3 * B + 1 :].reshape(pool_cap, 5)
+    stats = flat[3 * B + 1 : 3 * B + 1 + N_LAUNCH_STATS]
+    pool = flat[3 * B + 1 + N_LAUNCH_STATS :].reshape(pool_cap, 5)
     return offs, root, needs, (
         pool[:, 0], pool[:, 1], pool[:, 2], pool[:, 3], pool[:, 4]
-    )
+    ), stats
 
 
 # -- host assembly -------------------------------------------------------------

@@ -100,6 +100,104 @@ CAUSE_NAMES = {
 # host-side-only cause (query vocabulary never reached the device)
 CAUSE_NAME_UNINDEXED = "unindexed"
 
+# -- launch introspection counters ---------------------------------------------
+# Every BFS kernel (check, sharded check, expand, reverse) accumulates a
+# small int32 stats vector inside its bounded loop and appends it to the
+# packed result, so the counters ride the batch's EXISTING resolve-phase
+# readback — zero extra host syncs (ketolint's host-sync pass still sees
+# exactly one annotated sync point per batch). Slot layout is shared so
+# the flight recorder (observability.FlightRecorder) and the bench
+# summaries can treat every launch kind uniformly; kernels that have no
+# value for a slot leave it zero.
+N_LAUNCH_STATS = 8
+STAT_STEPS = 0          # loop iterations actually executed (vs the cap)
+STAT_FRONTIER_SUM = 1   # sum of n_tasks over executed steps (task-steps)
+STAT_FRONTIER_MAX = 2   # max n_tasks over executed steps
+STAT_LIVE_SUM = 3       # sum of genuinely-live tasks (excludes bucket
+                        # padding: seeded invalid queries sit at depth -1)
+STAT_PROBE_HITS = 4     # direct-edge probe hits accumulated (check only)
+STAT_EDGE_ROWS = 5      # candidate rows materially gathered (valid
+                        # expansion children / emitted expand edges)
+STAT_DEDUPE_KEPT = 6    # dedupe survivors admitted to the next frontier
+STAT_RESERVED = 7
+
+STAT_NAMES = (
+    "steps", "frontier_sum", "frontier_max", "live_sum",
+    "probe_hits", "edge_rows", "dedupe_kept", "reserved",
+)
+
+
+def empty_launch_stats():
+    return jnp.zeros(N_LAUNCH_STATS, dtype=jnp.int32)
+
+
+def update_launch_stats(
+    stats: jnp.ndarray,
+    n_tasks: jnp.ndarray,
+    n_live: jnp.ndarray,
+    n_hits: jnp.ndarray,
+    n_children: jnp.ndarray,
+    n_kept: jnp.ndarray,
+) -> jnp.ndarray:
+    """One step's counter accumulation (shared by the single-device and
+    sharded check kernels so both report identical semantics). All
+    operands must be REPLICATED values on a mesh — the sharded caller
+    passes post-collective quantities only."""
+    inc = jnp.stack([
+        jnp.int32(1),
+        n_tasks.astype(jnp.int32),
+        jnp.int32(0),
+        n_live.astype(jnp.int32),
+        n_hits.astype(jnp.int32),
+        n_children.astype(jnp.int32),
+        n_kept.astype(jnp.int32),
+        jnp.int32(0),
+    ])
+    return (stats + inc).at[STAT_FRONTIER_MAX].max(n_tasks.astype(jnp.int32))
+
+
+def launch_stats_dict(stats) -> dict:
+    """Host-side view of a stats vector as named fields (entry payload
+    for the flight recorder and the bench aggregates)."""
+    vals = [int(v) for v in stats]
+    return {
+        name: vals[i]
+        for i, name in enumerate(STAT_NAMES)
+        if name != "reserved"
+    }
+
+
+def estimate_step_gather_bytes(cfg: dict) -> int:
+    """Estimated bytes the check kernel's gather sites move in ONE BFS
+    step, from the launch's static config. The hot gathers are DENSE over
+    the frontier cap (padding rows gather like live ones — that is the
+    measured cost model, tools/microbench_gather_layout.py: one bucket
+    row = one 256 B gather regardless of occupancy), so the estimate is
+    exact up to XLA fusion choices and scales with frontier_cap and the
+    probe depths, which themselves grow with table load. Multiply by
+    STAT_STEPS for a launch's total; the resolve path records it in the
+    flight-recorder entry."""
+    F = int(cfg["frontier_cap"])
+    K = int(cfg["K"])
+    S = K + 1
+    has_delta = bool(cfg.get("has_delta", True))
+    bucket_row = 256  # every bucket is one 256 B gather row (snapshot.py)
+
+    def pb(probes: int, spb: int) -> int:
+        return (int(probes) + spb - 1) // spb
+
+    b = F * 16                                  # qsub packed subject rows
+    b += F * pb(cfg["dh_probes"], 8) * bucket_row       # dh edge probe
+    b += F * S * pb(cfg["rh_probes"], 16) * bucket_row  # rh span probe
+    if has_delta:
+        b += F * pb(DELTA_PROBES, 8) * bucket_row       # dd overlay probe
+        b += F * S * pb(DELTA_PROBES, 16) * bucket_row  # dirty-row probe
+    b += F * K * 16                             # instruction row lanes
+    b += F * 32                                 # srcmat [F, 8] rows
+    b += F * 8                                  # e_pack (obj, rel) rows
+    b += 2 * F * 16                             # dedupe winner + key rows
+    return b
+
 
 def _mix32(x: jnp.ndarray) -> jnp.ndarray:
     x = x ^ (x >> jnp.uint32(16))
@@ -337,6 +435,7 @@ class _State(NamedTuple):
     isl_pid: jnp.ndarray  # [max(NI,1)] program id (selects the circuit)
     n_isl: jnp.ndarray  # scalar int32
     step: jnp.ndarray  # scalar int32
+    stats: jnp.ndarray  # [N_LAUNCH_STATS] launch introspection counters
 
 
 class Expansion(NamedTuple):
@@ -784,6 +883,7 @@ def seed_state(
         isl_pid=jnp.zeros(max(n_island_cap, 1), jnp.int32),
         n_isl=jnp.int32(0),
         step=jnp.int32(0),
+        stats=empty_launch_stats(),
     )
 
 
@@ -855,22 +955,29 @@ def run_bfs_loop(step_fn, init, max_steps: int, n_queries: int):
 
 def finalize(
     final: _State, max_steps: int, n_queries: int
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+) -> tuple[
+    jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray,
+    jnp.ndarray,
+]:
     """Step-budget exhaustion with live tasks means the device did NOT
     finish exploring: those queries must go to the host, not be reported
     NotMember (silent false denials otherwise).
 
-    Returns (ctx_hit, needs_host, isl_parent, isl_pid, n_isl) — the
-    engine combines island circuits on host and reads the per-query
+    Returns (ctx_hit, needs_host, isl_parent, isl_pid, n_isl, stats) —
+    the engine combines island circuits on host and reads the per-query
     verdict from ctx_hit[:B] (engine/islands.py). needs_host carries the
-    CAUSE_* code (nonzero => host replay)."""
+    CAUSE_* code (nonzero => host replay); stats is the launch's
+    introspection counter vector (STAT_* slots)."""
     F = final.t_q.shape[0]
     exhausted = (final.step >= max_steps) & (final.n_tasks > 0)
     live = jnp.arange(F, dtype=jnp.int32) < final.n_tasks
     needs_host = final.needs_host.at[final.t_q].max(
         jnp.where(exhausted & live, CAUSE_STEP_EXHAUSTED, 0).astype(jnp.int32)
     )
-    return final.ctx_hit, needs_host, final.isl_parent, final.isl_pid, final.n_isl
+    return (
+        final.ctx_hit, needs_host, final.isl_parent, final.isl_pid,
+        final.n_isl, final.stats,
+    )
 
 
 def _check_kernel_impl(
@@ -892,10 +999,14 @@ def _check_kernel_impl(
     frontier_cap: int,
     n_island_cap: int = 0,
     has_delta: bool = True,
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+) -> tuple[
+    jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray,
+    jnp.ndarray,
+]:
     """Returns (ctx_hit[B + NI*K], needs_host[B], isl_parent, isl_pid,
-    n_isl); the per-query verdict is ctx_hit[:B] after the host island
-    combine (a no-op for monotone-only configs, where n_island_cap=0)."""
+    n_isl, stats[N_LAUNCH_STATS]); the per-query verdict is ctx_hit[:B]
+    after the host island combine (a no-op for monotone-only configs,
+    where n_island_cap=0)."""
     B = q_obj.shape[0]
     F = frontier_cap
     # packed per-query subject key: ONE [F, 4] row-gather per step
@@ -945,9 +1056,20 @@ def _check_kernel_impl(
             children, F, B
         )
         needs_host = jnp.maximum(needs_host, overflow2)
+        # launch introspection: a handful of scalar reductions per step
+        # (measured in the committed A/B leg as within-noise); depth >= 0
+        # excludes the seed bucket's padding tasks from the live count
+        stats = update_launch_stats(
+            st.stats,
+            st.n_tasks,
+            (live & (depth >= 0)).sum(),
+            hit.sum(),
+            children.valid.sum(),
+            n_new,
+        )
         return _State(
             nt_q, nt_ctx, nt_obj, nt_rel, nt_depth, n_new,
-            ctx_hit, needs_host, *isl_state, st.step + 1,
+            ctx_hit, needs_host, *isl_state, st.step + 1, stats,
         )
 
     init = seed_state(q_obj, q_rel, q_depth, q_valid, F, n_island_cap, K)
@@ -984,7 +1106,8 @@ def check_kernel_packed(
     """check_kernel with single-buffer I/O: `qpack` is ONE [7, B] int32
     array (obj, rel, depth, skind, sa, sb, valid) and the result is ONE
     int32 vector [n_isl, ctx_hit(B + NI*K), needs_host(B), isl_parent(NI),
-    isl_pid(NI)].
+    isl_pid(NI), stats(N_LAUNCH_STATS)]. The launch stats ride the same
+    single readback — the flight recorder costs no extra transfer.
 
     Through the axon TPU tunnel every host<->device buffer transfer pays
     its own round-trip (measured r04: a 4096-batch dispatch cost ~300 ms
@@ -992,7 +1115,7 @@ def check_kernel_packed(
     query uploads + five result readbacks of per-call RTT, not kernel
     time). One upload + one readback per batch is the transfer-count
     floor. unpack/concat compile to free reshapes on device."""
-    ctx_hit, needs_host, isl_parent, isl_pid, n_isl = _check_kernel_impl(
+    ctx_hit, needs_host, isl_parent, isl_pid, n_isl, stats = _check_kernel_impl(
         tables,
         qpack[0], qpack[1], qpack[2], qpack[3], qpack[4], qpack[5],
         qpack[6].astype(bool),
@@ -1007,6 +1130,9 @@ def check_kernel_packed(
         needs_host.astype(jnp.int32),
         isl_parent.astype(jnp.int32),
         isl_pid.astype(jnp.int32),
+        # stats LAST so existing front-anchored slicing (e.g.
+        # tools/scale_1e8_shard.py) keeps working unchanged
+        stats.astype(jnp.int32),
     ])
 
 
@@ -1024,7 +1150,9 @@ def pack_queries(
 
 def unpack_results(flat: np.ndarray, B: int, n_island_cap: int, K: int):
     """Slice check_kernel_packed's result vector back into
-    (ctx_hit, needs_host, isl_parent, isl_pid, n_isl) numpy views."""
+    (ctx_hit, needs_host, isl_parent, isl_pid, n_isl, stats) numpy
+    views. `stats` is the launch introspection counter vector
+    (STAT_* slots; launch_stats_dict names them)."""
     NI = max(n_island_cap, 1)
     NC = B + n_island_cap * K
     n_isl = int(flat[0])
@@ -1032,7 +1160,9 @@ def unpack_results(flat: np.ndarray, B: int, n_island_cap: int, K: int):
     needs_host = flat[1 + NC : 1 + NC + B]
     isl_parent = flat[1 + NC + B : 1 + NC + B + NI]
     isl_pid = flat[1 + NC + B + NI : 1 + NC + B + 2 * NI]
-    return ctx_hit, needs_host, isl_parent, isl_pid, n_isl
+    base = 1 + NC + B + 2 * NI
+    stats = flat[base : base + N_LAUNCH_STATS]
+    return ctx_hit, needs_host, isl_parent, isl_pid, n_isl, stats
 
 
 PASSTHROUGH_TABLE_KEYS = (

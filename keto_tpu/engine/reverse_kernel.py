@@ -71,16 +71,19 @@ from .kernel import (
     CAUSE_ISLAND_HOST,
     CAUSE_STEP_EXHAUSTED,
     Expansion,
+    N_LAUNCH_STATS,
     _isolate,
     _multi_pair_key_probe,
     bounded_loop,
     dedupe_phase,
+    empty_launch_stats,
     flag_phase,
     pack_instr_table,
     pack_pair_table,
     pack_rh_span_table,
     program_lookup,
     scan_seg_map_backend,
+    update_launch_stats,
 )
 from .snapshot import (
     EMPTY,
@@ -286,6 +289,7 @@ class _RevState(NamedTuple):
     res_count: jnp.ndarray  # [B]
     needs_host: jnp.ndarray  # [B] CAUSE_* code
     step: jnp.ndarray
+    stats: jnp.ndarray  # [N_LAUNCH_STATS] launch introspection counters
 
 
 _REVERSE_STATICS = (
@@ -362,6 +366,7 @@ def _list_objects_impl(
         res_count=jnp.zeros(B, jnp.int32),
         needs_host=needs_host,
         step=jnp.int32(0),
+        stats=empty_launch_stats(),
     )
 
     def step_fn(st: _RevState) -> _RevState:
@@ -523,9 +528,17 @@ def _list_objects_impl(
             dedupe_phase(children, F, B)
         )
         needs_host = jnp.maximum(needs_host, overflow_q)
+        stats = update_launch_stats(
+            st.stats,
+            st.n_tasks,
+            (live & (depth >= 0)).sum(),
+            emit.sum(),
+            children.valid.sum(),
+            n_new,
+        )
         return _RevState(
             nt_q, nt_obj, nt_rel, nt_depth, n_new,
-            res_obj, res_count, needs_host, st.step + 1,
+            res_obj, res_count, needs_host, st.step + 1, stats,
         )
 
     def cond_fn(st: _RevState):
@@ -543,7 +556,7 @@ def _list_objects_impl(
     needs_host = final.needs_host.at[final.t_q].max(
         jnp.where(exhausted & live, CAUSE_STEP_EXHAUSTED, 0).astype(jnp.int32)
     )
-    return final.res_obj, final.res_count, needs_host
+    return final.res_obj, final.res_count, needs_host, final.stats
 
 
 @functools.partial(
@@ -565,12 +578,13 @@ def list_objects_kernel_packed(
     has_delta: bool,
 ):
     """Single-buffer I/O + device-side compaction: ONE int32 vector
-    [ offsets (B+1) | needs_host (B) | pool rows (pool_cap) ]; query i's
-    matched object slots live at pool[offsets[i]:offsets[i+1]] (may
-    contain revisit duplicates — the host decoder dedupes)."""
+    [ offsets (B+1) | needs_host (B) | stats (N_LAUNCH_STATS) |
+    pool rows (pool_cap) ]; query i's matched object slots live at
+    pool[offsets[i]:offsets[i+1]] (may contain revisit duplicates — the
+    host decoder dedupes)."""
     B = qpack.shape[1]
     R = result_cap
-    res_obj, res_count, needs_host = _list_objects_impl(
+    res_obj, res_count, needs_host, stats = _list_objects_impl(
         tables,
         qpack[0], qpack[1], qpack[2], qpack[3], qpack[4],
         qpack[5].astype(bool),
@@ -597,15 +611,17 @@ def list_objects_kernel_packed(
         ).astype(jnp.int32),
     )
     offs = jnp.minimum(offs, pool_cap)
-    return jnp.concatenate([offs, needs_host, pool])
+    return jnp.concatenate([offs, needs_host, stats.astype(jnp.int32), pool])
 
 
 def unpack_list_results(flat: np.ndarray, B: int):
-    """(offsets[B+1], needs_host[B] cause codes, pool values)."""
+    """(offsets[B+1], needs_host[B] cause codes, pool values,
+    stats[N_LAUNCH_STATS])."""
     offs = flat[: B + 1]
     needs = flat[B + 1 : 2 * B + 1]
-    pool = flat[2 * B + 1 :]
-    return offs, needs, pool
+    stats = flat[2 * B + 1 : 2 * B + 1 + N_LAUNCH_STATS]
+    pool = flat[2 * B + 1 + N_LAUNCH_STATS :]
+    return offs, needs, pool, stats
 
 
 # -- ListSubjects: forward BFS with subject emission ---------------------------
@@ -621,6 +637,7 @@ class _SubState(NamedTuple):
     res_count: jnp.ndarray  # [B]
     needs_host: jnp.ndarray  # [B] CAUSE_* code
     step: jnp.ndarray
+    stats: jnp.ndarray  # [N_LAUNCH_STATS] launch introspection counters
 
 
 _SUBJECTS_STATICS = (
@@ -668,6 +685,7 @@ def _list_subjects_impl(
         res_count=jnp.zeros(B, jnp.int32),
         needs_host=jnp.zeros(B, dtype=jnp.int32),
         step=jnp.int32(0),
+        stats=empty_launch_stats(),
     )
 
     def step_fn(st: _SubState) -> _SubState:
@@ -819,9 +837,17 @@ def _list_subjects_impl(
             dedupe_phase(children, F, B)
         )
         needs_host = jnp.maximum(needs_host, overflow_q)
+        stats = update_launch_stats(
+            st.stats,
+            st.n_tasks,
+            (live & (depth >= 0)).sum(),
+            emit.sum(),
+            children.valid.sum(),
+            n_new,
+        )
         return _SubState(
             nt_q, nt_obj, nt_rel, nt_depth, n_new,
-            res_sub, res_count, needs_host, st.step + 1,
+            res_sub, res_count, needs_host, st.step + 1, stats,
         )
 
     def cond_fn(st: _SubState):
@@ -837,7 +863,7 @@ def _list_subjects_impl(
     needs_host = final.needs_host.at[final.t_q].max(
         jnp.where(exhausted & live, CAUSE_STEP_EXHAUSTED, 0).astype(jnp.int32)
     )
-    return final.res_sub, final.res_count, needs_host
+    return final.res_sub, final.res_count, needs_host, final.stats
 
 
 @functools.partial(
@@ -858,11 +884,12 @@ def list_subjects_kernel_packed(
     has_delta: bool,
 ):
     """Packed twin of list_objects_kernel_packed for the subjects leg:
-    [ offsets (B+1) | needs_host (B) | pool (pool_cap) ] of plain
-    subject ids (revisit duplicates possible; host dedupes)."""
+    [ offsets (B+1) | needs_host (B) | stats (N_LAUNCH_STATS) |
+    pool (pool_cap) ] of plain subject ids (revisit duplicates possible;
+    host dedupes)."""
     B = qpack.shape[1]
     R = result_cap
-    res_sub, res_count, needs_host = _list_subjects_impl(
+    res_sub, res_count, needs_host, stats = _list_subjects_impl(
         tables,
         qpack[0], qpack[1], qpack[2], qpack[3].astype(bool),
         K=K, fsh_probes=fsh_probes, max_steps=max_steps,
@@ -888,7 +915,7 @@ def list_subjects_kernel_packed(
         ).astype(jnp.int32),
     )
     offs = jnp.minimum(offs, pool_cap)
-    return jnp.concatenate([offs, needs_host, pool])
+    return jnp.concatenate([offs, needs_host, stats.astype(jnp.int32), pool])
 
 
 def decode_pool_slice(pool: np.ndarray, lo: int, hi: int) -> list[int]:

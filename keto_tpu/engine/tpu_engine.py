@@ -44,12 +44,16 @@ from .definitions import (
     Membership,
     paginate_names,
 )
+from ..observability import next_launch_id
 from .delta import SnapshotView, empty_delta_tables
 from .kernel import (
     CAUSE_NAME_UNINDEXED,
     CAUSE_NAMES,
+    _KERNEL_STATICS,
     check_kernel,
+    estimate_step_gather_bytes,
     kernel_static_config,
+    launch_stats_dict,
     snapshot_tables,
 )
 from .reference import ReferenceEngine
@@ -126,6 +130,7 @@ class TPUCheckEngine:
         metrics=None,
         tracer=None,
         auto_frontier: bool = True,
+        flightrec=None,
     ):
         self.manager = manager
         self.config = config
@@ -179,6 +184,11 @@ class TPUCheckEngine:
             "host_cause": {},
         }
         self.metrics = metrics
+        # launch flight recorder (observability.FlightRecorder | None):
+        # one ring entry per device launch, written at the resolve sync
+        # point; launch ids are allocated process-wide either way so logs
+        # and typed errors stay correlatable when recording is off
+        self.flightrec = flightrec
         if tracer is None:
             from ..observability import _NoopTracer
 
@@ -870,6 +880,78 @@ class TPUCheckEngine:
         with self._lock:
             self._state = None
 
+    def hbm_snapshot(self) -> dict:
+        """Structured device-memory + staleness accounting for the
+        current mirror generation: per-buffer table bytes (forward check
+        tables incl. the delta overlay and rewrite programs, plus the
+        lazily-built expand/reverse/subjects extras) and how stale the
+        mirror is relative to the live store. Served by
+        `GET /admin/flightrec` and read by the bench; also refreshes the
+        keto_tpu_hbm_table_bytes{buffer} gauges. Zero device contact —
+        nbytes is array metadata."""
+        with self._lock:
+            state = self._state
+        if state is None:
+            return {"built": False}
+        # store read OUTSIDE the engine lock (ketolint lock-discipline)
+        store_version = self.manager.version(nid=self.nid)
+
+        def per_key(tables) -> dict:
+            if tables is None:
+                return {}
+            if isinstance(tables, tuple):
+                merged: dict = {}
+                for part in tables:
+                    for k, v in per_key(part).items():
+                        merged[k] = merged.get(k, 0) + v
+                return merged
+            return {
+                k: int(getattr(v, "nbytes", 0) or 0)
+                for k, v in tables.items()
+            }
+
+        check_keys = per_key(state.tables)
+        delta_bytes = sum(
+            v for k, v in check_keys.items()
+            if k in ("dd_pack", "dirty_pack", "rd_pack")
+        )
+        program_bytes = sum(
+            v for k, v in check_keys.items()
+            if k in ("instr_pack", "prog_flags", "ns_has_config")
+        )
+        buffers = {
+            "check": check_keys,
+            "expand": per_key(state.expand_tables),
+            "reverse": per_key(state.reverse_tables),
+            "subjects": per_key(state.subjects_tables),
+        }
+        totals = {
+            name: sum(keys.values()) for name, keys in buffers.items()
+        }
+        if self.metrics is not None:
+            for name, total in totals.items():
+                self.metrics.hbm_table_bytes.labels(name).set(total)
+        return {
+            "built": True,
+            "nid": self.nid,
+            "n_tuples": state.snapshot.n_tuples,
+            "buffers": buffers,
+            "totals": totals,
+            "delta_overlay_bytes": delta_bytes,
+            "rewrite_program_bytes": program_bytes,
+            "total_bytes": sum(totals.values()),
+            # mirror staleness: how far the served snapshot trails the
+            # live store, and how much churn the overlay absorbs
+            "base_version": state.base_version,
+            "covered_version": state.covered_version,
+            "store_version": store_version,
+            "staleness_versions": store_version - state.covered_version,
+            "compaction_lag_versions": (
+                state.covered_version - state.base_version
+            ),
+            "has_delta": state.has_delta,
+        }
+
     def _ensure_expand_state(self) -> _EngineState:
         """State with the expand-kernel extras (full-edge CSR + dirty
         tables + decoder) populated. The CSR follows the BASE snapshot;
@@ -1146,6 +1228,7 @@ class TPUCheckEngine:
                 q_valid.astype(np.int32),
             ]
         ).astype(np.int32)
+        launch_id = next_launch_id()
         with self.tracer.span("engine.list_objects_launch", batch=B):
             flat = list_objects_kernel_packed(
                 state.reverse_tables,
@@ -1164,7 +1247,8 @@ class TPUCheckEngine:
                 has_delta=state.has_delta,
             )
         # ketolint: allow[host-sync] reason=this IS the batch's designated sync point: resolve is the synchronize phase of the split-phase submit/resolve contract, and the single-buffer I/O design makes this readback the ONE device->host transfer for the whole batch
-        offs, needs, pool = unpack_list_results(np.asarray(flat), B)
+        offs, needs, pool, lstats = unpack_list_results(np.asarray(flat), B)
+        self._record_list_launch("list_objects", B, n, lstats, launch_id)
         return self._resolve_reverse(
             "list_objects", queries, empty_idx, q_valid, needs,
             lambda i: sorted(
@@ -1236,6 +1320,7 @@ class TPUCheckEngine:
                 q_valid.astype(np.int32),
             ]
         ).astype(np.int32)
+        launch_id = next_launch_id()
         with self.tracer.span("engine.list_subjects_launch", batch=B):
             flat = list_subjects_kernel_packed(
                 state.subjects_tables,
@@ -1253,7 +1338,8 @@ class TPUCheckEngine:
                 has_delta=state.has_delta,
             )
         # ketolint: allow[host-sync] reason=this IS the batch's designated sync point: resolve is the synchronize phase of the split-phase submit/resolve contract, and the single-buffer I/O design makes this readback the ONE device->host transfer for the whole batch
-        offs, needs, pool = unpack_list_results(np.asarray(flat), B)
+        offs, needs, pool, lstats = unpack_list_results(np.asarray(flat), B)
+        self._record_list_launch("list_subjects", B, n, lstats, launch_id)
         return self._resolve_reverse(
             "list_subjects", queries, empty_idx, q_valid, needs,
             lambda i: sorted(
@@ -1264,6 +1350,30 @@ class TPUCheckEngine:
                 qr[0], qr[1], qr[2], max_depth, self.nid
             ),
         )
+
+    def _record_list_launch(
+        self, kind: str, B: int, n: int, stats, launch_id: int
+    ) -> None:
+        """Flight-recorder entry for a reverse/expand launch: lighter
+        than the check entry (no stage breakdown — these legs resolve
+        inline), but the same counter vocabulary. The caller allocates
+        `launch_id` BEFORE its kernel dispatch so ids keep advancing
+        while recording is disabled and id order tracks dispatch order
+        across launch kinds."""
+        fr = self.flightrec
+        if fr is None or not fr.enabled:
+            return
+        entry = {
+            "launch_id": launch_id,
+            "kind": kind,
+            "nid": self.nid,
+            "bucket": B,
+            "n": n,
+            "occupancy": round((n / B) if B else 1.0, 4),
+        }
+        if stats is not None:
+            entry.update(launch_stats_dict(stats))
+        fr.record(entry)
 
     def _resolve_reverse(
         self, leg, queries, empty_idx, q_valid, needs, decode_fn, host_fn
@@ -1414,6 +1524,7 @@ class TPUCheckEngine:
                 q_obj[i], q_rel[i] = node
                 q_valid[i] = True
 
+        launch_id = next_launch_id()
         if self.mesh is not None:
             from ..parallel.expand import sharded_expand_kernel
 
@@ -1460,10 +1571,11 @@ class TPUCheckEngine:
                 edge_cap=edge_cap,
                 pool_cap=pool_cap,
             )
-            offs, root_has_children, needs_host, pool_cols = (
+            offs, root_has_children, needs_host, pool_cols, estats = (
                 # ketolint: allow[host-sync] reason=this IS the batch's designated sync point: resolve is the synchronize phase of the split-phase submit/resolve contract, and the single-buffer I/O design makes this readback the ONE device->host transfer for the whole batch
                 unpack_expand_results(np.asarray(flat), B, pool_cap)
             )
+            self._record_list_launch("expand", B, n, estats, launch_id)
             eb = None
         if eb is not None:
             eb_pobj, eb_prel, eb_skind, eb_sa, eb_sb = (
@@ -1476,6 +1588,14 @@ class TPUCheckEngine:
             root_has_children = np.asarray(eb[6])
             # ketolint: allow[host-sync] reason=this IS the batch's designated sync point: resolve is the synchronize phase of the split-phase submit/resolve contract, and the single-buffer I/O design makes this readback the ONE device->host transfer for the whole batch
             needs_host = np.asarray(eb[7])
+            if self.flightrec is not None and self.flightrec.enabled:
+                # gated so a DISABLED recorder costs zero extra
+                # transfers on the mesh path (the eager np.asarray
+                # would otherwise run before record()'s enabled check)
+                self._record_list_launch(
+                    # ketolint: allow[host-sync] reason=part of the same designated resolve sync point: the sharded expand's replicated stats vector reads back with the batch results, not as an extra round-trip
+                    "expand", B, n, np.asarray(eb[8]), launch_id
+                )
             offs = None
             pool_cols = None
 
@@ -1552,6 +1672,27 @@ class TPUCheckEngine:
         n = len(tuples)
         if n == 0:
             return ("empty", [], None)
+        # flight-recorder correlation: the launch id exists BEFORE any
+        # failable work (fault injection, state build, XLA compile) so a
+        # submit-phase failure carries it into classify_engine_error's
+        # typed CheckBatchFailedError and the auto-dump
+        launch_id = next_launch_id()
+        try:
+            return self._check_batch_submit_inner(
+                tuples, max_depth, telemetry, launch_id
+            )
+        except Exception as e:
+            # don't clobber an id a recursive split-slice submit already
+            # stamped: the slice's id has the ring entry, not the parent's
+            if getattr(e, "launch_id", None) is None:
+                e.launch_id = launch_id
+            raise
+
+    def _check_batch_submit_inner(
+        self, tuples: Sequence[RelationTuple], max_depth: int,
+        telemetry, launch_id: int,
+    ):
+        n = len(tuples)
         # fault-injection point (keto_tpu/faults.py): a stall here models
         # a wedged device/tunnel launch, an error a dying device — BEFORE
         # any state build, so the batcher's watchdog/breaker see exactly
@@ -1641,6 +1782,7 @@ class TPUCheckEngine:
         # to host replay (overflow is safe, just slow)
         island_cap = 2 * B if state.snapshot.island_circuits else 0
         t_launch = time.perf_counter()
+        n_shards = 1
         with self.tracer.span(
             "engine.kernel_launch", batch=B, frontier=launch_cap
         ):
@@ -1654,6 +1796,11 @@ class TPUCheckEngine:
                     state.sharded, global_max, launch_cap,
                     n_island_cap=island_cap, has_delta=state.has_delta,
                 )
+                # dict view of the statics tuple for the gather-bytes
+                # estimate (each shard runs the full per-step gather set
+                # over its own tables)
+                cfg = dict(zip(_KERNEL_STATICS, statics))
+                n_shards = int(self.mesh.devices.size)
                 sharded_tables, replicated_tables = state.tables
                 outputs = sharded_check_kernel(
                     self.mesh, sharded_tables, replicated_tables,
@@ -1701,6 +1848,15 @@ class TPUCheckEngine:
                     "dispatch": t_done - t_launch,
                 },
                 "telemetry": telemetry,
+                # flight-recorder fields, read back at the resolve sync
+                # point together with the device stats vector
+                "launch_id": launch_id,
+                "t_submit": t_submit,
+                "launch_cap": launch_cap,
+                "step_cap": int(cfg["max_steps"]),
+                "gather_step_bytes": (
+                    n_shards * estimate_step_gather_bytes(cfg)
+                ),
             },
         )
 
@@ -1729,28 +1885,43 @@ class TPUCheckEngine:
                 results.extend(r)
                 versions.extend(v)
             return results, versions
+        try:
+            return self._check_batch_resolve_v_inner(outputs, meta)
+        except Exception as e:
+            # resolve-phase failures carry the launch id into the typed
+            # error surface and the flight-recorder dump
+            e.launch_id = meta.get("launch_id")
+            raise
+
+    def _check_batch_resolve_v_inner(self, outputs, meta):
         state = meta["state"]
         tuples = meta["tuples"]
         n, B, max_depth = meta["n"], meta["B"], meta["max_depth"]
         q_valid = meta["q_valid"]
         t_resolve = time.perf_counter()
         if meta.get("island_cap") is not None:
-            # packed single-device result: ONE device->host readback
+            # packed single-device result: ONE device->host readback —
+            # the launch stats vector rides the same transfer
             from .kernel import unpack_results
 
-            ctx_hit, needs_host, isl_parent, isl_pid, n_isl = unpack_results(
-                # ketolint: allow[host-sync] reason=this IS the batch's designated sync point: resolve is the synchronize phase of the split-phase submit/resolve contract, and the single-buffer I/O design makes this readback the ONE device->host transfer for the whole batch
-                np.asarray(outputs), B, meta["island_cap"], state.snapshot.K
+            ctx_hit, needs_host, isl_parent, isl_pid, n_isl, stats = (
+                unpack_results(
+                    # ketolint: allow[host-sync] reason=this IS the batch's designated sync point: resolve is the synchronize phase of the split-phase submit/resolve contract, and the single-buffer I/O design makes this readback the ONE device->host transfer for the whole batch
+                    np.asarray(outputs), B, meta["island_cap"],
+                    state.snapshot.K,
+                )
             )
             ctx_hit = ctx_hit.copy()
         else:
-            ctx_hit, needs_host, isl_parent, isl_pid, n_isl = outputs
+            ctx_hit, needs_host, isl_parent, isl_pid, n_isl, stats = outputs
             # ketolint: allow[host-sync] reason=this IS the batch's designated sync point: resolve is the synchronize phase of the split-phase submit/resolve contract, and the single-buffer I/O design makes this readback the ONE device->host transfer for the whole batch
             ctx_hit = np.asarray(ctx_hit).copy()
             # ketolint: allow[host-sync] reason=this IS the batch's designated sync point: resolve is the synchronize phase of the split-phase submit/resolve contract, and the single-buffer I/O design makes this readback the ONE device->host transfer for the whole batch
             needs_host = np.asarray(needs_host)
             # ketolint: allow[host-sync] reason=this IS the batch's designated sync point: resolve is the synchronize phase of the split-phase submit/resolve contract, and the single-buffer I/O design makes this readback the ONE device->host transfer for the whole batch
             n_isl = int(n_isl)
+            # ketolint: allow[host-sync] reason=part of the same designated resolve sync point: the mesh path's replicated stats vector reads back with the batch results, not as an extra round-trip
+            stats = np.asarray(stats)
         if _faults.get("batch_corrupt") is not None:
             # fault-injection point: poison every slot's device verdict
             # so each query takes the exact-host-replay escape hatch the
@@ -1790,7 +1961,9 @@ class TPUCheckEngine:
             if self.metrics is not None:
                 self.metrics.check_batch_size.observe(n)
                 self.metrics.checks_total.labels("device").inc(n)
-            self._finish_check_stages(meta, device_wait_s, 0.0, n, B)
+            self._finish_check_stages(
+                meta, device_wait_s, 0.0, n, B, stats=stats
+            )
             return results, [state.covered_version] * n
 
         results = []
@@ -1856,18 +2029,23 @@ class TPUCheckEngine:
                 self.metrics.checks_total.labels("host").inc(n_host)
             for cause, cnt in host_causes.items():
                 self.metrics.host_fallback_total.labels(cause).inc(cnt)
-        self._finish_check_stages(meta, device_wait_s, host_s, n, B)
+        self._finish_check_stages(
+            meta, device_wait_s, host_s, n, B,
+            stats=stats, host_causes=host_causes,
+        )
         return results, versions
 
     def _finish_check_stages(
-        self, meta, device_wait_s: float, host_s: float, n: int, B: int
+        self, meta, device_wait_s: float, host_s: float, n: int, B: int,
+        stats=None, host_causes=None,
     ) -> None:
         """Finalize one batch's stage attribution: per-stage histogram
         samples (once per batch), the occupancy gauge, each rider's
-        RequestTrace stages, and per-request engine spans when tracing.
-        Batch-shared stages are attributed identically to every rider —
-        the breakdown says where the BATCH spent its time, which is what
-        a tail-latency investigation needs."""
+        RequestTrace stages (+ launch id), the flight-recorder entry,
+        and per-request engine spans when tracing. Batch-shared stages
+        are attributed identically to every rider — the breakdown says
+        where the BATCH spent its time, which is what a tail-latency
+        investigation needs."""
         stage_s = dict(meta.get("stage_s") or ())
         stage_s["device_wait"] = device_wait_s
         if host_s > 0.0:
@@ -1876,16 +2054,71 @@ class TPUCheckEngine:
             for name, dur in stage_s.items():
                 self.metrics.observe_stage(name, dur)
             self.metrics.batch_occupancy.set(n / B if B else 1.0)
+        self._record_launch(meta, stats, n, B, host_causes, stage_s)
         telemetry = meta.get("telemetry")
         if not telemetry:
             return
         spans = getattr(self.tracer, "active", False)
+        launch_id = meta.get("launch_id")
         for rt in telemetry:
             if rt is None:
                 continue
+            if launch_id is not None:
+                ids = getattr(rt, "launch_ids", None)
+                if ids is not None:
+                    ids.append(launch_id)
             for name, dur in stage_s.items():
                 rt.add_stage(name, dur)
                 if spans:
                     self.tracer.record(
                         f"engine.{name}", ctx=rt.ctx, duration_s=dur, batch=B
                     )
+
+    def _record_launch(
+        self, meta, stats, n: int, B: int, host_causes, stage_s
+    ) -> None:
+        """One flight-recorder entry + the keto_tpu_launch_* metric
+        samples for a resolved device batch. Everything here is host
+        arithmetic over the counters that rode the batch's existing
+        readback — no extra device contact."""
+        sd = launch_stats_dict(stats) if stats is not None else {}
+        step_cap = int(meta.get("step_cap", 0))
+        gather_bytes = sd.get("steps", 0) * int(
+            meta.get("gather_step_bytes", 0)
+        )
+        occupancy = (n / B) if B else 1.0
+        if self.metrics is not None and sd:
+            self.metrics.observe_launch(
+                sd["steps"], step_cap, sd["frontier_max"], gather_bytes,
+                sd["edge_rows"], round(1.0 - occupancy, 4),
+            )
+        fr = self.flightrec
+        if fr is None or not fr.enabled:
+            return
+        t_submit = meta.get("t_submit")
+        entry = {
+            "launch_id": meta.get("launch_id"),
+            "kind": "check",
+            "nid": self.nid,
+            "bucket": B,
+            "n": n,
+            "occupancy": round(occupancy, 4),
+            "frontier_cap": meta.get("launch_cap"),
+            "step_cap": step_cap,
+            "gather_bytes_est": gather_bytes,
+            "host_causes": dict(host_causes or {}),
+            "trace_ids": [
+                rt.ctx.trace_id
+                for rt in (meta.get("telemetry") or ())
+                if rt is not None
+            ],
+            "stage_ms": {
+                k: round(v * 1e3, 3) for k, v in stage_s.items()
+            },
+            **sd,
+        }
+        if t_submit is not None:
+            entry["wall_ms"] = round(
+                (time.perf_counter() - t_submit) * 1e3, 3
+            )
+        fr.record(entry)

@@ -82,6 +82,13 @@ def get(point: str) -> Optional[FaultSpec]:
     return _SPECS.get(point)
 
 
+def armed_names() -> list[str]:
+    """Names of currently armed injection points (flight-recorder
+    entries stamp them so a fault-window launch is self-describing)."""
+    with _mu:
+        return list(_SPECS)
+
+
 def inject(point: str) -> None:
     """Serve one injection: sleep the stall, then raise the error (both
     optional). A disarmed point is one dict miss."""

@@ -25,6 +25,7 @@ import contextlib
 import contextvars
 import logging
 import secrets
+import threading
 import time
 from typing import Optional
 
@@ -105,14 +106,19 @@ class RequestTrace:
     batcher into the engine; every layer adds its stage durations.
     `deadline` (resilience.Deadline | None) rides the same handoff so
     every stage boundary can fail the request fast once the end-to-end
-    budget is spent — the Zanzibar deadline-scoped-evaluation carrier."""
+    budget is spent — the Zanzibar deadline-scoped-evaluation carrier.
+    `launch_ids` collects the flight-recorder launch ids of every device
+    batch this request rode (normally one; multi-split batches append
+    several), so a slow-query line or request log joins its exact
+    launch record in `GET /admin/flightrec`."""
 
-    __slots__ = ("ctx", "stages", "deadline")
+    __slots__ = ("ctx", "stages", "deadline", "launch_ids")
 
     def __init__(self, ctx: Optional[SpanContext] = None, deadline=None):
         self.ctx = ctx if ctx is not None else new_trace()
         self.stages: dict[str, float] = {}
         self.deadline = deadline
+        self.launch_ids: list[int] = []
 
     def add_stage(self, name: str, seconds: float) -> None:
         self.stages[name] = self.stages.get(name, 0.0) + seconds
@@ -136,6 +142,136 @@ def reset_request_trace(token) -> None:
 
 def current_request_trace() -> Optional[RequestTrace]:
     return CURRENT_TRACE.get()
+
+
+# -- flight recorder -----------------------------------------------------------
+
+# process-wide monotonically increasing launch ids: unique across every
+# engine/plane in the process so one id joins the slow-query log, the
+# typed batch-failure error, and the ring entry unambiguously
+_launch_id_lock = threading.Lock()
+_launch_id_next = 0
+
+
+def next_launch_id() -> int:
+    """Allocate one launch id (ids advance even when recording is
+    disabled — logs and errors still need a stable correlation key)."""
+    global _launch_id_next
+    with _launch_id_lock:
+        _launch_id_next += 1
+        return _launch_id_next
+
+
+class FlightRecorder:
+    """Bounded per-process ring of per-launch device introspection
+    entries — the serving plane's black-box recorder.
+
+    One entry per device launch (check batches; expand and reverse
+    launches record too), written at the launch's EXISTING resolve-phase
+    sync point from counters the kernel accumulated on device
+    (engine/kernel.py STAT_*): loop iterations used vs cap, frontier
+    occupancy (sum/max/live), probe hits, candidate rows gathered,
+    estimated gather bytes, batch occupancy real/padded, host-replay
+    causes, per-stage seconds, and the riders' trace ids. Context
+    providers (registry-wired: breaker state, armed faults) stamp every
+    entry with ambient device-path health.
+
+    `dump()` is the failure path's escape hatch: the batchers call it on
+    device-batch failure / watchdog abandon so the last launches' records
+    land in the log before the evidence scrolls out of the ring; the
+    metrics listener serves the live ring at `GET /admin/flightrec`.
+
+    Thread-safe; recording is O(1) appends onto a deque. Entries carry
+    `t_mono` (time.monotonic at resolve) — wall-clock stamps are banned
+    repo-wide (ketolint clock-monotonic); readers compute ages against
+    the monotonic clock they already hold."""
+
+    DUMP_TAIL = 16  # entries logged per dump (the full ring would spam)
+
+    def __init__(self, enabled: bool = True, capacity: int = 256,
+                 metrics=None):
+        import collections
+
+        self.enabled = bool(enabled)
+        self.capacity = max(int(capacity), 1)
+        self.metrics = metrics
+        self._ring = collections.deque(maxlen=self.capacity)
+        self._mu = threading.Lock()
+        # () -> dict merged into every entry; registered by the registry
+        # (breaker state, armed faults). Called OUTSIDE the ring lock.
+        self.context_providers: list = []
+
+    def record(self, entry: dict) -> None:
+        if not self.enabled:
+            return
+        for provider in self.context_providers:
+            try:
+                entry.update(provider())
+            except Exception:  # a broken provider must never fail a launch
+                logger.debug("flightrec context provider failed", exc_info=True)
+        entry.setdefault("t_mono", time.monotonic())
+        with self._mu:
+            self._ring.append(entry)
+
+    def entries(self) -> list[dict]:
+        with self._mu:
+            return list(self._ring)
+
+    def dump(self, reason: str) -> list[dict]:
+        """Auto-dump on batch failure / watchdog abandon: log the tail of
+        the ring as one structured WARNING (the entries most likely to
+        explain the failure) and count the dump. Returns the full ring
+        for programmatic callers (smoke tools, tests). Disabled recorder:
+        silent no-op — an empty-tail WARNING per batch failure is noise
+        with zero evidence (batch-failed counters already count those)."""
+        if not self.enabled:
+            return []
+        entries = self.entries()
+        if self.metrics is not None:
+            self.metrics.flightrec_dumps_total.labels(reason).inc()
+        tail = entries[-self.DUMP_TAIL:]
+        logger.warning(
+            "flight recorder dump reason=%s entries=%d tail=%s",
+            reason, len(entries), tail,
+        )
+        return entries
+
+
+def summarize_launches(entries: list[dict]) -> dict:
+    """Per-leg aggregates of flight-recorder entries — the BENCH/SCALE
+    json's launch-telemetry record (mean/p95 iterations, gather bytes
+    per check, padding waste). Schema pinned by the bench golden test;
+    returns {} for an empty window so legs without launches stay absent
+    from the json instead of recording degenerate zeros."""
+    checks = [e for e in entries if e.get("kind") == "check"]
+    if not checks:
+        return {}
+
+    def _vals(key):
+        return [float(e.get(key, 0)) for e in checks]
+
+    def _p95(vals):
+        s = sorted(vals)
+        return s[min(int(0.95 * (len(s) - 1) + 0.5), len(s) - 1)]
+
+    iters = _vals("steps")
+    waste = [1.0 - float(e.get("occupancy", 1.0)) for e in checks]
+    n_checks = sum(int(e.get("n", 0)) for e in checks) or 1
+    return {
+        "launches": len(checks),
+        "iterations_mean": round(sum(iters) / len(iters), 2),
+        "iterations_p95": round(_p95(iters), 2),
+        "step_cap": int(max(e.get("step_cap", 0) for e in checks)),
+        "frontier_peak_max": int(max(e.get("frontier_max", 0) for e in checks)),
+        "live_task_steps_mean": round(
+            sum(_vals("live_sum")) / len(checks), 1
+        ),
+        "gather_bytes_per_check": round(
+            sum(_vals("gather_bytes_est")) / n_checks, 1
+        ),
+        "edge_rows_per_check": round(sum(_vals("edge_rows")) / n_checks, 3),
+        "padding_waste_mean": round(sum(waste) / len(waste), 4),
+    }
 
 
 class Metrics:
@@ -373,6 +509,77 @@ class Metrics:
             ["cause"],
             registry=self.registry,
         )
+        # engine flight recorder (this module's FlightRecorder + the
+        # kernel launch counters, engine/kernel.py STAT_*): the device
+        # side of every launch measured instead of projected
+        self.launch_iterations = prom.Histogram(
+            "keto_tpu_launch_iterations",
+            "BFS loop iterations actually executed per device check "
+            "launch (the counted-loop budget is keto_tpu_launch_step_cap; "
+            "iterations == cap with live tasks means step-exhausted host "
+            "replays)",
+            registry=self.registry,
+            buckets=(1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48),
+        )
+        self.launch_step_cap = prom.Gauge(
+            "keto_tpu_launch_step_cap",
+            "Static step budget (max_steps) of the most recent device "
+            "check launch — the denominator for iterations-vs-cap",
+            registry=self.registry,
+        )
+        self.launch_frontier_peak = prom.Histogram(
+            "keto_tpu_launch_frontier_peak",
+            "Peak per-step frontier task count within one device check "
+            "launch (capacity is the launch frontier_cap; peaks at cap "
+            "mean frontier-overflow host replays are near)",
+            registry=self.registry,
+            buckets=(16, 64, 256, 1024, 4096, 16384, 65536),
+        )
+        self.launch_gather_bytes = prom.Histogram(
+            "keto_tpu_launch_gather_bytes",
+            "Estimated bytes moved by the kernel's gather sites per "
+            "device check launch (engine/kernel.py "
+            "estimate_step_gather_bytes x iterations used) — the "
+            "measured stand-in for the gather-volume droop hypothesis",
+            registry=self.registry,
+            buckets=(
+                1e5, 1e6, 4e6, 1.6e7, 6.4e7, 2.56e8, 1e9, 4e9,
+            ),
+        )
+        self.launch_edge_rows = prom.Histogram(
+            "keto_tpu_launch_edge_rows",
+            "Candidate rows materially gathered per device check launch "
+            "(valid expansion children across all steps) — the dynamic "
+            "half of gather volume, scales with graph fanout",
+            registry=self.registry,
+            buckets=(1, 10, 100, 1000, 10000, 100000, 1000000),
+        )
+        self.launch_padding_waste = prom.Histogram(
+            "keto_tpu_launch_padding_waste",
+            "Padded fraction of the launch bucket ((B - real) / B): 0 = "
+            "full bucket, 0.9 = 90% of the launch cost spent on padding "
+            "rows",
+            registry=self.registry,
+            buckets=(0.0, 0.1, 0.25, 0.5, 0.75, 0.9, 0.99),
+        )
+        self.flightrec_dumps_total = prom.Counter(
+            "keto_tpu_flightrec_dumps_total",
+            "Flight-recorder auto-dumps, by reason (device | "
+            "device_timeout | host | manual): each dump writes the ring "
+            "tail to the log before the failure evidence scrolls out",
+            ["reason"],
+            registry=self.registry,
+        )
+        self.hbm_table_bytes = prom.Gauge(
+            "keto_tpu_hbm_table_bytes",
+            "Device bytes held per buffer family of the default "
+            "network's mirror (check = packed check tables incl. the "
+            "delta overlay, expand / reverse / subjects = the lazy "
+            "read-path extras) — refreshed by TPUCheckEngine."
+            "hbm_snapshot(), which GET /admin/flightrec calls",
+            ["buffer"],
+            registry=self.registry,
+        )
         self.client_retries_total = prom.Counter(
             "keto_tpu_client_retries_total",
             "In-process ReadClient retries (resilience.RetryPolicy: "
@@ -391,6 +598,24 @@ class Metrics:
 
     def export(self) -> bytes:
         return prom.generate_latest(self.registry)
+
+    def observe_launch(
+        self,
+        steps: int,
+        step_cap: int,
+        frontier_max: int,
+        gather_bytes: float,
+        edge_rows: int,
+        padding_waste: float,
+    ) -> None:
+        """One check launch's counter samples (called once per device
+        batch at its resolve sync point)."""
+        self.launch_iterations.observe(steps)
+        self.launch_step_cap.set(step_cap)
+        self.launch_frontier_peak.observe(frontier_max)
+        self.launch_gather_bytes.observe(gather_bytes)
+        self.launch_edge_rows.observe(edge_rows)
+        self.launch_padding_waste.observe(padding_waste)
 
     def observe_stage(self, stage: str, seconds: float) -> None:
         """One per-stage sample (cached label child; see observe_request
@@ -718,11 +943,13 @@ def request_log(
     duration_s: float,
     trace_id: str = "",
     stages: Optional[dict] = None,
+    launch_ids: Optional[list] = None,
 ) -> None:
     """Structured per-request log line (ref: reqlog middleware
-    daemon.go:294), now carrying the trace id and the per-stage ms
-    breakdown. The isEnabledFor gate inside logger.info keeps this free
-    on the serve hot path at the default WARNING level."""
+    daemon.go:294), now carrying the trace id, the per-stage ms
+    breakdown, and the flight-recorder launch ids the request rode. The
+    isEnabledFor gate inside logger.info keeps this free on the serve
+    hot path at the default WARNING level."""
     if not logger.isEnabledFor(logging.INFO):
         return
     extra = {
@@ -735,6 +962,8 @@ def request_log(
         extra["trace_id"] = trace_id
     if stages:
         extra["stages_ms"] = _stages_ms(stages)
+    if launch_ids:
+        extra["launch_ids"] = list(launch_ids)
     logger.info("request handled", extra=extra)
 
 
@@ -746,10 +975,13 @@ def slow_query_log(
     duration_s: float,
     trace_id: str = "",
     stages: Optional[dict] = None,
+    launch_ids: Optional[list] = None,
 ) -> None:
     """Threshold-configurable slow-query line (`log.slow_query_ms`):
-    one structured WARNING with the trace id and per-stage ms, so a
-    single slow request is attributable without turning on full request
+    one structured WARNING with the trace id, per-stage ms, and the
+    launch ids of the device batches the request rode (join key into
+    `GET /admin/flightrec`), so a single slow request is attributable —
+    down to its exact launch record — without turning on full request
     logging. None threshold = disabled; fires at duration >= threshold."""
     if threshold_ms is None:
         return
@@ -758,12 +990,13 @@ def slow_query_log(
         return
     logger.warning(
         "slow request trace_id=%s transport=%s method=%r code=%s "
-        "duration_ms=%.3f stages_ms=%s",
+        "duration_ms=%.3f launch_ids=%s stages_ms=%s",
         trace_id or "-",
         transport,
         method,
         code,
         duration_ms,
+        list(launch_ids or ()),
         _stages_ms(stages),
     )
 
@@ -791,12 +1024,14 @@ def finish_request_telemetry(
     )
     if rode_pipeline and metrics is not None:
         metrics.observe_stage("transport", rt.stages["transport"])
+    launch_ids = getattr(rt, "launch_ids", None)
     request_log(
         transport, method, code, duration_s,
-        trace_id=rt.ctx.trace_id, stages=rt.stages,
+        trace_id=rt.ctx.trace_id, stages=rt.stages, launch_ids=launch_ids,
     )
     if not skip_slow:
         slow_query_log(
             threshold_ms, transport, method, code, duration_s,
             trace_id=rt.ctx.trace_id, stages=rt.stages,
+            launch_ids=launch_ids,
         )

@@ -39,6 +39,8 @@ from ..engine.kernel import (
     bounded_loop,
     dedupe_phase,
     dirty_lookup,
+    empty_launch_stats,
+    update_launch_stats,
 )
 from ..engine.snapshot import EMPTY
 from .sharding import _EXPAND_SHARDED_KEYS
@@ -182,10 +184,21 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
             # dedupe reports int32 cause codes (shared with the check
             # kernel); the expand state keeps a boolean flag
             needs_host = needs_host | (overflow_q > 0)
+            # launch counters: `write` and the dedupe output are derived
+            # from REPLICATED values, so the stats vector stays identical
+            # on every shard (sound under the replicated out_spec)
+            stats = update_launch_stats(
+                st.stats,
+                st.n_tasks,
+                (live & (depth >= 0)).sum(),
+                jnp.int32(0),
+                write.sum(),
+                n_new,
+            )
             return _ExpandState(
                 nt_q, nt_obj, nt_rel, nt_depth, n_new,
                 eb_pobj, eb_prel, eb_skind, eb_sa, eb_sb,
-                eb_count, needs_host, st.step + 1,
+                eb_count, needs_host, st.step + 1, stats,
             )
 
         pad = F - B
@@ -207,6 +220,7 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
             eb_count=jnp.zeros(B, jnp.int32),
             needs_host=init_needs_host,
             step=jnp.int32(0),
+            stats=empty_launch_stats(),
         )
 
         def cond_fn(st: _ExpandState):
@@ -224,13 +238,16 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
                 final.eb_sa, final.eb_sb,
             )
         ]
-        return (*merged, final.eb_count, root_has_children, final.needs_host)
+        return (
+            *merged, final.eb_count, root_has_children, final.needs_host,
+            final.stats,
+        )
 
     mapped = _shard_map(
         run,
         mesh=mesh,
         in_specs=(P(axis), P(), P(), P(), P(), P()),
-        out_specs=tuple([P()] * 8),
+        out_specs=tuple([P()] * 9),
         check_vma=False,
     )
     return jax.jit(mapped)

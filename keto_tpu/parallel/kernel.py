@@ -55,6 +55,7 @@ from ..engine.kernel import (
     program_lookup,
     run_bfs_loop,
     seed_state,
+    update_launch_stats,
 )
 from .sharding import (
     ShardedSnapshot,
@@ -146,9 +147,21 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
                 gathered, F, B
             )
             needs_host = jnp.maximum(needs_host, overflow2)
+            # launch counters: every operand is REPLICATED (post-psum
+            # hit, the all-gathered candidate set, the shared dedupe
+            # output), so the stats vector stays identical on all shards
+            # and the replicated out_spec is sound
+            stats = update_launch_stats(
+                st.stats,
+                st.n_tasks,
+                (live & (depth >= 0)).sum(),
+                hit.sum(),
+                gathered.valid.sum(),
+                n_new,
+            )
             return _State(
                 nt_q, nt_ctx, nt_obj, nt_rel, nt_depth, n_new,
-                ctx_hit, needs_host, *isl_state, st.step + 1,
+                ctx_hit, needs_host, *isl_state, st.step + 1, stats,
             )
 
         # loop construct per backend (engine/kernel.bounded_loop via
@@ -165,7 +178,7 @@ def _build_kernel(mesh: Mesh, axis: str, statics: tuple):
         run,
         mesh=mesh,
         in_specs=(P(axis), P(), P(), P(), P(), P(), P(), P(), P()),
-        out_specs=(P(), P(), P(), P(), P()),
+        out_specs=(P(), P(), P(), P(), P(), P()),
         check_vma=False,
     )
     return jax.jit(mapped)
@@ -287,7 +300,7 @@ def sharded_check_kernel(
     axis: str = "x",
 ):
     """Returns (ctx_hit, needs_host[B] cause codes, isl_parent, isl_pid,
-    n_isl); see engine/kernel.check_kernel."""
+    n_isl, stats); see engine/kernel.check_kernel."""
     assert set(sharded_tables) == set(_SHARDED_DEVICE_KEYS)
     assert set(replicated_tables) == set(_REPLICATED_KEYS) | set(
         _DELTA_DEVICE_KEYS

@@ -133,6 +133,7 @@ class Registry:
         self._metrics = None
         self._tracer = None
         self._profiler = None
+        self._flightrec = None
         self._watch_hub = None
         self._check_cache = None
         self._check_cache_built = False
@@ -270,6 +271,7 @@ class Registry:
                 auto_frontier=bool(
                     self.config.get("check.auto_frontier", True)
                 ),
+                flightrec=self.flight_recorder(),
             )
         if kind == "host":
             return _HostEngineFacade(
@@ -434,6 +436,59 @@ class Registry:
 
                 self._profiler = Profiler()
             return self._profiler
+
+    def flight_recorder(self):
+        """The process-wide launch flight recorder
+        (observability.FlightRecorder): ONE bounded ring shared by every
+        engine and both batching planes, so `GET /admin/flightrec` and
+        the failure auto-dumps see all launches in arrival order.
+        `observability.flightrec.{enabled,capacity}` configure it; ids
+        keep advancing when disabled so logs stay correlatable."""
+        with self._lock:
+            if self._flightrec is None:
+                from .observability import FlightRecorder
+
+                self._flightrec = FlightRecorder(
+                    enabled=bool(
+                        self.config.get("observability.flightrec.enabled", True)
+                    ),
+                    capacity=int(
+                        self.config.get("observability.flightrec.capacity", 256)
+                    ),
+                    metrics=self.metrics(),
+                )
+                # ambient device-path health stamped onto every entry;
+                # attribute reads only (no locks) — a provider must never
+                # contend with the serve path
+                self._flightrec.context_providers.append(
+                    self._flightrec_context
+                )
+            return self._flightrec
+
+    def _flightrec_context(self) -> dict:
+        """Breaker + armed-faults state for flight-recorder entries.
+        Reads the already-built breaker reference (never builds one —
+        recording must not construct services)."""
+        from . import faults as _faults
+
+        breaker = self._breaker
+        ctx: dict = {
+            "faults": sorted(_faults.armed_names()),
+        }
+        if breaker is not None:
+            ctx["breaker"] = breaker.state()
+        return ctx
+
+    def built_engines(self) -> dict:
+        """Engines that already exist (default network + tenant LRU),
+        WITHOUT building any — the admin plane reads state, it must not
+        instantiate device mirrors."""
+        with self._lock:
+            out: dict = {}
+            if self._engine is not None:
+                out[self.nid] = self._engine
+            out.update(self._nid_engines)
+            return out
 
 
 class _HostEngineFacade:

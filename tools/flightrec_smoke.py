@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Flight-recorder cycle smoke: CPU-runnable, CI-wired.
+
+Drives the whole launch-telemetry loop the way an operator would meet it:
+
+  1. serve under OPEN-LOOP load — a real daemon (memory store, TPU-engine
+     code path pinned to CPU, check cache off so every check rides a
+     device launch), driven by tools/load_gen.py as a subprocess in its
+     `--record` committed-artifact mode (the load_gen CPU smoke leg);
+  2. dump — `GET /admin/flightrec` on the metrics listener must return
+     well-formed entries: unique integer launch ids (the endpoint sorts
+     by id — two batching planes resolve out of order — so uniqueness,
+     not order, is the client-checkable invariant), the kernel counter
+     fields (steps / frontier / gather bytes / occupancy), and a built
+     HBM snapshot with nonzero table bytes;
+  3. correlate — every launch id the per-request logs attached
+     (observability.request_log `launch_ids`) must be a launch id the
+     ring recorded: the slow-query -> flight-record join key actually
+     joins.
+
+Exit 0 prints one JSON summary line; any violation exits 1.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+class _Capture(logging.Handler):
+    def __init__(self):
+        super().__init__(level=logging.INFO)
+        self.records: list[logging.LogRecord] = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        self.records.append(record)
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import bench
+    from keto_tpu.api.daemon import Daemon
+    from keto_tpu.config import Config
+    from keto_tpu.registry import Registry
+
+    namespaces, tuples, queries = bench.build_dataset()
+    cfg = Config({
+        "dsn": "memory",
+        # cache off: every check must ride a device launch so the ring
+        # fills; info logs on: request_log carries launch_ids
+        "check": {"engine": "tpu", "cache": {"enabled": False}},
+        "limit": {"max_read_depth": 5},
+        "log": {"level": "info"},
+        # exercises the schema'd flightrec keys end to end (capacity
+        # sized so no launch this smoke produces can be evicted before
+        # the correlation check reads the ring)
+        "observability": {"flightrec": {"enabled": True, "capacity": 8192}},
+        "serve": {
+            "read": {"host": "127.0.0.1", "port": 0},
+            "write": {"host": "127.0.0.1", "port": 0},
+            "metrics": {"host": "127.0.0.1", "port": 0},
+        },
+    })
+    cfg.set_namespaces(namespaces)
+    reg = Registry(cfg)
+    reg.relation_tuple_manager().write_relation_tuples(tuples)
+    reg.check_engine().check_batch(queries[:1])  # XLA warm-up
+    reg.check_engine().check_batch(queries[:64])
+
+    capture = _Capture()
+    logging.getLogger("keto_tpu").addHandler(capture)
+
+    out: dict = {}
+    d = Daemon(reg)
+    d.start()
+    try:
+        # 1. open-loop load via load_gen's committed-artifact mode
+        record_path = os.path.join(
+            tempfile.mkdtemp(prefix="flightrec_smoke"), "loadgen.json"
+        )
+        query_path = record_path.replace("loadgen.json", "queries.json")
+        with open(query_path, "w") as f:
+            json.dump([q.to_dict() for q in queries[:64]], f)
+        proc = subprocess.run(
+            [
+                sys.executable, os.path.join(REPO, "tools", "load_gen.py"),
+                "--addr", f"127.0.0.1:{d.read_port}",
+                "--rate", "150", "--seconds", "3", "--mode", "single",
+                "--queries", query_path, "--record", record_path,
+            ],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        out["load_gen_rc"] = proc.returncode
+        loadgen = {}
+        if proc.returncode == 0 and os.path.exists(record_path):
+            with open(record_path) as f:
+                loadgen = json.load(f)
+        out["load_gen_record"] = loadgen
+        load_ok = (
+            proc.returncode == 0
+            and loadgen.get("achieved_checks_per_s", 0) > 0
+            and loadgen.get("errors", 1) == 0
+        )
+        if not load_ok:
+            out["load_gen_stderr"] = proc.stderr[-2000:]
+
+        # 2. the dump endpoint: well-formed entries + HBM accounting
+        dump = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{d.metrics_port}/admin/flightrec", timeout=10
+        ))
+        entries = [e for e in dump.get("entries", []) if e.get("kind") == "check"]
+        ids = [e.get("launch_id") for e in entries]
+        out["ring_entries"] = len(entries)
+        well_formed = bool(entries) and all(
+            isinstance(e.get("launch_id"), int)
+            and isinstance(e.get("steps"), int)
+            and e.get("steps") >= 1
+            and 0.0 < e.get("occupancy", 0) <= 1.0
+            and e.get("gather_bytes_est", 0) > 0
+            and e.get("frontier_max", 0) >= 1
+            for e in entries
+        )
+        # the dump route returns entries sorted by launch_id, so an
+        # ordering assertion here would be tautological — uniqueness is
+        # the invariant an HTTP client can actually falsify
+        ids_unique = bool(ids) and len(set(ids)) == len(ids)
+        hbm_ok = any(
+            v.get("built") and v.get("total_bytes", 0) > 0
+            and v.get("staleness_versions", -1) >= 0
+            for v in dump.get("hbm", {}).values()
+        )
+
+        # 3. request-log launch ids all resolve to ring entries
+        logged_ids: set[int] = set()
+        logged_requests = 0
+        for rec in capture.records:
+            rid = getattr(rec, "launch_ids", None)
+            if rid:
+                logged_requests += 1
+                logged_ids.update(rid)
+        ring_ids = set(ids)
+        unmatched = sorted(logged_ids - ring_ids)
+        out["logged_requests_with_launch_ids"] = logged_requests
+        out["logged_launch_ids"] = len(logged_ids)
+        out["unmatched_launch_ids"] = unmatched[:10]
+        correlate_ok = logged_requests > 0 and not unmatched
+
+        out["ok"] = bool(
+            load_ok and well_formed and ids_unique and hbm_ok
+            and correlate_ok
+        )
+        out.update({
+            "well_formed": well_formed,
+            "ids_unique": ids_unique,
+            "hbm_ok": hbm_ok,
+            "correlate_ok": correlate_ok,
+        })
+    finally:
+        logging.getLogger("keto_tpu").removeHandler(capture)
+        d.stop()
+    print(json.dumps(out))
+    return 0 if out.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,10 @@ def main() -> int:
     ap.add_argument("--queries", default=None,
                     help="JSON file of relation tuples; default: the "
                          "bench dataset's query mix")
+    ap.add_argument("--record", default=None, metavar="OUT_JSON",
+                    help="also write the result record to this file — "
+                         "the committed-artifact mode (saturation curves "
+                         "land in the repo, not just a terminal scroll)")
     args = ap.parse_args()
 
     from keto_tpu.api import ReadClient, open_channel
@@ -143,6 +147,10 @@ def main() -> int:
             "lat_p99_ms": round(float(np.percentile(a, 99)), 2),
         })
     print(json.dumps(out))
+    if args.record:
+        with open(args.record, "w") as f:
+            json.dump(out, f, indent=2, sort_keys=True)
+            f.write("\n")
     return 0
 
 

@@ -341,6 +341,59 @@ def scenario_degraded_paths(out: dict) -> bool:
         d.stop()
 
 
+def scenario_flightrec(out: dict) -> bool:
+    """Flight recorder under failure: healthy launches land in the ring
+    (well-formed entries with counters + unique launch ids on
+    GET /admin/flightrec), and a device-path failure AUTO-DUMPS the ring
+    (keto_tpu_flightrec_dumps_total{reason="device"} advances) while the
+    riders still answer correctly from the host oracle."""
+    from keto_tpu import faults
+
+    d = build_daemon({})
+    try:
+        # a few healthy launches populate the ring
+        if not check_answers_match_oracle(d, out, "flightrec_warm", n_rounds=1):
+            out["flightrec_ok"] = False
+            return False
+        dump = json.load(urllib.request.urlopen(
+            f"http://127.0.0.1:{d.metrics_port}/admin/flightrec", timeout=10
+        ))
+        entries = dump.get("entries", [])
+        ids = [e.get("launch_id") for e in entries if e.get("kind") == "check"]
+        well_formed = bool(entries) and all(
+            isinstance(e.get("launch_id"), int)
+            and "steps" in e and "occupancy" in e and "gather_bytes_est" in e
+            for e in entries
+            if e.get("kind") == "check"
+        )
+        # dump route pre-sorts by launch_id; uniqueness is the real check
+        ids_unique = bool(ids) and len(set(ids)) == len(ids)
+        hbm_ok = any(
+            v.get("built") and v.get("total_bytes", 0) > 0
+            for v in dump.get("hbm", {}).values()
+        )
+        # device-path failure: riders host-serve correctly AND the ring
+        # auto-dumps (the dump evidence is the counter + the log line)
+        faults.set_fault("device_launch", error="device died")
+        failed_ok = check_answers_match_oracle(
+            d, out, "flightrec_failure", n_rounds=1
+        )
+        faults.clear()
+        text = scrape(d)
+        dumped = 'keto_tpu_flightrec_dumps_total{reason="device"}' in text
+        out["flightrec_entries"] = len(entries)
+        out["flightrec_ids_unique"] = ids_unique
+        out["flightrec_dumped_on_failure"] = dumped
+        out["flightrec_hbm_ok"] = hbm_ok
+        out["flightrec_ok"] = (
+            well_formed and ids_unique and hbm_ok and failed_ok and dumped
+        )
+        return out["flightrec_ok"]
+    finally:
+        faults.clear()
+        d.stop()
+
+
 def main() -> int:
     argparse.ArgumentParser(description=__doc__).parse_args()
 
@@ -352,7 +405,7 @@ def main() -> int:
     ok = True
     for scenario in (
         scenario_deadline, scenario_shed, scenario_breaker,
-        scenario_degraded_paths,
+        scenario_degraded_paths, scenario_flightrec,
     ):
         ok = scenario(out) and ok
     out["ok"] = ok
