@@ -881,12 +881,15 @@ def _stage_summary(metrics) -> dict:
     }
 
 
-def bench_served(namespaces, tuples, queries) -> dict:
+def bench_served(namespaces, tuples, queries, serve_workers: int = 1) -> dict:
     """Served path per BASELINE.md: a real daemon (direct gRPC listener +
     batcher + device engine) under concurrent gRPC clients; per-REQUEST
     latency percentiles, not per-batch. The direct listener (serve.read.
     grpc) skips the cmux-parity byte splice — the muxed port remains the
-    wire-parity default, this is the measured high-throughput path."""
+    wire-parity default, this is the measured high-throughput path.
+    `serve_workers` >= 2 runs the replica group (api/replica.py): the
+    record then carries per-worker QPS/occupancy so 1-vs-N comparisons
+    are first-class in the artifact."""
     import os as _os
     import threading
 
@@ -912,6 +915,7 @@ def bench_served(namespaces, tuples, queries) -> dict:
                              "grpc": grpc_cfg},
                     "write": {"host": "127.0.0.1", "port": 0},
                     "metrics": {"host": "127.0.0.1", "port": 0},
+                    "check": {"workers": max(int(serve_workers), 1)},
                 },
             }
         )
@@ -1102,6 +1106,24 @@ def bench_served(namespaces, tuples, queries) -> dict:
         served_launches = summarize_launches(
             daemon.registry.flight_recorder().entries()
         )
+        # replica mode: the per-worker answered-checks breakdown (the
+        # plain-int twin of worker_checks_total) — 1-vs-N comparisons
+        # read occupancy skew straight from the artifact
+        worker_breakdown = None
+        if daemon._group is not None:
+            group = daemon._group
+            counts = {
+                str(w.worker_id): int(w.checks_answered)
+                for w in group.workers
+            }
+            total = sum(counts.values()) or 1
+            worker_breakdown = {
+                "checks": counts,
+                "occupancy": {
+                    k: round(v / total, 4) for k, v in counts.items()
+                },
+                "hedge_stats": group.stats()["hedge"],
+            }
     finally:
         daemon.stop()
 
@@ -1124,7 +1146,14 @@ def bench_served(namespaces, tuples, queries) -> dict:
     except Exception as e:  # the aio leg must never sink the bench line
         aio = {"error": f"{type(e).__name__}: {e}"}
 
-    out = {"host_cores": len(_os.sched_getaffinity(0))}
+    out = {
+        "host_cores": len(_os.sched_getaffinity(0)),
+        # 1-vs-N replica comparisons are first-class in the artifact:
+        # every served leg records how many workers answered it
+        "serve_workers": max(int(serve_workers), 1),
+    }
+    if worker_breakdown is not None:
+        out["served_worker_breakdown"] = worker_breakdown
     if stage_ms:
         out["served_stage_ms"] = stage_ms
     if served_launches:
@@ -1203,6 +1232,14 @@ def main() -> int:
     ap.add_argument("--probe-attempts", type=int, default=2)
     ap.add_argument("--skip-serve", action="store_true")
     ap.add_argument(
+        "--serve-workers", type=int,
+        default=int(os.environ.get("KETO_BENCH_SERVE_WORKERS", 1)),
+        help="replica serve workers for the served legs "
+             "(serve.check.workers; 1 = the single-stack daemon) — the "
+             "BENCH json records serve_workers + the per-worker "
+             "QPS/occupancy breakdown so 1-vs-N compares in-artifact",
+    )
+    ap.add_argument(
         "--ab-flightrec", action="store_true",
         help="run ONLY the flight-recorder counter-overhead A/B leg "
              "(recorder on vs off QPS + non-degeneracy contrasts) and "
@@ -1279,7 +1316,12 @@ def main() -> int:
         record.update(bench_watch())
 
         if not args.skip_serve:
-            record.update(bench_served(namespaces, tuples, queries))
+            record.update(
+                bench_served(
+                    namespaces, tuples, queries,
+                    serve_workers=args.serve_workers,
+                )
+            )
 
         record["device"] = str(jax.devices()[0])
         print(json.dumps(record))

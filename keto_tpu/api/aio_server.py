@@ -495,9 +495,15 @@ class _AioReadServices:
     shared _Services bodies on a small executor; Version/Health answer
     in-loop. One behavior surface with the threaded plane."""
 
-    def __init__(self, services: _Services, batcher: AioCheckBatcher):
+    def __init__(self, services: _Services, batcher: AioCheckBatcher,
+                 worker=None):
         self._svc = services
         self._batcher = batcher
+        # replica mode: the ServeWorker this listener belongs to (worker
+        # 0 — the aio plane stays a single loop). Check applies the
+        # snaptoken routing rule; hedging rides the threaded plane
+        # (api/replica.py replica_check_async).
+        self._worker = worker
         self._blocking = ThreadPoolExecutor(
             max_workers=4, thread_name_prefix="keto-aio-blocking"
         )
@@ -560,21 +566,35 @@ class _AioReadServices:
             t = self._svc._check_tuple(req)
             self._svc.registry.validate_namespaces(t)
             nid = self._svc._nid(context)
-            # store-version read + token enforcement are dict/counter
-            # reads — fine in-loop (no device or SQL round-trip on the
-            # memory manager; sqlite's counter SELECT is ~10 us)
-            version = self._svc._enforce_snaptoken(req.snaptoken, nid)
             max_depth = int(req.max_depth)
-            # serve fast path (api/check_cache.py): a hit answers
-            # in-loop before the batcher — no executor hop, no
-            # assemble/dispatch/device stages; the lookup is one lock +
-            # two dict ops, loop-safe like the version read above
-            from .check_cache import cached_check_async
+            if self._worker is not None:
+                # replica mode: the routing rule's fast path (applied
+                # version satisfies the token) stays entirely in-loop;
+                # catch-up holds and fresh-worker routing run on the
+                # blocking executor (api/replica.py)
+                from .replica import replica_check_async
 
-            res = await cached_check_async(
-                self._svc.registry, self._batcher, nid, t, max_depth,
-                version, current_request_trace(),
-            )
+                res, version = await replica_check_async(
+                    self._worker, self._batcher, nid, t, max_depth,
+                    req.snaptoken, current_request_trace(),
+                    asyncio.get_running_loop(), self._blocking,
+                )
+            else:
+                # store-version read + token enforcement are dict/counter
+                # reads — fine in-loop (no device or SQL round-trip on
+                # the memory manager; sqlite's counter SELECT is ~10 us)
+                version = self._svc._enforce_snaptoken(req.snaptoken, nid)
+                # serve fast path (api/check_cache.py): a hit answers
+                # in-loop before the batcher — no executor hop, no
+                # assemble/dispatch/device stages; the lookup is one
+                # lock + two dict ops, loop-safe like the version read
+                # above
+                from .check_cache import cached_check_async
+
+                res = await cached_check_async(
+                    self._svc.registry, self._batcher, nid, t, max_depth,
+                    version, current_request_trace(),
+                )
             if res.error is not None:
                 raise res.error
             return pb.CheckResponse(
@@ -777,10 +797,12 @@ class AioReadServer:
     returns the port, stop() drains."""
 
     def __init__(self, registry, host: str, port: int,
-                 pipeline_depth: int = 4, window_s: float = 0.002):
+                 pipeline_depth: int = 4, window_s: float = 0.002,
+                 worker=None):
         self.registry = registry
         self.host = host
         self.port = port
+        self.worker = worker  # replica ServeWorker | None
         self.bound_port: int | None = None
         self._pipeline_depth = pipeline_depth
         self._window_s = window_s
@@ -832,7 +854,9 @@ class AioReadServer:
             flightrec=self.registry.flight_recorder(),
         )
         self.batcher.start()
-        self._services = _AioReadServices(services, self.batcher)
+        self._services = _AioReadServices(
+            services, self.batcher, worker=self.worker
+        )
         server = grpc.aio.server()
         server.add_generic_rpc_handlers(tuple(_aio_handlers(self._services)))
         self.bound_port = server.add_insecure_port(f"{self.host}:{self.port}")

@@ -195,6 +195,8 @@ class CheckBatcher:
         device_timeout_ms: float | None = None,
         breaker=None,
         flightrec=None,
+        pending_total=None,
+        drain_ways: int = 1,
     ):
         # per-request tenancy: batches are grouped by nid and dispatched
         # to that tenant's engine (ref: ketoctx Contextualizer,
@@ -248,6 +250,13 @@ class CheckBatcher:
         self.max_queue = int(max_queue) if max_queue else 0
         self._pending = 0
         self._pending_mu = threading.Lock()
+        # replica group wiring: `pending_total` reports the GROUP's
+        # admitted-but-unresolved count (Retry-After drain estimates must
+        # reflect group-wide load, not one worker's queue) and
+        # `drain_ways` how many batchers drain it in parallel; solo
+        # batchers keep the local count and 1 way
+        self._pending_total = pending_total
+        self._drain_ways = max(int(drain_ways), 1)
         # device-path resilience: launch watchdog budget + shared breaker
         # (serve.check.device_timeout_ms / serve.check.breaker.*)
         self.device_timeout_s = (
@@ -284,8 +293,12 @@ class CheckBatcher:
     def _queue_delay_estimate_s(self, pending: int) -> float:
         """Retry-after hint for a shed request: how long the currently
         admitted work plausibly takes to drain (batches of max_batch, one
-        window each) — a heuristic floor, never a promise."""
-        batches = pending // max(self.max_batch, 1) + 1
+        window each) — a heuristic floor, never a promise. In a replica
+        group the numerator is the GROUP-wide pending count and the
+        denominator scales by how many batchers drain in parallel."""
+        if self._pending_total is not None:
+            pending = self._pending_total()
+        batches = pending // max(self.max_batch * self._drain_ways, 1) + 1
         return max(batches * max(self.window_s, 0.001), 0.05)
 
     def admit(self, deadline=None) -> None:
@@ -341,26 +354,44 @@ class CheckBatcher:
         state's covered_version, plumbed through check_batch_resolve_v)
         or None when the evaluation path cannot pin one (host engine,
         host-replayed rider) — the check cache's store contract."""
+        return self.wait_pending(self.submit(tuple, max_depth, nid, rt), rt)
+
+    def submit(self, tuple, max_depth: int = 0, nid=None, rt=None) -> _Pending:
+        """Enqueue one check WITHOUT blocking on its result; returns the
+        _Pending whose `future` resolves to (CheckResult, version).
+        The non-blocking half of check_versioned — the replica plane's
+        hedging needs future-level access so two rides can race."""
         if self._closed:
             # typed drain shed + embedder `except RuntimeError` compat
             # (tri-plane parity with AioCheckBatcher.check_versioned)
             raise BatcherClosedError(retry_after_s=1.0)
         # atomic admission bound: check-and-increment under one lock so
         # concurrent callers can never push past max_queue (the
-        # acceptance property "queue never grows past max_queue")
+        # acceptance property "queue never grows past max_queue"). The
+        # shed's retry-after estimate is computed AFTER releasing the
+        # lock: in a replica group it reads every worker's pending count
+        # — including this batcher's own non-reentrant _pending_mu
+        shed_pending = None
         with self._pending_mu:
             if self.max_queue and self._pending >= self.max_queue:
-                self._count_shed()
-                raise OverloadedError(
-                    "check queue is full",
-                    retry_after_s=self._queue_delay_estimate_s(self._pending),
-                )
-            self._pending += 1
+                shed_pending = self._pending
+            else:
+                self._pending += 1
+        if shed_pending is not None:
+            self._count_shed()
+            raise OverloadedError(
+                "check queue is full",
+                retry_after_s=self._queue_delay_estimate_s(shed_pending),
+            )
         p = _Pending(tuple, max_depth, nid, rt, time.perf_counter())
         p.future.add_done_callback(self._dec_pending)
         self._queue.put(p)
         if self._depth_gauge is not None:
             self._depth_gauge.set(self._queue.qsize())
+        return p
+
+    def wait_pending(self, p: _Pending, rt=None):
+        """Block on one submitted pending, bounded by `rt.deadline`."""
         deadline = rt.deadline if rt is not None else None
         if deadline is None:
             return p.future.result()
@@ -430,6 +461,12 @@ class CheckBatcher:
         slot-reclamation half of the contract)."""
         live: list[_Pending] = []
         for p in group:
+            if p.future.done():
+                # already answered elsewhere — a cancelled hedge loser
+                # (the winning ride answered the caller) must not occupy
+                # a batch slot; its pending count was released by the
+                # future's done callback
+                continue
             dl = p.rt.deadline if p.rt is not None else None
             if dl is not None and dl.expired():
                 if self.metrics is not None and not p.dl_counted:

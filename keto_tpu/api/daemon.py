@@ -33,14 +33,32 @@ class PortMux:
     With `ssl_context` the mux TERMINATES TLS (serve.<kind>.tls config,
     ref: daemon.go:289-349): the preface sniff and the loopback splice
     run over the decrypted stream, so both gRPC and REST backends stay
-    plaintext-internal."""
+    plaintext-internal.
 
-    def __init__(self, host: str, port: int, grpc_addr, http_addr, ssl_context=None):
-        self.grpc_addr = grpc_addr
-        self.http_addr = http_addr
+    Replica mode (serve.check.workers >= 2): `grpc_addr`/`http_addr`
+    accept LISTS of parallel backends — one (grpc, http) pair per serve
+    worker — and each accepted connection round-robins across them (the
+    lightweight FRONT MUX for platforms without SO_REUSEPORT). Where
+    SO_REUSEPORT exists, the daemon instead binds one single-backend mux
+    per worker on the same public port (`reuse_port=True`) and the
+    kernel balances accepts — no extra splice hop."""
+
+    def __init__(self, host: str, port: int, grpc_addr, http_addr,
+                 ssl_context=None, reuse_port: bool = False):
+        self.grpc_addrs = (
+            list(grpc_addr) if isinstance(grpc_addr, list) else [grpc_addr]
+        )
+        self.http_addrs = (
+            list(http_addr) if isinstance(http_addr, list) else [http_addr]
+        )
+        assert len(self.grpc_addrs) == len(self.http_addrs)
+        import itertools
+
+        self._rr = itertools.count()
         self.ssl_context = ssl_context
         self._listener = socket.create_server(
-            (host, port), family=socket.AF_INET, backlog=128, reuse_port=False
+            (host, port), family=socket.AF_INET, backlog=128,
+            reuse_port=reuse_port,
         )
         self._listener.settimeout(0.5)
         self._stop = threading.Event()
@@ -122,8 +140,12 @@ class PortMux:
             if not head:
                 conn.close()
                 return
+            # one backend PAIR per connection (round-robin): in front-mux
+            # replica mode every worker owns a parallel (grpc, http) pair
+            idx = next(self._rr) % len(self.grpc_addrs)
             backend_addr = (
-                self.grpc_addr if head.startswith(_H2_PREFACE) else self.http_addr
+                self.grpc_addrs[idx]
+                if head.startswith(_H2_PREFACE) else self.http_addrs[idx]
             )
             backend = socket.create_connection(backend_addr)
             if consumed:
@@ -209,10 +231,46 @@ class Daemon:
         self.metrics_addr = cfg.metrics_api_address()
         if host is not None:
             self.read_addr.host = self.write_addr.host = self.metrics_addr.host = host
+        self.n_workers = max(int(cfg.get("serve.check.workers", 1)), 1)
+        if self.n_workers > 1:
+            # replica serving group (api/replica.py): N full serve stacks
+            # over ONE device engine; each worker owns a batcher + cache
+            # + replica view, and the Retry-After drain estimate scales
+            # to group-wide pending across N parallel drains
+            from .replica import ReplicaGroup
+
+            self._group = ReplicaGroup(
+                registry, self.n_workers,
+                make_batcher=lambda group: self._make_batcher(
+                    pending_total=group.group_pending,
+                    drain_ways=self.n_workers,
+                ),
+                make_cache=self._make_worker_cache,
+            )
+            registry.replica_group = self._group
+            # compat alias: tools/tests address `daemon.batcher`; worker
+            # 0's is the group's first among equals
+            self.batcher = self._group.workers[0].batcher
+        else:
+            self._group = None
+            self.batcher = self._make_batcher()
+        self._grpc_read = None
+        self._grpc_write = None
+        self.read_grpc_port = None
+        self.write_grpc_port = None
+        self._rest = {}
+        self._muxes = {}
+        self._worker_grpc: list = []
+        self._worker_rest: list = []
+        self._started = False
+
+    def _make_batcher(self, pending_total=None, drain_ways: int = 1):
         # pipeline depth bounds launched-but-unresolved device batches
         # (in-flight cap = 2x depth); raise it for remote/tunneled TPUs
         # where the device round-trip dwarfs per-batch compute
-        self.batcher = CheckBatcher(
+        registry = self.registry
+        cfg = registry.config
+        return CheckBatcher(
             registry.check_engine(),
             engine_resolver=registry.check_engine,
             pipeline_depth=int(cfg.get("check.pipeline_depth", 2)),
@@ -227,14 +285,29 @@ class Daemon:
             device_timeout_ms=cfg.get("serve.check.device_timeout_ms"),
             breaker=registry.circuit_breaker(),
             flightrec=registry.flight_recorder(),
+            pending_total=pending_total,
+            drain_ways=drain_ways,
         )
-        self._grpc_read = None
-        self._grpc_write = None
-        self.read_grpc_port = None
-        self.write_grpc_port = None
-        self._rest = {}
-        self._muxes = {}
-        self._started = False
+
+    def _make_worker_cache(self):
+        """One replica-LOCAL check cache per serve worker (None when
+        check.cache.enabled is false). Invalidation rides the worker's
+        own changelog tail (ReplicaView) instead of the registry
+        singleton's commit hook; the version gate carries correctness
+        either way."""
+        registry = self.registry
+        cfg = registry.config
+        if not bool(cfg.get("check.cache.enabled", True)):
+            return None
+        from .check_cache import CheckCache
+
+        return CheckCache(
+            registry.relation_tuple_manager(),
+            cfg,
+            max_entries=int(cfg.get("check.cache.max_entries", 65536)),
+            ttl_s=float(cfg.get("check.cache.ttl_s", 0.0)),
+            metrics=registry.metrics(),
+        )
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -246,55 +319,60 @@ class Daemon:
 
         configure_logging(reg.config)
         # internal loopback backends (ephemeral ports)
-        self._grpc_read = build_grpc_server(reg, write=False, batcher=self.batcher)
         self._grpc_write = build_grpc_server(reg, write=True)
-        grpc_read_port = self._grpc_read.add_insecure_port("127.0.0.1:0")
         grpc_write_port = self._grpc_write.add_insecure_port("127.0.0.1:0")
-        # optional DIRECT public gRPC listeners (serve.<kind>.grpc): gRPC
-        # traffic skips the mux's preface sniff + two-socket byte splice —
-        # on a 1-core host the splice alone costs ~1/3 of the serve
-        # ceiling. The muxed port stays for reference wire parity (one
-        # port, both protocols); this is the high-throughput side door.
-        cfg0 = reg.config
-        if cfg0.get("serve.read.grpc") and cfg0.get("serve.read.grpc.aio"):
-            # asyncio read plane for the direct listener: all RPCs run as
-            # coroutines on one loop thread — no per-request cross-thread
-            # handoff (api/aio_server.py); the muxed port stays threaded
-            # for wire parity
-            from .aio_server import AioReadServer
-
-            g = cfg0.get("serve.read.grpc")
-            self._aio_read = AioReadServer(
-                reg, g.get("host", "127.0.0.1"), int(g.get("port", 0)),
-                pipeline_depth=int(cfg0.get("check.pipeline_depth", 2)),
-                window_s=float(cfg0.get("check.batch_window_ms", 2.0)) / 1e3,
-            )
-            self.read_grpc_port = self._aio_read.start()
-        else:
-            self._aio_read = None
-            self.read_grpc_port = self._add_direct_grpc("read", self._grpc_read)
         self.write_grpc_port = self._add_direct_grpc("write", self._grpc_write)
-        self._grpc_read.start()
         self._grpc_write.start()
+        cfg = cfg0 = reg.config
+        if self._group is not None:
+            self._start_replica_read_plane()
+        else:
+            self._grpc_read = build_grpc_server(
+                reg, write=False, batcher=self.batcher
+            )
+            grpc_read_port = self._grpc_read.add_insecure_port("127.0.0.1:0")
+            # optional DIRECT public gRPC listeners (serve.<kind>.grpc):
+            # gRPC traffic skips the mux's preface sniff + two-socket
+            # byte splice — on a 1-core host the splice alone costs ~1/3
+            # of the serve ceiling. The muxed port stays for reference
+            # wire parity (one port, both protocols); this is the
+            # high-throughput side door.
+            if cfg0.get("serve.read.grpc") and cfg0.get("serve.read.grpc.aio"):
+                # asyncio read plane for the direct listener: all RPCs
+                # run as coroutines on one loop thread — no per-request
+                # cross-thread handoff (api/aio_server.py); the muxed
+                # port stays threaded for wire parity
+                from .aio_server import AioReadServer
 
-        cfg = reg.config
-        self._rest["read"] = RESTServer(
-            reg, "read", "127.0.0.1", 0, batcher=self.batcher,
-            cors=cfg.get("serve.read.cors"),
-        )
+                g = cfg0.get("serve.read.grpc")
+                self._aio_read = AioReadServer(
+                    reg, g.get("host", "127.0.0.1"), int(g.get("port", 0)),
+                    pipeline_depth=int(cfg0.get("check.pipeline_depth", 2)),
+                    window_s=float(cfg0.get("check.batch_window_ms", 2.0)) / 1e3,
+                )
+                self.read_grpc_port = self._aio_read.start()
+            else:
+                self._aio_read = None
+                self.read_grpc_port = self._add_direct_grpc(
+                    "read", self._grpc_read
+                )
+            self._grpc_read.start()
+            self._rest["read"] = RESTServer(
+                reg, "read", "127.0.0.1", 0, batcher=self.batcher,
+                cors=cfg.get("serve.read.cors"),
+            )
+            self._rest["read"].start()
+            self._muxes["read"] = PortMux(
+                self.read_addr.host,
+                self.read_addr.port,
+                ("127.0.0.1", grpc_read_port),
+                ("127.0.0.1", self._rest["read"].port),
+                ssl_context=self._tls_context("read"),
+            )
         self._rest["write"] = RESTServer(
             reg, "write", "127.0.0.1", 0, cors=cfg.get("serve.write.cors")
         )
-        for s in self._rest.values():
-            s.start()
-
-        self._muxes["read"] = PortMux(
-            self.read_addr.host,
-            self.read_addr.port,
-            ("127.0.0.1", grpc_read_port),
-            ("127.0.0.1", self._rest["read"].port),
-            ssl_context=self._tls_context("read"),
-        )
+        self._rest["write"].start()
         self._muxes["write"] = PortMux(
             self.write_addr.host,
             self.write_addr.port,
@@ -322,6 +400,102 @@ class Daemon:
             self.write_addr.host, self.write_port,
             self.metrics_addr.host, self.metrics_port,
         )
+
+    def _start_replica_read_plane(self) -> None:
+        """Replica mode (serve.check.workers >= 2): one full read stack
+        PER WORKER — its own gRPC server, REST listener, and public mux
+        accept loop — all sharing the one device engine through the
+        batchers' existing submit path.
+
+        Listener strategy: where the platform supports SO_REUSEPORT
+        (Linux), every worker binds its own socket on the SAME public
+        read port and the kernel balances accepted connections across
+        them; the direct gRPC listeners share their port the same way
+        (grpc.so_reuseport). Platforms without it get ONE front mux
+        whose accept loop round-robins connections across the workers'
+        loopback backends."""
+        reg = self.registry
+        cfg = reg.config
+        group = self._group
+        tls = self._tls_context("read")
+        reuseport = hasattr(socket, "SO_REUSEPORT")
+        g = cfg.get("serve.read.grpc")
+        aio = bool(g and cfg.get("serve.read.grpc.aio"))
+        backends: list[tuple] = []  # (grpc_addr, http_addr) per worker
+        direct_port: int | None = None
+        for w in group.workers:
+            server = build_grpc_server(
+                reg, write=False, batcher=w.batcher, worker=w,
+                so_reuseport=reuseport,
+            )
+            loop_port = server.add_insecure_port("127.0.0.1:0")
+            if g and not aio:
+                # direct public read-gRPC: worker 0 binds the configured
+                # port (resolving 0 to an ephemeral one), the rest join
+                # it via SO_REUSEPORT — or bind their own ephemeral port
+                # where the platform lacks it (recorded per worker)
+                if direct_port is None:
+                    want = int(g.get("port", 0))
+                elif reuseport:
+                    want = direct_port
+                else:
+                    want = 0  # no SO_REUSEPORT: own ephemeral port
+                addr = f"{g.get('host', '127.0.0.1')}:{want}"
+                bound = server.add_insecure_port(addr)
+                if direct_port is None:
+                    direct_port = bound
+                w.ports["grpc_direct"] = bound
+            server.start()
+            rest = RESTServer(
+                reg, "read", "127.0.0.1", 0, batcher=w.batcher,
+                cors=cfg.get("serve.read.cors"), worker=w,
+            )
+            rest.start()
+            self._worker_grpc.append(server)
+            self._worker_rest.append(rest)
+            w.ports["grpc_loopback"] = loop_port
+            w.ports["rest"] = rest.port
+            backends.append(
+                (("127.0.0.1", loop_port), ("127.0.0.1", rest.port))
+            )
+        if aio:
+            # the no-handoff asyncio listener stays single (one loop
+            # thread): worker 0 owns it; routing consistency applies,
+            # hedging rides the threaded plane (api/replica.py)
+            from .aio_server import AioReadServer
+
+            self._aio_read = AioReadServer(
+                reg, g.get("host", "127.0.0.1"), int(g.get("port", 0)),
+                pipeline_depth=int(cfg.get("check.pipeline_depth", 2)),
+                window_s=float(cfg.get("check.batch_window_ms", 2.0)) / 1e3,
+                worker=group.workers[0],
+            )
+            self.read_grpc_port = self._aio_read.start()
+        else:
+            self._aio_read = None
+            self.read_grpc_port = direct_port
+        if reuseport:
+            first = PortMux(
+                self.read_addr.host, self.read_addr.port,
+                backends[0][0], backends[0][1],
+                ssl_context=tls, reuse_port=True,
+            )
+            self._muxes["read"] = first
+            for i, (ga, ha) in enumerate(backends[1:], start=1):
+                self._muxes[f"read_w{i}"] = PortMux(
+                    self.read_addr.host, first.port, ga, ha,
+                    ssl_context=tls, reuse_port=True,
+                )
+        else:
+            self._muxes["read"] = PortMux(
+                self.read_addr.host, self.read_addr.port,
+                [b[0] for b in backends], [b[1] for b in backends],
+                ssl_context=tls,
+            )
+        for i, w in enumerate(group.workers):
+            w.ports["mux"] = self._muxes[
+                "read" if (i == 0 or not reuseport) else f"read_w{i}"
+            ].port
 
     def _add_direct_grpc(self, kind: str, server) -> int | None:
         """Bind `server` on serve.<kind>.grpc as a second, unmuxed public
@@ -384,12 +558,15 @@ class Daemon:
         # balancers stop routing while stragglers get a clear signal
         self.registry.draining.set()
         # grace window: let admitted-but-unresolved checks finish (the
-        # batcher's pending count reaches zero) before closing listeners
+        # GROUP's pending count reaches zero — every worker's batcher)
+        # before closing listeners
         deadline = _time.monotonic() + grace
-        while _time.monotonic() < deadline and not self.batcher.idle():
+        idle = self._group.idle if self._group is not None else self.batcher.idle
+        while _time.monotonic() < deadline and not idle():
             _time.sleep(0.02)
         # end watch streams first so draining servers aren't pinned by
-        # parked subscriber threads
+        # parked subscriber threads (this also ends the replica views'
+        # changelog tails — the hub closes their subscriptions)
         if self.registry._watch_hub is not None:
             self.registry._watch_hub.stop()
         for m in self._muxes.values():
@@ -398,11 +575,21 @@ class Daemon:
             self._aio_read.stop(grace)
         if self._grpc_read is not None:
             self._grpc_read.stop(grace).wait(grace)
+        for s in self._worker_grpc:
+            s.stop(grace).wait(grace)
         if self._grpc_write is not None:
             self._grpc_write.stop(grace).wait(grace)
         for s in self._rest.values():
             s.stop()
-        self.batcher.close()
+        for s in self._worker_rest:
+            s.stop()
+        if self._group is not None:
+            for w in self._group.workers:
+                w.batcher.close()
+            # replica views + per-worker cache invalidation threads
+            self._group.close()
+        else:
+            self.batcher.close()
         # end the check cache's invalidation thread (daemon thread, but
         # a clean stop keeps test teardowns quiet)
         self.registry.close_check_cache()

@@ -123,9 +123,14 @@ def _metadata_dict(context) -> dict:
 class _Services:
     """The shared handler implementations behind both gRPC servers."""
 
-    def __init__(self, registry, batcher=None):
+    def __init__(self, registry, batcher=None, worker=None):
         self.registry = registry
         self.batcher = batcher
+        # replica mode (api/replica.py): the ServeWorker this server
+        # belongs to — Check rides the worker's snaptoken-routed
+        # cache/batcher path (with hedging) instead of the registry
+        # singletons; None = single-stack serving, exactly as before
+        self.worker = worker
         self.metrics = registry.metrics()
         # streaming RPCs (health Watch, tuple WatchService) pin one
         # sync-server worker thread each for their lifetime; ONE shared
@@ -245,18 +250,30 @@ class _Services:
         t = self._check_tuple(req)
         self.registry.validate_namespaces(t)
         nid = self._nid(context)
-        version = self._enforce_snaptoken(req.snaptoken, nid)
         max_depth = int(req.max_depth)
-        # serve fast path (api/check_cache.py): a hit returns before the
-        # batcher — no assemble/dispatch/device stages run, and the
-        # response (snaptoken included) is byte-identical to a miss at
-        # the same store version
-        from .check_cache import cached_check
+        if self.worker is not None:
+            # replica mode: snaptoken routing (hold for catch-up ->
+            # route to a fresh worker -> escalate, never stale) + the
+            # answering worker's cache/batcher with hedging; the
+            # response token is minted at the answering version
+            from .replica import replica_check
 
-        res = cached_check(
-            self.registry, self.batcher, nid, t, max_depth, version,
-            current_request_trace(),
-        )
+            res, version = replica_check(
+                self.worker, nid, t, max_depth, req.snaptoken,
+                current_request_trace(),
+            )
+        else:
+            version = self._enforce_snaptoken(req.snaptoken, nid)
+            # serve fast path (api/check_cache.py): a hit returns before
+            # the batcher — no assemble/dispatch/device stages run, and
+            # the response (snaptoken included) is byte-identical to a
+            # miss at the same store version
+            from .check_cache import cached_check
+
+            res = cached_check(
+                self.registry, self.batcher, nid, t, max_depth, version,
+                current_request_trace(),
+            )
         if res.error is not None:
             raise res.error
         return pb.CheckResponse(
@@ -646,16 +663,24 @@ def _service_handlers(services: _Services, write: bool):
 
 
 def build_grpc_server(
-    registry, *, write: bool, batcher=None, max_workers: int = 32
+    registry, *, write: bool, batcher=None, max_workers: int = 32,
+    worker=None, so_reuseport: bool | None = None,
 ) -> grpc.Server:
     """One gRPC server for the read (:4466) or write (:4467) API.
-    The caller binds ports and manages lifecycle (see daemon.py)."""
-    services = _Services(registry, batcher=batcher)
+    The caller binds ports and manages lifecycle (see daemon.py).
+    `worker` attaches the server to one replica ServeWorker;
+    `so_reuseport` pins the grpc.so_reuseport channel arg (replica
+    workers share one public direct port through it)."""
+    services = _Services(registry, batcher=batcher, worker=worker)
+    options = None
+    if so_reuseport is not None:
+        options = (("grpc.so_reuseport", 1 if so_reuseport else 0),)
     server = grpc.server(
         _futures.ThreadPoolExecutor(
             max_workers=max_workers,
             thread_name_prefix="keto-grpc-write" if write else "keto-grpc-read",
-        )
+        ),
+        options=options,
     )
     for h in _service_handlers(services, write=write):
         server.add_generic_rpc_handlers((h,))

@@ -74,6 +74,9 @@ PROFILING_STOP_ROUTE = "/admin/profiling/stop"
 # device introspection counters, launch ids (join key for slow-query
 # lines and typed batch errors), HBM/staleness accounting per built engine
 FLIGHTREC_ROUTE = "/admin/flightrec"
+# replica serving group (metrics listener): per-worker applied versions,
+# pending counts, listener ports, and the hedge policy's live state
+REPLICAS_ROUTE = "/admin/replicas"
 SPEC_ROUTE = "/.well-known/openapi.json"
 
 # route -> router kind, the ONE ownership table (consumed by the spec
@@ -97,6 +100,7 @@ ROUTE_KINDS = {
     PROFILING_ROUTE: "metrics",
     PROFILING_STOP_ROUTE: "metrics",
     FLIGHTREC_ROUTE: "metrics",
+    REPLICAS_ROUTE: "metrics",
 }
 
 
@@ -129,6 +133,7 @@ class _Handler(BaseHTTPRequestHandler):
     # members injected by make_handler_class
     registry = None
     batcher = None
+    worker = None  # replica ServeWorker (api/replica.py) | None
     kind = "read"  # read | write | metrics
     cors = None  # serve.<kind>.cors config dict (ref: daemon.go:289-349)
     watch_slots = None  # per-listener SSE watcher cap (make_handler_class)
@@ -340,6 +345,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return PROFILING_STOP_ROUTE, self._profiling_stop
             if method == "GET" and path == FLIGHTREC_ROUTE:
                 return FLIGHTREC_ROUTE, self._flightrec_dump
+            if method == "GET" and path == REPLICAS_ROUTE:
+                return REPLICAS_ROUTE, self._replicas_status
             return None
 
         if self.kind == "read":
@@ -440,12 +447,26 @@ class _Handler(BaseHTTPRequestHandler):
         # deadline ingestion + admission gate BEFORE any work: shed
         # requests answer a typed 429 (Retry-After attached), expired
         # ones a typed 504 — the same error surface the gRPC planes map
-        admit_check(self.registry, self.batcher, self._ingest_deadline())
+        rt = self._ingest_deadline()
+        admit_check(self.registry, self.batcher, rt)
         params = self._params()
         max_depth = _get_max_depth(params)
         t = self._check_tuple_from_request(method)
         nid = self._nid()
-        version = self._enforce_snaptoken(params.get("snaptoken", ""), nid)
+        token = params.get("snaptoken", "")
+        if self.worker is not None:
+            # replica mode: the snaptoken routing rule picks the
+            # answering worker and the version the response token is
+            # minted at (token parse/409 precedence matches the
+            # single-stack enforce path: before the namespace corner)
+            from .replica import resolve_version, serve_on
+
+            target, version = resolve_version(
+                self.worker.group, self.worker, nid, token, rt
+            )
+        else:
+            target = None
+            version = self._enforce_snaptoken(token, nid)
         token_hdr = [("X-Keto-Snaptoken", encode_snaptoken(version, nid))]
         try:
             self.registry.validate_namespaces(t)
@@ -454,16 +475,19 @@ class _Handler(BaseHTTPRequestHandler):
             code = 403 if mirror_status else 200
             self._json(code, {"allowed": False}, extra_headers=token_hdr)
             return
-        # serve fast path (api/check_cache.py): a hit returns before the
-        # batcher — no assemble/dispatch/device stages run, and the
-        # response (snaptoken included) is byte-identical to a miss at
-        # the same store version
-        from .check_cache import cached_check
+        if target is not None:
+            res = serve_on(target, nid, t, max_depth, version, rt)
+        else:
+            # serve fast path (api/check_cache.py): a hit returns before
+            # the batcher — no assemble/dispatch/device stages run, and
+            # the response (snaptoken included) is byte-identical to a
+            # miss at the same store version
+            from .check_cache import cached_check
 
-        res = cached_check(
-            self.registry, self.batcher, nid, t, max_depth, version,
-            getattr(self, "_rt", None),
-        )
+            res = cached_check(
+                self.registry, self.batcher, nid, t, max_depth, version,
+                rt,
+            )
         if res.error is not None:
             raise res.error
         code = 403 if (mirror_status and not res.allowed) else 200
@@ -824,6 +848,18 @@ class _Handler(BaseHTTPRequestHandler):
             "hbm": hbm,
         })
 
+    def _replicas_status(self) -> None:
+        """GET /admin/replicas: the replica serving group's live state —
+        per-worker applied store versions (the snaptoken routing rule's
+        input), admitted-but-unresolved counts, listener ports, and the
+        hedge policy's current quantile delay. {"workers": []} outside
+        replica mode (serve.check.workers unset or 1)."""
+        group = self.registry.replica_group
+        if group is None:
+            self._json(200, {"workers": [], "group_pending": 0})
+            return
+        self._json(200, group.stats())
+
     # -- write handlers -------------------------------------------------------
 
     def _create_relation(self) -> None:
@@ -908,7 +944,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
 
 
-def make_handler_class(registry, kind: str, batcher=None, cors=None):
+def make_handler_class(registry, kind: str, batcher=None, cors=None,
+                       worker=None):
     # one watcher-slot pool per listener, shared by every connection of
     # the handler class (the SSE analog of _Services._watch_slots)
     watch_slots = threading.BoundedSemaphore(
@@ -918,7 +955,7 @@ def make_handler_class(registry, kind: str, batcher=None, cors=None):
         f"KetoHTTP{kind.capitalize()}Handler",
         (_Handler,),
         {"registry": registry, "kind": kind, "batcher": batcher,
-         "cors": cors, "watch_slots": watch_slots},
+         "cors": cors, "watch_slots": watch_slots, "worker": worker},
     )
 
 
@@ -926,9 +963,11 @@ class RESTServer:
     """One HTTP listener (read, write, or metrics router)."""
 
     def __init__(
-        self, registry, kind: str, host: str, port: int, batcher=None, cors=None
+        self, registry, kind: str, host: str, port: int, batcher=None,
+        cors=None, worker=None,
     ):
-        handler = make_handler_class(registry, kind, batcher, cors=cors)
+        handler = make_handler_class(registry, kind, batcher, cors=cors,
+                                     worker=worker)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
         self.kind = kind

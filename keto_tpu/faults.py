@@ -41,12 +41,45 @@ class FaultInjected(RuntimeError):
 
 
 class FaultSpec:
-    __slots__ = ("stall_s", "error", "hits")
+    __slots__ = (
+        "stall_s", "error", "hits", "probability", "max_hits", "_rng", "_mu",
+    )
 
-    def __init__(self, stall_s: float = 0.0, error: Optional[str] = None):
+    def __init__(
+        self,
+        stall_s: float = 0.0,
+        error: Optional[str] = None,
+        probability: float = 1.0,
+        max_hits: Optional[int] = None,
+        seed: Optional[int] = None,
+    ):
         self.stall_s = float(stall_s or 0.0)
         self.error = error
+        # partial faults: `probability` injects on a fraction of hits (a
+        # FLAKY device path — the tail-latency shape request hedging
+        # exists for: p50 healthy, p99 eats the stall); `max_hits` bounds
+        # served injections (deterministic tests: exactly the first N
+        # launches stall). Both default to the old always-on behavior.
+        self.probability = min(max(float(probability), 0.0), 1.0)
+        self.max_hits = max_hits if max_hits is None else int(max_hits)
+        import random
+
+        self._rng = random.Random(seed)
         self.hits = 0  # injections served (test/smoke observable)
+        self._mu = threading.Lock()
+
+    def should_fire(self) -> bool:
+        """Atomically decide AND claim one injection (bumping `hits`):
+        concurrent launch threads can never push past `max_hits`, so the
+        'exactly the first N' deterministic-bound contract holds."""
+        with self._mu:
+            if self.max_hits is not None and self.hits >= self.max_hits:
+                return False
+            if (self.probability < 1.0
+                    and self._rng.random() >= self.probability):
+                return False
+            self.hits += 1
+            return True
 
 
 POINTS = ("device_launch", "store_read", "batch_corrupt")
@@ -56,15 +89,25 @@ _mu = threading.Lock()
 
 
 def set_fault(
-    point: str, stall_s: float = 0.0, error: Optional[str] = None
+    point: str,
+    stall_s: float = 0.0,
+    error: Optional[str] = None,
+    probability: float = 1.0,
+    max_hits: Optional[int] = None,
+    seed: Optional[int] = None,
 ) -> FaultSpec:
     """Arm one injection point; returns its spec (hits counter included).
-    A spec with neither stall nor error is a pure marker (batch_corrupt)."""
+    A spec with neither stall nor error is a pure marker (batch_corrupt);
+    `probability` < 1 makes the fault flaky (served on a fraction of
+    hits), `max_hits` bounds served injections (deterministic tests)."""
     if point not in POINTS:
         raise ValueError(
             f"unknown fault point {point!r}; known: {', '.join(POINTS)}"
         )
-    spec = FaultSpec(stall_s=stall_s, error=error)
+    spec = FaultSpec(
+        stall_s=stall_s, error=error, probability=probability,
+        max_hits=max_hits, seed=seed,
+    )
     with _mu:
         _SPECS[point] = spec
     return spec
@@ -91,11 +134,13 @@ def armed_names() -> list[str]:
 
 def inject(point: str) -> None:
     """Serve one injection: sleep the stall, then raise the error (both
-    optional). A disarmed point is one dict miss."""
+    optional). A disarmed point is one dict miss; a partial fault
+    (probability < 1 / max_hits reached) passes through untouched."""
     spec = _SPECS.get(point)
     if spec is None:
         return
-    spec.hits += 1
+    if not spec.should_fire():  # atomically claims the hit when it fires
+        return
     if spec.stall_s:
         time.sleep(spec.stall_s)
     if spec.error is not None:
@@ -105,7 +150,10 @@ def inject(point: str) -> None:
 def configure(text: str) -> None:
     """Parse the KETO_FAULTS format: comma-separated
     ``point=stall:<seconds>`` / ``point=error:<message>`` / ``point=on``
-    entries. Replaces the whole armed set."""
+    entries; a ``@<probability>`` suffix on a stall value makes the
+    fault flaky (``device_launch=stall:0.25@0.2`` stalls ~20% of
+    launches — the tail-latency shape the hedging smoke injects).
+    Replaces the whole armed set."""
     clear()
     for entry in (text or "").split(","):
         entry = entry.strip()
@@ -115,7 +163,11 @@ def configure(text: str) -> None:
         mode, _, value = spec.partition(":")
         name, mode = name.strip(), mode.strip()
         if mode == "stall":
-            set_fault(name, stall_s=float(value))
+            value, _, prob = value.partition("@")
+            set_fault(
+                name, stall_s=float(value),
+                probability=float(prob) if prob else 1.0,
+            )
         elif mode == "error":
             set_fault(name, error=value or "injected fault")
         elif mode == "on":

@@ -589,6 +589,60 @@ class Metrics:
             "this counter (embedders, bench, load tools)",
             registry=self.registry,
         )
+        # multi-replica serving plane (api/replica.py): N serve workers
+        # over one device engine, snaptoken-routed consistency, and
+        # deadline-budget-aware request hedging (Zanzibar §2.4.1/§4)
+        self.worker_checks_total = prom.Counter(
+            "keto_tpu_worker_checks_total",
+            "Check() requests answered per replica serve worker (replica "
+            "mode only, serve.check.workers >= 2) — the per-worker QPS "
+            "breakdown the bench records; routed requests count on the "
+            "ANSWERING worker",
+            ["worker"],
+            registry=self.registry,
+        )
+        self.replica_applied_version = prom.Gauge(
+            "keto_tpu_replica_applied_version",
+            "Store version a replica serve worker has applied from its "
+            "Watch-changelog tail (default network; compare across "
+            "workers for replica lag — snaptoken routing holds/routes "
+            "requests demanding newer versions)",
+            ["worker"],
+            registry=self.registry,
+        )
+        self.replica_routed_total = prom.Counter(
+            "keto_tpu_replica_routed_total",
+            "Checks whose snaptoken demanded a version newer than the "
+            "receiving worker's applied version, by resolution: "
+            "caught_up (the worker's tail applied it within the "
+            "catch-up hold), routed (proxied to a fresh worker), "
+            "escalated (no worker fresh — served at the live store "
+            "version; still never stale)",
+            ["outcome"],
+            registry=self.registry,
+        )
+        self.hedge_launched_total = prom.Counter(
+            "keto_tpu_hedge_launched_total",
+            "Hedge duplicates launched (a check unanswered within the "
+            "hedge policy's latency quantile fired one duplicate onto "
+            "another worker's batcher; deadline-budget-aware — a budget "
+            "too thin to fit a hedge never fires one)",
+            registry=self.registry,
+        )
+        self.hedge_wins_total = prom.Counter(
+            "keto_tpu_hedge_wins_total",
+            "Hedged checks resolved, by which ride answered first "
+            "(primary | hedge) — first answer wins, the loser is "
+            "cancelled",
+            ["ride"],
+            registry=self.registry,
+        )
+        self.hedge_cancelled_total = prom.Counter(
+            "keto_tpu_hedge_cancelled_total",
+            "Losing hedge rides cancelled before their batch launched "
+            "(a cancelled pending never occupies a device batch slot)",
+            registry=self.registry,
+        )
         # hot-path cache: (transport, method) -> (duration child,
         # {code: counter child})
         self._observe_cache: dict = {}

@@ -145,6 +145,10 @@ class Registry:
         # the admission gate (resilience.admit_check) sheds new checks
         # with a typed 429 while in-flight work completes
         self.draining = threading.Event()
+        # replica serving group (api/replica.py), attached by the daemon
+        # when serve.check.workers >= 2; the metrics listener's
+        # GET /admin/replicas reads it (None = single-stack serving)
+        self.replica_group = None
 
     # -- storage --------------------------------------------------------------
 

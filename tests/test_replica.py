@@ -1,0 +1,665 @@
+"""Replica serving plane (api/replica.py): N workers over one engine.
+
+Covers the ISSUE-8 tentpole contracts:
+  - tri-plane byte parity ACROSS REPLICAS: the same check answers with
+    identical wire bytes (snaptoken included) regardless of which
+    worker's listener answered it — REST per-worker backends, the
+    shared muxed port, the threaded gRPC plane, and the aio plane;
+  - forced-lag read-your-writes: a write's snaptoken checked against a
+    worker whose changelog tail is forcibly held answers FRESH (routed
+    to a live worker, or escalated to the store version when every
+    worker lags) — never stale;
+  - the snaptoken routing rule's three outcomes (caught_up / routed /
+    escalated) and the 409 contract for tokens ahead of the store;
+  - deadline-budget-aware hedging: first answer wins, loser cancelled,
+    budget too thin -> no hedge (HedgePolicy unit tests + a
+    deterministic two-worker race on a controllable engine);
+  - the front-mux fallback (round-robin across worker backends) and the
+    group-wide Retry-After drain estimate;
+  - faults.py partial-fault support (probability / max_hits) the
+    hedging smoke injects.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from keto_tpu import faults
+from keto_tpu.api import ReadClient, open_channel
+from keto_tpu.api.daemon import Daemon, PortMux
+from keto_tpu.api.replica import HedgePolicy, ReplicaView, _hedged_ride
+from keto_tpu.config import Config
+from keto_tpu.ketoapi import RelationTuple
+from keto_tpu.namespace import Namespace
+from keto_tpu.registry import Registry
+from keto_tpu.resilience import Deadline
+
+N_WORKERS = 3
+
+
+def make_config(workers: int = N_WORKERS, aio: bool = False, **check_extra):
+    serve_check = {"workers": workers, "replica_catchup_ms": 25}
+    serve_check.update(check_extra)
+    grpc_cfg = {"host": "127.0.0.1", "port": 0}
+    if aio:
+        grpc_cfg["aio"] = True
+    cfg = Config({
+        "dsn": "memory",
+        "check": {"engine": "host"},
+        "serve": {
+            "read": {"host": "127.0.0.1", "port": 0, "grpc": grpc_cfg},
+            "write": {"host": "127.0.0.1", "port": 0},
+            "metrics": {"host": "127.0.0.1", "port": 0},
+            "check": serve_check,
+        },
+    })
+    cfg.set_namespaces([Namespace(name="files"), Namespace(name="groups")])
+    return cfg
+
+
+FIXTURE = [
+    RelationTuple.make("files", "doc", "owner", "alice"),
+    RelationTuple.make("files", "doc2", "owner", "bob"),
+]
+
+
+def start_daemon(cfg):
+    reg = Registry(cfg)
+    reg.relation_tuple_manager().write_relation_tuples(FIXTURE)
+    d = Daemon(reg)
+    d.start()
+    return d
+
+
+def rest_check_raw(port: int, t: RelationTuple, snaptoken: str = ""):
+    """(status, raw body bytes, snaptoken header) for one REST check."""
+    qs = {
+        "namespace": t.namespace, "object": t.object,
+        "relation": t.relation, "subject_id": t.subject_id,
+    }
+    if snaptoken:
+        qs["snaptoken"] = snaptoken
+    url = (
+        f"http://127.0.0.1:{port}/relation-tuples/check/openapi?"
+        + urllib.parse.urlencode(qs)
+    )
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.status, r.read(), r.headers.get("X-Keto-Snaptoken")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), e.headers.get("X-Keto-Snaptoken")
+
+
+import urllib.error  # noqa: E402  (used in rest_check_raw's except)
+
+
+def wait_settled(group, nid: str, version: int, timeout_s: float = 5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if all(
+            w.view.applied_version(nid) >= version for w in group.workers
+        ):
+            return
+        time.sleep(0.01)
+    raise AssertionError("replica views never settled")
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaDaemon:
+    @pytest.fixture(scope="class")
+    def daemon(self):
+        d = start_daemon(make_config(aio=True))
+        yield d
+        d.stop()
+
+    def test_group_shape(self, daemon):
+        g = daemon._group
+        assert len(g.workers) == N_WORKERS
+        # one public muxed port shared by every worker (SO_REUSEPORT) or
+        # a single front mux; per-worker loopback backends are distinct
+        assert len({w.ports["rest"] for w in g.workers}) == N_WORKERS
+        assert len({w.ports["grpc_loopback"] for w in g.workers}) == N_WORKERS
+        assert daemon.read_port > 0
+
+    def test_byte_parity_across_replica_rest_backends(self, daemon):
+        g = daemon._group
+        m = daemon.registry.relation_tuple_manager()
+        wait_settled(g, "default", m.version())
+        t = FIXTURE[0]
+        answers = {
+            rest_check_raw(w.ports["rest"], t) for w in g.workers
+        }
+        answers.add(rest_check_raw(daemon.read_port, t))
+        # identical (status, body bytes, snaptoken header) regardless of
+        # which worker answered — repeat so cache hits are covered too
+        answers |= {rest_check_raw(w.ports["rest"], t) for w in g.workers}
+        assert len(answers) == 1, answers
+        status, body, token = answers.pop()
+        assert status == 200 and json.loads(body) == {"allowed": True}
+        assert token and token.startswith("ktv1_")
+
+    def test_tri_plane_parity_replica(self, daemon):
+        """REST (any worker), threaded gRPC (muxed port), and the aio
+        direct listener agree byte-for-byte on verdict + snaptoken."""
+        g = daemon._group
+        m = daemon.registry.relation_tuple_manager()
+        wait_settled(g, "default", m.version())
+        t = FIXTURE[1]
+        _, rest_body, rest_token = rest_check_raw(
+            g.workers[1].ports["rest"], t
+        )
+        muxed = ReadClient(open_channel(f"127.0.0.1:{daemon.read_port}"))
+        aio = ReadClient(open_channel(f"127.0.0.1:{daemon.read_grpc_port}"))
+        try:
+            g_allowed, g_token = muxed.check_with_token(t)
+            a_allowed, a_token = aio.check_with_token(t)
+        finally:
+            muxed.close()
+            aio.close()
+        assert json.loads(rest_body) == {"allowed": True}
+        assert g_allowed is True and a_allowed is True
+        assert rest_token == g_token == a_token
+
+    def test_forced_lag_read_your_writes(self, daemon):
+        """Write on the shared store, check with the post-write token
+        against a STALLED worker: the answer is fresh (routed), never
+        stale."""
+        from keto_tpu.engine.snaptoken import encode_snaptoken
+
+        g = daemon._group
+        m = daemon.registry.relation_tuple_manager()
+        routed_before = g.metrics.replica_routed_total.labels(
+            "routed"
+        )._value.get()
+        lagged = g.workers[2]
+        # make sure the view exists before holding it
+        lagged.view.applied_version("default")
+        lagged.view.hold()
+        try:
+            extra = RelationTuple.make("files", "doc", "owner", "carol")
+            m.write_relation_tuples([extra])
+            token = encode_snaptoken(m.version(), "default")
+            status, body, resp_token = rest_check_raw(
+                lagged.ports["rest"], extra, snaptoken=token
+            )
+            assert status == 200 and json.loads(body) == {"allowed": True}
+            # the answering version satisfies the token
+            assert int(resp_token.rsplit("_", 1)[1]) >= m.version()
+        finally:
+            lagged.view.release()
+        routed_after = g.metrics.replica_routed_total.labels(
+            "routed"
+        )._value.get()
+        assert routed_after > routed_before
+        m.delete_relation_tuples([extra])
+
+    def test_all_workers_lagged_escalates_fresh(self, daemon):
+        from keto_tpu.engine.snaptoken import encode_snaptoken
+
+        g = daemon._group
+        m = daemon.registry.relation_tuple_manager()
+        esc_before = g.metrics.replica_routed_total.labels(
+            "escalated"
+        )._value.get()
+        for w in g.workers:
+            w.view.applied_version("default")
+            w.view.hold()
+        try:
+            extra = RelationTuple.make("files", "doc2", "owner", "dave")
+            m.write_relation_tuples([extra])
+            token = encode_snaptoken(m.version(), "default")
+            status, body, _ = rest_check_raw(
+                g.workers[0].ports["rest"], extra, snaptoken=token
+            )
+            assert status == 200 and json.loads(body) == {"allowed": True}
+        finally:
+            for w in g.workers:
+                w.view.release()
+        esc_after = g.metrics.replica_routed_total.labels(
+            "escalated"
+        )._value.get()
+        assert esc_after > esc_before
+        m.delete_relation_tuples([extra])
+
+    def test_token_ahead_of_store_409(self, daemon):
+        from keto_tpu.engine.snaptoken import encode_snaptoken
+
+        m = daemon.registry.relation_tuple_manager()
+        w = daemon._group.workers[0]
+        future_token = encode_snaptoken(m.version() + 1000, "default")
+        status, body, _ = rest_check_raw(
+            w.ports["rest"], FIXTURE[0], snaptoken=future_token
+        )
+        assert status == 409
+        assert json.loads(body)["error"]["code"] == 409
+
+    def test_admin_replicas_endpoint(self, daemon):
+        status = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{daemon.metrics_port}/admin/replicas"
+        ).read())
+        assert len(status["workers"]) == N_WORKERS
+        assert {w["worker"] for w in status["workers"]} == {0, 1, 2}
+        for w in status["workers"]:
+            assert "applied" in w and "ports" in w
+        assert "hedge" in status and "enabled" in status["hedge"]
+
+    def test_worker_checks_counted(self, daemon):
+        g = daemon._group
+        w = g.workers[1]
+        before = w._checks_counter._value.get()
+        rest_check_raw(w.ports["rest"], FIXTURE[0])
+        assert w._checks_counter._value.get() > before
+
+
+class TestSingleWorkerUnchanged:
+    def test_workers_1_has_no_group(self):
+        d = start_daemon(make_config(workers=1))
+        try:
+            assert d._group is None
+            assert d.registry.replica_group is None
+            status = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{d.metrics_port}/admin/replicas"
+            ).read())
+            assert status == {"workers": [], "group_pending": 0}
+            s, body, _ = rest_check_raw(d.read_port, FIXTURE[0])
+            assert s == 200 and json.loads(body) == {"allowed": True}
+        finally:
+            d.stop()
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestHedgePolicy:
+    def test_warmup_gate(self):
+        p = HedgePolicy(min_delay_ms=2.0)
+        assert p.delay_s() is None
+        for _ in range(HedgePolicy.WARMUP):
+            p.observe(0.010)
+        assert p.delay_s() == pytest.approx(0.010, rel=0.01)
+
+    def test_min_delay_floor(self):
+        p = HedgePolicy(min_delay_ms=50.0)
+        for _ in range(HedgePolicy.WARMUP):
+            p.observe(0.001)
+        assert p.delay_s() == pytest.approx(0.050)
+
+    def test_quantile_tracks_tail(self):
+        p = HedgePolicy(quantile=0.9, min_delay_ms=0.0)
+        for i in range(100):
+            p.observe(0.3 if i % 10 == 0 else 0.01)  # 10% slow
+        # p90 sits at the healthy/stall boundary: must be far below the
+        # stall and at or above the healthy latency
+        assert 0.01 <= p.delay_s() <= 0.3
+
+    def test_budget_gate_blocks_thin_deadlines(self):
+        p = HedgePolicy(min_delay_ms=0.0)
+        for _ in range(HedgePolicy.WARMUP):
+            p.observe(0.050)
+        assert p.hedge_after_s(None) == pytest.approx(0.050, rel=0.01)
+        # remaining 60 ms < 2 * 50 ms: the duplicate could not finish
+        # inside the budget — never launched
+        assert p.hedge_after_s(Deadline(0.060)) is None
+        assert p.hedge_after_s(Deadline(1.0)) == pytest.approx(
+            0.050, rel=0.01
+        )
+
+    def test_disabled_never_hedges(self):
+        p = HedgePolicy(enabled=False)
+        for _ in range(HedgePolicy.WARMUP):
+            p.observe(0.050)
+        assert p.delay_s() is None
+        assert p.hedge_after_s(None) is None
+
+
+class _StallOnceEngine:
+    """check_batch stalls on its first call (the primary ride), answers
+    instantly afterwards (the hedge ride) — a deterministic two-worker
+    race."""
+
+    def __init__(self, stall_s: float):
+        self.stall_s = stall_s
+        self.calls = 0
+        self._mu = threading.Lock()
+
+    def check_batch(self, tuples, max_depth=0):
+        with self._mu:
+            self.calls += 1
+            first = self.calls == 1
+        if first:
+            time.sleep(self.stall_s)
+        from keto_tpu.engine.definitions import CheckResult, Membership
+
+        return [
+            CheckResult(membership=Membership.IS_MEMBER) for _ in tuples
+        ]
+
+
+class TestHedgedRide:
+    def _group(self, engine, hedge_cfg=None):
+        cfg = make_config(**(hedge_cfg or {}))
+        reg = Registry(cfg)
+        reg.relation_tuple_manager().write_relation_tuples(FIXTURE)
+        from keto_tpu.api.batcher import CheckBatcher
+        from keto_tpu.api.replica import ReplicaGroup
+
+        group = ReplicaGroup(
+            reg, 2,
+            make_batcher=lambda g: CheckBatcher(
+                engine, engine_resolver=lambda nid: engine,
+                metrics=reg.metrics(),
+            ),
+            make_cache=lambda: None,
+        )
+        return reg, group
+
+    def _teardown(self, group):
+        for w in group.workers:
+            w.batcher.close()
+        group.close()
+
+    def test_hedge_fires_and_wins(self):
+        engine = _StallOnceEngine(0.8)
+        reg, group = self._group(engine)
+        try:
+            for _ in range(HedgePolicy.WARMUP):
+                group.hedge.observe(0.005)
+            launched_before = reg.metrics().hedge_launched_total._value.get()
+            t0 = time.perf_counter()
+            res, ver = _hedged_ride(
+                group, group.workers[0], FIXTURE[0], 0, None, None
+            )
+            took = time.perf_counter() - t0
+            assert res.allowed is True
+            # answered by the hedge, far inside the primary's 0.8s stall
+            assert took < 0.5, took
+            assert engine.calls >= 2
+            assert (
+                reg.metrics().hedge_launched_total._value.get()
+                > launched_before
+            )
+            assert reg.metrics().hedge_wins_total.labels(
+                "hedge"
+            )._value.get() >= 1
+        finally:
+            self._teardown(group)
+
+    def test_no_hedge_without_second_worker(self):
+        engine = _StallOnceEngine(0.0)
+        cfg = make_config()
+        reg = Registry(cfg)
+        from keto_tpu.api.batcher import CheckBatcher
+        from keto_tpu.api.replica import ReplicaGroup
+
+        group = ReplicaGroup(
+            reg, 1,
+            make_batcher=lambda g: CheckBatcher(
+                engine, engine_resolver=lambda nid: engine
+            ),
+            make_cache=lambda: None,
+        )
+        try:
+            assert group.hedge_worker(exclude=group.workers[0]) is None
+        finally:
+            self._teardown(group)
+
+    def test_hedge_submit_failure_falls_back_to_primary(self):
+        # hedging is a pure latency optimization: when the hedge
+        # target's batcher refuses the duplicate (draining here; a full
+        # queue sheds the same typed OverloadedError), the request must
+        # ride out the healthy primary, not fail
+        engine = _StallOnceEngine(0.3)
+        reg, group = self._group(engine)
+        try:
+            for _ in range(HedgePolicy.WARMUP):
+                group.hedge.observe(0.005)
+            group.workers[1].batcher.close()
+            launched_before = reg.metrics().hedge_launched_total._value.get()
+            res, _ = _hedged_ride(
+                group, group.workers[0], FIXTURE[0], 0, None, None
+            )
+            assert res.allowed is True
+            assert (
+                reg.metrics().hedge_launched_total._value.get()
+                == launched_before
+            )
+        finally:
+            self._teardown(group)
+
+    def test_thin_budget_never_hedges(self):
+        # primary answers inside the deadline but past the hedge delay:
+        # with a budget too thin for a duplicate (remaining < 2x delay),
+        # the hedge must never fire — the primary's answer arrives alone
+        engine = _StallOnceEngine(0.1)
+        reg, group = self._group(engine)
+        try:
+            for _ in range(HedgePolicy.WARMUP):
+                group.hedge.observe(0.2)  # delay 200ms
+            from keto_tpu.observability import RequestTrace
+
+            rt = RequestTrace(deadline=Deadline(0.25))  # < 2x delay
+            launched_before = reg.metrics().hedge_launched_total._value.get()
+            res, _ = _hedged_ride(
+                group, group.workers[0], FIXTURE[0], 0, None, rt
+            )
+            assert res.allowed is True
+            assert (
+                reg.metrics().hedge_launched_total._value.get()
+                == launched_before
+            )
+        finally:
+            self._teardown(group)
+
+
+# ---------------------------------------------------------------------------
+
+
+class TestReplicaView:
+    def test_tail_advances_and_catch_up(self):
+        cfg = make_config(workers=1)
+        reg = Registry(cfg)
+        m = reg.relation_tuple_manager()
+        m.write_relation_tuples(FIXTURE)
+        hub = reg.watch_hub()
+        view = ReplicaView(hub, m)
+        try:
+            v0 = view.applied_version("default")
+            assert v0 == m.version()
+            extra = RelationTuple.make("files", "doc", "owner", "erin")
+            m.write_relation_tuples([extra])
+            assert view.catch_up("default", m.version(), 2.0) == m.version()
+            # held view stops applying; catch_up times out at the old
+            # version, release catches it back up
+            view.hold()
+            m.write_relation_tuples(
+                [RelationTuple.make("files", "doc", "owner", "frank")]
+            )
+            stuck = view.catch_up("default", m.version(), 0.15)
+            assert stuck < m.version()
+            view.release()
+            assert view.catch_up("default", m.version(), 2.0) == m.version()
+        finally:
+            view.close()
+            hub.stop()
+
+
+class TestFrontMuxFallback:
+    def test_round_robin_across_backends(self):
+        """PortMux with LISTS of backends (the no-SO_REUSEPORT path):
+        consecutive connections land on consecutive workers."""
+        hits = []
+        servers = []
+
+        def backend(idx):
+            srv = socket.create_server(("127.0.0.1", 0))
+            servers.append(srv)
+
+            def run():
+                while True:
+                    try:
+                        conn, _ = srv.accept()
+                    except OSError:
+                        return
+                    conn.recv(1024)
+                    hits.append(idx)
+                    conn.sendall(
+                        b"HTTP/1.1 200 OK\r\nContent-Length: 1\r\n"
+                        b"Connection: close\r\n\r\n" + str(idx).encode()
+                    )
+                    conn.close()
+
+            threading.Thread(target=run, daemon=True).start()
+            return ("127.0.0.1", srv.getsockname()[1])
+
+        addrs = [backend(0), backend(1)]
+        mux = PortMux("127.0.0.1", 0, list(addrs), list(addrs))
+        mux.start()
+        try:
+            got = set()
+            for _ in range(4):
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{mux.port}/", timeout=5
+                ) as r:
+                    got.add(r.read())
+            assert got == {b"0", b"1"}
+        finally:
+            mux.stop()
+            for s in servers:
+                s.close()
+
+
+class TestGroupRetryAfter:
+    def test_drain_estimate_uses_group_pending(self):
+        from keto_tpu.api.batcher import CheckBatcher
+
+        class _Noop:
+            def check_batch(self, tuples, max_depth=0):
+                return []
+
+        solo = CheckBatcher(_Noop(), window_s=0.002)
+        group_wide = CheckBatcher(
+            _Noop(), window_s=0.002,
+            pending_total=lambda: 80000, drain_ways=4,
+        )
+        try:
+            # solo: the passed (local) pending count drives the hint;
+            # group: the callable's GROUP-wide pending over 4 parallel
+            # drains — the same backlog drains 4x faster, and the
+            # local pending argument (1 here) is ignored entirely
+            est_solo = solo._queue_delay_estimate_s(80000)
+            est_group = group_wide._queue_delay_estimate_s(1)
+            assert est_group < est_solo
+            assert est_group == group_wide._queue_delay_estimate_s(80000)
+        finally:
+            solo.close()
+            group_wide.close()
+
+    def test_full_queue_shed_with_group_pending_does_not_deadlock(self):
+        # the group-wide pending callable re-acquires each batcher's own
+        # non-reentrant _pending_mu (ReplicaGroup.group_pending): the
+        # atomic admission bound's shed must compute its retry-after
+        # estimate OUTSIDE the lock, or the shedding thread deadlocks
+        # against itself holding the lock it needs
+        from keto_tpu.api.batcher import CheckBatcher
+        from keto_tpu.errors import OverloadedError
+
+        class _Stall:
+            def check_batch(self, tuples, max_depth=0):
+                time.sleep(5.0)
+                return []
+
+        batchers: list[CheckBatcher] = []
+
+        def group_pending() -> int:
+            total = 0
+            for b in batchers:
+                with b._pending_mu:
+                    total += b._pending
+            return total
+
+        batcher = CheckBatcher(
+            _Stall(), max_queue=1, window_s=0.005,
+            pending_total=group_pending, drain_ways=2,
+        )
+        batchers.append(batcher)
+        try:
+            batcher.submit(FIXTURE[0])  # occupies the one queue slot
+            outcome: list = []
+
+            def second_submit():
+                try:
+                    batcher.submit(FIXTURE[0])
+                    outcome.append("accepted")
+                except OverloadedError as e:
+                    outcome.append(e)
+
+            t = threading.Thread(target=second_submit, daemon=True)
+            t.start()
+            t.join(2.0)
+            assert not t.is_alive(), "submit deadlocked on group pending"
+            assert outcome and isinstance(outcome[0], OverloadedError)
+            assert outcome[0].retry_after_s > 0
+        finally:
+            batchers.clear()  # let close() drain without the group read
+            batcher.close()
+
+
+class TestPartialFaults:
+    def test_max_hits_bounds_injections(self):
+        spec = faults.set_fault("device_launch", stall_s=0.0, max_hits=2)
+        try:
+            for _ in range(5):
+                faults.inject("device_launch")
+            assert spec.hits == 2
+        finally:
+            faults.clear()
+
+    def test_max_hits_atomic_under_concurrency(self):
+        # N launch threads race inject(): should_fire claims the hit
+        # under the spec's lock, so the bound can never be raced past
+        spec = faults.set_fault("device_launch", stall_s=0.001, max_hits=5)
+        try:
+            threads = [
+                threading.Thread(
+                    target=lambda: [
+                        faults.inject("device_launch") for _ in range(5)
+                    ]
+                )
+                for _ in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert spec.hits == 5
+        finally:
+            faults.clear()
+
+    def test_probability_zero_never_fires(self):
+        spec = faults.set_fault(
+            "device_launch", error="boom", probability=0.0
+        )
+        try:
+            for _ in range(20):
+                faults.inject("device_launch")  # must not raise
+            assert spec.hits == 0
+        finally:
+            faults.clear()
+
+    def test_keto_faults_probability_syntax(self):
+        faults.configure("device_launch=stall:0.5@0.25")
+        try:
+            spec = faults.get("device_launch")
+            assert spec.stall_s == 0.5
+            assert spec.probability == 0.25
+        finally:
+            faults.clear()

@@ -19,6 +19,15 @@ Two request shapes:
 Prints one JSON line: offered vs achieved rate, completion latency
 percentiles (measured from SCHEDULED send time — queueing delay from a
 saturated server counts, as it should), error/timeout counts.
+
+Saturation curves (`--curve`): a stepped offered-QPS ladder — each step
+runs the open loop at one offered rate for `--seconds`, recording
+achieved QPS and p50/p95/p99 per step, so the knee where achieved
+detaches from offered (and latency departs) is measurable in ONE
+committed artifact instead of hand-run points:
+
+    python tools/load_gen.py --addr 127.0.0.1:4466 \
+        --curve 200,400,800,1600 --seconds 5 --record CURVE.json
 """
 
 from __future__ import annotations
@@ -34,62 +43,35 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main() -> int:
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--addr", default="127.0.0.1:4466")
-    ap.add_argument("--rate", type=float, default=100.0,
-                    help="request ticks per second (open-loop schedule)")
-    ap.add_argument("--seconds", type=float, default=10.0)
-    ap.add_argument("--mode", choices=("single", "batch"), default="single")
-    ap.add_argument("--batch", type=int, default=512)
-    ap.add_argument("--timeout", type=float, default=30.0)
-    ap.add_argument("--workers", type=int, default=64,
-                    help="in-flight cap (past it, ticks count as shed)")
-    ap.add_argument("--queries", default=None,
-                    help="JSON file of relation tuples; default: the "
-                         "bench dataset's query mix")
-    ap.add_argument("--record", default=None, metavar="OUT_JSON",
-                    help="also write the result record to this file — "
-                         "the committed-artifact mode (saturation curves "
-                         "land in the repo, not just a terminal scroll)")
-    args = ap.parse_args()
-
-    from keto_tpu.api import ReadClient, open_channel
-    from keto_tpu.ketoapi import RelationTuple
-
-    if args.queries:
-        with open(args.queries) as f:
-            queries = [RelationTuple.from_dict(d) for d in json.load(f)]
-    else:
-        import bench
-
-        _, _, queries = bench.build_dataset()
-
+def run_step(
+    clients, queries, rate: float, seconds: float,
+    mode: str = "single", batch: int = 512, timeout: float = 30.0,
+    workers: int = 64,
+) -> dict:
+    """One open-loop step at a fixed offered rate; returns the result
+    record (achieved QPS, scheduled-send latency percentiles, errors,
+    shed ticks). `clients` is a pool of ReadClients reused across steps
+    so channel setup never lands inside a timed window."""
     rng = random.Random(0)
     qn = len(queries)
-
-    # a small client pool: gRPC channels multiplex, but one channel's
-    # Python-side completion queue serializes; a handful spreads it
-    clients = [ReadClient(open_channel(args.addr)) for _ in range(8)]
-
     lock = threading.Lock()
     lat: list[float] = []
     errors = [0]
     checks_done = [0]
     shed = [0]
-    inflight = threading.Semaphore(args.workers)
+    inflight = threading.Semaphore(workers)
 
-    def fire(scheduled: float, client: ReadClient) -> None:
+    def fire(scheduled: float, client) -> None:
         try:
-            if args.mode == "single":
+            if mode == "single":
                 q = queries[rng.randrange(qn)]
-                client.check(q, timeout=args.timeout)
+                client.check(q, timeout=timeout)
                 n = 1
             else:
                 start = rng.randrange(qn)
-                qs = [queries[(start + j) % qn] for j in range(args.batch)]
-                client.check_batch(qs, timeout=args.timeout)
-                n = args.batch
+                qs = [queries[(start + j) % qn] for j in range(batch)]
+                client.check_batch(qs, timeout=timeout)
+                n = batch
             done = time.perf_counter()
             with lock:
                 lat.append(done - scheduled)
@@ -100,8 +82,8 @@ def main() -> int:
         finally:
             inflight.release()
 
-    n_ticks = int(args.rate * args.seconds)
-    interval = 1.0 / args.rate
+    n_ticks = int(rate * seconds)
+    interval = 1.0 / rate
     t0 = time.perf_counter()
     threads: list[threading.Thread] = []
     for i in range(n_ticks):
@@ -120,19 +102,15 @@ def main() -> int:
         th.start()
         threads.append(th)
     for th in threads:
-        th.join(timeout=args.timeout + 5)
+        th.join(timeout=timeout + 5)
     wall = time.perf_counter() - t0
-    for c in clients:
-        c.close()
 
     import numpy as np
 
     out = {
-        "mode": args.mode,
-        "offered_rps": args.rate,
-        "offered_checks_per_s": args.rate * (
-            1 if args.mode == "single" else args.batch
-        ),
+        "mode": mode,
+        "offered_rps": rate,
+        "offered_checks_per_s": rate * (1 if mode == "single" else batch),
         "achieved_checks_per_s": round(checks_done[0] / wall, 1),
         "completed_rpcs": len(lat),
         "errors": errors[0],
@@ -146,6 +124,104 @@ def main() -> int:
             "lat_p95_ms": round(float(np.percentile(a, 95)), 2),
             "lat_p99_ms": round(float(np.percentile(a, 99)), 2),
         })
+    return out
+
+
+def run_curve(
+    addr: str, rates, seconds: float, mode: str = "single",
+    batch: int = 512, timeout: float = 30.0, workers: int = 64,
+    queries=None, n_clients: int = 8,
+) -> dict:
+    """The stepped saturation ladder as a callable (replica_smoke's
+    committed-artifact path imports this): one open-loop step per
+    offered rate, one shared client pool, results under "curve"."""
+    from keto_tpu.api import ReadClient, open_channel
+
+    if queries is None:
+        import bench
+
+        _, _, queries = bench.build_dataset()
+    clients = [ReadClient(open_channel(addr)) for _ in range(n_clients)]
+    try:
+        steps = [
+            run_step(
+                clients, queries, rate, seconds,
+                mode=mode, batch=batch, timeout=timeout, workers=workers,
+            )
+            for rate in rates
+        ]
+    finally:
+        for c in clients:
+            c.close()
+    peak = max(
+        (s["achieved_checks_per_s"] for s in steps), default=0.0
+    )
+    return {
+        "mode": mode,
+        "step_seconds": seconds,
+        "curve": steps,
+        "peak_achieved_checks_per_s": peak,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--addr", default="127.0.0.1:4466")
+    ap.add_argument("--rate", type=float, default=100.0,
+                    help="request ticks per second (open-loop schedule)")
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--mode", choices=("single", "batch"), default="single")
+    ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--workers", type=int, default=64,
+                    help="in-flight cap (past it, ticks count as shed)")
+    ap.add_argument("--curve", default=None, metavar="R1,R2,...",
+                    help="stepped open-loop mode: run --seconds at each "
+                         "offered rate in the comma-separated ladder and "
+                         "emit per-step achieved QPS + p50/p95/p99 — the "
+                         "saturation-curve artifact")
+    ap.add_argument("--queries", default=None,
+                    help="JSON file of relation tuples; default: the "
+                         "bench dataset's query mix")
+    ap.add_argument("--record", default=None, metavar="OUT_JSON",
+                    help="also write the result record to this file — "
+                         "the committed-artifact mode (saturation curves "
+                         "land in the repo, not just a terminal scroll)")
+    args = ap.parse_args()
+
+    from keto_tpu.api import ReadClient, open_channel
+    from keto_tpu.ketoapi import RelationTuple
+
+    if args.queries:
+        with open(args.queries) as f:
+            queries = [RelationTuple.from_dict(d) for d in json.load(f)]
+    else:
+        import bench
+
+        queries = None
+        if args.curve is None:
+            _, _, queries = bench.build_dataset()
+
+    if args.curve is not None:
+        rates = [float(r) for r in args.curve.split(",") if r.strip()]
+        out = run_curve(
+            args.addr, rates, args.seconds, mode=args.mode,
+            batch=args.batch, timeout=args.timeout, workers=args.workers,
+            queries=queries,
+        )
+    else:
+        # a small client pool: gRPC channels multiplex, but one channel's
+        # Python-side completion queue serializes; a handful spreads it
+        clients = [ReadClient(open_channel(args.addr)) for _ in range(8)]
+        try:
+            out = run_step(
+                clients, queries, args.rate, args.seconds,
+                mode=args.mode, batch=args.batch, timeout=args.timeout,
+                workers=args.workers,
+            )
+        finally:
+            for c in clients:
+                c.close()
     print(json.dumps(out))
     if args.record:
         with open(args.record, "w") as f:
