@@ -391,6 +391,11 @@ class Daemon:
         # so the store write hooks and engine push-invalidation are live
         # from the first request
         reg.watch_hub()
+        # anti-entropy mirror scrubber (engine/scrub.py): background
+        # device-vs-host checksum loop; start() is a no-op unless
+        # scrub.enabled (POST /admin/scrub triggers a pass either way)
+        reg.mirror_scrubber().start()
+        self._log_recovery_state()
         reg.draining.clear()
         reg.ready.set()
         self._started = True
@@ -399,6 +404,41 @@ class Daemon:
             self.read_addr.host, self.read_port,
             self.write_addr.host, self.write_port,
             self.metrics_addr.host, self.metrics_port,
+        )
+
+    def _log_recovery_state(self) -> None:
+        """Cold-start recovery audit: ONE structured line pinning the
+        version-consistency facts a post-crash start depends on — the
+        durable store version and what the persisted mirror checkpoint
+        (if any) can contribute. A torn/stale checkpoint is reported as
+        the rebuild it will cause, never an error: the store is the
+        truth, the checkpoint is a warm-restart optimization."""
+        reg = self.registry
+        try:
+            store_version = reg.relation_tuple_manager().version(nid=reg.nid)
+        except Exception:  # noqa: BLE001 — an audit line must not fail start
+            logger.warning("recovery audit: store version unreadable",
+                           exc_info=True)
+            return
+        checkpoint = "none"
+        cache_dir = reg.config.get("check.mirror_cache")
+        if cache_dir:
+            from ..engine.checkpoint import checkpoint_info, mirror_cache_path
+
+            info = checkpoint_info(mirror_cache_path(cache_dir, reg.nid))
+            if info is None:
+                checkpoint = "none"
+            elif not info.get("loadable"):
+                checkpoint = "torn/incompatible (will rebuild from store)"
+            else:
+                checkpoint = (
+                    f"loadable n_tuples={info.get('n_tuples')} "
+                    f"(trusted only if it matches store v{store_version} "
+                    "+ config fingerprint)"
+                )
+        logger.info(
+            "cold-start recovery: nid=%s store=v%d mirror_checkpoint=%s",
+            reg.nid, store_version, checkpoint,
         )
 
     def _start_replica_read_plane(self) -> None:
@@ -569,6 +609,8 @@ class Daemon:
         # changelog tails — the hub closes their subscriptions)
         if self.registry._watch_hub is not None:
             self.registry._watch_hub.stop()
+        if self.registry._scrubber is not None:
+            self.registry._scrubber.stop()
         for m in self._muxes.values():
             m.stop()
         if getattr(self, "_aio_read", None) is not None:

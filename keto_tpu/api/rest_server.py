@@ -77,6 +77,10 @@ FLIGHTREC_ROUTE = "/admin/flightrec"
 # replica serving group (metrics listener): per-worker applied versions,
 # pending counts, listener ports, and the hedge policy's live state
 REPLICAS_ROUTE = "/admin/replicas"
+# anti-entropy mirror scrubber (metrics listener, engine/scrub.py): GET
+# reads counters/last-pass state, POST runs one full pass on demand and
+# returns the per-nid report
+SCRUB_ROUTE = "/admin/scrub"
 SPEC_ROUTE = "/.well-known/openapi.json"
 
 # route -> router kind, the ONE ownership table (consumed by the spec
@@ -101,6 +105,7 @@ ROUTE_KINDS = {
     PROFILING_STOP_ROUTE: "metrics",
     FLIGHTREC_ROUTE: "metrics",
     REPLICAS_ROUTE: "metrics",
+    SCRUB_ROUTE: "metrics",
 }
 
 
@@ -347,6 +352,11 @@ class _Handler(BaseHTTPRequestHandler):
                 return FLIGHTREC_ROUTE, self._flightrec_dump
             if method == "GET" and path == REPLICAS_ROUTE:
                 return REPLICAS_ROUTE, self._replicas_status
+            if path == SCRUB_ROUTE:
+                if method == "GET":
+                    return SCRUB_ROUTE, self._scrub_status
+                if method == "POST":
+                    return SCRUB_ROUTE, self._scrub_trigger
             return None
 
         if self.kind == "read":
@@ -847,6 +857,21 @@ class _Handler(BaseHTTPRequestHandler):
             "entries": entries,
             "hbm": hbm,
         })
+
+    def _scrub_status(self) -> None:
+        """GET /admin/scrub: the anti-entropy scrubber's config +
+        counters + last-pass facts (engine/scrub.py). Reads state only —
+        no pass runs, no engine is built."""
+        self._json(200, self.registry.mirror_scrubber().status())
+
+    def _scrub_trigger(self) -> None:
+        """POST /admin/scrub: run ONE full scrub pass NOW (works with
+        `scrub.enabled: false` — the on-demand audit an operator runs
+        after a device scare) and return the per-nid report plus the
+        refreshed status."""
+        scrubber = self.registry.mirror_scrubber()
+        report = scrubber.scrub_pass()
+        self._json(200, {"report": report, **scrubber.status()})
 
     def _replicas_status(self) -> None:
         """GET /admin/replicas: the replica serving group's live state —

@@ -20,8 +20,19 @@ import hashlib
 import json
 import os
 import tempfile
+import zlib
 from typing import Optional
 from zipfile import BadZipFile
+
+# every way a torn/corrupt/bit-rotted checkpoint file can surface from
+# np.load: OSError (fs), KeyError (missing member), ValueError (format),
+# EOFError (truncated member data), BadZipFile (mangled zip structure),
+# zlib.error (deflate stream corrupted in place — bit rot with an intact
+# central directory). Loading must DEGRADE on all of them, never raise
+# through Daemon.start or the check path.
+_TORN_FILE_ERRORS = (
+    OSError, KeyError, ValueError, EOFError, BadZipFile, zlib.error,
+)
 
 import numpy as np
 
@@ -46,6 +57,14 @@ _INT_FIELDS = (
     "n_config_rels", "wildcard_rel", "dh_probes", "rh_probes",
     "K", "version", "n_tuples",
 )
+
+
+def mirror_cache_path(cache_dir: str, nid: str) -> str:
+    """THE naming contract for a network's mirror checkpoint file —
+    shared by the engine's persist/load path and the daemon's cold-start
+    recovery audit, so the audit can never drift into probing a name
+    the engine stopped writing."""
+    return os.path.join(cache_dir, f"mirror-{nid}.npz")
 
 
 def stable_fingerprint(obj) -> int:
@@ -107,13 +126,39 @@ def save_snapshot(snapshot: GraphSnapshot, path: str) -> None:
             ),
         }
     )
+    from .. import faults as _faults
+
     d = os.path.dirname(os.path.abspath(path))
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez_compressed(f, **payload)
+            # crash-ordering contract (tools/crash_smoke.py): the temp
+            # file's BYTES must be on disk before the rename can publish
+            # its NAME — without this fsync a crash shortly after
+            # os.replace can surface a renamed-but-empty file, the one
+            # torn state load_snapshot's fallback cannot distinguish
+            # from a legitimately empty write
+            f.flush()
+            os.fsync(f.fileno())
+        # crash point: temp durable, rename not yet issued — restart
+        # must see the OLD checkpoint (or none) plus a stray .npz.tmp
+        _faults.inject("checkpoint_pre_rename")
         os.replace(tmp, path)
+        # the rename itself is made durable by fsyncing the DIRECTORY
+        # (POSIX: a dir entry update is data of the directory file)
+        try:
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass  # platforms without dir fsync: rename atomicity remains
+        # crash point: fully published — restart must load THIS file or
+        # (version mismatch) ignore it, never see a torn one
+        _faults.inject("checkpoint_post_rename")
     except BaseException:
         try:
             os.unlink(tmp)
@@ -122,8 +167,39 @@ def save_snapshot(snapshot: GraphSnapshot, path: str) -> None:
         raise
 
 
+def checkpoint_info(path: str) -> Optional[dict]:
+    """Cheap checkpoint metadata probe for the cold-start recovery
+    audit (api/daemon.py): reads ONLY the tiny `meta` array out of the
+    zip — no vocabulary/CSR deserialization. Returns None when the file
+    is missing; a dict with ``loadable: False`` when it exists but is
+    torn/corrupt/incompatible (the states load_snapshot degrades to a
+    rebuild on)."""
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            meta = z["meta"]
+            info = {
+                "format_version": int(meta[0]),
+                "loadable": int(meta[0]) == FORMAT_VERSION,
+            }
+            if len(meta) == len(_INT_FIELDS) + 1:
+                info.update(
+                    {k: int(meta[i + 1]) for i, k in enumerate(_INT_FIELDS)}
+                )
+            else:
+                info["loadable"] = False
+            return info
+    except _TORN_FILE_ERRORS:
+        return {"loadable": False}
+
+
 def load_snapshot(path: str) -> Optional[GraphSnapshot]:
-    """Load a snapshot; None when missing/corrupt/incompatible."""
+    """Load a snapshot; None when missing/corrupt/incompatible — a torn
+    or truncated file (crash mid-write on a filesystem without the
+    fsync ordering save_snapshot now enforces, or a stray partial copy)
+    degrades to the same rebuild path as a missing one, never an error
+    through Daemon.start."""
     try:
         with np.load(path, allow_pickle=False) as z:
             meta = z["meta"]
@@ -140,7 +216,7 @@ def load_snapshot(path: str) -> Optional[GraphSnapshot]:
                 int(k): tuple(tuple(op) for op in v)
                 for k, v in json.loads(str(z["island_circuits"][0])).items()
             }
-    except (OSError, KeyError, ValueError, BadZipFile):
+    except _TORN_FILE_ERRORS:
         return None
     # big vocabs reload as ArrayMaps (sorted keys + explicit id values):
     # rebuilding 1e7-entry Python dicts would pay the exact memory/CPU

@@ -1,0 +1,279 @@
+"""Anti-entropy device-mirror scrubber.
+
+Zanzibar's availability story assumes a restarted or degraded server
+never serves answers from a corrupt mirror (PAPER.md §2.4.1); the
+engine's per-request version gate catches STALE mirrors, but nothing in
+the serving path can notice a mirror whose bytes silently diverged from
+the store's truth — a flipped HBM bit, a bad DMA, a partial upload. The
+scrubber closes that gap the way storage systems do: background
+anti-entropy comparison against a independently-derived expectation.
+
+Design:
+
+  - One `MirrorScrubber` per process (registry singleton), configured by
+    the `scrub.{enabled,interval_s,slice_rows}` schema keys and
+    started/stopped by the daemon around serving. `GET /admin/scrub` on
+    the metrics listener reads its state; `POST /admin/scrub` runs one
+    full pass on demand (works even when the background loop is
+    disabled).
+  - Every `interval_s` the loop runs one full pass: for each BUILT
+    engine (never builds one — scrubbing must not instantiate device
+    mirrors) it captures the current immutable `_EngineState` and
+    compares every device table against a host recomputation at that
+    state's covered version. Both sides hang off the SAME state object
+    — `state.tables` (device) vs `pack_raw_tables(snapshot +
+    delta overlay)` (host) — so an engine swapping states mid-pass can
+    never produce a false divergence.
+  - Comparison is row-sliced (`slice_rows` per chunk, no engine lock
+    held, a bounded device readback per chunk) so a 1e8-edge mirror
+    scrubs as many short device syncs instead of one giant one. The
+    host expectation is computed once per state generation and cached
+    until the engine moves on.
+  - Divergence is never repaired in place: the whole mirror generation
+    is condemned. `keto_tpu_scrub_divergence_total{table}` counts it,
+    the flight-recorder ring is dumped (the launches that served off
+    the poisoned mirror are the evidence), and the repair rides the
+    existing breaker-style degrade path — `CircuitBreaker.trip()` opens
+    the device path (checks host-oracle-serve, answers stay correct)
+    while `engine.invalidate()` forces the next check to rebuild the
+    mirror from the store. Host-oracle-correct answers throughout, the
+    same argument as every other degrade in this repo.
+
+A clean mirror scrubs to zero divergence by construction: the device
+tables are uploaded from exactly the arrays the expectation recomputes,
+so any inequality is a real device/host split, not noise.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger("keto_tpu")
+
+
+class MirrorScrubber:
+    """Background device-mirror anti-entropy loop (module docstring)."""
+
+    def __init__(
+        self,
+        registry,
+        enabled: bool = False,
+        interval_s: float = 30.0,
+        slice_rows: int = 1 << 16,
+        metrics=None,
+    ):
+        self.registry = registry
+        self.enabled = bool(enabled)
+        self.interval_s = max(float(interval_s), 0.05)
+        self.slice_rows = max(int(slice_rows), 1)
+        self.metrics = metrics
+        self._mu = threading.Lock()
+        # pass-level serialization: the background loop and the
+        # on-demand POST /admin/scrub trigger must never scrub the same
+        # mirror concurrently — a shared divergence would double-count,
+        # double-dump the flight recorder, and race the `_expected`
+        # cache (whose mutations all happen under this lock)
+        self._pass_mu = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # host expectation cache: nid -> (state object, expected tables);
+        # identity-keyed on the immutable state so a new generation
+        # recomputes and the old expectation is dropped with it
+        self._expected: dict[str, tuple[object, dict]] = {}
+        self.stats: dict = {
+            "passes": 0,
+            "slices": 0,
+            "divergences": 0,
+            "repairs": 0,
+            "last_pass_mono": None,
+            "last_pass_duration_s": None,
+            "last_divergence": None,  # {"nid", "table", "rows": [lo, hi]}
+        }
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the background loop; a no-op when `scrub.enabled` is
+        false (the on-demand pass still works) or already running."""
+        if not self.enabled:
+            return
+        with self._mu:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="keto-scrub", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._mu:
+            thread, self._thread = self._thread, None
+        self._stop.set()
+        if thread is not None:
+            thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scrub_pass()
+            except Exception:  # noqa: BLE001 — the scrubber must never die;
+                # a pass that errors is retried at the next interval
+                logger.warning("mirror scrub pass failed", exc_info=True)
+
+    # -- one pass --------------------------------------------------------------
+
+    def scrub_pass(self) -> dict:
+        """Checksum every built engine's device mirror once; returns a
+        per-nid report (also the POST /admin/scrub response body).
+        Serialized: a concurrent caller blocks until the running pass
+        finishes, then runs its own."""
+        with self._pass_mu:
+            return self._scrub_pass_locked()
+
+    def _scrub_pass_locked(self) -> dict:
+        t0 = time.monotonic()
+        report: dict = {}
+        scrubbed_nids: set[str] = set()
+        for nid, engine in self.registry.built_engines().items():
+            state_fn = getattr(engine, "mirror_state", None)
+            if state_fn is None:
+                continue  # host engine facade: no device mirror to scrub
+            state = state_fn()
+            if state is None or not isinstance(state.tables, dict):
+                # unbuilt, or the mesh path (per-shard tables live on N
+                # devices; scrubbing them is the multi-chip follow-up)
+                report[nid] = {"scrubbed": False}
+                continue
+            scrubbed_nids.add(nid)
+            report[nid] = self._scrub_engine(nid, engine, state)
+        # drop expectations for engines that vanished (tenant-LRU
+        # eviction, invalidation): each entry pins an _EngineState plus a
+        # full host copy of its packed tables — tenant churn must not
+        # grow host memory without bound. (Retaining the copy for LIVE
+        # engines between passes is the deliberate trade: host RAM for
+        # not re-packing O(edges) tables every interval.)
+        for nid in list(self._expected):
+            if nid not in scrubbed_nids:
+                self._expected.pop(nid, None)
+        with self._mu:
+            self.stats["passes"] += 1
+            self.stats["last_pass_mono"] = t0
+            self.stats["last_pass_duration_s"] = time.monotonic() - t0
+        if self.metrics is not None:
+            self.metrics.scrub_passes_total.inc()
+        return report
+
+    def _scrub_engine(self, nid: str, engine, state) -> dict:
+        expected = self._expected_tables(nid, state)
+        diverged: list[dict] = []
+        slices = 0
+        for key in sorted(state.tables):
+            exp = expected.get(key)
+            dev = state.tables[key]
+            if exp is None or tuple(exp.shape) != tuple(dev.shape):
+                # no host twin (shouldn't happen) or an overlay-resized
+                # vocab array the expectation missed: treat as divergence
+                # evidence, not silence
+                diverged.append({"table": key, "rows": None})
+                continue
+            exp = np.asarray(exp)
+            n = exp.shape[0] if exp.ndim else 1
+            for lo in range(0, max(n, 1), self.slice_rows):
+                hi = min(lo + self.slice_rows, n)
+                # bounded device readback per chunk; no locks held
+                dev_slice = np.asarray(dev[lo:hi] if exp.ndim else dev)
+                exp_slice = exp[lo:hi] if exp.ndim else exp
+                slices += 1
+                if not np.array_equal(dev_slice, exp_slice):
+                    diverged.append({"table": key, "rows": [lo, hi]})
+                    break  # one hit condemns the table; scan the rest
+        with self._mu:
+            self.stats["slices"] += slices
+        if self.metrics is not None and slices:
+            self.metrics.scrub_slices_total.inc(slices)
+        if diverged:
+            self._repair(nid, engine, diverged)
+        return {
+            "scrubbed": True,
+            "covered_version": state.covered_version,
+            "tables": len(state.tables),
+            "slices": slices,
+            "diverged": diverged,
+        }
+
+    def _expected_tables(self, nid: str, state) -> dict:
+        """The host truth for one state generation: the exact packed
+        arrays `snapshot_tables` / `refresh_delta_tables` uploaded —
+        recomputed from `state.snapshot` + `state.delta_np` (+ the
+        vocab overlay the view carries), cached by state identity."""
+        cached = self._expected.get(nid)
+        if cached is not None and cached[0] is state:
+            return cached[1]
+        from .delta import empty_delta_tables
+        from .kernel import pack_raw_tables
+
+        raw = dict(state.snapshot.device_arrays())
+        raw.update(state.delta_np or empty_delta_tables())
+        expected = pack_raw_tables(raw)
+        overlay = getattr(state.view, "overlay", None)
+        if overlay is not None:
+            # delta states upload the overlay-extended vocab arrays, not
+            # the base snapshot's (tpu_engine._delta_refresh)
+            expected["objslot_ns"] = overlay.objslot_ns
+            expected["ns_has_config"] = overlay.ns_has_config
+        self._expected[nid] = (state, expected)
+        return expected
+
+    def _repair(self, nid: str, engine, diverged: list[dict]) -> None:
+        """Breaker-style degrade: open the device path (host-oracle
+        answers while degraded), dump the flight recorder (the poisoned
+        launches are the evidence), drop the condemned state (next check
+        rebuilds from the store)."""
+        with self._mu:
+            self.stats["divergences"] += len(diverged)
+            self.stats["repairs"] += 1
+            self.stats["last_divergence"] = {
+                "nid": nid,
+                "tables": [d["table"] for d in diverged],
+            }
+        logger.error(
+            "mirror scrub DIVERGENCE nid=%s tables=%s — tripping the "
+            "device-path breaker and rebuilding the mirror from the store",
+            nid, [d["table"] for d in diverged],
+        )
+        if self.metrics is not None:
+            for d in diverged:
+                self.metrics.scrub_divergence_total.labels(d["table"]).inc()
+            self.metrics.scrub_repairs_total.inc()
+        flightrec = getattr(self.registry, "_flightrec", None)
+        if flightrec is not None:
+            flightrec.dump("scrub")
+        self.registry.circuit_breaker().trip()
+        invalidate = getattr(engine, "invalidate", None)
+        if invalidate is not None:
+            invalidate()
+        # the condemned generation's expectation dies with it
+        self._expected.pop(nid, None)
+
+    # -- admin surface ---------------------------------------------------------
+
+    def status(self) -> dict:
+        """GET /admin/scrub body: config + counters + last-pass facts
+        (monotonic stamps — wall clocks are banned repo-wide; age is
+        `now_mono` minus `last_pass_mono`)."""
+        with self._mu:
+            stats = dict(self.stats)
+        return {
+            "enabled": self.enabled,
+            "running": self._thread is not None,
+            "interval_s": self.interval_s,
+            "slice_rows": self.slice_rows,
+            "now_mono": time.monotonic(),
+            **stats,
+        }

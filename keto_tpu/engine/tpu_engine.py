@@ -360,6 +360,10 @@ class TPUCheckEngine:
             logging.getLogger("keto_tpu").warning(
                 "mirror checkpoint write failed: %s", err
             )
+            if self.metrics is not None:
+                # counted HERE, where the failure is swallowed — the
+                # registry-level shutdown catch never sees this path
+                self.metrics.checkpoint_write_failures_total.inc()
 
     def _delta_refresh(
         self, state: _EngineState, store_version: int
@@ -748,9 +752,9 @@ class TPUCheckEngine:
         d = self.config.get("check.mirror_cache")
         if not d:
             return None
-        import os
+        from .checkpoint import mirror_cache_path
 
-        return os.path.join(d, f"mirror-{self.nid}.npz")
+        return mirror_cache_path(d, self.nid)
 
     def _rebuild(
         self, store_version: int, config_fp, namespaces
@@ -781,6 +785,22 @@ class TPUCheckEngine:
                 self.stats["snapshot_loads"] = self.stats.get("snapshot_loads", 0) + 1
                 self._set_mirror_gauges(state.tables)
                 return state, None
+            # a checkpoint existed but could not warm this restart:
+            # count why (cold-start recovery audit — "stale" is a file
+            # for another (store version, config) pair, "corrupt" a
+            # torn/truncated/incompatible one). The rebuild below IS the
+            # degrade path; answers never depend on the cache.
+            import os as _os
+
+            if _os.path.exists(cache_path):
+                reason = "stale" if cached is not None else "corrupt"
+                self.stats[f"checkpoint_fallback_{reason}"] = (
+                    self.stats.get(f"checkpoint_fallback_{reason}", 0) + 1
+                )
+                if self.metrics is not None:
+                    self.metrics.checkpoint_load_fallbacks_total.labels(
+                        reason
+                    ).inc()
         build_start = time.perf_counter()
         # columnar fast path: stores exposing all_tuple_columns feed the
         # vectorized builder directly — no per-tuple Python objects on
@@ -879,6 +899,48 @@ class TPUCheckEngine:
     def invalidate(self) -> None:
         with self._lock:
             self._state = None
+
+    def mirror_state(self):
+        """The current immutable state generation (or None before the
+        first build). The anti-entropy scrubber (engine/scrub.py) reads
+        it to checksum device tables against `state.snapshot`'s host
+        truth — both sides of that comparison live on the SAME state
+        object, so the scrub stays consistent even if the engine swaps
+        states mid-pass."""
+        with self._lock:
+            return self._state
+
+    def corrupt_mirror(
+        self, table: Optional[str] = None, bit: int = 0
+    ) -> Optional[str]:
+        """Flip one bit in a device-mirror table in place — the
+        `mirror_corrupt` fault's payload (a silent HBM fault stand-in,
+        test/smoke only). Returns the corrupted table key, or None when
+        no single-device state is built. The host-side snapshot is left
+        intact: exactly the divergence the scrubber exists to catch."""
+        with self._lock:
+            state = self._state
+        if state is None or not isinstance(state.tables, dict):
+            return None  # mesh path: per-shard tables, not scrubbed
+        tables = state.tables
+        key = table or max(
+            tables,
+            key=lambda k: int(getattr(tables[k], "nbytes", 0) or 0),
+        )
+        import jax.numpy as jnp
+
+        host = np.asarray(tables[key]).copy()
+        flat = host.reshape(-1).view(np.uint8)
+        if flat.size == 0:
+            return None
+        flat[bit // 8 % flat.size] ^= np.uint8(1 << (bit % 8))
+        with self._lock:
+            if self._state is state:  # don't poison a successor state
+                tables[key] = jnp.asarray(host)
+        self.stats["mirror_corruptions"] = (
+            self.stats.get("mirror_corruptions", 0) + 1
+        )
+        return key
 
     def hbm_snapshot(self) -> dict:
         """Structured device-memory + staleness accounting for the
@@ -1700,6 +1762,13 @@ class TPUCheckEngine:
         _faults.inject("device_launch")
         t_submit = time.perf_counter()
         state = self._ensure_state()
+        # marker fault (keto_tpu/faults.py mirror_corrupt): flip one bit
+        # in a device table before this launch — the silent-HBM-fault
+        # stand-in the anti-entropy scrubber (engine/scrub.py) must
+        # detect and auto-repair. Disarmed: one dict miss.
+        corrupt_spec = _faults.get("mirror_corrupt")
+        if corrupt_spec is not None and corrupt_spec.should_fire():
+            self.corrupt_mirror()
         global_max = self.config.max_read_depth()
         depth = max_depth if 0 < max_depth <= global_max else global_max
 

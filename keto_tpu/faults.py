@@ -16,6 +16,38 @@ an empty dict when nothing is armed — nanoseconds on the hot path):
     every slot's device verdict so each query replays on the EXACT host
     oracle — the same cause-coded escape hatch capacity overflows use,
     now drivable on demand. Answers must stay byte-correct.
+  - ``mirror_corrupt``  — marker fault: `check_batch_submit` flips one
+    bit in a device-mirror table before launching (a silent HBM fault).
+    The anti-entropy scrubber (engine/scrub.py) must detect it within
+    one scrub interval and repair through the breaker-degrade path.
+
+CRASH points (the crash-recovery plane, tools/crash_smoke.py): a
+``crash:<exit code>`` spec makes the point die with ``os._exit(code)``
+the instant it fires — no atexit hooks, no flushes, the in-process
+equivalent of ``kill -9`` at a named instruction boundary. The points
+bracket every durability-ordering window the kill-anywhere harness
+audits:
+
+  - ``store_commit_pre``      — inside the store write transaction,
+    AFTER the rows and changelog are staged but BEFORE COMMIT: the
+    write must NOT survive the crash (it was never acked).
+  - ``store_commit_post``     — after COMMIT, before the post-commit
+    write hooks run: durable but unacked — the restarted server may
+    serve it, the client never assumed it.
+  - ``changelog_append``      — inside the transaction, between the
+    tuple writes and the changelog insert: the crash must lose BOTH
+    atomically (a tuple without its changelog row would silently
+    starve watch resume).
+  - ``checkpoint_pre_rename`` — mirror checkpoint written + fsynced but
+    not yet renamed into place: restart sees the OLD checkpoint (or
+    none) plus a stray ``*.npz.tmp`` — never a torn file.
+  - ``checkpoint_post_rename``— after the atomic rename: restart sees
+    the NEW checkpoint, loadable or version-mismatched, never torn.
+  - ``cache_invalidation``    — after commit, before engine/check-cache
+    push-invalidation delivery (registry._push_invalidate).
+  - ``watch_broadcast``       — after the hub tailer read the
+    changelog, before fanning the events out to subscribers: resumed
+    cursors must still see the events exactly once from the store.
 
 Armed per-process, either programmatically (`set_fault` / `clear`, the
 tests' and smoke harness's path) or via the ``KETO_FAULTS`` environment
@@ -23,6 +55,13 @@ variable parsed at import::
 
     KETO_FAULTS="device_launch=stall:0.25,store_read=error:disk gone"
     KETO_FAULTS="batch_corrupt=on"
+    KETO_FAULTS="store_commit_pre=crash:137@0.25"   # crash ~25% of commits
+    KETO_FAULTS="changelog_append=crash:137!1"      # at most one crash
+
+``@<probability>`` and ``!<max_hits>`` suffixes compose with the
+``stall`` / ``crash`` / ``on`` modes (the env-var spelling of the
+programmatic ``probability=`` / ``max_hits=`` arguments); ``error``
+messages are taken verbatim — arm flaky error faults via ``set_fault``.
 
 Never armed in production images by default: an empty spec table makes
 every injection point a single dict miss.
@@ -42,19 +81,24 @@ class FaultInjected(RuntimeError):
 
 class FaultSpec:
     __slots__ = (
-        "stall_s", "error", "hits", "probability", "max_hits", "_rng", "_mu",
+        "stall_s", "error", "crash", "hits", "probability", "max_hits",
+        "_rng", "_mu",
     )
 
     def __init__(
         self,
         stall_s: float = 0.0,
         error: Optional[str] = None,
+        crash: Optional[int] = None,
         probability: float = 1.0,
         max_hits: Optional[int] = None,
         seed: Optional[int] = None,
     ):
         self.stall_s = float(stall_s or 0.0)
         self.error = error
+        # crash-mode exit code (os._exit — the in-process kill -9); None
+        # for stall/error/marker faults
+        self.crash = crash if crash is None else int(crash)
         # partial faults: `probability` injects on a fraction of hits (a
         # FLAKY device path — the tail-latency shape request hedging
         # exists for: p50 healthy, p99 eats the stall); `max_hits` bounds
@@ -82,7 +126,14 @@ class FaultSpec:
             return True
 
 
-POINTS = ("device_launch", "store_read", "batch_corrupt")
+POINTS = (
+    "device_launch", "store_read", "batch_corrupt", "mirror_corrupt",
+    # crash-recovery plane boundaries (module docstring; every one is a
+    # dict miss when disarmed, like the rest)
+    "store_commit_pre", "store_commit_post", "changelog_append",
+    "checkpoint_pre_rename", "checkpoint_post_rename",
+    "cache_invalidation", "watch_broadcast",
+)
 
 _SPECS: dict[str, FaultSpec] = {}
 _mu = threading.Lock()
@@ -92,20 +143,23 @@ def set_fault(
     point: str,
     stall_s: float = 0.0,
     error: Optional[str] = None,
+    crash: Optional[int] = None,
     probability: float = 1.0,
     max_hits: Optional[int] = None,
     seed: Optional[int] = None,
 ) -> FaultSpec:
     """Arm one injection point; returns its spec (hits counter included).
-    A spec with neither stall nor error is a pure marker (batch_corrupt);
-    `probability` < 1 makes the fault flaky (served on a fraction of
-    hits), `max_hits` bounds served injections (deterministic tests)."""
+    A spec with no stall/error/crash is a pure marker (batch_corrupt);
+    `crash` makes the point os._exit with that code (kill-anywhere
+    harness); `probability` < 1 makes the fault flaky (served on a
+    fraction of hits), `max_hits` bounds served injections
+    (deterministic tests)."""
     if point not in POINTS:
         raise ValueError(
             f"unknown fault point {point!r}; known: {', '.join(POINTS)}"
         )
     spec = FaultSpec(
-        stall_s=stall_s, error=error, probability=probability,
+        stall_s=stall_s, error=error, crash=crash, probability=probability,
         max_hits=max_hits, seed=seed,
     )
     with _mu:
@@ -133,7 +187,7 @@ def armed_names() -> list[str]:
 
 
 def inject(point: str) -> None:
-    """Serve one injection: sleep the stall, then raise the error (both
+    """Serve one injection: sleep the stall, then crash or raise (all
     optional). A disarmed point is one dict miss; a partial fault
     (probability < 1 / max_hits reached) passes through untouched."""
     spec = _SPECS.get(point)
@@ -143,39 +197,86 @@ def inject(point: str) -> None:
         return
     if spec.stall_s:
         time.sleep(spec.stall_s)
+    if spec.crash is not None:
+        # the in-process kill -9: no atexit, no finally blocks, no
+        # buffered-IO flush — exactly the torn state a SIGKILL at this
+        # instruction boundary would leave behind
+        os._exit(spec.crash)
     if spec.error is not None:
         raise FaultInjected(spec.error)
 
 
+def _split_suffixes(value: str) -> tuple[str, float, Optional[int]]:
+    """Strip the shared ``@<probability>`` / ``!<max_hits>`` suffixes
+    off an env-var mode value (either order), returning
+    (bare value, probability, max_hits)."""
+    probability, max_hits = 1.0, None
+    # scan from the right so a literal '@'/'!' inside an error message
+    # body (left of the first suffix) is never consumed
+    while True:
+        at, bang = value.rfind("@"), value.rfind("!")
+        cut = max(at, bang)
+        if cut < 0:
+            break
+        head, tail = value[:cut], value[cut + 1:]
+        try:
+            if cut == at:
+                probability = float(tail)
+            else:
+                max_hits = int(tail)
+        except ValueError:
+            break  # not a suffix: part of the value proper
+        value = head
+    return value, probability, max_hits
+
+
 def configure(text: str) -> None:
     """Parse the KETO_FAULTS format: comma-separated
-    ``point=stall:<seconds>`` / ``point=error:<message>`` / ``point=on``
-    entries; a ``@<probability>`` suffix on a stall value makes the
-    fault flaky (``device_launch=stall:0.25@0.2`` stalls ~20% of
-    launches — the tail-latency shape the hedging smoke injects).
-    Replaces the whole armed set."""
+    ``point=stall:<seconds>`` / ``point=error:<message>`` /
+    ``point=crash:<exit code>`` / ``point=on`` entries; on the stall /
+    crash / on modes, ``@<probability>`` makes the entry flaky
+    (``device_launch=stall:0.25@0.2`` stalls ~20% of launches — the
+    tail-latency shape the hedging smoke injects;
+    ``store_commit_pre=crash:137@0.25`` crashes ~25% of commits) and
+    ``!<max_hits>`` bounds served injections; error messages are taken
+    verbatim (module docstring). Replaces the whole armed set."""
     clear()
     for entry in (text or "").split(","):
         entry = entry.strip()
         if not entry:
             continue
         name, _, spec = entry.partition("=")
-        mode, _, value = spec.partition(":")
+        mode, sep, value = spec.partition(":")
         name, mode = name.strip(), mode.strip()
+        probability, max_hits = 1.0, None
+        if not sep:
+            # value-less modes (``on``) carry the suffixes on the mode
+            # token itself: ``mirror_corrupt=on!1``
+            mode, probability, max_hits = _split_suffixes(mode)
+        elif mode != "error":
+            # error MESSAGES are taken verbatim — '@'/'!' are legitimate
+            # message content ("error:HTTP 429!") and must never be
+            # reinterpreted as suffixes; arm flaky/bounded error faults
+            # programmatically (set_fault) instead
+            value, probability, max_hits = _split_suffixes(value)
         if mode == "stall":
-            value, _, prob = value.partition("@")
             set_fault(
                 name, stall_s=float(value),
-                probability=float(prob) if prob else 1.0,
+                probability=probability, max_hits=max_hits,
             )
         elif mode == "error":
             set_fault(name, error=value or "injected fault")
+        elif mode == "crash":
+            set_fault(
+                name, crash=int(value or 137),
+                probability=probability, max_hits=max_hits,
+            )
         elif mode == "on":
-            set_fault(name)
+            set_fault(name, probability=probability, max_hits=max_hits)
         else:
             raise ValueError(
                 f"unknown fault mode {mode!r} in {entry!r} "
-                "(use stall:<s>, error:<msg>, or on)"
+                "(use stall:<s>, error:<msg>, crash:<code>, or on)"
             )
 
 

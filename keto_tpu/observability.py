@@ -643,6 +643,60 @@ class Metrics:
             "(a cancelled pending never occupies a device batch slot)",
             registry=self.registry,
         )
+        # crash-recovery plane (engine/scrub.py, engine/checkpoint.py,
+        # tools/crash_smoke.py): cold-start recovery + anti-entropy
+        self.checkpoint_load_fallbacks_total = prom.Counter(
+            "keto_tpu_checkpoint_load_fallbacks_total",
+            "Warm-restart mirror checkpoints that existed but could not "
+            "be used, by reason: corrupt (torn/truncated/incompatible "
+            "file — crash mid-write or format drift) or stale (valid "
+            "file for another (store version, config) pair). Either way "
+            "the engine rebuilt from the store — the fallback is the "
+            "contract, this counts how often it fires",
+            ["reason"],
+            registry=self.registry,
+        )
+        self.checkpoint_write_failures_total = prom.Counter(
+            "keto_tpu_checkpoint_write_failures_total",
+            "Mirror checkpoint writes that failed (full disk, revoked "
+            "mount) — deferred-flush OSErrors and shutdown-flush "
+            "failures both count; serving and drain continue either "
+            "way (the store is the durability, the checkpoint is a "
+            "warm-restart optimization)",
+            registry=self.registry,
+        )
+        self.scrub_passes_total = prom.Counter(
+            "keto_tpu_scrub_passes_total",
+            "Completed anti-entropy scrub passes (every engine's device "
+            "mirror fully checksummed against the host truth once per "
+            "pass; incremental slices — scrub.slice_rows — spread the "
+            "work across the interval)",
+            registry=self.registry,
+        )
+        self.scrub_slices_total = prom.Counter(
+            "keto_tpu_scrub_slices_total",
+            "Device-mirror table slices checksummed by the anti-entropy "
+            "scrubber (engine/scrub.py)",
+            registry=self.registry,
+        )
+        self.scrub_divergence_total = prom.Counter(
+            "keto_tpu_scrub_divergence_total",
+            "Device-mirror slices whose checksum DIVERGED from the host "
+            "recomputation at the mirror's covered version, by device "
+            "table — a silent HBM/table corruption caught by the "
+            "scrubber; each divergence dumps the flight-recorder tail "
+            "and triggers the breaker-degrade auto-repair",
+            ["table"],
+            registry=self.registry,
+        )
+        self.scrub_repairs_total = prom.Counter(
+            "keto_tpu_scrub_repairs_total",
+            "Automatic mirror repairs triggered by scrub divergence: "
+            "the breaker opens (checks host-oracle-serve, staying "
+            "correct), the poisoned state is dropped, and the next "
+            "check rebuilds the mirror from the store",
+            registry=self.registry,
+        )
         # hot-path cache: (transport, method) -> (duration child,
         # {code: counter child})
         self._observe_cache: dict = {}

@@ -134,6 +134,7 @@ class Registry:
         self._tracer = None
         self._profiler = None
         self._flightrec = None
+        self._scrubber = None
         self._watch_hub = None
         self._check_cache = None
         self._check_cache_built = False
@@ -250,15 +251,27 @@ class Registry:
     def flush_checkpoints(self) -> None:
         """Flush pending device-mirror checkpoints for EVERY cached
         engine (default network + all tenants); the daemon calls this on
-        graceful shutdown."""
+        graceful shutdown. A failing write (full disk, revoked mount)
+        must not abort the drain: the checkpoint is a warm-restart
+        optimization — the store is the durability — so each failure is
+        logged + counted and the remaining engines still flush."""
         with self._lock:
             engines = list(self._nid_engines.values())
             if self._engine is not None:
                 engines.append(self._engine)
         for engine in engines:
             flush = getattr(engine, "flush_checkpoints", None)
-            if flush is not None:
+            if flush is None:
+                continue
+            try:
                 flush()
+            except Exception:  # noqa: BLE001 — shutdown must complete
+                logger.warning(
+                    "mirror checkpoint flush failed for nid=%s "
+                    "(cold start will rebuild from the store)",
+                    getattr(engine, "nid", "?"), exc_info=True,
+                )
+                self.metrics().checkpoint_write_failures_total.inc()
 
     def _build_engine(self, nid: str):
         kind = self.config.get("check.engine", "tpu")
@@ -315,6 +328,13 @@ class Registry:
         (never builds one — a tenant nobody queries must not get a device
         mirror just because someone wrote to it) and the serve-side
         check cache's invalidation thread."""
+        from . import faults as _faults
+
+        # crash point (keto_tpu/faults.py): committed + hub-notified but
+        # the engine/cache pokes never ran — the restarted process must
+        # converge from the durable store alone (it does: invalidation
+        # is hygiene, the per-request version gate is the correctness)
+        _faults.inject("cache_invalidation")
         with self._lock:
             engine = (
                 self._engine if nid == self.nid else self._nid_engines.get(nid)
@@ -429,6 +449,27 @@ class Registry:
                     metrics=self.metrics(),
                 )
             return self._breaker
+
+    def mirror_scrubber(self):
+        """The anti-entropy device-mirror scrubber (engine/scrub.py):
+        one background singleton incrementally checksumming every built
+        engine's device tables against the host truth at the mirror's
+        covered version. `scrub.{enabled,interval_s,slice_rows}`
+        configure it; the daemon starts/stops the loop around serving,
+        and `GET/POST /admin/scrub` on the metrics listener read state /
+        trigger a full pass on demand."""
+        with self._lock:
+            if self._scrubber is None:
+                from .engine.scrub import MirrorScrubber
+
+                self._scrubber = MirrorScrubber(
+                    self,
+                    enabled=bool(self.config.get("scrub.enabled", False)),
+                    interval_s=float(self.config.get("scrub.interval_s", 30.0)),
+                    slice_rows=int(self.config.get("scrub.slice_rows", 1 << 16)),
+                    metrics=self.metrics(),
+                )
+            return self._scrubber
 
     def profiler(self):
         """The process-wide on-demand capture session (profiling.py),

@@ -292,6 +292,9 @@ class CircuitBreaker:
         self._state = self.CLOSED
         self._failures = 0
         self._open_until = 0.0
+        # trip() floor: while the clock is below it, record_success from
+        # batches launched BEFORE the trip must not close the breaker
+        self._floor_until = 0.0
         self._probe_inflight = False
         self._probe_started = 0.0
         # bounded transition trail (tests/smoke observability; a
@@ -354,8 +357,17 @@ class CircuitBreaker:
         with self._lock:
             self._failures = 0
             self._probe_inflight = False
-            if self._state != self.CLOSED:
-                self._transition(self.CLOSED)
+            if self._state == self.CLOSED:
+                return
+            # a trip() floor holds the breaker open against successes
+            # from batches that were already in flight when the trip
+            # landed: their outcome says nothing about the condition
+            # (e.g. mirror divergence) the tripper detected. Recovery
+            # then rides the normal cooldown -> half-open probe, which
+            # allow() only grants after the floor has passed.
+            if self._clock() < self._floor_until:
+                return
+            self._transition(self.CLOSED)
 
     def record_failure(self) -> None:
         with self._lock:
@@ -367,4 +379,21 @@ class CircuitBreaker:
             self._failures += 1
             if self._state == self.CLOSED and self._failures >= self.threshold:
                 self._open_until = self._clock() + self.cooldown_s
+                self._transition(self.OPEN)
+
+    def trip(self, cooldown_s: Optional[float] = None) -> None:
+        """Open the breaker NOW, unconditionally — the degrade entry
+        point for detectors that established device-path unhealthiness
+        out of band (the anti-entropy scrubber on mirror divergence:
+        consecutive-failure counting is meaningless when the evidence is
+        a checksum, not a request). Checks host-oracle-serve for the
+        cooldown; the usual half-open probe then decides recovery
+        against the rebuilt mirror."""
+        with self._lock:
+            self._probe_inflight = False
+            self._open_until = self._clock() + (
+                self.cooldown_s if cooldown_s is None else float(cooldown_s)
+            )
+            self._floor_until = self._open_until
+            if self._state != self.OPEN:
                 self._transition(self.OPEN)

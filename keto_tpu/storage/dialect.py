@@ -185,7 +185,21 @@ class SQLiteDialect(Dialect):
         return conn
 
     def on_connect(self, conn) -> None:
+        # the DECLARED durability contract the crash harness
+        # (tools/crash_smoke.py) asserts — pinned here instead of riding
+        # driver/compile-time defaults, and test-asserted
+        # (tests/test_store.py::TestDurabilityPragmas):
+        #   journal_mode=WAL    — a committed transaction lives in the
+        #     write-ahead log the instant COMMIT returns; a process
+        #     killed mid-write leaves the log either without the commit
+        #     record (rolled back on open) or with it (replayed) — never
+        #     a torn page in the main file
+        #   synchronous=FULL    — COMMIT fsyncs the WAL, so an acked
+        #     write survives power loss too, not just process death
+        #     (NORMAL would survive kill -9 but can lose the tail of the
+        #     log on an OS crash)
         conn.execute("PRAGMA journal_mode=WAL")
+        conn.execute("PRAGMA synchronous=FULL")
         conn.execute("PRAGMA foreign_keys=ON")
 
 

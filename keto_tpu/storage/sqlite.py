@@ -36,6 +36,7 @@ import threading
 import uuid
 from typing import Iterable, Sequence
 
+from .. import faults as _faults
 from ..errors import NotFoundError
 from ..ketoapi import RelationQuery, RelationTuple, SubjectSet
 from .definitions import (
@@ -768,6 +769,8 @@ class SQLPersister(WriteHookMixin):
                 changed = True
                 self._bump_version(nid)
                 self._log_changes(nid, [("delete", t) for t in doomed])
+            _faults.inject("store_commit_pre")  # see transact_relation_tuples
+        _faults.inject("store_commit_post")
         self._notify_write(nid, changed)
 
     def transact_relation_tuples(
@@ -819,6 +822,14 @@ class SQLPersister(WriteHookMixin):
             if ops:
                 self._bump_version(nid)
                 self._log_changes(nid, ops)
+            # crash point (keto_tpu/faults.py): die INSIDE the write
+            # transaction — rows + changelog staged, COMMIT never runs.
+            # The kill-anywhere harness asserts the whole commit is lost
+            # atomically (the client was never acked).
+            _faults.inject("store_commit_pre")
+        # crash point: die AFTER the commit, before the post-commit write
+        # hooks — durable but unacked (the client's connection just died)
+        _faults.inject("store_commit_post")
         self._notify_write(nid, bool(ops))
 
     # -- change log (delta-overlay + watch feed) ------------------------------
@@ -859,6 +870,11 @@ class SQLPersister(WriteHookMixin):
         version = self._conn.execute(
             "SELECT version FROM keto_store_version WHERE nid = ?", (nid,)
         ).fetchone()[0]
+        # crash point (keto_tpu/faults.py): die between the tuple writes
+        # and the changelog append, still inside the transaction — the
+        # crash must lose BOTH atomically (a committed tuple without its
+        # changelog row would silently starve watch resume)
+        _faults.inject("changelog_append")
         self._conn.executemany(
             "INSERT INTO keto_change_log (nid, version, op, tuple) VALUES (?, ?, ?, ?)",
             [(nid, version, op, json.dumps(t.to_dict())) for op, t in ops],

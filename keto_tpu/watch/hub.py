@@ -429,16 +429,36 @@ class WatchHub:
         return min([state.tail_version] + [s.cursor for s in subs])
 
     def stop(self) -> None:
-        """Daemon shutdown: close every subscription and stop tailers."""
+        """Daemon shutdown: close every subscription, stop tailers, and
+        JOIN them — "stopped" means quiesced, so a caller may close the
+        underlying store the moment this returns (the crash-recovery
+        restart tests do exactly that) without a parting tailer drain
+        racing the closed connection."""
         self._stopped = True
         with self._states_lock:
             states = list(self._states.items())
+        threads = []
         for _nid, state in states:
             with state.lock:
                 subs = list(state.subs)
+                if state.thread is not None:
+                    threads.append(state.thread)
                 state.cond.notify_all()
             for sub in subs:
                 sub.close()
+        for thread in threads:
+            thread.join(timeout=5)
+            if thread.is_alive():
+                # the quiesced-on-return contract could not be met (a
+                # tailer wedged >5s inside a store read): SAY so — the
+                # caller about to close the store can decide, instead of
+                # rediscovering this as a use-after-close race
+                import logging
+
+                logging.getLogger("keto_tpu").warning(
+                    "watch hub stop: tailer %s still running after join "
+                    "timeout; store teardown may race it", thread.name,
+                )
 
     # -- internals -------------------------------------------------------------
 
@@ -520,6 +540,14 @@ class WatchHub:
                 sub._force_reset(event)
                 self._count_reset()
         else:
+            # crash point (keto_tpu/faults.py): the tailer read the
+            # durable changelog but dies before fanning it out — resumed
+            # cursors must still get these events exactly once from the
+            # store after restart (the tail position is derived, never
+            # persisted, so nothing here can be lost ahead of delivery)
+            from .. import faults as _faults
+
+            _faults.inject("watch_broadcast")
             delivered = 0
             for event in self._group(nid, ops):
                 for sub in state.subs:
@@ -556,6 +584,13 @@ class WatchHub:
                     return
                 if not state.dirty:
                     state.cond.wait(self.poll_interval)
+                # re-check AFTER the park: stop() may have flipped the
+                # flag while this thread waited — one more drain here
+                # would race whatever the stopper tears down next (e.g.
+                # the store connection on a restart-test shutdown)
+                if self._stopped:
+                    state.thread = None
+                    return
                 self._drain_locked(state, nid)
 
     # -- metrics helpers -------------------------------------------------------

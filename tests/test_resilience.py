@@ -144,6 +144,71 @@ class TestFaultsUnit:
     def test_disarmed_inject_is_noop(self):
         faults.inject("device_launch")  # no spec: returns silently
 
+    def test_configure_parses_crash_and_suffixes(self):
+        faults.configure(
+            "store_commit_pre=crash:137@0.25,"
+            "changelog_append=crash:9!1,"
+            "device_launch=stall:0.5@0.2!3,"
+            "watch_broadcast=crash:",
+        )
+        pre = faults.get("store_commit_pre")
+        assert pre.crash == 137 and pre.probability == 0.25
+        cl = faults.get("changelog_append")
+        assert cl.crash == 9 and cl.max_hits == 1
+        dl = faults.get("device_launch")
+        assert dl.stall_s == 0.5 and dl.probability == 0.2 and dl.max_hits == 3
+        assert faults.get("watch_broadcast").crash == 137  # default code
+        faults.clear()
+        # value-less modes carry suffixes on the mode token itself
+        faults.configure("mirror_corrupt=on!1@0.5")
+        mc = faults.get("mirror_corrupt")
+        assert mc.max_hits == 1 and mc.probability == 0.5
+        assert mc.crash is None and mc.error is None and mc.stall_s == 0
+        faults.clear()
+
+    def test_error_messages_taken_verbatim(self):
+        # '@'/'!' are legitimate message content — never reinterpreted
+        # as probability/max_hits suffixes on the error mode
+        faults.configure("store_read=error:HTTP 429!3")
+        spec = faults.get("store_read")
+        assert spec.error == "HTTP 429!3"
+        assert spec.max_hits is None and spec.probability == 1.0
+        faults.clear()
+
+    def test_crash_inject_exits_process(self, tmp_path):
+        """The crash mode really is os._exit at the point — proven in a
+        subprocess (faults.py imports stand alone, so the child pays no
+        jax/grpc import)."""
+        import subprocess
+        import sys
+
+        code = (
+            "import importlib.util\n"
+            "spec = importlib.util.spec_from_file_location("
+            "'faults', 'keto_tpu/faults.py')\n"
+            "m = importlib.util.module_from_spec(spec)\n"
+            "spec.loader.exec_module(m)\n"
+            "m.configure('store_commit_pre=crash:41')\n"
+            "m.inject('store_commit_pre')\n"
+            "raise SystemExit(0)  # unreachable: inject never returns\n"
+        )
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        proc = subprocess.run(
+            [sys.executable, "-c", code], cwd=repo, timeout=60
+        )
+        assert proc.returncode == 41
+
+    def test_crash_spec_respects_max_hits_before_firing(self):
+        """A crash spec whose max_hits budget is exhausted passes through
+        (exercised in-process: should_fire consumes the only hit, the
+        next inject is a no-op — were it not, this test would die)."""
+        spec = faults.set_fault("store_commit_pre", crash=137, max_hits=0)
+        faults.inject("store_commit_pre")  # budget 0: must NOT exit
+        assert spec.hits == 0
+        faults.clear()
+
 
 # ---------------------------------------------------------------------------
 # unit: backoff + RetryPolicy
@@ -313,6 +378,33 @@ class TestCircuitBreakerUnit:
         assert m.breaker_state._value.get() == 2
         br.record_success()
         assert m.breaker_state._value.get() == 0
+
+    def test_trip_holds_against_inflight_successes(self):
+        """A scrubber trip() must not be undone by record_success from
+        batches already in flight when the trip landed: their outcome
+        says nothing about the out-of-band evidence (mirror divergence)
+        that opened the breaker."""
+        clock = [0.0]
+        br = CircuitBreaker(threshold=5, cooldown_s=5.0, clock=lambda: clock[0])
+        br.trip()
+        assert br.state == "open"
+        br.record_success()  # straggler from a pre-trip batch
+        assert br.state == "open"
+        assert not br.allow()  # still cooling down
+        clock[0] = 5.1
+        assert br.allow()  # half-open probe granted after the floor
+        assert br.state == "half_open"
+        br.record_success()  # the probe's own outcome closes it
+        assert br.state == "closed"
+
+    def test_trip_custom_cooldown(self):
+        clock = [0.0]
+        br = CircuitBreaker(threshold=5, cooldown_s=5.0, clock=lambda: clock[0])
+        br.trip(cooldown_s=1.0)
+        clock[0] = 0.5
+        assert not br.allow()
+        clock[0] = 1.1
+        assert br.allow() and br.state == "half_open"
 
 
 # ---------------------------------------------------------------------------

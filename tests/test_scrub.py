@@ -1,0 +1,263 @@
+"""Anti-entropy mirror scrubber (engine/scrub.py): device-vs-host
+checksum passes, the mirror_corrupt fault differential (detection within
+one interval, breaker-degrade auto-repair, zero wrong answers during
+degrade vs the host oracle), clean-run zero false positives, and the
+/admin/scrub surface."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from keto_tpu import faults
+from keto_tpu.config import Config
+from keto_tpu.ketoapi import RelationTuple
+from keto_tpu.namespace.ast import ComputedSubjectSet, Relation, SubjectSetRewrite
+from keto_tpu.namespace.definitions import Namespace
+from keto_tpu.registry import Registry
+
+NAMESPACES = [
+    Namespace(
+        name="files",
+        relations=[
+            Relation(name="owner"),
+            Relation(
+                name="view",
+                subject_set_rewrite=SubjectSetRewrite(
+                    children=[ComputedSubjectSet(relation="owner")]
+                ),
+            ),
+        ],
+    ),
+    Namespace(name="groups", relations=[Relation(name="member")]),
+]
+
+FIXTURE = [
+    "files:a#owner@alice",
+    "files:a#view@(files:b#owner)",
+    "files:b#owner@bob",
+    "groups:g#member@carol",
+]
+QUERIES = [
+    "files:a#owner@alice",
+    "files:a#owner@bob",
+    "files:a#view@bob",
+    "files:a#view@eve",
+    "groups:g#member@carol",
+]
+
+
+def ts(*strs):
+    return [RelationTuple.from_string(s) for s in strs]
+
+
+def make_registry(**scrub):
+    cfg = Config({"dsn": "memory", "scrub": scrub} if scrub else {"dsn": "memory"})
+    cfg.set_namespaces(NAMESPACES)
+    reg = Registry(cfg)
+    reg.relation_tuple_manager().write_relation_tuples(ts(*FIXTURE))
+    return reg
+
+
+def oracle(reg, q):
+    from keto_tpu.engine.reference import ReferenceEngine
+    from keto_tpu.storage.definitions import DEFAULT_NETWORK
+
+    ref = ReferenceEngine(reg.relation_tuple_manager(), reg.config)
+    return bool(
+        ref.check_relation_tuple(
+            RelationTuple.from_string(q), 0, DEFAULT_NETWORK
+        ).allowed
+    )
+
+
+@pytest.fixture(autouse=True)
+def _clear_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+class TestScrubPass:
+    def test_clean_mirror_zero_divergence(self):
+        reg = make_registry()
+        engine = reg.check_engine()
+        assert engine.check_is_member(ts("files:a#view@bob")[0])
+        report = reg.mirror_scrubber().scrub_pass()
+        assert report["default"]["scrubbed"] is True
+        assert report["default"]["diverged"] == []
+        assert report["default"]["slices"] > 0
+
+    def test_clean_delta_overlay_state_zero_divergence(self):
+        """A state carrying a live delta overlay (and its overlay-
+        extended vocab arrays) must also scrub clean — the expectation
+        recomputes the overlay, not just the base snapshot."""
+        reg = make_registry()
+        engine = reg.check_engine()
+        engine.check_is_member(ts("files:a#view@bob")[0])
+        reg.relation_tuple_manager().write_relation_tuples(
+            ts("files:brandnew#owner@dora")
+        )
+        assert engine.check_is_member(ts("files:brandnew#owner@dora")[0])
+        state = engine.mirror_state()
+        assert state.has_delta  # the overlay path really is under test
+        report = reg.mirror_scrubber().scrub_pass()
+        assert report["default"]["diverged"] == []
+
+    def test_unbuilt_engine_not_materialized(self):
+        reg = make_registry()
+        report = reg.mirror_scrubber().scrub_pass()
+        assert report == {}  # built_engines() empty: nothing scrubbed
+        assert reg._engine is None
+
+    def test_expectation_cache_pruned_for_vanished_engines(self):
+        """The host-side expectation copy dies with its engine — tenant
+        churn / invalidation must not grow host memory without bound."""
+        reg = make_registry()
+        engine = reg.check_engine()
+        engine.check_is_member(ts("files:a#owner@alice")[0])
+        scrubber = reg.mirror_scrubber()
+        scrubber.scrub_pass()
+        assert "default" in scrubber._expected
+        engine.invalidate()  # state gone: nothing to scrub next pass
+        scrubber.scrub_pass()
+        assert scrubber._expected == {}
+
+    def test_slice_rows_bounds_chunks(self):
+        reg = make_registry(enabled=False, slice_rows=4)
+        engine = reg.check_engine()
+        engine.check_is_member(ts("files:a#owner@alice")[0])
+        scrubber = reg.mirror_scrubber()
+        assert scrubber.slice_rows == 4
+        report = scrubber.scrub_pass()
+        # every table of >4 rows splits into multiple slices
+        assert report["default"]["slices"] > report["default"]["tables"]
+
+
+class TestCorruptionDifferential:
+    def test_bitflip_detected_and_auto_repaired(self):
+        reg = make_registry()
+        engine = reg.check_engine()
+        engine.check_is_member(ts("files:a#view@bob")[0])
+        key = engine.corrupt_mirror()
+        assert key is not None
+        scrubber = reg.mirror_scrubber()
+        report = scrubber.scrub_pass()
+        diverged = report["default"]["diverged"]
+        assert diverged and diverged[0]["table"] == key
+        # breaker-degrade path engaged + state condemned
+        assert reg.circuit_breaker().state == "open"
+        assert engine.mirror_state() is None
+        # ZERO wrong answers during degrade: every check now matches the
+        # host oracle (the rebuild happens on the first check)
+        for q in QUERIES:
+            assert engine.check_is_member(
+                RelationTuple.from_string(q)
+            ) == oracle(reg, q)
+        # the rebuilt mirror scrubs clean again
+        report2 = scrubber.scrub_pass()
+        assert report2["default"]["diverged"] == []
+        assert scrubber.status()["repairs"] == 1
+        m = reg.metrics()
+        assert m.scrub_divergence_total.labels(key)._value.get() >= 1
+        assert m.scrub_repairs_total._value.get() == 1
+
+    def test_mirror_corrupt_fault_fires_on_submit(self):
+        """The mirror_corrupt fault point: one check launch flips a bit,
+        the scrubber's next pass catches it (the crash-recovery plane's
+        acceptance differential, in-process half)."""
+        reg = make_registry()
+        engine = reg.check_engine()
+        engine.check_batch(ts(QUERIES[0]))  # warm build, clean
+        scrubber = reg.mirror_scrubber()
+        assert scrubber.scrub_pass()["default"]["diverged"] == []
+        spec = faults.set_fault("mirror_corrupt", max_hits=1)
+        engine.check_batch(ts(QUERIES[0]))  # fires exactly once
+        assert spec.hits == 1
+        assert engine.stats.get("mirror_corruptions") == 1
+        report = scrubber.scrub_pass()
+        assert report["default"]["diverged"]
+        # post-repair: answers equal the oracle, mirror scrubs clean
+        for q in QUERIES:
+            assert engine.check_is_member(
+                RelationTuple.from_string(q)
+            ) == oracle(reg, q)
+        assert scrubber.scrub_pass()["default"]["diverged"] == []
+
+    def test_background_loop_detects_within_interval(self):
+        reg = make_registry(enabled=True, interval_s=0.1)
+        engine = reg.check_engine()
+        engine.check_is_member(ts("files:a#owner@alice")[0])
+        scrubber = reg.mirror_scrubber()
+        scrubber.start()
+        try:
+            engine.corrupt_mirror()
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if scrubber.status()["repairs"] >= 1:
+                    break
+                time.sleep(0.02)
+            status = scrubber.status()
+            assert status["repairs"] >= 1, status
+            # the pass that found it completes (passes counts at end)
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                if scrubber.status()["passes"] >= 1:
+                    break
+                time.sleep(0.02)
+            assert scrubber.status()["passes"] >= 1
+        finally:
+            scrubber.stop()
+        assert scrubber.status()["running"] is False
+
+
+class TestScrubAdmin:
+    def _daemon(self, **scrub):
+        from keto_tpu.api.daemon import Daemon
+
+        cfg = Config({
+            "dsn": "memory",
+            "check": {"engine": "tpu"},
+            "scrub": scrub,
+            "serve": {
+                "read": {"host": "127.0.0.1", "port": 0},
+                "write": {"host": "127.0.0.1", "port": 0},
+                "metrics": {"host": "127.0.0.1", "port": 0},
+            },
+        })
+        cfg.set_namespaces(NAMESPACES)
+        reg = Registry(cfg)
+        reg.relation_tuple_manager().write_relation_tuples(ts(*FIXTURE))
+        d = Daemon(reg)
+        d.start()
+        return d
+
+    def test_admin_scrub_status_and_trigger(self):
+        d = self._daemon(enabled=False)
+        try:
+            base = f"http://127.0.0.1:{d.metrics_port}/admin/scrub"
+            with urllib.request.urlopen(base, timeout=10) as r:
+                status = json.load(r)
+            assert status["enabled"] is False and status["passes"] == 0
+            # warm the engine so the on-demand pass has a mirror to scrub
+            d.registry.check_engine().check_is_member(
+                ts("files:a#owner@alice")[0]
+            )
+            req = urllib.request.Request(base, data=b"", method="POST")
+            with urllib.request.urlopen(req, timeout=30) as r:
+                body = json.load(r)
+            assert body["passes"] == 1
+            assert body["report"]["default"]["scrubbed"] is True
+            assert body["report"]["default"]["diverged"] == []
+        finally:
+            d.stop()
+
+    def test_daemon_starts_and_stops_background_loop(self):
+        d = self._daemon(enabled=True, interval_s=0.1)
+        try:
+            scrubber = d.registry.mirror_scrubber()
+            assert scrubber.status()["running"] is True
+        finally:
+            d.stop()
+        assert scrubber.status()["running"] is False

@@ -653,3 +653,50 @@ class TestChangelogTrimCutoff:
         p.write_relation_tuples(ts("a:1#r@u"))
         _align_change_log(p)  # below the cap: never trimmed, keep all
         assert len(p.changelog_since(0)) == 1
+
+
+class TestDurabilityPragmas:
+    """The durability contract the crash harness (tools/crash_smoke.py)
+    asserts is DECLARED, not inherited from driver defaults: the sqlite
+    dialect pins journal_mode + synchronous on every connection and this
+    test pins the EFFECTIVE values back."""
+
+    def test_file_backed_pragmas(self, tmp_path):
+        p = SQLitePersister(str(tmp_path / "durable.sqlite"))
+        try:
+            raw = p._conn.raw
+            assert raw.execute("PRAGMA journal_mode").fetchone()[0] == "wal"
+            # synchronous: 2 == FULL (COMMIT fsyncs the WAL — acked
+            # writes survive power loss, not just kill -9)
+            assert raw.execute("PRAGMA synchronous").fetchone()[0] == 2
+            assert raw.execute("PRAGMA foreign_keys").fetchone()[0] == 1
+        finally:
+            p.close()
+
+    def test_memory_db_gets_same_session_setup(self):
+        # :memory: cannot do WAL (journal_mode reports "memory") but the
+        # synchronous pin must still apply — one code path for both
+        p = SQLitePersister("memory")
+        try:
+            raw = p._conn.raw
+            assert raw.execute("PRAGMA journal_mode").fetchone()[0] == "memory"
+            assert raw.execute("PRAGMA synchronous").fetchone()[0] == 2
+        finally:
+            p.close()
+
+    def test_acked_write_survives_reopen(self, tmp_path):
+        """Reopen-durability floor (the crash harness proves the real
+        kill -9 version of this across processes)."""
+        path = str(tmp_path / "durable.sqlite")
+        p = SQLitePersister(path)
+        p.write_relation_tuples(ts("files:doc#owner@alice"))
+        version = p.version()
+        p.close()
+        p2 = SQLitePersister(path)
+        try:
+            assert p2.version() == version
+            assert [str(t) for t in p2.all_relation_tuples()] == [
+                "files:doc#owner@alice"
+            ]
+        finally:
+            p2.close()

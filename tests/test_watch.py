@@ -1042,3 +1042,126 @@ class TestReverseRestSnaptokenParity:
                 snaptoken=encode_snaptoken(10**9, NID),
             )
         assert err.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+
+
+class TestRestartResume:
+    """Watch cursor resume ACROSS A PROCESS RESTART on a file-backed
+    store (the crash-recovery plane's watch contract, driven at scale by
+    tools/crash_smoke.py): the pre-restart hub and subscription objects
+    are gone, only the durable sqlite changelog and the client's
+    snaptoken survive — the resumed cursor must still see every change
+    strictly after it, exactly once, in version order."""
+
+    def _registry(self, path):
+        cfg = Config({
+            "dsn": f"sqlite://{path}",
+            "check": {"engine": "host"},
+            "namespaces": NAMESPACES,
+            "watch": {"poll_interval": 0.05},
+        })
+        return Registry(cfg)
+
+    def test_hub_resume_across_reopen(self, tmp_path):
+        path = str(tmp_path / "store.sqlite")
+        # "process 1": write, watch, consume a prefix, die (no clean
+        # hub shutdown — the subscription is simply abandoned)
+        reg1 = self._registry(path)
+        m1 = reg1.relation_tuple_manager()
+        hub1 = reg1.watch_hub()
+        sub = hub1.subscribe(NID)
+        for i in range(4):  # four separate commits: versions 1..4
+            m1.write_relation_tuples([vt(i)])
+        m1.delete_relation_tuples([vt(1)])  # version 5
+        consumed = drain(sub, 3)
+        assert [e.version for e in consumed] == [1, 2, 3]
+        cursor = consumed[-1].version
+        m1.write_relation_tuples([vt(9, "late")])  # v6, never consumed
+        # "die": nothing hub-side is persisted or handed over — only the
+        # sqlite file survives (hub.stop() joins its tailers, so closing
+        # the store right after is safe in-process; a real crash kills
+        # both at once)
+        hub1.stop()
+        m1.close()
+
+        # "process 2": fresh registry over the same file; resume at the
+        # pre-crash cursor — versions 4..7 arrive exactly once, in order
+        reg2 = self._registry(path)
+        m2 = reg2.relation_tuple_manager()
+        hub2 = reg2.watch_hub()
+        sub2 = hub2.subscribe(NID, min_version=cursor)
+        m2.write_relation_tuples([vt(10, "after-restart")])  # v7
+        events = drain(sub2, 4)
+        assert [e.kind for e in events] == ["change"] * 4
+        assert [e.version for e in events] == [4, 5, 6, 7]
+        # the whole resumed run matches the durable changelog exactly
+        assert changes_of(events) == oracle_since(m2, cursor)
+        hub2.stop()
+        m2.close()
+
+    def test_daemon_sse_resume_across_restart(self, tmp_path):
+        path = str(tmp_path / "store.sqlite")
+
+        def make(port=0):
+            cfg = Config({
+                "dsn": f"sqlite://{path}",
+                "check": {"engine": "host"},
+                "serve": {
+                    "read": {"host": "127.0.0.1", "port": 0},
+                    "write": {"host": "127.0.0.1", "port": 0},
+                    "metrics": {"host": "127.0.0.1", "port": 0},
+                },
+                "namespaces": NAMESPACES,
+                "watch": {"poll_interval": 0.05},
+            })
+            return Daemon(Registry(cfg))
+
+        def sse_events(port, snaptoken, n):
+            url = (
+                f"http://127.0.0.1:{port}/relation-tuples/watch"
+                f"?max_events={n}"
+            )
+            if snaptoken:
+                url += "&snaptoken=" + urllib.parse.quote(snaptoken)
+            out = []
+            with urllib.request.urlopen(url, timeout=10) as r:
+                data = []
+                for raw in r:
+                    line = raw.rstrip(b"\n")
+                    if line.startswith(b"data:"):
+                        data.append(line[5:].strip())
+                    elif not line and data:
+                        out.append(json.loads(b"".join(data)))
+                        data = []
+                        if len(out) >= n:
+                            break
+            return out
+
+        d1 = make()
+        d1.start()
+        try:
+            m = d1.registry.relation_tuple_manager()
+            for i in range(3):  # three separate commits: versions 1..3
+                m.write_relation_tuples([vt(i)])
+            # consume the first two committed versions
+            events = sse_events(
+                d1.read_port, encode_snaptoken(0, NID), 2
+            )
+            cursor_token = events[-1]["snaptoken"]
+            assert parse_snaptoken(cursor_token, NID) == 2
+        finally:
+            d1.stop()
+
+        # restart: a second daemon process-equivalent over the same file
+        d2 = make()
+        d2.start()
+        try:
+            m2 = d2.registry.relation_tuple_manager()
+            m2.write_relation_tuples([vt(7, "post-restart")])
+            events = sse_events(d2.read_port, cursor_token, 2)
+            versions = [parse_snaptoken(e["snaptoken"], NID) for e in events]
+            assert versions == [3, 4]
+            assert all(e["event_type"] == "change" for e in events)
+            # exactly-once: nothing at or before the cursor re-delivered
+            assert all(v > 2 for v in versions)
+        finally:
+            d2.stop()

@@ -1,0 +1,674 @@
+#!/usr/bin/env python
+"""Kill-anywhere crash-recovery harness: CPU-runnable, CI-wired.
+
+Supervises a real daemon over a FILE-BACKED sqlite store and kills it —
+`os._exit(137)` at named crash points (keto_tpu/faults.py `crash:` specs
+armed via KETO_FAULTS in the child) and raw SIGKILL at random intervals
+— across N cycles, restarting and auditing the durability contract
+every time:
+
+  1. DURABILITY — every *acked* write (the client saw 201/204 + its
+     X-Keto-Snaptoken) is present after restart, visible at its
+     snaptoken through the REST check path; every acked delete stays
+     deleted. The ONE write in flight at the crash is indeterminate by
+     definition (durable-but-unacked is allowed, lost-and-unacked is
+     allowed) and is tracked separately.
+  2. NO PHANTOMS — the restarted store contains nothing the client
+     never attempted: post-mortem the sqlite file is opened directly
+     and every tuple must be an attempted insert that is not
+     acked-deleted.
+  3. WATCH RESUME — an SSE watch cursor resumed across the restart
+     (snaptoken = last consumed event) sees every committed version
+     strictly after it exactly once, in contiguous version order, or an
+     explicit RESET — never a silent gap, never a duplicate.
+  4. CHECKPOINT TORN-WRITE — cycles crashing at
+     checkpoint_{pre,post}_rename leave the mirror-cache directory in
+     one of exactly two recoverable states (old-or-absent checkpoint +
+     stray temp, or fully-published new checkpoint); `load_snapshot`
+     never raises, and a fresh TPU engine over the store + cache dir
+     answers byte-identically to the host oracle (rebuild-with-delta on
+     a stale/torn file, warm load on a published one).
+
+The daemon children run `check.engine: host` (the durability plane under
+test is store/changelog/watch/recovery — the device path has its own
+harnesses), so no XLA compile cost per restart; the checkpoint cycles
+build a real TPUCheckEngine state (table upload, no kernel launch) in a
+separate light child. Exit 0 prints one JSON summary line (also written
+to --out); any contract violation exits 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+# the ways an HTTP round-trip dies when the server is killed mid-request
+_CONN_ERRORS = (urllib.error.URLError, OSError, http.client.HTTPException)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NID = "default"
+
+# daemon-cycle crash points: (fault spec for KETO_FAULTS, human tag).
+# Probabilities make the crash land mid-traffic instead of on the first
+# write; a cycle whose fault never fires ends in the random SIGKILL.
+DAEMON_FAULTS = [
+    ("store_commit_pre=crash:137@0.22", "store_commit_pre"),
+    ("store_commit_post=crash:137@0.22", "store_commit_post"),
+    ("changelog_append=crash:137@0.22", "changelog_append"),
+    ("cache_invalidation=crash:137@0.22", "cache_invalidation"),
+    ("watch_broadcast=crash:137@0.35", "watch_broadcast"),
+    ("", "kill"),  # no injected point: raw SIGKILL at a random interval
+]
+CHECKPOINT_FAULTS = [
+    ("checkpoint_pre_rename=crash:137", "checkpoint_pre_rename"),
+    ("checkpoint_post_rename=crash:137", "checkpoint_post_rename"),
+]
+
+FIXTURE_NAMESPACES = ("files", "groups")
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def build_config(dsn_path: str, mirror_cache: str, ports: dict, engine: str):
+    from keto_tpu.config import Config
+    from keto_tpu.namespace import Namespace
+
+    cfg = Config({
+        "dsn": f"sqlite://{dsn_path}",
+        "check": {
+            "engine": engine,
+            "cache": {"enabled": True},
+            "mirror_cache": mirror_cache,
+        },
+        "serve": {
+            "read": {"host": "127.0.0.1", "port": ports["read"]},
+            "write": {"host": "127.0.0.1", "port": ports["write"]},
+            "metrics": {"host": "127.0.0.1", "port": ports["metrics"]},
+        },
+    })
+    cfg.set_namespaces([Namespace(name=n) for n in FIXTURE_NAMESPACES])
+    return cfg
+
+
+# -- child modes ---------------------------------------------------------------
+
+
+def serve_child(args) -> int:
+    """One daemon over the shared sqlite file; killed by the supervisor
+    (or by an armed crash point). Host check engine: no XLA compile per
+    restart — the durability plane is what's under test."""
+    from keto_tpu.api.daemon import Daemon
+    from keto_tpu.registry import Registry
+
+    ports = {"read": args.read_port, "write": args.write_port,
+             "metrics": args.metrics_port}
+    cfg = build_config(args.dsn, args.mirror_cache, ports, engine="host")
+    Daemon(Registry(cfg)).serve_forever()
+    return 0
+
+
+def checkpoint_child(args) -> int:
+    """Build a real TPU-engine mirror state over the sqlite store and
+    flush its checkpoint with a crash armed at the rename boundary
+    (KETO_FAULTS in the environment). State build uploads tables but
+    launches no kernel, so this child never compiles XLA."""
+    from keto_tpu.registry import Registry
+
+    ports = {"read": 0, "write": 0, "metrics": 0}
+    cfg = build_config(args.dsn, args.mirror_cache, ports, engine="tpu")
+    engine = Registry(cfg).check_engine()
+    engine._ensure_state()
+    engine.flush_checkpoints()  # -> save_snapshot -> armed crash fires
+    return 7  # the armed crash (probability 1) should never let us get here
+
+
+# -- supervisor-side client helpers -------------------------------------------
+
+
+class WatchClient:
+    """One SSE watch stream consumed on a background thread; events are
+    appended (with their parsed versions) until the connection dies with
+    the daemon. The supervisor owns the cursor across restarts."""
+
+    def __init__(self, read_port: int, snaptoken: str):
+        url = (
+            f"http://127.0.0.1:{read_port}/relation-tuples/watch"
+            f"?snaptoken={urllib.parse.quote(snaptoken)}"
+        )
+        self.events: list[dict] = []
+        self._mu = threading.Lock()
+        self.error: str | None = None
+        self._resp = urllib.request.urlopen(url, timeout=300)
+        self._thread = threading.Thread(target=self._read, daemon=True)
+        self._thread.start()
+
+    def _read(self) -> None:
+        try:
+            data_lines: list[bytes] = []
+            for raw in self._resp:
+                line = raw.rstrip(b"\n")
+                if line.startswith(b"data:"):
+                    data_lines.append(line[5:].strip())
+                elif not line and data_lines:
+                    payload = json.loads(b"".join(data_lines))
+                    data_lines = []
+                    with self._mu:
+                        self.events.append(payload)
+        except Exception as e:  # noqa: BLE001 — the daemon died mid-stream
+            self.error = type(e).__name__
+        finally:
+            try:
+                self._resp.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def snapshot(self) -> list[dict]:
+        with self._mu:
+            return list(self.events)
+
+    def close(self) -> None:
+        try:
+            self._resp.close()
+        except Exception:  # noqa: BLE001
+            pass
+        self._thread.join(timeout=5)
+
+
+class Supervisor:
+    def __init__(self, base: str, seed: int, out: dict):
+        self.base = base
+        self.rng = random.Random(seed)
+        self.out = out
+        self.dsn = os.path.join(base, "store.sqlite")
+        self.mirror_cache = os.path.join(base, "mirror")
+        os.makedirs(self.mirror_cache, exist_ok=True)
+        self.ports = {"read": free_port(), "write": free_port(),
+                      "metrics": free_port()}
+        # durability ledger (the client's view of the world)
+        self.attempted: set[str] = set()
+        self.acked: dict[str, int] = {}  # tuple str -> ack version
+        self.acked_deleted: dict[str, int] = {}
+        self.indeterminate: set[str] = set()  # in flight at a crash
+        self.indeterminate_deletes: set[str] = set()
+        # watch ledger
+        self.cursor = 0  # last consumed committed version
+        self.seen_versions: set[int] = set()
+        self.resets = 0
+        self.violations: list[dict] = []
+        self.write_seq = 0
+        self.child: subprocess.Popen | None = None
+
+    # -- child lifecycle -------------------------------------------------------
+
+    def spawn(self, fault_spec: str) -> subprocess.Popen:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        if fault_spec:
+            env["KETO_FAULTS"] = fault_spec
+        else:
+            env.pop("KETO_FAULTS", None)
+        cmd = [
+            sys.executable, os.path.abspath(__file__), "--serve",
+            "--dsn", self.dsn, "--mirror-cache", self.mirror_cache,
+            "--read-port", str(self.ports["read"]),
+            "--write-port", str(self.ports["write"]),
+            "--metrics-port", str(self.ports["metrics"]),
+        ]
+        self.child = subprocess.Popen(
+            cmd, env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        return self.child
+
+    def wait_ready(self, timeout: float = 90.0) -> bool:
+        deadline = time.monotonic() + timeout
+        url = f"http://127.0.0.1:{self.ports['read']}/health/ready"
+        while time.monotonic() < deadline:
+            if self.child is not None and self.child.poll() is not None:
+                return False
+            try:
+                with urllib.request.urlopen(url, timeout=2) as r:
+                    if r.status == 200:
+                        return True
+            except (urllib.error.URLError, OSError):
+                pass
+            time.sleep(0.05)
+        return False
+
+    def wait_dead(self, timeout: float) -> int | None:
+        try:
+            return self.child.wait(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            return None
+
+    # -- REST ops --------------------------------------------------------------
+
+    def _token_version(self, token: str) -> int:
+        from keto_tpu.engine.snaptoken import parse_snaptoken
+
+        return parse_snaptoken(token, NID) or 0
+
+    def put_tuple(self, tuple_str: str) -> tuple[bool, int | None]:
+        """PUT one relation tuple; returns (acked, ack_version)."""
+        from keto_tpu.ketoapi import RelationTuple
+
+        body = json.dumps(
+            RelationTuple.from_string(tuple_str).to_dict()
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.ports['write']}/admin/relation-tuples",
+            data=body, method="PUT",
+            headers={"Content-Type": "application/json"},
+        )
+        self.attempted.add(tuple_str)
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                token = r.headers.get("X-Keto-Snaptoken", "")
+                return r.status == 201, self._token_version(token)
+        except _CONN_ERRORS:
+            return False, None
+
+    def patch_delete(self, tuple_str: str) -> tuple[bool, int | None]:
+        from keto_tpu.ketoapi import RelationTuple
+
+        body = json.dumps([{
+            "action": "delete",
+            "relation_tuple": RelationTuple.from_string(tuple_str).to_dict(),
+        }]).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.ports['write']}/admin/relation-tuples",
+            data=body, method="PATCH",
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=10) as r:
+                token = r.headers.get("X-Keto-Snaptoken", "")
+                return r.status == 204, self._token_version(token)
+        except _CONN_ERRORS:
+            return False, None
+
+    def rest_check(self, tuple_str: str, snaptoken_version: int | None):
+        from keto_tpu.engine.snaptoken import encode_snaptoken
+        from keto_tpu.ketoapi import RelationTuple
+
+        t = RelationTuple.from_string(tuple_str)
+        url = (
+            f"http://127.0.0.1:{self.ports['read']}"
+            f"/relation-tuples/check/openapi"
+            f"?namespace={t.namespace}&object={urllib.parse.quote(t.object)}"
+            f"&relation={t.relation}&subject_id={urllib.parse.quote(t.subject_id)}"
+        )
+        if snaptoken_version is not None:
+            url += "&snaptoken=" + urllib.parse.quote(
+                encode_snaptoken(snaptoken_version, NID)
+            )
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, json.load(r)
+
+    # -- ledger + assertions ---------------------------------------------------
+
+    def violation(self, kind: str, **facts) -> None:
+        self.violations.append({"kind": kind, **facts})
+
+    def consume_watch(self, client: WatchClient, tag: str) -> None:
+        """Fold a finished stream segment into the ledger: versions must
+        be contiguous from the cursor, never repeated; RESET is the only
+        legitimate gap and must carry the version it jumps to."""
+        for event in client.snapshot():
+            version = self._token_version(event.get("snaptoken", ""))
+            if event.get("event_type") == "reset":
+                self.resets += 1
+                self.cursor = max(self.cursor, version)
+                continue
+            if version in self.seen_versions:
+                self.violation(
+                    "watch_duplicate", tag=tag, version=version
+                )
+            if version != self.cursor + 1:
+                self.violation(
+                    "watch_gap", tag=tag, cursor=self.cursor,
+                    version=version,
+                )
+            self.seen_versions.add(version)
+            self.cursor = max(self.cursor, version)
+
+    def verify_recovery(self, tag: str) -> None:
+        """Phase A (restarted daemon serving): every acked write visible
+        AT ITS SNAPTOKEN through the REST check path."""
+        live = {
+            t: v for t, v in self.acked.items()
+            if t not in self.acked_deleted
+            # an UNACKED delete in flight at a crash is indeterminate:
+            # durable-but-unacked is allowed, so its target may
+            # legitimately be gone — same exclusion the post-mortem
+            # audit applies
+            and t not in self.indeterminate_deletes
+        }
+        sample = list(live.items())
+        self.rng.shuffle(sample)
+        for tuple_str, version in sample[:25]:
+            try:
+                code, body = self.rest_check(tuple_str, version)
+            except Exception as e:  # noqa: BLE001 — a dead daemon is a finding
+                self.violation("check_error", tag=tag, tuple=tuple_str,
+                               error=repr(e))
+                continue
+            if code != 200 or body.get("allowed") is not True:
+                self.violation(
+                    "lost_acked_write", tag=tag, tuple=tuple_str,
+                    snaptoken_version=version, code=code, body=body,
+                )
+        for tuple_str, version in list(self.acked_deleted.items())[-10:]:
+            try:
+                code, body = self.rest_check(tuple_str, version)
+            except Exception as e:  # noqa: BLE001
+                self.violation("check_error", tag=tag, tuple=tuple_str,
+                               error=repr(e))
+                continue
+            if code != 200 or body.get("allowed") is not False:
+                self.violation(
+                    "resurrected_acked_delete", tag=tag, tuple=tuple_str,
+                    code=code, body=body,
+                )
+
+    def postmortem(self, tag: str) -> dict:
+        """Authoritative durability audit, straight off the sqlite file
+        the dead child left behind (no daemon in the way)."""
+        from keto_tpu.storage.sqlite import SQLitePersister
+
+        store = SQLitePersister(self.dsn)
+        try:
+            present = {str(t) for t in store.all_relation_tuples(nid=NID)}
+            version = store.version(nid=NID)
+        finally:
+            store.close()
+        lost = [
+            t for t in self.acked
+            if t not in self.acked_deleted
+            and t not in self.indeterminate_deletes
+            and t not in present
+        ]
+        phantoms = [t for t in present if t not in self.attempted]
+        resurrected = [t for t in self.acked_deleted if t in present]
+        for t in lost:
+            self.violation("lost_acked_write_postmortem", tag=tag, tuple=t)
+        for t in phantoms:
+            self.violation("phantom_tuple", tag=tag, tuple=t)
+        for t in resurrected:
+            self.violation("resurrected_acked_delete_postmortem", tag=tag,
+                           tuple=t)
+        max_acked = max(self.acked.values(), default=0)
+        if version < max_acked:
+            self.violation(
+                "store_version_regressed", tag=tag, store_version=version,
+                max_acked_version=max_acked,
+            )
+        return {
+            "store_version": version, "present": len(present),
+            "lost": len(lost), "phantoms": len(phantoms),
+        }
+
+    # -- one daemon cycle ------------------------------------------------------
+
+    def daemon_cycle(self, cycle: int, fault_spec: str, tag: str) -> dict:
+        self.spawn(fault_spec)
+        if not self.wait_ready():
+            # a crash point CAN legally fire before ready (e.g. a
+            # leftover fault on the startup migration write path); treat
+            # as an immediate crash and audit
+            rc = self.wait_dead(10)
+            exit_code = rc if rc is not None else self.kill()
+            return {"tag": tag, "ready": False, "exit_code": exit_code,
+                    "postmortem": self.postmortem(tag)}
+        self.verify_recovery(tag)
+        from keto_tpu.engine.snaptoken import encode_snaptoken
+
+        watch = WatchClient(
+            self.ports["read"], encode_snaptoken(self.cursor, NID)
+        )
+        kill_after = self.rng.uniform(0.3, 1.2)
+        t0 = time.monotonic()
+        n_writes = 0
+        exit_code = None
+        while True:
+            if self.child.poll() is not None:
+                exit_code = self.child.returncode
+                break
+            if tag == "kill" and time.monotonic() - t0 >= kill_after:
+                exit_code = self.kill()
+                break
+            if time.monotonic() - t0 > 20:  # fault never fired: force it
+                exit_code = self.kill()
+                break
+            self.write_seq += 1
+            tuple_str = (
+                f"files:c{cycle}_o{self.write_seq}#owner@u{self.write_seq % 5}"
+            )
+            acked, version = self.put_tuple(tuple_str)
+            if acked:
+                self.acked[tuple_str] = version
+                n_writes += 1
+            else:
+                self.indeterminate.add(tuple_str)
+                exit_code = self.wait_dead(10)
+                break
+            # occasionally delete an earlier acked tuple
+            if n_writes % 7 == 0 and len(self.acked) > len(self.acked_deleted) + 4:
+                victim = self.rng.choice([
+                    t for t in self.acked
+                    if t not in self.acked_deleted
+                    and t not in self.indeterminate_deletes
+                ])
+                ok, dv = self.patch_delete(victim)
+                if ok:
+                    self.acked_deleted[victim] = dv
+                else:
+                    self.indeterminate_deletes.add(victim)
+                    exit_code = self.wait_dead(10)
+                    break
+            time.sleep(0.01)
+        if exit_code is None:
+            # the write failed but the child survived (transient HTTP
+            # error, not the armed crash): end the cycle as a raw kill
+            # so the ports free up for the next restart
+            exit_code = self.wait_dead(15)
+            if exit_code is None:
+                exit_code = self.kill()
+        time.sleep(0.1)  # let the SSE reader drain its socket
+        self.consume_watch(watch, tag)
+        watch.close()
+        return {
+            "tag": tag, "ready": True, "acked_writes": n_writes,
+            "exit_code": exit_code, "postmortem": self.postmortem(tag),
+        }
+
+    def kill(self) -> int:
+        try:
+            self.child.send_signal(signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        return self.child.wait(timeout=15)
+
+    # -- one checkpoint cycle --------------------------------------------------
+
+    def checkpoint_cycle(self, fault_spec: str, tag: str) -> dict:
+        """Crash the mirror-checkpoint write at the rename boundary and
+        prove the cache directory recovers to correct answers."""
+        # advance the store first (a direct, by-definition-acked write):
+        # guarantees the child's state build is a FRESH build whose
+        # checkpoint flush actually runs (a warm load persists nothing),
+        # and feeds the durability ledger one more audited write
+        from keto_tpu.ketoapi import RelationTuple
+        from keto_tpu.storage.sqlite import SQLitePersister
+
+        self.write_seq += 1
+        tuple_str = f"files:ckpt_o{self.write_seq}#owner@ck"
+        store = SQLitePersister(self.dsn)
+        try:
+            store.write_relation_tuples(
+                [RelationTuple.from_string(tuple_str)], nid=NID
+            )
+            self.attempted.add(tuple_str)
+            self.acked[tuple_str] = store.version(nid=NID)
+        finally:
+            store.close()
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["KETO_FAULTS"] = fault_spec
+        proc = subprocess.run(
+            [
+                sys.executable, os.path.abspath(__file__),
+                "--checkpoint-child", "--dsn", self.dsn,
+                "--mirror-cache", self.mirror_cache,
+            ],
+            env=env, cwd=REPO, timeout=300,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        result: dict = {"tag": tag, "exit_code": proc.returncode}
+        if proc.returncode != 137:
+            self.violation("checkpoint_crash_missed", tag=tag,
+                           exit_code=proc.returncode)
+        # torn-state audit: the final file, if present, must be loadable
+        # or cleanly ignorable — never an exception; strays are counted
+        from keto_tpu.engine.checkpoint import load_snapshot
+
+        strays = [
+            f for f in os.listdir(self.mirror_cache) if f.endswith(".tmp")
+        ]
+        result["stray_tmp_files"] = len(strays)
+        for f in strays:  # janitor: bounded disk across cycles
+            os.unlink(os.path.join(self.mirror_cache, f))
+        final = os.path.join(self.mirror_cache, f"mirror-{NID}.npz")
+        loaded = None
+        if os.path.exists(final):
+            try:
+                loaded = load_snapshot(final)
+            except Exception as e:  # noqa: BLE001 — the contract under test
+                self.violation("checkpoint_load_raised", tag=tag,
+                               error=repr(e))
+        result["final_exists"] = os.path.exists(final)
+        result["final_loadable"] = loaded is not None
+        if tag == "checkpoint_post_rename" and loaded is None:
+            # fully published by the atomic rename + fsync ordering: the
+            # file must load (version match is the engine's concern)
+            self.violation("checkpoint_published_but_torn", tag=tag)
+        # recovery: a fresh engine over store + cache dir must answer
+        # exactly like the host oracle, warm-loading or rebuilding
+        result.update(self._verify_engine_recovery(tag))
+        return result
+
+    def _verify_engine_recovery(self, tag: str) -> dict:
+        from keto_tpu.engine.reference import ReferenceEngine
+        from keto_tpu.ketoapi import RelationTuple
+        from keto_tpu.registry import Registry
+
+        cfg = build_config(
+            self.dsn, self.mirror_cache,
+            {"read": 0, "write": 0, "metrics": 0}, engine="tpu",
+        )
+        reg = Registry(cfg)
+        engine = reg.check_engine()
+        oracle = ReferenceEngine(reg.relation_tuple_manager(), cfg)
+        live = [t for t in self.acked if t not in self.acked_deleted]
+        self.rng.shuffle(live)
+        wrong = 0
+        for tuple_str in live[:5] or ["files:absent#owner@nobody"]:
+            t = RelationTuple.from_string(tuple_str)
+            want = bool(oracle.check_relation_tuple(t, 0, NID).allowed)
+            got = engine.check_is_member(t)
+            if got != want:
+                wrong += 1
+                self.violation("checkpoint_recovery_wrong_answer", tag=tag,
+                               tuple=tuple_str, got=got, want=want)
+        stats = engine.stats
+        return {
+            "recovery_wrong_answers": wrong,
+            "recovery_snapshot_builds": stats.get("snapshot_builds", 0),
+            "recovery_snapshot_loads": stats.get("snapshot_loads", 0),
+        }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--serve", action="store_true", help="child: run the daemon")
+    ap.add_argument("--checkpoint-child", action="store_true",
+                    help="child: build + crash-flush a mirror checkpoint")
+    ap.add_argument("--dsn", default="")
+    ap.add_argument("--mirror-cache", default="")
+    ap.add_argument("--read-port", type=int, default=0)
+    ap.add_argument("--write-port", type=int, default=0)
+    ap.add_argument("--metrics-port", type=int, default=0)
+    ap.add_argument("--cycles", type=int, default=24,
+                    help="total kill/restart cycles (daemon + checkpoint)")
+    ap.add_argument("--seed", type=int, default=9)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+    if args.serve:
+        return serve_child(args)
+    if args.checkpoint_child:
+        return checkpoint_child(args)
+
+    import tempfile
+
+    out: dict = {"cycles": []}
+    base = tempfile.mkdtemp(prefix="keto-crash-smoke-")
+    sup = Supervisor(base, args.seed, out)
+    # interleave: every 4th cycle exercises a checkpoint rename crash,
+    # the rest rotate through the daemon crash points + random SIGKILL
+    d_i = c_i = 0
+    t_start = time.monotonic()
+    for cycle in range(args.cycles):
+        if cycle % 4 == 3:
+            spec, tag = CHECKPOINT_FAULTS[c_i % len(CHECKPOINT_FAULTS)]
+            c_i += 1
+            record = sup.checkpoint_cycle(spec, tag)
+        else:
+            spec, tag = DAEMON_FAULTS[d_i % len(DAEMON_FAULTS)]
+            d_i += 1
+            record = sup.daemon_cycle(cycle, spec, tag)
+        record["cycle"] = cycle
+        out["cycles"].append(record)
+        print(json.dumps(record), file=sys.stderr)
+    out.update({
+        "n_cycles": args.cycles,
+        "duration_s": round(time.monotonic() - t_start, 1),
+        "attempted_writes": len(sup.attempted),
+        "acked_writes": len(sup.acked),
+        "acked_deletes": len(sup.acked_deleted),
+        "indeterminate_writes": len(sup.indeterminate),
+        "watch_versions_consumed": len(sup.seen_versions),
+        "watch_resets": sup.resets,
+        "violations": sup.violations,
+        "ok": not sup.violations,
+    })
+    print(json.dumps(out))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1, sort_keys=True)
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
