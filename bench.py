@@ -614,11 +614,10 @@ def _tree_size(tree) -> int:
     return 1 + sum(_tree_size(c) for c in (tree.children or ()))
 
 
-def bench_config4_deep() -> dict:
-    """BASELINE config 4: drive-style nested folders, depth-20 recursive
-    Check (scaled bench_test.go:56-86 'deep' namespace)."""
+def _deep_dataset():
+    """The depth-20 drive topology (scaled bench_test.go:56-86 'deep'
+    namespace) shared by the deep leg and the closure A/B leg."""
     from keto_tpu.config import Config
-    from keto_tpu.engine.tpu_engine import TPUCheckEngine
     from keto_tpu.ketoapi import RelationTuple
     from keto_tpu.namespace import Namespace
     from keto_tpu.namespace.ast import (
@@ -655,16 +654,50 @@ def bench_config4_deep() -> dict:
         c = rng.randrange(n_chains)
         sub = owners[c] if i % 2 == 0 else f"u{rng.randrange(n_users)}"
         queries.append(RelationTuple.from_string(f"deep:c{c}f0#viewer@{sub}"))
-    from keto_tpu.observability import FlightRecorder, summarize_launches
-
-    cfg = Config({"limit": {"max_read_depth": depth + 4}})
+    cfg = Config({
+        "limit": {"max_read_depth": depth + 4},
+        "closure": {"enabled": True},
+    })
     cfg.set_namespaces(ns)
     m = MemoryManager()
     m.write_relation_tuples(tuples)
+    return m, cfg, queries
+
+
+def _closure_stats_record(engine, prefix: str) -> dict:
+    """The closure observability fields every closure-bearing leg
+    records: hit ratio over the leg's window, per-cause fallbacks, and
+    the index lag at capture time."""
+    hits = engine.stats.get("closure_hits", 0)
+    fallbacks = dict(engine.stats.get("closure_fallback", {}))
+    total = hits + sum(fallbacks.values())
+    idx = engine.closure_index()
+    return {
+        f"{prefix}_hit_ratio": round(hits / total, 4) if total else 0.0,
+        f"{prefix}_fallback_total": fallbacks,
+        f"{prefix}_lag_versions": idx.lag_versions(
+            engine.manager.version(nid=engine.nid)
+        ),
+    }
+
+
+def bench_config4_deep(closure: bool = True) -> dict:
+    """BASELINE config 4: depth-20 recursive Check. With `closure` (the
+    default serving shape for this leg) the Leopard index answers the
+    chains in one probe step — deep20_qps is then read against the flat
+    leg's value (acceptance: within 1.5x); closure=False measures the
+    raw BFS kernel (the flight-recorder A/B's iteration contrast)."""
+    from keto_tpu.engine.tpu_engine import TPUCheckEngine
+    from keto_tpu.observability import FlightRecorder, summarize_launches
+
+    m, cfg, queries = _deep_dataset()
     flightrec = FlightRecorder(capacity=64)
     engine = TPUCheckEngine(
         m, cfg, frontier_cap=2 * BATCH, flightrec=flightrec
     )
+    engine.closure_enabled = closure
+    if closure:
+        engine.closure_ensure_built()
     engine.check_batch(queries)
     rounds = 5
     t0 = time.perf_counter()
@@ -672,14 +705,24 @@ def bench_config4_deep() -> dict:
     for h in handles:
         engine.check_batch_resolve(h)
     wall = time.perf_counter() - t0
-    return {
+    out = {
         "deep20_qps": round(rounds * BATCH / wall, 1),
         "deep20_host_checks": engine.stats["host_checks"],
-        # iterations here should sit near the chain depth — the flat
-        # leg's launch_telemetry is the contrast that proves the
-        # counters are non-degenerate
+        "deep20_closure": closure,
+        # BFS iterations sit near the chain depth — the flat leg's
+        # launch_telemetry is the non-degeneracy contrast; with closure
+        # on, check-kind launches only happen for fallbacks
         "deep20_launch_telemetry": summarize_launches(flightrec.entries()),
     }
+    if closure:
+        out.update(_closure_stats_record(engine, "closure"))
+        # the closure launches' own telemetry: iterations_mean must sit
+        # at 1.0 regardless of chain depth — THE contrast the subsystem
+        # exists for (the BFS arm's deep20 telemetry shows ~chain depth)
+        out["deep20_closure_launch_telemetry"] = summarize_launches(
+            flightrec.entries(), kind="closure"
+        )
+    return out
 
 
 def bench_flightrec_ab() -> dict:
@@ -739,8 +782,10 @@ def bench_flightrec_ab() -> dict:
         "rh_probes": engine._ensure_state().snapshot.rh_probes,
     }
 
-    # deep-20 contrast: iterations must track the chain depth
-    deep = bench_config4_deep().get("deep20_launch_telemetry", {})
+    # deep-20 contrast: iterations must track the chain depth (closure
+    # OFF — this leg measures the BFS kernel's counters, and a closure
+    # hit would answer in one step by design)
+    deep = bench_config4_deep(closure=False).get("deep20_launch_telemetry", {})
 
     # table-size contrast: the same drive topology at ~1e6 tuples
     # (vectorized columnar build — the scale tier's ingest path; a
@@ -800,6 +845,81 @@ def bench_flightrec_ab() -> dict:
         "flat_launch_telemetry": flat,
         "deep20_launch_telemetry": deep,
         "large_table_launch_telemetry": large,
+    }
+
+
+def bench_closure_ab() -> dict:
+    """Leopard-closure A/B (acceptance leg, CPU-runnable): the deep-20
+    workload with the closure index ON vs OFF on the SAME engine and
+    store, toggled per call so ambient-load drift hits both arms
+    (medians over many synchronous samples — the --ab-flightrec
+    protocol). Every ON sample's verdicts are compared against the OFF
+    arm's reference answers: the record carries the mismatch count,
+    which must be zero. The flat flagship workload rides along as the
+    contrast leg — the acceptance bar reads deep20-ON against flat."""
+    from keto_tpu.config import Config
+    from keto_tpu.engine.tpu_engine import TPUCheckEngine
+    from keto_tpu.storage import MemoryManager
+
+    m, cfg, queries = _deep_dataset()
+    engine = TPUCheckEngine(m, cfg, frontier_cap=2 * BATCH)
+    engine.closure_enabled = False
+    t0 = time.perf_counter()
+    engine.closure_ensure_built()
+    build_s = time.perf_counter() - t0
+    engine.check_batch(queries)  # BFS compile + ramp
+    engine.closure_enabled = True
+    engine.check_batch(queries)  # closure compile + ramp
+    engine.closure_enabled = False
+    expected = [r.membership for r in engine.check_batch(queries)]
+
+    on_t: list = []
+    off_t: list = []
+    mismatches = 0
+    for i in range(60):
+        engine.closure_enabled = i % 2 == 1
+        t0 = time.perf_counter()
+        res = engine.check_batch(queries)
+        dt = time.perf_counter() - t0
+        (on_t if i % 2 == 1 else off_t).append(dt)
+        if i % 2 == 1:
+            mismatches += sum(
+                1 for r, want in zip(res, expected) if r.membership != want
+            )
+    med_on = sorted(on_t)[len(on_t) // 2]
+    med_off = sorted(off_t)[len(off_t) // 2]
+
+    # flat contrast on the flagship dataset: the acceptance denominator
+    namespaces, tuples, flat_queries = build_dataset()
+    fcfg = Config({"limit": {"max_read_depth": 5}})
+    fcfg.set_namespaces(namespaces)
+    fm = MemoryManager()
+    fm.write_relation_tuples(tuples)
+    fengine = TPUCheckEngine(fm, fcfg, frontier_cap=2 * BATCH)
+    fengine.check_batch(flat_queries)
+    flat_t: list = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        fengine.check_batch(flat_queries)
+        flat_t.append(time.perf_counter() - t0)
+    flat_qps = BATCH / sorted(flat_t)[len(flat_t) // 2]
+
+    idx = engine.closure_index().describe()
+    return {
+        "metric": "closure_ab",
+        "ab_batch": BATCH,
+        "closure_on_deep20_qps": round(BATCH / med_on, 1),
+        "closure_off_deep20_qps": round(BATCH / med_off, 1),
+        "on_vs_off": round(med_off / med_on, 4),
+        "flat_qps": round(flat_qps, 1),
+        # the acceptance ratio: deep chains within 1.5x of flat checks
+        "deep20_vs_flat": round((BATCH / med_on) / flat_qps, 4),
+        "ab_samples_per_arm": len(on_t),
+        "verdict_mismatches": mismatches,
+        "closure_covered_nodes": idx["covered_nodes"],
+        "closure_entries": idx["entries"],
+        "closure_build_s": round(build_s, 3),
+        **_closure_stats_record(engine, "closure"),
     }
 
 
@@ -1245,6 +1365,13 @@ def main() -> int:
              "(recorder on vs off QPS + non-degeneracy contrasts) and "
              "print its JSON record",
     )
+    ap.add_argument(
+        "--ab-closure", action="store_true",
+        help="run ONLY the Leopard-closure A/B leg (deep-20 QPS with "
+             "the closure index on vs off, verdict-equality checked, "
+             "plus the flat-contrast acceptance ratio) and print its "
+             "JSON record",
+    )
     args = ap.parse_args()
 
     platform = args.platform
@@ -1297,6 +1424,12 @@ def main() -> int:
 
         if args.ab_flightrec:
             ab = bench_flightrec_ab()
+            ab["device"] = str(jax.devices()[0])
+            print(json.dumps(ab))
+            return 0
+
+        if args.ab_closure:
+            ab = bench_closure_ab()
             ab["device"] = str(jax.devices()[0])
             print(json.dumps(ab))
             return 0

@@ -223,8 +223,14 @@ class Daemon:
     """ServeAll: compose batcher + 2 gRPC servers + 3 REST routers + muxes.
     ref: daemon.go:87-126 (errgroup of three listeners)."""
 
-    def __init__(self, registry, host: str | None = None):
+    def __init__(self, registry, host: str | None = None,
+                 pid_file: str | None = None):
         self.registry = registry
+        # optional pid file (supervisors/smokes): written by start(),
+        # REMOVED by stop() — a stale pid file outliving a clean
+        # shutdown is a lie a later supervisor can act on (kill -0
+        # succeeding against a recycled pid)
+        self.pid_file = pid_file
         cfg = registry.config
         self.read_addr = cfg.read_api_address()
         self.write_addr = cfg.write_api_address()
@@ -395,6 +401,16 @@ class Daemon:
         # device-vs-host checksum loop; start() is a no-op unless
         # scrub.enabled (POST /admin/scrub triggers a pass either way)
         reg.mirror_scrubber().start()
+        # Leopard closure maintenance plane (keto_tpu/closure): the
+        # changelog tailer that keeps the deep-check index fresh;
+        # version-gating at submit keeps answers correct without it
+        if bool(cfg.get("closure.enabled", False)):
+            reg.closure_maintainer().start()
+        if self.pid_file:
+            import os as _os
+
+            with open(self.pid_file, "w") as f:
+                f.write(str(_os.getpid()))
         self._log_recovery_state()
         reg.draining.clear()
         reg.ready.set()
@@ -607,6 +623,11 @@ class Daemon:
         # end watch streams first so draining servers aren't pinned by
         # parked subscriber threads (this also ends the replica views'
         # changelog tails — the hub closes their subscriptions)
+        # stop the closure maintainer BEFORE the hub: its subscriptions
+        # close with it, so the hub's stop never waits on a tailer that
+        # is mid-pass against a store about to be torn down
+        if self.registry._closure_maintainer is not None:
+            self.registry._closure_maintainer.stop()
         if self.registry._watch_hub is not None:
             self.registry._watch_hub.stop()
         if self.registry._scrubber is not None:
@@ -639,6 +660,22 @@ class Daemon:
         # AND all tenant engines) before exiting so the next start
         # warm-restarts from the latest compaction
         self.registry.flush_checkpoints()
+        # clean shutdown removes the pid file LAST: while any part of
+        # the daemon is still draining, the pid is still meaningfully
+        # alive to a supervisor. Remove only if WE still own it — a
+        # supervisor may have restarted a replacement daemon onto the
+        # same path while this one drained, and deleting the
+        # replacement's file would recreate the exact lie this feature
+        # exists to prevent.
+        if self.pid_file:
+            import contextlib
+            import os as _os
+
+            with contextlib.suppress(OSError, ValueError):
+                with open(self.pid_file) as f:
+                    owner = int(f.read().strip() or 0)
+                if owner == _os.getpid():
+                    _os.unlink(self.pid_file)
 
     def serve_forever(self) -> None:
         """Blocks until SIGINT/SIGTERM (ref: daemon.go:93-117 graceful)."""

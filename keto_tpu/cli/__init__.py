@@ -138,7 +138,9 @@ def cmd_serve(args) -> int:
     # env/config-driven profiling around the whole serve lifetime
     # (ref: profilex.Profile() in /root/reference/main.go:24)
     with profiled(config.get("profiling")):
-        Daemon(Registry(config)).serve_forever()
+        Daemon(
+            Registry(config), pid_file=getattr(args, "pid_file", None)
+        ).serve_forever()
     return 0
 
 
@@ -628,6 +630,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     p = sub.add_parser("serve", help="serve the read/write/metrics APIs")
     p.add_argument("--config", "-c", default=None)
+    p.add_argument(
+        "--pid-file", default=None,
+        help="write the daemon pid here on start; removed on clean "
+             "shutdown (a stale pid file outliving a clean stop lies "
+             "to supervisors)",
+    )
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("migrate", help="run SQL migrations")

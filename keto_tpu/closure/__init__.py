@@ -1,0 +1,7 @@
+"""Closure maintenance plane: the registry-wired tailer that keeps every
+engine's Leopard index (engine/closure.py) fresh from the Watch
+changelog. See maintainer.ClosureMaintainer."""
+
+from .maintainer import ClosureMaintainer
+
+__all__ = ["ClosureMaintainer"]

@@ -1,0 +1,178 @@
+"""ClosureMaintainer: the Leopard index's freshness loop.
+
+One background thread per process (registry singleton, daemon-managed)
+keeps every BUILT engine's closure index (engine/closure.py) current:
+
+  - a Watch-hub subscription per network id tails the changelog (PR 2's
+    versioned feed — the same substrate the check cache and replica
+    views ride); each WatchEvent's changes are folded into the index's
+    dirty-node overlay (transitive-ancestor marking), advancing its
+    synced version. A RESET event (ring overflow / changelog truncation)
+    marks the index wholly stale — incremental maintenance lost the
+    thread, so the next pass re-powers.
+  - per pass, every index that needs (re)building — first touch, base
+    snapshot swapped by a compaction, dirty-overlay overflow, RESET —
+    is re-powered OFF the request path via engine.closure_ensure_built.
+
+Correctness NEVER depends on this thread: every closure answer is
+version-gated at submit (index synced_version >= the serving state's
+covered_version, engine/tpu_engine.py _closure_gate), so a paused,
+slow, or dead maintainer degrades deep-check latency back to the BFS
+kernel and nothing else. `hold()`/`release()` exist precisely to prove
+that in tests and smokes — a held maintainer is the forced-lag fault
+(the `tools/replica_smoke.py` held-tailer trick, applied here).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+logger = logging.getLogger("keto_tpu")
+
+DEFAULT_POLL_INTERVAL = 0.25
+
+
+class ClosureMaintainer:
+    def __init__(self, registry, poll_interval: float = DEFAULT_POLL_INTERVAL):
+        self.registry = registry
+        self.poll_interval = max(float(poll_interval), 0.01)
+        self._subs: dict[str, object] = {}
+        self._wake = threading.Event()
+        self._stopped = threading.Event()
+        self._held = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._mu = threading.Lock()
+        self.stats = {"passes": 0, "events": 0, "rebuilds": 0, "resets": 0}
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        with self._mu:
+            if self._thread is not None:
+                return
+            self._stopped.clear()
+            # commit-listener wakeup: writes poke the loop immediately
+            # instead of waiting out the poll interval (flag flip only —
+            # the listener runs on the writer thread). Registered ONCE
+            # per maintainer: the hub has no remove API, and a
+            # start/stop/start cycle must not accumulate listeners.
+            if not getattr(self, "_listener_registered", False):
+                self.registry.watch_hub().add_commit_listener(
+                    self._on_commit
+                )
+                self._listener_registered = True
+            self._thread = threading.Thread(
+                target=self._loop, name="keto-closure-maintainer", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._mu:
+            thread, self._thread = self._thread, None
+        self._stopped.set()
+        self._wake.set()
+        if thread is not None:
+            thread.join(timeout=5)
+        for sub in self._subs.values():
+            try:
+                sub.close()
+            except Exception:  # noqa: BLE001 — teardown must complete
+                logger.debug("closure subscription close failed",
+                             exc_info=True)
+        self._subs.clear()
+
+    def hold(self) -> None:
+        """Freeze maintenance (tests/smokes force the lagging-index
+        regime: fallbacks must stay correct while held)."""
+        self._held.set()
+
+    def release(self) -> None:
+        self._held.clear()
+        self._wake.set()
+
+    def _on_commit(self, nid: str) -> None:
+        self._wake.set()
+
+    # -- the loop -------------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stopped.is_set():
+            self._wake.wait(self.poll_interval)
+            self._wake.clear()
+            if self._stopped.is_set():
+                return
+            if self._held.is_set():
+                continue
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 — the freshness loop must
+                # never die; the version gate keeps serving correct and
+                # the next pass retries
+                logger.debug("closure maintenance pass failed", exc_info=True)
+
+    def step(self) -> int:
+        """One maintenance pass over every built engine: drain pending
+        watch events into the dirty overlays, then (re)build whatever
+        needs powering. Returns the number of events applied (tests and
+        the correctness smoke call this directly for deterministic
+        interleaving)."""
+        applied = 0
+        self.stats["passes"] += 1
+        for nid, engine in self.registry.built_engines().items():
+            index_fn = getattr(engine, "closure_index", None)
+            if index_fn is None or not getattr(engine, "closure_enabled", False):
+                continue
+            idx = index_fn()
+            # ensure BEFORE draining events: ensure_for advances the op
+            # encoder to the engine's current overlay view and its
+            # catch_up marks under it — an event drained first would
+            # apply (and advance synced past) ops the STALE encoder
+            # cannot encode, permanently skipping their marks. It is
+            # idempotent-cheap when current (one store version read),
+            # re-powers after compactions/staleness, runs the dirty
+            # refresh, and folds changes the event path missed
+            # (out-of-process writers).
+            before = idx.stats["builds"]
+            try:
+                engine.closure_ensure_built()
+            except Exception:  # noqa: BLE001 — a failing powering must
+                # not stop maintenance of other engines
+                logger.warning(
+                    "closure build failed for nid=%s", nid, exc_info=True
+                )
+                continue
+            if idx.stats["builds"] != before:
+                self.stats["rebuilds"] += 1
+            applied += self._drain_events(nid, idx)
+        return applied
+
+    def _drain_events(self, nid: str, idx) -> int:
+        sub = self._subs.get(nid)
+        if sub is None:
+            hub = self.registry.watch_hub()
+            try:
+                sub = hub.subscribe(nid)
+            except RuntimeError:
+                return 0  # hub stopped: daemon is shutting down
+            self._subs[nid] = sub
+        applied = 0
+        while True:
+            try:
+                event = sub.get_nowait()
+            except Exception:  # noqa: BLE001 — a failed resume is a
+                # missed optimization, not an error (catch_up covers it)
+                break
+            if event is None:
+                break
+            if event.is_reset:
+                # the changelog gap is unrecoverable incrementally: the
+                # next build pass re-powers from the store
+                idx.mark_stale()
+                self.stats["resets"] += 1
+                continue
+            idx.apply_changes(event.changes, event.version)
+            applied += len(event.changes)
+        self.stats["events"] += applied
+        return applied

@@ -167,6 +167,96 @@ def save_snapshot(snapshot: GraphSnapshot, path: str) -> None:
         raise
 
 
+CLOSURE_FORMAT_VERSION = 2  # v2: meta carries (max_depth, max_set_rows)
+# — the powering parameters; a v1 file would be trusted under limits it
+# was not powered at, so a version mismatch just re-powers.
+
+_CLOSURE_ARRAYS = (
+    "covered_keys", "ent_obj", "ent_rel", "ent_skind", "ent_sa", "ent_sb",
+    "ent_req",
+)
+
+
+def closure_cache_path(cache_dir: str, nid: str) -> str:
+    """Naming contract for a network's Leopard closure checkpoint —
+    lives beside the mirror checkpoint so a warm restart restores both
+    (the closure file is valid for exactly one snapshot version; the
+    graph structures the maintainer needs re-extract from the restored
+    snapshot, only the expensive powering product is persisted)."""
+    return os.path.join(cache_dir, f"closure-{nid}.npz")
+
+
+def save_closure(build, path: str) -> None:
+    """Atomic, fsync-ordered write of one ClosureBuild's powering
+    product (engine/closure.py). Same crash-ordering discipline as
+    save_snapshot: bytes durable before the rename publishes the name."""
+    payload = {k: np.asarray(getattr(build, k)) for k in _CLOSURE_ARRAYS}
+    payload["meta"] = np.array(
+        [
+            CLOSURE_FORMAT_VERSION,
+            int(build.snapshot_version),
+            int(build.base_version),
+            int(build.n_nodes),
+            int(build.n_entries),
+            int(build.vocab_fp),
+            int(build.max_depth),
+            int(build.max_set_rows),
+        ],
+        dtype=np.int64,
+    )
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, **payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        try:
+            dfd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_closure(path: str):
+    """Load a persisted ClosureBuild; None when missing / torn /
+    incompatible — the maintainer then re-powers from the snapshot,
+    exactly as if no checkpoint existed."""
+    from .closure import ClosureBuild
+
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            meta = z["meta"]
+            # length check FIRST: a corrupt empty meta would raise
+            # IndexError (not in _TORN_FILE_ERRORS) out of meta[0]
+            if len(meta) != 8 or int(meta[0]) != CLOSURE_FORMAT_VERSION:
+                return None
+            arrays = {k: z[k] for k in _CLOSURE_ARRAYS}
+            return ClosureBuild(
+                snapshot_version=int(meta[1]),
+                base_version=int(meta[2]),
+                n_nodes=int(meta[3]),
+                n_entries=int(meta[4]),
+                vocab_fp=int(meta[5]),
+                max_depth=int(meta[6]),
+                max_set_rows=int(meta[7]),
+                **arrays,
+            )
+    except _TORN_FILE_ERRORS:
+        return None
+
+
 def checkpoint_info(path: str) -> Optional[dict]:
     """Cheap checkpoint metadata probe for the cold-start recovery
     audit (api/daemon.py): reads ONLY the tiny `meta` array out of the

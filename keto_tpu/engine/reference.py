@@ -473,6 +473,140 @@ class ReferenceEngine:
                 out.append(sid)
         return out
 
+    # -- closure oracle (keto_tpu extension; engine/closure.py's truth) -------
+
+    def closure_subjects(
+        self,
+        namespace: str,
+        obj: str,
+        relation: str,
+        max_depth: int = 0,
+        nid: str = DEFAULT_NETWORK,
+    ) -> tuple[bool, dict]:
+        """EXACT host computation of one node's Leopard closure set:
+        (monotone_ok, {subject key -> minimum required depth}).
+
+        Mirrors the device kernels' complete-walk semantics and depth
+        bookkeeping precisely: expand-subject and TTU hops cost one
+        depth level, computed-subject-set hops are free, a direct match
+        at distance d needs depth >= d + 1. Subject keys are
+        ("id", subject_id) or ("set", ns, obj, rel) — field-structured
+        because the display strings are not injective.
+
+        monotone_ok=False means the walk left the pure-union fragment
+        (an AND/NOT rewrite, or relation-not-found error semantics) —
+        the closure index must NOT cover this node; the returned sets
+        are then partial and only informative."""
+        from collections import deque
+
+        from ..namespace import ast as _ast
+
+        depth = self._clamp_depth(max_depth)
+        monotone_ok = True
+        best: dict[tuple, int] = {}
+        dist: dict[tuple[str, str, str], int] = {}
+        dq: deque = deque()
+        dq.append(((namespace, obj, relation), 0))
+        dist[(namespace, obj, relation)] = 0
+
+        def rewrite_monotone(rw) -> bool:
+            if rw is None:
+                return True
+            if rw.operation == _ast.Operator.AND:
+                return False
+            for child in rw.children:
+                if isinstance(child, _ast.InvertResult):
+                    return False
+                if isinstance(child, _ast.SubjectSetRewrite):
+                    if not rewrite_monotone(child):
+                        return False
+            return True
+
+        def node_tuples(ns_n: str, obj_n: str, rel_n: str):
+            query = RelationQuery(namespace=ns_n, object=obj_n, relation=rel_n)
+            page_token = ""
+            while True:
+                tuples, page_token = self.manager.get_relation_tuples(
+                    query, page_token=page_token, nid=nid
+                )
+                yield from tuples
+                if not page_token:
+                    break
+
+        while dq:
+            (ns_n, obj_n, rel_n), d = dq.popleft()
+            if dist.get((ns_n, obj_n, rel_n), d) < d:
+                continue  # superseded by a shorter discovery
+            # error semantics / rewrite shape at this node
+            relation_ast = None
+            try:
+                relation_ast = self._ast_relation_for(
+                    RelationTuple(namespace=ns_n, object=obj_n, relation=rel_n),
+                    nid,
+                )
+            except Exception:  # RelationNotFoundError: poison
+                monotone_ok = False
+            rewrite = (
+                relation_ast.subject_set_rewrite
+                if relation_ast is not None
+                else None
+            )
+            if not rewrite_monotone(rewrite):
+                monotone_ok = False
+
+            # direct subjects at distance d require depth >= d + 1
+            if d + 1 <= depth:
+                for t in node_tuples(ns_n, obj_n, rel_n):
+                    if t.subject_set is not None:
+                        s = t.subject_set
+                        key = ("set", s.namespace, s.object, s.relation)
+                    else:
+                        key = ("id", t.subject_id or "")
+                    if d + 1 < best.get(key, 1 << 30):
+                        best[key] = d + 1
+
+            def visit(node, nd):
+                # a node first reached at nd contributes direct entries
+                # of req >= nd + 1 (collected only while req <= depth),
+                # but its ERROR semantics fire as soon as it is visited —
+                # the reference raises relation-not-found before the
+                # depth guard — so the walk runs one ring past the
+                # subject horizon, to nd == depth
+                if nd > depth:
+                    return
+                if nd < dist.get(node, 1 << 30):
+                    dist[node] = nd
+                    if nd == d:
+                        dq.appendleft((node, nd))
+                    else:
+                        dq.append((node, nd))
+
+            # expand-subject edges (cost 1; wildcard-relation sets skip)
+            for t in node_tuples(ns_n, obj_n, rel_n):
+                s = t.subject_set
+                if s is None or s.relation == WILDCARD_RELATION:
+                    continue
+                visit((s.namespace, s.object, s.relation), d + 1)
+            # rewrite edges
+            if rewrite is not None:
+                for child in rewrite.children:
+                    if isinstance(child, _ast.ComputedSubjectSet):
+                        visit((ns_n, obj_n, child.relation), d)  # cost 0
+                    elif isinstance(child, _ast.TupleToSubjectSet):
+                        for t in node_tuples(ns_n, obj_n, child.relation):
+                            s = t.subject_set
+                            if s is None:
+                                continue
+                            visit(
+                                (
+                                    s.namespace, s.object,
+                                    child.computed_subject_set_relation,
+                                ),
+                                d + 1,
+                            )
+        # entries requiring more depth than the clamp can never fire
+        return monotone_ok, {k: v for k, v in best.items() if v <= depth}
+
     # -- expand (ref: internal/expand/engine.go) ------------------------------
 
     def _build_tree(

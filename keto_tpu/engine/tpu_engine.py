@@ -189,6 +189,13 @@ class TPUCheckEngine:
         # point; launch ids are allocated process-wide either way so logs
         # and typed errors stay correlatable when recording is off
         self.flightrec = flightrec
+        # Leopard closure index (engine/closure.py): deep checks answered
+        # in one probe step when the index covers them. `closure_enabled`
+        # is an attribute (not re-read per batch) so the bench's A/B legs
+        # can toggle it per call like the flight recorder
+        self.closure_enabled = bool(config.get("closure.enabled", False))
+        self._closure = None
+        self._closure_mu = threading.Lock()
         if tracer is None:
             from ..observability import _NoopTracer
 
@@ -981,11 +988,21 @@ class TPUCheckEngine:
             v for k, v in check_keys.items()
             if k in ("instr_pack", "prog_flags", "ns_has_config")
         )
+        # closure CSR + its delta overlay broken out as their own buffer
+        # families (the Leopard index lives in HBM beside the check
+        # tables; capacity planning must see it separately)
+        closure_keys = per_key(self.closure_device_tables())
         buffers = {
             "check": check_keys,
             "expand": per_key(state.expand_tables),
             "reverse": per_key(state.reverse_tables),
             "subjects": per_key(state.subjects_tables),
+            "closure": {
+                k: v for k, v in closure_keys.items() if k != "cd_pack"
+            },
+            "closure_delta": {
+                k: v for k, v in closure_keys.items() if k == "cd_pack"
+            },
         }
         totals = {
             name: sum(keys.values()) for name, keys in buffers.items()
@@ -1013,6 +1030,101 @@ class TPUCheckEngine:
             ),
             "has_delta": state.has_delta,
         }
+
+    # -- Leopard closure index (engine/closure.py) ----------------------------
+
+    def closure_index(self):
+        """The per-engine ClosureIndex (lazily created; a cheap shell
+        until the maintenance plane or closure_ensure_built powers it).
+        Exists regardless of `closure.enabled` so tests/bench can drive
+        it directly; the submit path gates on the enabled flag."""
+        with self._closure_mu:
+            if self._closure is None:
+                from .closure import (
+                    DEFAULT_LAG_BUDGET,
+                    DEFAULT_MAX_SET_ROWS,
+                    ClosureIndex,
+                )
+
+                cache_dir = self.config.get("check.mirror_cache")
+                cache_path = None
+                if cache_dir and self.mesh is None:
+                    from .checkpoint import closure_cache_path
+
+                    cache_path = closure_cache_path(cache_dir, self.nid)
+                self._closure = ClosureIndex(
+                    self.nid,
+                    max_set_rows=int(
+                        self.config.get(
+                            "closure.max_set_rows", DEFAULT_MAX_SET_ROWS
+                        )
+                    ),
+                    lag_budget_versions=int(
+                        self.config.get(
+                            "closure.lag_budget_versions", DEFAULT_LAG_BUDGET
+                        )
+                    ),
+                    metrics=self.metrics,
+                    cache_path=cache_path,
+                )
+            return self._closure
+
+    def closure_ensure_built(self) -> bool:
+        """Power (or refresh) the closure index for the CURRENT engine
+        state and fold in every committed write — the maintenance
+        plane's per-pass entry point (keto_tpu/closure), also called by
+        tests/bench for a deterministic warm index. Never called on the
+        check submit path: powering there would stall a batch."""
+        state = self._ensure_state()
+        idx = self.closure_index()
+        max_depth = self.config.max_read_depth()
+        ready = idx.ensure_for(state, self.manager, max_depth)
+        # incremental dirty refresh: re-power ONLY the write-perturbed
+        # nodes from current content (encoded through the state's
+        # overlay view, so post-base vocabulary resolves) — their checks
+        # return to the closure without waiting for the next compaction
+        idx.refresh_dirty(self.manager, max_depth, view=state.view)
+        return ready
+
+    def closure_device_tables(self) -> Optional[dict]:
+        """The installed closure device tables (hbm_snapshot's closure
+        buffer family), or None before the first build."""
+        idx = self._closure
+        if idx is None:
+            return None
+        with idx._mu:
+            view = idx._view
+        return view.tables if view is not None else None
+
+    def _closure_gate(self, state):
+        """(view, fallback_cause): the consistent closure view for one
+        submit, or the host-side cause every query in the batch will be
+        counted under. A LAGGING index gets one bounded inline catch-up
+        attempt (a changes_since read — comparable to the staleness read
+        _ensure_state just did) when the lag fits the budget; past the
+        budget the batch falls back and the background maintainer owns
+        recovery."""
+        from .closure import CAUSE_LAG
+
+        idx = self.closure_index()
+        view, cause = idx.view_for(state)
+        if view is None and cause == CAUSE_LAG:
+            lag = idx.lag_versions(state.covered_version)
+            if lag <= idx.lag_budget_versions and idx.catch_up(
+                self.manager, state.covered_version
+            ):
+                view, cause = idx.view_for(state)
+        if self.metrics is not None:
+            self.metrics.closure_lag_versions.set(
+                idx.lag_versions(state.covered_version)
+            )
+        return view, cause
+
+    def _count_closure_fallback(self, cause: str, n: int) -> None:
+        per = self.stats.setdefault("closure_fallback", {})
+        per[cause] = per.get(cause, 0) + n
+        if self.metrics is not None and n:
+            self.metrics.closure_fallback_total.labels(cause).inc(n)
 
     def _ensure_expand_state(self) -> _EngineState:
         """State with the expand-kernel extras (full-edge CSR + dirty
@@ -1715,7 +1827,7 @@ class TPUCheckEngine:
 
     def check_batch_submit(
         self, tuples: Sequence[RelationTuple], max_depth: int = 0,
-        telemetry=None,
+        telemetry=None, allow_closure: bool = True,
     ):
         """Launch the device kernel for one batch WITHOUT synchronizing.
 
@@ -1741,7 +1853,7 @@ class TPUCheckEngine:
         launch_id = next_launch_id()
         try:
             return self._check_batch_submit_inner(
-                tuples, max_depth, telemetry, launch_id
+                tuples, max_depth, telemetry, launch_id, allow_closure
             )
         except Exception as e:
             # don't clobber an id a recursive split-slice submit already
@@ -1752,7 +1864,7 @@ class TPUCheckEngine:
 
     def _check_batch_submit_inner(
         self, tuples: Sequence[RelationTuple], max_depth: int,
-        telemetry, launch_id: int,
+        telemetry, launch_id: int, allow_closure: bool = True,
     ):
         n = len(tuples)
         # fault-injection point (keto_tpu/faults.py): a stall here models
@@ -1785,6 +1897,7 @@ class TPUCheckEngine:
                         telemetry=(
                             telemetry[i : i + step] if telemetry else None
                         ),
+                        allow_closure=allow_closure,
                     )
                     for i in range(0, n, step)
                 ],
@@ -1830,6 +1943,64 @@ class TPUCheckEngine:
                 # unknown subject keeps the sentinel: traversal still runs
                 # so error flags surface, but no direct probe can hit
                 q_valid[i] = True
+
+        # Leopard closure fast path: when the index covers this engine
+        # state (same base snapshot, synced through covered_version), the
+        # WHOLE batch rides one single-step intersection launch first —
+        # chain depth stops mattering. Queries the index cannot answer
+        # (uncovered/dirty/invalid) are re-submitted through the BFS
+        # kernel at resolve time with cause-coded counters; host-side
+        # skip causes (unbuilt/stale/lag) count here, once per query.
+        # allow_closure=False is the resolve-time re-submission itself.
+        if allow_closure and self.closure_enabled:
+            cl_view, cl_cause = self._closure_gate(state)
+            if cl_view is not None:
+                from .closure_kernel import (
+                    closure_kernel_packed,
+                    estimate_closure_gather_bytes,
+                )
+                from .kernel import pack_queries
+
+                t_launch = time.perf_counter()
+                with self.tracer.span("engine.closure_launch", batch=B):
+                    outputs = closure_kernel_packed(
+                        cl_view.tables,
+                        pack_queries(
+                            q_obj, q_rel, q_depth, q_skind, q_sa, q_sb,
+                            q_valid,
+                        ),
+                        cc_probes=cl_view.cc_probes,
+                        ch_probes=cl_view.ch_probes,
+                        has_dirty=cl_view.has_dirty,
+                    )
+                t_done = time.perf_counter()
+                return (
+                    "closure",
+                    outputs,
+                    {
+                        "state": state,
+                        "tuples": tuples,
+                        "n": n,
+                        "B": B,
+                        "max_depth": max_depth,
+                        "q_valid": q_valid,
+                        "stage_s": {
+                            "assemble": t_launch - t_submit,
+                            "dispatch": t_done - t_launch,
+                        },
+                        "telemetry": telemetry,
+                        "launch_id": launch_id,
+                        "t_submit": t_submit,
+                        "kind": "closure",
+                        "step_cap": 1,
+                        "gather_step_bytes": estimate_closure_gather_bytes(
+                            B, cl_view.cc_probes, cl_view.ch_probes,
+                            cl_view.has_dirty,
+                        ),
+                    },
+                )
+            if cl_cause is not None:
+                self._count_closure_fallback(cl_cause, n)
 
         # per-launch frontier sizing: every BFS step's cost scales with the
         # frontier length, not the query count, so a small bucket must not
@@ -1954,6 +2125,15 @@ class TPUCheckEngine:
                 results.extend(r)
                 versions.extend(v)
             return results, versions
+        if kind == "closure":
+            try:
+                return self._closure_batch_resolve_v(outputs, meta)
+            except Exception as e:
+                # a failing leftover re-submission already stamped its
+                # own launch id — that id has the ring entry
+                if getattr(e, "launch_id", None) is None:
+                    e.launch_id = meta.get("launch_id")
+                raise
         try:
             return self._check_batch_resolve_v_inner(outputs, meta)
         except Exception as e:
@@ -1961,6 +2141,74 @@ class TPUCheckEngine:
             # error surface and the flight-recorder dump
             e.launch_id = meta.get("launch_id")
             raise
+
+    def _closure_batch_resolve_v(self, outputs, meta):
+        """Synchronize one closure launch: read the intersection verdicts
+        back, answer every resolved query at the view's (== the state's)
+        covered version, and re-submit the cause-coded remainder through
+        the BFS kernel (allow_closure=False — exactly one closure attempt
+        per batch). The common serving case resolves the whole batch here
+        with zero BFS contact."""
+        from .closure_kernel import CL_CAUSE_NAMES, unpack_closure_results
+
+        state = meta["state"]
+        tuples = meta["tuples"]
+        n, B, max_depth = meta["n"], meta["B"], meta["max_depth"]
+        telemetry = meta.get("telemetry")
+        t_resolve = time.perf_counter()
+        member, cause, stats = unpack_closure_results(
+            # ketolint: allow[host-sync] reason=this IS the closure batch's designated sync point: one packed readback carries verdicts, causes, and the launch stats vector — the same single-transfer resolve contract as every other kernel
+            np.asarray(outputs), B,
+        )
+        device_wait_s = time.perf_counter() - t_resolve
+
+        results: list = [None] * n
+        versions: list = [None] * n
+        covered = state.covered_version
+        leftover: list[int] = []
+        causes: dict[str, int] = {}
+        for i in range(n):
+            c = int(cause[i])
+            if c == 0:
+                results[i] = (
+                    RESULT_IS_MEMBER if member[i] else RESULT_NOT_MEMBER
+                )
+                versions[i] = covered
+            else:
+                leftover.append(i)
+                name = CL_CAUSE_NAMES.get(c, "uncovered")
+                causes[name] = causes.get(name, 0) + 1
+        n_hits = n - len(leftover)
+        self.stats["closure_hits"] = (
+            self.stats.get("closure_hits", 0) + n_hits
+        )
+        if self.metrics is not None:
+            if n_hits:
+                self.metrics.closure_hits_total.inc(n_hits)
+                self.metrics.checks_total.labels("device").inc(n_hits)
+            self.metrics.check_batch_size.observe(n)
+        self.stats["device_checks"] += n_hits
+        for name, cnt in causes.items():
+            self._count_closure_fallback(name, cnt)
+
+        meta["closure_resolved"] = n_hits
+        self._finish_check_stages(
+            meta, device_wait_s, 0.0, n, B, stats=stats, host_causes=causes
+        )
+        if leftover:
+            sub_handle = self.check_batch_submit(
+                [tuples[i] for i in leftover],
+                max_depth,
+                telemetry=(
+                    [telemetry[i] for i in leftover] if telemetry else None
+                ),
+                allow_closure=False,
+            )
+            sub_res, sub_ver = self.check_batch_resolve_v(sub_handle)
+            for j, i in enumerate(leftover):
+                results[i] = sub_res[j]
+                versions[i] = sub_ver[j]
+        return results, versions
 
     def _check_batch_resolve_v_inner(self, outputs, meta):
         state = meta["state"]
@@ -2167,7 +2415,7 @@ class TPUCheckEngine:
         t_submit = meta.get("t_submit")
         entry = {
             "launch_id": meta.get("launch_id"),
-            "kind": "check",
+            "kind": meta.get("kind", "check"),
             "nid": self.nid,
             "bucket": B,
             "n": n,
@@ -2186,6 +2434,8 @@ class TPUCheckEngine:
             },
             **sd,
         }
+        if "closure_resolved" in meta:
+            entry["closure_resolved"] = meta["closure_resolved"]
         if t_submit is not None:
             entry["wall_ms"] = round(
                 (time.perf_counter() - t_submit) * 1e3, 3

@@ -237,13 +237,15 @@ class FlightRecorder:
         return entries
 
 
-def summarize_launches(entries: list[dict]) -> dict:
+def summarize_launches(entries: list[dict], kind: str = "check") -> dict:
     """Per-leg aggregates of flight-recorder entries — the BENCH/SCALE
     json's launch-telemetry record (mean/p95 iterations, gather bytes
     per check, padding waste). Schema pinned by the bench golden test;
     returns {} for an empty window so legs without launches stay absent
-    from the json instead of recording degenerate zeros."""
-    checks = [e for e in entries if e.get("kind") == "check"]
+    from the json instead of recording degenerate zeros. `kind` selects
+    the launch family (the closure-on deep leg summarizes its
+    single-step `closure` launches instead of BFS `check` ones)."""
+    checks = [e for e in entries if e.get("kind") == kind]
     if not checks:
         return {}
 
@@ -695,6 +697,49 @@ class Metrics:
             "the breaker opens (checks host-oracle-serve, staying "
             "correct), the poisoned state is dropped, and the next "
             "check rebuilds the mirror from the store",
+            registry=self.registry,
+        )
+        # Leopard closure index (engine/closure.py): deep checks answered
+        # by the precomputed transitive-closure sets in one probe step
+        self.closure_hits_total = prom.Counter(
+            "keto_tpu_closure_hits_total",
+            "Check() queries answered by the Leopard closure index "
+            "(covered node, clean overlay, index synced through the "
+            "serving state's version) — positives AND definitive "
+            "negatives both count; every hit skipped the per-level BFS "
+            "entirely",
+            registry=self.registry,
+        )
+        self.closure_fallback_total = prom.Counter(
+            "keto_tpu_closure_fallback_total",
+            "Check() queries the closure index declined, by cause: "
+            "kernel-side `uncovered` (poisoned/oversized/unindexed "
+            "node), `dirty` (write-perturbed since the last powering), "
+            "`unindexed` (query vocabulary never encoded) and host-side "
+            "`unbuilt`/`stale_snapshot`/`lag` (index not ready for the "
+            "serving state — the batch never launched a closure probe). "
+            "Fallbacks ride the BFS kernel: correct, depth-priced",
+            ["cause"],
+            registry=self.registry,
+        )
+        self.closure_lag_versions = prom.Gauge(
+            "keto_tpu_closure_lag_versions",
+            "Store versions the closure index's dirty overlay trails the "
+            "serving state by (0 = synced; answers are version-gated, so "
+            "lag costs latency, never correctness)",
+            registry=self.registry,
+        )
+        self.closure_builds_total = prom.Counter(
+            "keto_tpu_closure_builds_total",
+            "Closure index powerings (initial build + re-powerings after "
+            "dirty-overlay overflow / changelog resets / snapshot "
+            "rebuilds)",
+            registry=self.registry,
+        )
+        self.closure_entries = prom.Gauge(
+            "keto_tpu_closure_entries",
+            "Materialized (node, subject) closure entries in the current "
+            "index build (the R·D product's row count on device)",
             registry=self.registry,
         )
         # hot-path cache: (transport, method) -> (duration child,

@@ -135,6 +135,7 @@ class Registry:
         self._profiler = None
         self._flightrec = None
         self._scrubber = None
+        self._closure_maintainer = None
         self._watch_hub = None
         self._check_cache = None
         self._check_cache_built = False
@@ -470,6 +471,25 @@ class Registry:
                     metrics=self.metrics(),
                 )
             return self._scrubber
+
+    def closure_maintainer(self):
+        """The Leopard-index maintenance plane (keto_tpu/closure): one
+        background tailer keeping every built engine's closure index
+        synced from the Watch changelog and re-powering it off the
+        request path. The daemon starts/stops it around serving when
+        `closure.enabled`; correctness never depends on it (every
+        closure answer is version-gated at submit)."""
+        with self._lock:
+            if self._closure_maintainer is None:
+                from .closure import ClosureMaintainer
+
+                self._closure_maintainer = ClosureMaintainer(
+                    self,
+                    poll_interval=float(
+                        self.config.get("watch.poll_interval", 0.25)
+                    ),
+                )
+            return self._closure_maintainer
 
     def profiler(self):
         """The process-wide on-demand capture session (profiling.py),

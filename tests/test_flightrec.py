@@ -525,6 +525,10 @@ class TestNoAdditionalDeviceSyncs:
         "check_batch_submit": 0,
         "check_batch_resolve_v": 0,
         "_check_batch_resolve_v_inner": 7,
+        # the closure fast path (engine/closure_kernel.py) keeps the
+        # same budget shape: zero syncs at submit, ONE packed readback
+        # at resolve carrying verdicts + causes + the stats vector
+        "_closure_batch_resolve_v": 1,
     }
 
     def test_sync_annotation_count_pinned(self):

@@ -279,6 +279,55 @@ class TestSubmitResolvePipeline:
         assert all(r.membership == Membership.IS_MEMBER for r in res)
 
 
+class TestPidFile:
+    """Daemon pid-file lifecycle (CLI `serve --pid-file`): written with
+    the live pid on start, removed LAST on clean stop — a pid file
+    outliving a clean shutdown lies to supervisors (kill -0 can succeed
+    against a recycled pid)."""
+
+    def test_written_on_start_removed_on_stop(self, tmp_path):
+        import os
+
+        from keto_tpu.api.daemon import Daemon
+
+        cfg = Config({
+            "dsn": "memory",
+            "serve": {
+                "read": {"host": "127.0.0.1", "port": 0},
+                "write": {"host": "127.0.0.1", "port": 0},
+                "metrics": {"host": "127.0.0.1", "port": 0},
+            },
+        })
+        cfg.set_namespaces([Namespace(name="files")])
+        pid_file = str(tmp_path / "serve.pid")
+        daemon = Daemon(Registry(cfg), pid_file=pid_file)
+        daemon.start()
+        try:
+            assert os.path.exists(pid_file)
+            with open(pid_file) as f:
+                assert int(f.read()) == os.getpid()
+        finally:
+            daemon.stop(grace=1.0)
+        assert not os.path.exists(pid_file)
+
+    def test_unconfigured_daemon_writes_nothing(self, tmp_path):
+        from keto_tpu.api.daemon import Daemon
+
+        cfg = Config({
+            "dsn": "memory",
+            "serve": {
+                "read": {"host": "127.0.0.1", "port": 0},
+                "write": {"host": "127.0.0.1", "port": 0},
+                "metrics": {"host": "127.0.0.1", "port": 0},
+            },
+        })
+        cfg.set_namespaces([Namespace(name="files")])
+        daemon = Daemon(Registry(cfg))
+        assert daemon.pid_file is None
+        daemon.start()
+        daemon.stop(grace=1.0)  # no pid file, no error
+
+
 class TestDrainShutdown:
     """Drain-aware daemon.stop (resilience plane): readiness flips off
     first, new admissions are shed with a typed OverloadedError during

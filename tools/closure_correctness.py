@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Closure-under-churn correctness smoke (CI-wired, CPU-runnable).
+
+The Leopard index's acceptance property is behavioral, not structural:
+under interleaved writes the index lags, marks dirty, re-powers — and
+through ALL of it every Check() answer must equal the exact host
+oracle's. This smoke drives that loop deterministically:
+
+  scenario_churn     — single-threaded interleaving of writes, closure
+                       maintenance steps, and differential check batches
+                       against the host oracle: ZERO wrong answers, and
+                       the fallback->catch-up->hit transitions must be
+                       OBSERVABLE in the engine's closure counters.
+  scenario_held_tail — the maintainer is HELD (the replica_smoke forced-
+                       lag trick): writes land, the index cannot catch
+                       up beyond the inline budget, answers stay
+                       oracle-correct the whole time; releasing the
+                       maintainer restores hits.
+  scenario_stores    — the churn loop repeated on memory, sqlite and
+                       columnar stores (the closure builder's three
+                       ingest shapes).
+
+Run: python tools/closure_correctness.py  (exit 0 = all invariants held)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import random  # noqa: E402
+
+from keto_tpu.config import Config  # noqa: E402
+from keto_tpu.engine.definitions import Membership  # noqa: E402
+from keto_tpu.engine.reference import ReferenceEngine  # noqa: E402
+from keto_tpu.engine.tpu_engine import TPUCheckEngine  # noqa: E402
+from keto_tpu.ketoapi import RelationTuple  # noqa: E402
+from keto_tpu.namespace import Namespace  # noqa: E402
+from keto_tpu.namespace.ast import (  # noqa: E402
+    ComputedSubjectSet,
+    Relation,
+    SubjectSetRewrite,
+    TupleToSubjectSet,
+)
+
+DEPTH = 8
+N_CHAINS = 12
+N_USERS = 16
+
+
+def deep_namespaces():
+    return [Namespace(name="deep", relations=[
+        Relation(name="owner"),
+        Relation(name="parent"),
+        Relation(name="viewer", subject_set_rewrite=SubjectSetRewrite(
+            children=[
+                ComputedSubjectSet(relation="owner"),
+                TupleToSubjectSet(
+                    relation="parent",
+                    computed_subject_set_relation="viewer",
+                ),
+            ]
+        )),
+    ])]
+
+
+def seed_tuples(rng):
+    tuples = []
+    for c in range(N_CHAINS):
+        for i in range(DEPTH):
+            tuples.append(RelationTuple.from_string(
+                f"deep:c{c}f{i}#parent@(deep:c{c}f{i + 1}#...)"
+            ))
+        tuples.append(RelationTuple.from_string(
+            f"deep:c{c}f{DEPTH}#owner@u{rng.randrange(N_USERS)}"
+        ))
+    return tuples
+
+
+def make_store(kind: str, tmpdir: str):
+    if kind == "memory":
+        from keto_tpu.storage import MemoryManager
+
+        return MemoryManager()
+    if kind == "sqlite":
+        from keto_tpu.storage.sqlite import SQLPersister
+
+        return SQLPersister(f"sqlite://{tmpdir}/closure_smoke_{os.getpid()}.db")
+    if kind == "columnar":
+        from keto_tpu.storage.columnar import ColumnarStore
+
+        return ColumnarStore()
+    raise ValueError(kind)
+
+
+def run_churn(store_kind: str, tmpdir: str, rounds: int = 30,
+              hold_tail: bool = False) -> dict:
+    rng = random.Random(42)
+    cfg = Config({
+        "limit": {"max_read_depth": DEPTH + 4},
+        "closure": {"enabled": True, "lag_budget_versions": 0 if hold_tail else 64},
+    })
+    cfg.set_namespaces(deep_namespaces())
+    manager = make_store(store_kind, tmpdir)
+    manager.write_relation_tuples(seed_tuples(rng))
+    engine = TPUCheckEngine(manager, cfg, frontier_cap=4096)
+    oracle = ReferenceEngine(manager, cfg)
+    assert engine.closure_ensure_built(), "initial powering must succeed"
+
+    wrong = 0
+    checked = 0
+    transitions = {"hit": 0, "fallback": 0, "recovered": 0}
+    was_falling_back = False
+    next_user = [N_USERS]
+    for r in range(rounds):
+        # one committed write per round: new member at a random chain
+        # tail, or delete one previously added
+        c = rng.randrange(N_CHAINS)
+        if rng.random() < 0.7:
+            u = f"w{next_user[0]}"
+            next_user[0] += 1
+            manager.write_relation_tuples([RelationTuple.from_string(
+                f"deep:c{c}f{rng.randrange(DEPTH + 1)}#owner@{u}"
+            )])
+        else:
+            manager.delete_relation_tuples([RelationTuple.from_string(
+                f"deep:c{c}f{DEPTH}#owner@u{rng.randrange(N_USERS)}"
+            )])
+        # maintenance runs only when the tail is NOT held: held = the
+        # forced-lag regime, the index must refuse rather than answer
+        if not hold_tail and r % 3 == 2:
+            engine.closure_ensure_built()
+
+        hits0 = engine.stats.get("closure_hits", 0)
+        fb0 = sum(engine.stats.get("closure_fallback", {}).values())
+        queries = []
+        for _ in range(16):
+            qc = rng.randrange(N_CHAINS)
+            qf = rng.randrange(DEPTH)
+            sub = (
+                f"u{rng.randrange(N_USERS)}"
+                if rng.random() < 0.5
+                else f"w{rng.randrange(max(next_user[0] - N_USERS, 1)) + N_USERS}"
+            )
+            queries.append(RelationTuple.from_string(
+                f"deep:c{qc}f{qf}#viewer@{sub}"
+            ))
+        results = engine.check_batch(queries)
+        for q, res in zip(queries, results):
+            want = oracle.check_relation_tuple(q)
+            checked += 1
+            if res.membership != want.membership:
+                wrong += 1
+        hit_d = engine.stats.get("closure_hits", 0) - hits0
+        fb_d = sum(engine.stats.get("closure_fallback", {}).values()) - fb0
+        if fb_d:
+            transitions["fallback"] += 1
+            was_falling_back = True
+        if hit_d and not fb_d:
+            transitions["hit"] += 1
+            if was_falling_back:
+                transitions["recovered"] += 1
+                was_falling_back = False
+    return {
+        "store": store_kind,
+        "hold_tail": hold_tail,
+        "rounds": rounds,
+        "checked": checked,
+        "wrong": wrong,
+        "closure_hits": engine.stats.get("closure_hits", 0),
+        "closure_fallback": dict(engine.stats.get("closure_fallback", {})),
+        "transitions": transitions,
+        "index": engine.closure_index().describe(),
+    }
+
+
+def main() -> int:
+    failures = []
+    with tempfile.TemporaryDirectory() as tmpdir:
+        for kind in ("memory", "sqlite", "columnar"):
+            rec = run_churn(kind, tmpdir)
+            print(f"[churn/{kind}] {rec}")
+            if rec["wrong"]:
+                failures.append(f"{kind}: {rec['wrong']} wrong answers")
+            if rec["closure_hits"] == 0:
+                failures.append(f"{kind}: closure never hit")
+            if not sum(rec["closure_fallback"].values()):
+                failures.append(
+                    f"{kind}: churn produced zero observable fallbacks"
+                )
+            if rec["transitions"]["recovered"] == 0:
+                failures.append(
+                    f"{kind}: no fallback->catch-up->hit transition observed"
+                )
+
+        held = run_churn("memory", tmpdir, hold_tail=True)
+        print(f"[held-tail] {held}")
+        if held["wrong"]:
+            failures.append(f"held-tail: {held['wrong']} wrong answers")
+        lagged = sum(
+            n for c, n in held["closure_fallback"].items()
+            if c in ("lag", "dirty", "stale_snapshot")
+        )
+        if lagged == 0:
+            failures.append(
+                "held-tail: a held maintainer produced no lag/dirty fallbacks"
+            )
+
+    if failures:
+        print("FAIL:")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("OK: zero wrong answers under churn; fallback/catch-up/hit "
+          "transitions observable; held tail degraded safely")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
