@@ -544,6 +544,172 @@ def bench_reverse(namespaces, tuples) -> dict:
     return out
 
 
+def bench_filter() -> dict:
+    """Bulk ACL filter leg (engine/filter_kernel.py): one subject, a
+    10k-object candidate column, one device ride — vs the pipelined
+    check_batch baseline on the SAME (subject, object) pairs. The
+    acceptance bar is >=10x lower per-object cost than the pipelined
+    per-Check ride (the motivation's "10k independent Check rides").
+
+    Three arms over one ~10k-object cat-videos topology:
+      - filter/frontier: closure off — the shared-frontier reverse walk
+        expands the subject's reachable set ONCE and intersects the
+        whole candidate column (the structural win: the walk explores
+        the SUBJECT's world, not 10k objects' ancestries).
+      - filter/closure: Leopard fast path — every covered candidate is
+        one batched membership gather.
+      - check_batch baselines, closure off AND on, pipelined exactly
+        like bench_kernel.
+    Verdict equality between the two filter arms is asserted, plus a
+    random-sample differential vs the host oracle (the full differential
+    lives in tests/test_filter.py + tools/filter_correctness.py)."""
+    import random as _random
+
+    from keto_tpu.config import Config
+    from keto_tpu.engine.reference import ReferenceEngine
+    from keto_tpu.engine.tpu_engine import TPUCheckEngine
+    from keto_tpu.ketoapi import RelationTuple
+    from keto_tpu.observability import FlightRecorder, summarize_launches
+    from keto_tpu.storage import MemoryManager
+
+    namespaces, _, _ = build_dataset()
+    # a >=10k-object candidate universe: 84 folders x 120 files
+    rng = _random.Random(77)
+    n_folders, files_per_folder = 84, 120
+    tuples = []
+    owners: dict[str, str] = {}
+    for d in range(n_folders):
+        owner = f"user{rng.randrange(N_USERS)}"
+        owners[f"/d{d}"] = owner
+        tuples.append(RelationTuple.from_string(f"videos:/d{d}#owner@{owner}"))
+        for f in range(files_per_folder):
+            obj = f"/d{d}/v{f}.mp4"
+            tuples.append(RelationTuple.from_string(
+                f"videos:{obj}#parent@(videos:/d{d}#...)"
+            ))
+    n_objects = int(os.environ.get("KETO_BENCH_FILTER_OBJECTS", 10000))
+    candidates = [
+        f"/d{rng.randrange(n_folders)}/v{rng.randrange(files_per_folder)}.mp4"
+        for _ in range(n_objects)
+    ]
+    # the filtering subject owns one folder: ~1.2% hit rate, the sparse
+    # search-result shape (most candidates are other people's documents)
+    subject = owners["/d0"]
+
+    cfg = Config({
+        "limit": {"max_read_depth": 5},
+        "closure": {"enabled": True},
+        "filter": {"chunk_size": 16384},
+    })
+    cfg.set_namespaces(namespaces)
+    m = MemoryManager()
+    m.write_relation_tuples(tuples)
+    rounds = 5
+    out: dict = {"filter_objects": n_objects}
+
+    def _filter_arm(closure: bool, prefix: str):
+        flightrec = FlightRecorder(capacity=64)
+        engine = TPUCheckEngine(m, cfg, flightrec=flightrec)
+        engine.closure_enabled = closure
+        if closure:
+            engine.closure_ensure_built()
+        verdicts = engine.filter_batch(
+            "videos", "view", subject, candidates, chunk_size=16384
+        )  # build + compile
+        host0 = engine.stats.get("filter_host", 0)
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            verdicts = engine.filter_batch(
+                "videos", "view", subject, candidates, chunk_size=16384
+            )
+        wall = time.perf_counter() - t0
+        out[f"{prefix}_objects_per_sec"] = round(rounds * n_objects / wall, 1)
+        out[f"{prefix}_per_object_us"] = round(
+            wall / (rounds * n_objects) * 1e6, 3
+        )
+        out[f"{prefix}_host"] = engine.stats.get("filter_host", 0) - host0
+        kind = "filter_closure" if closure else "filter"
+        out[f"{prefix}_launch_telemetry"] = summarize_launches(
+            flightrec.entries(), kind=kind
+        )
+        return verdicts, engine
+
+    frontier_verdicts, _ = _filter_arm(False, "filter_frontier")
+    closure_verdicts, _ = _filter_arm(True, "filter_closure")
+    assert frontier_verdicts == closure_verdicts, (
+        "filter arms disagree — differential bug"
+    )
+    out["filter_allowed"] = sum(frontier_verdicts)
+    # random-sample differential vs the exact host oracle
+    oracle = ReferenceEngine(m, cfg)
+    sample = rng.sample(range(n_objects), 200)
+    want = oracle.filter_objects(
+        "videos", "view", subject, [candidates[i] for i in sample]
+    )
+    got = [frontier_verdicts[i] for i in sample]
+    out["filter_oracle_sample_mismatches"] = sum(
+        1 for a, b in zip(got, want) if a != b
+    )
+
+    # headline metric: the closure-arm throughput (the steady serving
+    # shape — a warm Leopard index); the frontier arm is the
+    # closure-cold contrast
+    out["filter_objects_per_sec"] = out["filter_closure_objects_per_sec"]
+
+    # pipelined check_batch baselines on the SAME pairs
+    check_tuples = [
+        RelationTuple.from_string(f"videos:{obj}#view@{subject}")
+        for obj in candidates
+    ]
+
+    def _check_arm(closure: bool, prefix: str):
+        engine = TPUCheckEngine(m, cfg, frontier_cap=2 * BATCH)
+        engine.closure_enabled = closure
+        if closure:
+            engine.closure_ensure_built()
+        engine.check_batch(check_tuples)  # compile + warm
+        t0 = time.perf_counter()
+        handles = [
+            engine.check_batch_submit(check_tuples) for _ in range(rounds)
+        ]
+        results = None
+        for h in handles:
+            results = engine.check_batch_resolve(h)
+        wall = time.perf_counter() - t0
+        out[f"{prefix}_objects_per_sec"] = round(rounds * n_objects / wall, 1)
+        out[f"{prefix}_per_object_us"] = round(
+            wall / (rounds * n_objects) * 1e6, 3
+        )
+        return results
+
+    check_results = _check_arm(False, "checkbatch")
+    _check_arm(True, "checkbatch_closure")
+    from keto_tpu.engine.definitions import Membership
+
+    check_verdicts = [
+        r.error is None and r.membership == Membership.IS_MEMBER
+        for r in check_results
+    ]
+    assert check_verdicts == frontier_verdicts, (
+        "check_batch and filter disagree — differential bug"
+    )
+
+    # the acceptance ratio: per-object cost of the pipelined per-Check
+    # ride over the filter ride (>= 10 is the bar). Both filter arms
+    # are ratioed so the artifact shows the closure-warm AND
+    # closure-cold story; the closure-on check contrast sits beside it.
+    out["filter_per_object_us"] = out["filter_closure_per_object_us"]
+    out["filter_vs_checkbatch_per_object"] = round(
+        out["checkbatch_per_object_us"] / out["filter_closure_per_object_us"],
+        2,
+    )
+    out["filter_frontier_vs_checkbatch_per_object"] = round(
+        out["checkbatch_per_object_us"] / out["filter_frontier_per_object_us"],
+        2,
+    )
+    return out
+
+
 def bench_watch(n_events: int = 2000, n_subs: int = 4) -> dict:
     """Watch-subsystem leg (keto_tpu/watch): one writer churning
     single-tuple transactions against N live subscribers on the
@@ -1366,6 +1532,13 @@ def main() -> int:
              "print its JSON record",
     )
     ap.add_argument(
+        "--ab-filter", action="store_true",
+        help="run ONLY the BatchFilter leg (10k-object filter vs the "
+             "pipelined check_batch baseline, closure-warm and "
+             "closure-cold arms, per-object cost ratio + launch "
+             "telemetry) and print its JSON record",
+    )
+    ap.add_argument(
         "--ab-closure", action="store_true",
         help="run ONLY the Leopard-closure A/B leg (deep-20 QPS with "
              "the closure index on vs off, verdict-equality checked, "
@@ -1434,6 +1607,12 @@ def main() -> int:
             print(json.dumps(ab))
             return 0
 
+        if args.ab_filter:
+            ab = bench_filter()
+            ab["device"] = str(jax.devices()[0])
+            print(json.dumps(ab))
+            return 0
+
         namespaces, tuples, queries = build_dataset()
         record["tuples"] = len(tuples)
 
@@ -1446,6 +1625,7 @@ def main() -> int:
         record.update(bench_config3_expand())
         record.update(bench_config4_deep())
         record.update(bench_reverse(namespaces, tuples))
+        record.update(bench_filter())
         record.update(bench_watch())
 
         if not args.skip_serve:

@@ -88,7 +88,8 @@ _HOT_FILES = {
 _HOT_FUNCS = re.compile(
     r"^_?(check_batch_submit|check_batch_resolve(_v)?|check_batch"
     r"|closure_batch_resolve(_v)?"
-    r"|list_objects_batch|list_subjects_batch|expand_batch)(_inner)?$"
+    r"|list_objects_batch|list_subjects_batch|expand_batch"
+    r"|filter_batch|filter_chunk)(_inner)?$"
 )
 
 # a with-context (or receiver) names a lock when its final segment does

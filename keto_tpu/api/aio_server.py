@@ -715,6 +715,7 @@ def _aio_handlers(service: _AioReadServices):
     from .descriptors import (
         BATCH_CHECK_SERVICE,
         EXPAND_SERVICE,
+        FILTER_SERVICE,
         HEALTH_SERVICE,
         READ_SERVICE,
         REVERSE_READ_SERVICE,
@@ -767,6 +768,15 @@ def _aio_handlers(service: _AioReadServices):
             "ListSubjects": unary(
                 service._delegated("ListSubjects", svc.list_subjects),
                 pb.ListSubjectsRequest,
+            ),
+        }),
+        # bulk ACL filter extension: a whole candidate column per RPC is
+        # blocking device work (engine.filter_batch), delegated like
+        # BatchCheck — the in-loop batcher coalesces SINGLE checks,
+        # which a filter request has already batched client-side
+        grpc.method_handlers_generic_handler(FILTER_SERVICE, {
+            "Filter": unary(
+                service._delegated("Filter", svc.filter), pb.FilterRequest
             ),
         }),
         # changelog watch extension: loop-native async stream

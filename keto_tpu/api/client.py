@@ -19,6 +19,7 @@ from .descriptors import (
     BATCH_CHECK_SERVICE,
     CHECK_SERVICE,
     EXPAND_SERVICE,
+    FILTER_SERVICE,
     HEALTH_SERVICE,
     READ_SERVICE,
     REVERSE_READ_SERVICE,
@@ -225,6 +226,33 @@ class ReadClient(_BaseClient):
             pb.ListObjectsResponse, timeout,
         )
         return list(resp.objects), resp.next_page_token, resp.snaptoken
+
+    def filter(
+        self,
+        namespace: str,
+        relation: str,
+        subject: Subject,
+        objects: list[str],
+        max_depth: int = 0,
+        timeout=None,
+        snaptoken: str = "",
+    ) -> tuple[list[str], str]:
+        """keto_tpu bulk-ACL-filter extension (FilterService): (the
+        candidates the subject CAN see in request order, response
+        snaptoken). One RPC carries the whole candidate column — the
+        search-result-filtering workload as a single device ride
+        instead of N checks. Only this framework's server implements
+        the service; a stock Keto deployment raises UNIMPLEMENTED."""
+        req = pb.FilterRequest(
+            namespace=namespace, relation=relation, max_depth=max_depth,
+            snaptoken=snaptoken,
+        )
+        req.subject.CopyFrom(subject_to_proto(subject))
+        req.objects.extend(objects)
+        resp = self._rpc(
+            FILTER_SERVICE, "Filter", req, pb.FilterResponse, timeout,
+        )
+        return list(resp.allowed_objects), resp.snaptoken
 
     def list_subjects(
         self,

@@ -69,6 +69,11 @@ pb = SimpleNamespace(
     ListObjectsResponse=_msg("keto_tpu.reverse.v1.ListObjectsResponse"),
     ListSubjectsRequest=_msg("keto_tpu.reverse.v1.ListSubjectsRequest"),
     ListSubjectsResponse=_msg("keto_tpu.reverse.v1.ListSubjectsResponse"),
+    # bulk ACL filter extension (keto_tpu_filter.proto; descriptor
+    # appended by tools/gen_filter_descriptor.py): one subject, a whole
+    # candidate column, one device ride
+    FilterRequest=_msg("keto_tpu.filter.v1.FilterRequest"),
+    FilterResponse=_msg("keto_tpu.filter.v1.FilterResponse"),
     # watch extension (keto_tpu_watch.proto; descriptor appended by
     # tools/gen_watch_descriptor.py): streaming changelog
     WatchRequest=_msg("keto_tpu.watch.v1.WatchRequest"),
@@ -92,5 +97,7 @@ HEALTH_SERVICE = "grpc.health.v1.Health"
 BATCH_CHECK_SERVICE = "keto_tpu.batch.v1.BatchCheckService"
 # extension (keto_tpu_reverse.proto): ListObjects / ListSubjects
 REVERSE_READ_SERVICE = "keto_tpu.reverse.v1.ReverseReadService"
+# extension (keto_tpu_filter.proto): bulk ACL filtering (BatchFilter)
+FILTER_SERVICE = "keto_tpu.filter.v1.FilterService"
 # extension (keto_tpu_watch.proto): server-streaming changelog watch
 WATCH_SERVICE = "keto_tpu.watch.v1.WatchService"

@@ -46,6 +46,7 @@ from .descriptors import (
     BATCH_CHECK_SERVICE,
     CHECK_SERVICE,
     EXPAND_SERVICE,
+    FILTER_SERVICE,
     HEALTH_SERVICE,
     READ_SERVICE,
     REVERSE_READ_SERVICE,
@@ -406,6 +407,52 @@ class _Services:
         resp.subject_ids.extend(subjects)
         return resp
 
+    # -- FilterService (keto_tpu extension) -----------------------------------
+
+    def filter(self, req, context):
+        """keto_tpu extension (keto_tpu_filter.proto): bulk ACL filter —
+        which of these candidate objects can the subject see? One RPC
+        carries the whole candidate column into the engine's
+        shared-subject device formulation (closure fast path + shared-
+        frontier reverse walk, engine/filter_kernel.py). Admission
+        (typed 429/504 + the filter.max_objects 400) runs BEFORE any
+        work; the deadline is re-checked at every chunk boundary inside
+        the engine; snaptoken gating matches Check (replica mode routes
+        through the snaptoken hold/route/escalate rule)."""
+        from ..engine.snaptoken import encode_snaptoken
+        from ..ketoapi import RelationQuery
+        from ..resilience import admit_filter
+
+        rt = current_request_trace()
+        admit_filter(self.registry, len(req.objects), rt)
+        sub = subject_from_proto(req.subject)
+        if sub is None:
+            from ..errors import NilSubjectError
+
+            raise NilSubjectError()
+        self.registry.validate_namespaces(
+            RelationQuery(namespace=req.namespace),
+            sub if isinstance(sub, SubjectSet) else None,
+        )
+        nid = self._nid(context)
+        if self.worker is not None:
+            from .replica import resolve_version
+
+            _target, version = resolve_version(
+                self.worker.group, self.worker, nid, req.snaptoken, rt
+            )
+        else:
+            version = self._enforce_snaptoken(req.snaptoken, nid)
+        engine = self.registry.check_engine(nid)
+        allowed = engine.filter_objects(
+            req.namespace, req.relation, sub, list(req.objects),
+            int(req.max_depth),
+            deadline=getattr(rt, "deadline", None) if rt is not None else None,
+        )
+        resp = pb.FilterResponse(snaptoken=encode_snaptoken(version, nid))
+        resp.allowed_objects.extend(allowed)
+        return resp
+
     # -- ReadService ----------------------------------------------------------
 
     def list_relation_tuples(self, req, context):
@@ -644,6 +691,14 @@ def _service_handlers(services: _Services, write: bool):
                         "ListSubjects": _unary(
                             s, "ListSubjects", s.list_subjects,
                             pb.ListSubjectsRequest,
+                        ),
+                    },
+                ),
+                grpc.method_handlers_generic_handler(
+                    FILTER_SERVICE,
+                    {
+                        "Filter": _unary(
+                            s, "Filter", s.filter, pb.FilterRequest
                         ),
                     },
                 ),

@@ -16,6 +16,7 @@ from .rest_server import (
     CHECK_OPENAPI_ROUTE,
     CHECK_ROUTE_BASE,
     EXPAND_ROUTE,
+    FILTER_ROUTE,
     LIST_OBJECTS_ROUTE,
     LIST_SUBJECTS_ROUTE,
     READ_ROUTE_BASE,
@@ -110,6 +111,40 @@ def _schemas() -> dict:
                         },
                     },
                 },
+            },
+        },
+        "filterRequest": {
+            "type": "object",
+            "required": ["namespace", "relation", "objects"],
+            "properties": {
+                "namespace": {"type": "string"},
+                "relation": {"type": "string"},
+                "subject_id": {"type": "string"},
+                "subject_set": {
+                    "$ref": "#/components/schemas/subjectSet"
+                },
+                "objects": {
+                    "type": "array",
+                    "items": {"type": "string"},
+                    "description": "candidate object names — the whole "
+                                   "column rides one device evaluation "
+                                   "(bounded by filter.max_objects)",
+                },
+                "max_depth": {"type": "integer"},
+                "snaptoken": {"type": "string"},
+            },
+        },
+        "filterResponse": {
+            "type": "object",
+            "required": ["allowed_objects"],
+            "properties": {
+                "allowed_objects": {
+                    "type": "array",
+                    "items": {"type": "string"},
+                    "description": "candidates the subject can see, in "
+                                   "request order",
+                },
+                "snaptoken": {"type": "string"},
             },
         },
         "listObjectsResponse": {
@@ -364,6 +399,42 @@ def build_spec(version: str = "", kind: str | None = None) -> dict:
                 },
             }
         },
+        FILTER_ROUTE: {
+            "post": {
+                "summary": "Filter a candidate object list down to what "
+                           "the subject can see (keto_tpu bulk-ACL-"
+                           "filter extension — one request, many "
+                           "objects, one device ride)",
+                "requestBody": {
+                    "required": True,
+                    "content": {"application/json": {"schema": {
+                        "$ref": "#/components/schemas/filterRequest"
+                    }}},
+                },
+                "responses": {
+                    "200": _json_response(
+                        "candidates the subject can see, in request "
+                        "order",
+                        "filterResponse",
+                    ),
+                    "400": _json_response(
+                        "malformed input or candidate list over "
+                        "filter.max_objects",
+                        "errorGeneric",
+                    ),
+                    "404": _json_response("unknown namespace", "errorGeneric"),
+                    "409": _json_response(
+                        "snaptoken demands a newer snapshot", "errorGeneric"
+                    ),
+                    "429": _json_response(
+                        "server overloaded or draining", "errorGeneric"
+                    ),
+                    "504": _json_response(
+                        "deadline expired mid-evaluation", "errorGeneric"
+                    ),
+                },
+            }
+        },
         LIST_OBJECTS_ROUTE: {
             "get": {
                 "summary": "List the objects a subject reaches via a "
@@ -520,6 +591,7 @@ def build_spec(version: str = "", kind: str | None = None) -> dict:
         (CHECK_OPENAPI_ROUTE, "post"): "postCheck",
         (CHECK_BATCH_ROUTE, "post"): "postBatchCheck",
         (EXPAND_ROUTE, "get"): "getExpand",
+        (FILTER_ROUTE, "post"): "postFilter",
         (LIST_OBJECTS_ROUTE, "get"): "getListObjects",
         (LIST_SUBJECTS_ROUTE, "get"): "getListSubjects",
         (WATCH_ROUTE, "get"): "getWatch",

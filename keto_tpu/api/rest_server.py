@@ -56,6 +56,10 @@ EXPAND_ROUTE = "/relation-tuples/expand"
 # object" — the reference has no such routes (Zanzibar's Leopard family)
 LIST_OBJECTS_ROUTE = "/relation-tuples/list-objects"
 LIST_SUBJECTS_ROUTE = "/relation-tuples/list-subjects"
+# keto_tpu bulk-ACL-filter extension (engine/filter_kernel.py): POST a
+# candidate object column, get back the subset the subject can see —
+# search-result filtering (Zanzibar's dominant workload) as ONE request
+FILTER_ROUTE = "/relation-tuples/filter"
 # keto_tpu watch extension (keto_tpu/watch): the streaming changelog as
 # Server-Sent Events — Zanzibar's Watch API (§2.4.3), absent from the
 # reference
@@ -94,6 +98,7 @@ ROUTE_KINDS = {
     EXPAND_ROUTE: "read",
     LIST_OBJECTS_ROUTE: "read",
     LIST_SUBJECTS_ROUTE: "read",
+    FILTER_ROUTE: "read",
     WATCH_ROUTE: "read",
     WRITE_ROUTE_BASE: "write",
     ALIVE_PATH: "shared",
@@ -378,6 +383,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return LIST_OBJECTS_ROUTE, self._list_objects
             if method == "GET" and path == LIST_SUBJECTS_ROUTE:
                 return LIST_SUBJECTS_ROUTE, self._list_subjects
+            if method == "POST" and path == FILTER_ROUTE:
+                return FILTER_ROUTE, self._filter
             if method == "GET" and path == WATCH_ROUTE:
                 return WATCH_ROUTE, self._watch
             return None
@@ -660,6 +667,79 @@ class _Handler(BaseHTTPRequestHandler):
             200,
             {"subject_ids": subjects, "next_page_token": next_token},
             extra_headers=[("X-Keto-Snaptoken", encode_snaptoken(version, nid))],
+        )
+
+    def _filter(self) -> None:
+        """keto_tpu bulk-ACL-filter extension: POST {"namespace",
+        "relation", "subject_id" | "subject_set", "objects": [...],
+        "max_depth"?, "snaptoken"?} -> {"allowed_objects": [...],
+        "snaptoken": ...} — the subset of the candidate column the
+        subject can see, in request order. Admission (draining 429 /
+        expired 504 / filter.max_objects 400) runs BEFORE any work; the
+        engine re-checks the deadline at every chunk boundary; replica
+        mode routes the snaptoken through the hold/route/escalate rule
+        like Check."""
+        from ..engine.snaptoken import encode_snaptoken
+        from ..ketoapi import _subject_fields_from_dict
+        from ..resilience import admit_filter
+
+        rt = self._ingest_deadline()
+        body = self._body_json()
+        if not isinstance(body, dict):
+            raise MalformedInputError("could not unmarshal json: expected object")
+        objects = body.get("objects")
+        if not isinstance(objects, list) or not all(
+            isinstance(o, str) for o in objects
+        ):
+            raise MalformedInputError(
+                "filter requires \"objects\": an array of object names"
+            )
+        admit_filter(self.registry, len(objects), rt)
+        namespace = body.get("namespace")
+        relation = body.get("relation")
+        if not namespace or not relation:
+            raise MalformedInputError(
+                debug="filter requires namespace and relation"
+            )
+        subject_id, subject_set = _subject_fields_from_dict(body)
+        if subject_id is None and subject_set is None:
+            from ..errors import NilSubjectError
+
+            raise NilSubjectError()
+        subject = subject_set if subject_set is not None else subject_id
+        raw_depth = body.get("max_depth")
+        if raw_depth is None:
+            max_depth = _get_max_depth(self._params())
+        else:
+            try:
+                max_depth = int(raw_depth)
+            except (TypeError, ValueError):
+                raise MalformedInputError("max_depth must be an integer")
+        nid = self._nid()
+        token = body.get("snaptoken") or self._params().get("snaptoken", "")
+        if self.worker is not None:
+            from .replica import resolve_version
+
+            _target, version = resolve_version(
+                self.worker.group, self.worker, nid, token, rt
+            )
+        else:
+            version = self._enforce_snaptoken(token, nid)
+        self.registry.validate_namespaces(
+            RelationQuery(namespace=namespace),
+            subject if isinstance(subject, SubjectSet) else None,
+        )
+        engine = self.registry.check_engine(nid)
+        allowed = engine.filter_objects(
+            namespace, relation, subject, objects, max_depth,
+            deadline=getattr(rt, "deadline", None) if rt is not None else None,
+        )
+        self._json(
+            200,
+            {
+                "allowed_objects": allowed,
+                "snaptoken": encode_snaptoken(version, nid),
+            },
         )
 
     # SSE keep-alive cadence: also the disconnect-detection bound (a

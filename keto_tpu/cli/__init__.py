@@ -524,6 +524,58 @@ def cmd_list_subjects(args) -> int:
     return 0
 
 
+def cmd_filter(args) -> int:
+    """keto_tpu extension: bulk ACL filter — of the listed candidate
+    objects, which can the subject see? The whole candidate column rides
+    one FilterService RPC (the search-result-filtering workload). The
+    subject is a plain id positional or --subject-set
+    "namespace:object#relation"; candidates are positional object names
+    or one-per-line on stdin with --objects-stdin."""
+    if args.subject is None and not args.subject_set:
+        raise CLIError("a subject id or --subject-set is required")
+    objects = list(args.objects)
+    if args.subject_set and args.subject is not None:
+        # with --subject-set the positionals are (relation, namespace,
+        # objects...) — but argparse greedily fills the optional subject
+        # slot first, shifting relation->subject, namespace->relation,
+        # first candidate->namespace. Shift them back; without this the
+        # command silently queries the wrong namespace/relation and
+        # drops a candidate.
+        objects = (
+            [args.namespace] if args.namespace is not None else []
+        ) + objects
+        args.relation, args.namespace = args.subject, args.relation
+        args.subject = None
+    subject = (
+        SubjectSet.from_string(args.subject_set)
+        if args.subject_set
+        else args.subject
+    )
+    if args.objects_stdin:
+        import sys as _sys
+
+        objects.extend(
+            line.strip() for line in _sys.stdin if line.strip()
+        )
+    if not objects:
+        raise CLIError("at least one candidate object is required")
+    client = _read_client(args)
+    try:
+        allowed, token = client.filter(
+            args.namespace, args.relation, subject, objects,
+            max_depth=args.max_depth, snaptoken=args.snaptoken or "",
+        )
+    finally:
+        client.close()
+    obj = {"allowed_objects": allowed}
+    text = "\n".join(allowed) if allowed else "<no allowed objects>"
+    if getattr(args, "print_snaptoken", False):
+        obj["snaptoken"] = token
+        text += f"\n{token}"
+    _print_formatted(args, obj, text)
+    return 0
+
+
 def cmd_watch(args) -> int:
     """keto_tpu extension: stream the tuple changelog (Zanzibar's Watch
     API). Resumes from --snaptoken, filters with --namespace; --max-events
@@ -773,6 +825,30 @@ def build_parser() -> argparse.ArgumentParser:
     _add_remote_flags(p, read=True)
     _add_format_flag(p)
     p.set_defaults(fn=cmd_list_subjects)
+
+    p = sub.add_parser(
+        "filter",
+        help="filter a candidate object list down to what a subject can "
+             "see (bulk ACL filtering — one request, many objects)",
+    )
+    p.add_argument("subject", nargs="?", default=None,
+                   help="plain subject id (or use --subject-set)")
+    p.add_argument("relation")
+    p.add_argument("namespace")
+    p.add_argument("objects", nargs="*",
+                   help="candidate object names (or --objects-stdin)")
+    p.add_argument("--subject-set", default=None,
+                   help='"namespace:object#relation"')
+    p.add_argument("--objects-stdin", action="store_true",
+                   help="also read candidate objects one-per-line from "
+                        "stdin (for 10k-object lists)")
+    p.add_argument("--max-depth", "-d", type=int, default=0)
+    p.add_argument("--snaptoken", default=None,
+                   help="pin the read to at least this snapshot")
+    p.add_argument("--print-snaptoken", action="store_true")
+    _add_remote_flags(p, read=True)
+    _add_format_flag(p)
+    p.set_defaults(fn=cmd_filter)
 
     p = sub.add_parser(
         "watch",

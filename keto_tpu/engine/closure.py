@@ -904,6 +904,207 @@ class ClosureIndex:
             skipped,
         )
 
+    # -- region-scoped refresh reads (the ROADMAP item 3 scale fix) -----------
+
+    def _decode_slots(self, encoder, slots) -> Optional[dict]:
+        """slot -> (ns_name, obj_name) for exactly the requested slots,
+        or None when any fails to decode (full-read fallback). Dict
+        vocabs pay one pass over obj_slots.items() — no store reads and
+        no per-tuple encode, cheap against the O(store) read this
+        replaces; ArrayMap vocabs decode each slot in O(1)."""
+        base = getattr(encoder, "snapshot", encoder)
+        overlay = getattr(encoder, "overlay", None)
+        ns_names = {v: k for k, v in base.ns_ids.items()}
+        if overlay is not None:
+            ns_names.update({v: k for k, v in overlay.ns_ids.items()})
+        want = set(int(s) for s in slots)
+        out: dict[int, tuple[str, str]] = {}
+
+        def _take(ns_id, obj_name, slot):
+            ns = ns_names.get(int(ns_id))
+            if ns is not None:
+                out[int(slot)] = (ns, obj_name)
+
+        base_slots = base.obj_slots
+        if hasattr(base_slots, "key_by_id"):  # ArrayMap
+            n_base = len(base_slots)
+            for slot in want:
+                if 0 <= slot < n_base:
+                    ns_id, obj_name = base_slots.key_by_id(slot)
+                    _take(ns_id, obj_name, slot)
+        else:
+            for (ns_id, obj_name), slot in base_slots.items():
+                if slot in want:
+                    _take(ns_id, obj_name, slot)
+        if overlay is not None:
+            for (ns_id, obj_name), slot in overlay.obj_slots.items():
+                if slot in want:
+                    _take(ns_id, obj_name, slot)
+        if len(out) != len(want):
+            return None
+        return out
+
+    def _region_content(self, manager, encoder, dirty_objs: dict,
+                        budget_objs: int):
+        """Indexed region walk: fetch ONLY the dirty nodes' consulting
+        regions via per-object `get_relation_tuples` queries, following
+        subject-set children — every node the powering can reach from a
+        refresh source lives at an object the walk visits (folded cost-1
+        edges always target a row's subject-set object at the same
+        source object). Returns (content, skipped_sites, rows_read), or
+        None when the walk outgrows `budget_objs` distinct objects (the
+        full-read fallback stays exact, just slower).
+
+        The same encode/skip discipline as _store_content: rows whose
+        node side encodes but whose subject cannot are reported as
+        skipped sites (their regions stay dirty), node-unkeyable rows
+        drop silently (reachable only through an edge whose own op
+        marks)."""
+        from ..ketoapi import RelationQuery
+
+        base = getattr(encoder, "snapshot", encoder)
+        R = max(len(base.rel_ids), 1)
+        cols = [[], [], [], [], []]
+        skipped: set[tuple[int, int]] = set()
+        rows = 0
+        visited: set[tuple[str, str]] = set(dirty_objs.values())
+        frontier = set(visited)
+        while frontier:
+            nxt: set[tuple[str, str]] = set()
+            for ns_name, obj_name in frontier:
+                page = ""
+                while True:
+                    tuples, page = manager.get_relation_tuples(
+                        RelationQuery(namespace=ns_name, object=obj_name),
+                        page_token=page, page_size=2048, nid=self.nid,
+                    )
+                    for t in tuples:
+                        rows += 1
+                        if t.subject_set is not None:
+                            nxt.add(
+                                (t.subject_set.namespace, t.subject_set.object)
+                            )
+                        node = encoder.encode_node(
+                            t.namespace, t.object, t.relation
+                        )
+                        subj = encoder.encode_subject(t)
+                        if node is not None and node[1] >= R:
+                            continue
+                        if (
+                            node is None
+                            or subj is None
+                            or (subj[0] == 1 and subj[2] >= R)
+                        ):
+                            if node is not None:
+                                skipped.add((int(node[0]), int(node[1])))
+                            continue
+                        cols[0].append(node[0])
+                        cols[1].append(node[1])
+                        cols[2].append(subj[0])
+                        cols[3].append(subj[1])
+                        cols[4].append(subj[2])
+                    if not page:
+                        break
+            frontier = nxt - visited
+            visited |= frontier
+            if len(visited) > budget_objs:
+                return None
+        content = tuple(np.array(c, dtype=np.int32) for c in cols)
+        return content, skipped, rows
+
+    def _refresh_content(self, manager, encoder, dirty_keys):
+        """(content, skipped_sites, scoped) for one dirty refresh:
+        region-scoped store reads when the dirty set decodes and its
+        regions fit the walk budget — cost proportional to the dirty
+        set, not the store — else the full _store_content read. The
+        refresh's correctness protocol is identical either way; `scoped`
+        tells the caller to MERGE (not replace) the dependency graph,
+        since a region graph only covers the walked neighborhood."""
+        # dirty keys are obj * R + rel: regions are per OBJECT
+        R = self._graph_R(encoder)
+        slots = sorted({int(k) // R for k in dirty_keys})
+        budget = max(4096, 4 * self.max_set_rows)
+        if getattr(manager, "get_relation_tuples", None) is not None:
+            decoded = self._decode_slots(encoder, slots)
+            if decoded is not None:
+                region = self._region_content(
+                    manager, encoder, decoded, budget
+                )
+                if region is not None:
+                    content, skipped, rows = region
+                    self.stats["refresh_rows_read"] = (
+                        self.stats.get("refresh_rows_read", 0) + rows
+                    )
+                    self.stats["scoped_refreshes"] = (
+                        self.stats.get("scoped_refreshes", 0) + 1
+                    )
+                    return content, skipped, True
+        content, skipped = self._store_content(manager, encoder)
+        self.stats["refresh_rows_read"] = (
+            self.stats.get("refresh_rows_read", 0) + len(content[0])
+        )
+        self.stats["full_refresh_reads"] = (
+            self.stats.get("full_refresh_reads", 0) + 1
+        )
+        return content, skipped, False
+
+    @staticmethod
+    def _graph_R(encoder) -> int:
+        base = getattr(encoder, "snapshot", encoder)
+        return max(len(base.rel_ids), 1)
+
+    @staticmethod
+    def _merge_dependency(old: ClosureGraph, region: ClosureGraph) -> ClosureGraph:
+        """Dependency graph for future dirty marking after a
+        region-scoped refresh: the UNION of the old transposed CSR and
+        the region's. The refreshed rows may reach objects the base-era
+        structures cannot even express, so their dependency edges must
+        join; edges the region re-read no longer contains stay — for
+        MARKING, over-marking is conservative (costs a re-power),
+        under-marking would silently serve stale covered answers.
+        Everything else (consult maps, poison, R) is per-namespace
+        program structure — identical in both graphs up to overlay-era
+        trivial extensions, so the longer wins."""
+        import dataclasses
+
+        def pairs(g: ClosureGraph) -> np.ndarray:
+            if len(g.t_src) == 0:
+                return np.zeros((0, 2), dtype=np.int64)
+            counts = np.diff(g.t_ptr)
+            dst = np.repeat(g.t_dst_keys, counts)
+            return np.stack([dst, g.t_src], axis=1)
+
+        allp = np.concatenate([pairs(old), pairs(region)], axis=0)
+        if len(allp):
+            allp = np.unique(allp, axis=0)
+            dst = allp[:, 0]
+            src = allp[:, 1]
+            uniq, starts = np.unique(dst, return_index=True)
+            ptr = np.append(starts, len(dst)).astype(np.int64)
+        else:
+            uniq = np.zeros(0, np.int64)
+            ptr = np.zeros(1, np.int64)
+            src = np.zeros(0, np.int64)
+        objslot_ns = (
+            old.objslot_ns
+            if len(old.objslot_ns) >= len(region.objslot_ns)
+            else region.objslot_ns
+        )
+        consult = (
+            region.consult
+            if len(region.consult) >= len(old.consult)
+            else old.consult
+        )
+        fpoison = (
+            region.fpoison
+            if region.fpoison.shape[0] >= old.fpoison.shape[0]
+            else old.fpoison
+        )
+        return dataclasses.replace(
+            old, t_dst_keys=uniq, t_ptr=ptr, t_src=src,
+            objslot_ns=objslot_ns, consult=consult, fpoison=fpoison,
+        )
+
     def _rebuild(self, snap: GraphSnapshot, base_version: int,
                  max_depth: int, content=None) -> None:
         graph = extract_graph(snap, content)
@@ -1084,7 +1285,13 @@ class ClosureIndex:
                 return False
             dirty_before = set(self._dirty)
         encoder = view if view is not None else snap
-        content, skipped_sites = self._store_content(manager, encoder)
+        # region-scoped read (the ROADMAP item 3 scale fix): fetch only
+        # the dirty nodes' consulting regions via indexed per-object
+        # queries — refresh cost proportional to the dirty set, not the
+        # store; oversized/undecodable regions fall back to a full read
+        content, skipped_sites, scoped = self._refresh_content(
+            manager, encoder, dirty_before
+        )
         v2 = manager.version(nid=self.nid)
         if v2 != v1:
             changes_since = getattr(manager, "changes_since", None)
@@ -1157,11 +1364,17 @@ class ClosureIndex:
                 # the next maintenance pass retries over the fresh marks
                 return False
             self._build = merged
-            # the refresh content graph becomes THE dependency graph and
-            # its view THE op encoder: future writes at objects the
+            # the refresh content informs THE dependency graph and its
+            # view becomes THE op encoder: future writes at objects the
             # refreshed rows now reach must mark their ancestors (the
-            # base-era structures cannot even encode those objects)
-            self._graph = g2
+            # base-era structures cannot even encode those objects). A
+            # FULL-read graph replaces outright; a region-scoped graph
+            # only covers the walked neighborhood, so its dependency
+            # edges UNION into the old CSR (over-marking is safe,
+            # dropping unwalked edges would under-mark)
+            self._graph = (
+                self._merge_dependency(graph, g2) if scoped else g2
+            )
             self._encoder = encoder
             self._dirty -= refresh
             self._synced_version = max(self._synced_version, v2)

@@ -445,6 +445,40 @@ class ReferenceEngine:
                 out.append(obj)
         return out
 
+    def filter_objects(
+        self,
+        namespace: str,
+        relation: str,
+        subject: Subject,
+        objects: list[str],
+        max_depth: int = 0,
+        nid: str = DEFAULT_NETWORK,
+    ) -> list[bool]:
+        """Bulk ACL filter oracle: verdicts[i] is True iff
+        Check(namespace:objects[i]#relation@subject) is IS_MEMBER — N
+        independent checks, the definitional baseline the BatchFilter
+        device path (engine/filter_kernel.py) is differentially tested
+        against. Errored candidates (relation-not-found error semantics
+        on their rewrite region) are False: the filter surface answers
+        "which of these can they see", and an error candidate is not
+        visible — exactly list_objects' admission rule applied to an
+        explicit candidate column instead of the store-enumerated one."""
+        checker = self._complete_checker()
+        out: list[bool] = []
+        for obj in objects:
+            r = RelationTuple(
+                namespace=namespace, object=obj, relation=relation
+            )
+            if isinstance(subject, SubjectSet):
+                r.subject_set = subject
+            else:
+                r.subject_id = subject
+            res = checker.check_relation_tuple(r, max_depth, nid)
+            out.append(
+                res.error is None and res.membership == Membership.IS_MEMBER
+            )
+        return out
+
     def list_subjects(
         self,
         namespace: str,

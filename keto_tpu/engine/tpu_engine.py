@@ -1606,6 +1606,368 @@ class TPUCheckEngine:
         subs = self.list_subjects_batch([(namespace, obj, relation)], max_depth)[0]
         return _paginate(subs, page_size, page_token)
 
+    # -- bulk ACL filtering (BatchFilter) --------------------------------------
+
+    def _count_filter(
+        self, n_closure: int, n_frontier: int, n_host: int, causes
+    ) -> None:
+        """Per-path resolution bookkeeping for one filter evaluation:
+        engine stats + the keto_tpu_filter_objects_total{path} series +
+        the shared host_cause split."""
+        self.stats["filter_closure"] = (
+            self.stats.get("filter_closure", 0) + n_closure
+        )
+        self.stats["filter_frontier"] = (
+            self.stats.get("filter_frontier", 0) + n_frontier
+        )
+        self.stats["filter_host"] = self.stats.get("filter_host", 0) + n_host
+        for cause, cnt in causes.items():
+            self.stats["host_cause"][cause] = (
+                self.stats["host_cause"].get(cause, 0) + cnt
+            )
+        if self.metrics is not None:
+            for path, n in (
+                ("closure", n_closure), ("frontier", n_frontier),
+                ("host", n_host),
+            ):
+                if n:
+                    self.metrics.filter_objects_total.labels(path).inc(n)
+
+    def _filter_host(self, namespace, relation, subject, objects, max_depth):
+        """Exact host-oracle verdicts for a candidate slice (the
+        complete checker — the same admission rule the device paths
+        reproduce)."""
+        return self.reference.filter_objects(
+            namespace, relation, subject, objects, max_depth, self.nid
+        )
+
+    def filter_batch(
+        self,
+        namespace: str,
+        relation: str,
+        subject,
+        objects: Sequence[str],
+        max_depth: int = 0,
+        frontier_cap: int = 4096,
+        deadline=None,
+        chunk_size: int = 0,
+    ) -> list[bool]:
+        """Bulk ACL filter: verdicts[i] is True iff
+        Check(namespace:objects[i]#relation@subject) is IS_MEMBER — the
+        search-result-filtering workload (Zanzibar's dominant production
+        query shape) priced as ONE device ride instead of N.
+
+        Device formulation (the shared-subject exploit):
+          1. closure fast path — every candidate covered by the Leopard
+             index resolves with a single batched membership gather over
+             the packed-bucket subject-set tables (`req <= depth` gating
+             exactly as closure_kernel.py); no per-object BFS at all.
+          2. shared-frontier fallback (engine/filter_kernel.py) — the
+             subject's reverse-reachable set expands ONCE over the
+             transposed mirror and intersects against the whole leftover
+             candidate column; a clean completed walk answers positives
+             AND definitive negatives.
+          3. cause-coded host fallback — AND/NOT islands (the reverse
+             kernel's POISON discipline), dirty rows, overflow, unknown
+             vocabulary, or a NOT-bearing config replay on the exact
+             host oracle (reference.filter_objects).
+
+        `deadline` (observability.Deadline | None) is checked at every
+        chunk boundary — a 10k-object request respects its budget by
+        failing fast with the typed 504 instead of finishing device work
+        whose client is gone. `chunk_size` 0 reads filter.chunk_size."""
+        from ..errors import DeadlineExceededError
+
+        n = len(objects)
+        if n == 0:
+            return []
+        self.stats["filter_requests"] = (
+            self.stats.get("filter_requests", 0) + 1
+        )
+        if self.metrics is not None:
+            self.metrics.filter_requests_total.inc()
+            self.metrics.filter_request_objects.observe(n)
+        chunk = int(
+            chunk_size or self.config.get("filter.chunk_size", 4096)
+        )
+        chunk = max(1, min(chunk, _BUCKETS[-1]))
+        out: list[bool] = []
+        for i in range(0, n, chunk):
+            if deadline is not None and deadline.expired():
+                if self.metrics is not None:
+                    self.metrics.deadline_exceeded_total.labels(
+                        "filter_chunk"
+                    ).inc()
+                raise DeadlineExceededError(
+                    "filter deadline expired mid-evaluation "
+                    f"({i}/{n} candidates answered)"
+                )
+            out.extend(
+                self._filter_chunk(
+                    namespace, relation, subject, list(objects[i : i + chunk]),
+                    max_depth, frontier_cap,
+                )
+            )
+        return out
+
+    def filter_objects(
+        self,
+        namespace: str,
+        relation: str,
+        subject,
+        objects: Sequence[str],
+        max_depth: int = 0,
+        deadline=None,
+    ) -> list[str]:
+        """The transport-facing subset form: the candidates the subject
+        CAN see, in input order (duplicates preserved — each occurrence
+        answers independently, like N checks would)."""
+        verdicts = self.filter_batch(
+            namespace, relation, subject, objects, max_depth,
+            deadline=deadline,
+        )
+        return [o for o, ok in zip(objects, verdicts) if ok]
+
+    def _filter_chunk(
+        self, namespace, relation, subject, objects, max_depth, frontier_cap
+    ) -> list[bool]:
+        """One bounded evaluation: closure probe, shared-frontier walk,
+        host replay — in that order, each consuming what the previous
+        stage could not resolve."""
+        from ..ketoapi import RelationTuple as _RT
+        from ..ketoapi import SubjectSet as _SubjectSet
+        from .closure_kernel import CL_CAUSE_NAMES
+        from .filter_kernel import (
+            filter_kernel_packed,
+            pack_filter_query,
+            unpack_filter_results,
+        )
+        from .snapshot import (
+            FLAG_HOST_ONLY as _F_HOST,
+            FLAG_ISLAND as _F_ISL,
+            reverse_subject_tag,
+        )
+
+        n = len(objects)
+        state = self._ensure_state()
+        global_max = self.config.max_read_depth()
+        depth = max_depth if 0 < max_depth <= global_max else global_max
+
+        # monotone-only configs (no AND/NOT islands, no host-only
+        # rewrites anywhere): membership needs an actual edge path, and
+        # the reference has no trivial self-membership, so a subject or
+        # candidate whose name never encodes is DEFINITIVELY invisible —
+        # False with zero device or host work (errors the candidate's
+        # region could raise map to False on the filter surface anyway).
+        # Any island/host-only program disables the shortcut: a NOT can
+        # make unknown names members, so they host-replay instead.
+        monotone_vocab = not bool(
+            np.any(state.snapshot.prog_flags & (_F_HOST | _F_ISL))
+        )
+
+        # -- shared-query encoding (one subject, one relation) ----------------
+        ns_id = state.view.ns_id(namespace)
+        rel_id = state.view.rel_id(relation)
+        proxy = _RT(namespace=namespace, object="", relation=relation)
+        if isinstance(subject, _SubjectSet):
+            proxy.subject_set = subject
+        else:
+            proxy.subject_id = subject
+        sub = state.view.encode_subject(proxy)
+        if ns_id is not None and rel_id is not None and sub is None \
+                and monotone_vocab:
+            # known target node vocabulary, unknown subject, monotone
+            # config: no edge can mention the subject — every candidate
+            # is a definitive NOT_MEMBER
+            self._count_filter(0, 0, 0, {})
+            self.stats["filter_vocab"] = (
+                self.stats.get("filter_vocab", 0) + n
+            )
+            if self.metrics is not None:
+                self.metrics.filter_objects_total.labels("vocab").inc(n)
+            return [False] * n
+        if ns_id is None or rel_id is None or sub is None:
+            # names unknown to graph+config under a non-monotone (or
+            # unknown-relation) config: error semantics and NOT rewrites
+            # may still apply per candidate — exact host eval
+            verdicts = self._filter_host(
+                namespace, relation, subject, objects, max_depth
+            )
+            self._count_filter(0, 0, n, {CAUSE_NAME_UNINDEXED: n})
+            return verdicts
+        # ketolint: allow[host-sync] reason=encode_subject returns host-side python/numpy scalars (vocabulary lookups never touch the device), so these int() coercions cannot sync
+        skind, sa, sb = (int(x) for x in sub)
+
+        # -- candidate encoding: one composed-key binary search ---------------
+        from .snapshot import encode_object_column
+
+        # ketolint: allow[host-sync] reason=ns_id is a host-side vocabulary lookup result (python int / numpy scalar), never a device value — no sync
+        c_obj, c_valid = encode_object_column(state.view, int(ns_id), objects)
+
+        # resolved/value masks instead of a per-candidate Python loop:
+        # at 10k candidates the bookkeeping must be numpy-vectorized or
+        # the host loop dominates the device work it orchestrates
+        resolved = np.zeros(n, dtype=bool)
+        value = np.zeros(n, dtype=bool)
+        causes: dict[str, int] = {}
+        n_closure = 0
+        n_vocab = 0
+        if monotone_vocab and not c_valid.all():
+            # candidate names unknown to graph+config: no edge can seed
+            # or match them — definitive NOT_MEMBER (the common "most of
+            # these documents have no ACLs at all" case answers free)
+            unknown = ~c_valid
+            resolved |= unknown  # value stays False
+            n_vocab = int(unknown.sum())
+            self.stats["filter_vocab"] = (
+                self.stats.get("filter_vocab", 0) + n_vocab
+            )
+            if self.metrics is not None:
+                self.metrics.filter_objects_total.labels("vocab").inc(n_vocab)
+
+        # -- 1. closure fast path: one batched subject-set gather -------------
+        if self.closure_enabled:
+            cl_view, cl_cause = self._closure_gate(state)
+            if cl_view is not None:
+                from .closure_kernel import (
+                    closure_kernel_packed,
+                    unpack_closure_results,
+                )
+                from .kernel import pack_queries
+
+                B = next((b for b in _BUCKETS if b >= n), _BUCKETS[-1])
+                q_obj = np.zeros(B, dtype=np.int32)
+                q_obj[:n] = c_obj[:n]
+                q_valid = np.zeros(B, dtype=bool)
+                q_valid[:n] = c_valid[:n]
+                launch_id = next_launch_id()
+                with self.tracer.span("engine.filter_closure", batch=B):
+                    flat = closure_kernel_packed(
+                        cl_view.tables,
+                        pack_queries(
+                            q_obj,
+                            np.full(B, rel_id, dtype=np.int32),
+                            np.full(B, depth, dtype=np.int32),
+                            np.full(B, skind, dtype=np.int32),
+                            np.full(B, sa, dtype=np.int32),
+                            np.full(B, sb, dtype=np.int32),
+                            q_valid,
+                        ),
+                        cc_probes=cl_view.cc_probes,
+                        ch_probes=cl_view.ch_probes,
+                        has_dirty=cl_view.has_dirty,
+                    )
+                member, ccause, cstats = unpack_closure_results(
+                    # ketolint: allow[host-sync] reason=this IS the closure probe's designated sync point: one packed readback carries verdicts, causes, and the launch stats vector — the shared single-transfer resolve contract
+                    np.asarray(flat), B,
+                )
+                self._record_list_launch(
+                    "filter_closure", B, n, cstats, launch_id
+                )
+                ok = c_valid & (ccause[:n] == 0)
+                value |= member[:n] & ok
+                resolved |= ok
+                n_closure = int(ok.sum())
+                declined = c_valid & ~ok
+                if declined.any():
+                    codes, cnts = np.unique(
+                        ccause[:n][declined], return_counts=True
+                    )
+                    for code, cnt in zip(codes.tolist(), cnts.tolist()):
+                        self._count_closure_fallback(
+                            # ketolint: allow[host-sync] reason=code is a host python int from np.unique(...).tolist() over the already-synced readback — no device contact
+                            CL_CAUSE_NAMES.get(int(code), "uncovered"),
+                            # ketolint: allow[host-sync] reason=cnt is a host python int from the same tolist() — no device contact
+                            int(cnt),
+                        )
+            elif cl_cause is not None:
+                self._count_closure_fallback(cl_cause, n)
+
+        vp = np.flatnonzero(c_valid & ~resolved)
+        n_frontier = 0
+
+        # -- 2. shared-frontier walk over the leftover column -----------------
+        if len(vp):
+            rstate = self._ensure_reverse_state()
+            rnp = rstate.reverse_np
+            if rstate.snapshot is not state.snapshot:
+                # a compaction swapped the base snapshot between the
+                # encode and the reverse build: candidate slots no
+                # longer address these tables — exact host replay for
+                # the leftovers (rare; the next call re-encodes)
+                causes[CAUSE_NAME_UNINDEXED] = (
+                    causes.get(CAUSE_NAME_UNINDEXED, 0) + len(vp)
+                )
+            elif rnp["host_all"]:
+                # a NOT exists somewhere in the config: NOT-members
+                # exist precisely where no path exists, which the
+                # reachability walk cannot observe — exact host oracle
+                causes["island_host"] = (
+                    causes.get("island_host", 0) + len(vp)
+                )
+            else:
+                uniq = np.unique(c_obj[vp])
+                C = next(
+                    (b for b in _BUCKETS if b >= len(uniq)), _BUCKETS[-1]
+                )
+                qc = pack_filter_query(
+                    sa, int(reverse_subject_tag(skind, sb)), rel_id, depth,
+                    uniq, C,
+                )
+                launch_id = next_launch_id()
+                with self.tracer.span("engine.filter_launch", batch=C):
+                    flat = filter_kernel_packed(
+                        rstate.reverse_tables,
+                        qc,
+                        rvh_probes=rnp["rvh_probes"],
+                        rsh_probes=rnp["rsh_probes"],
+                        RK=rnp["RK"],
+                        max_steps=int(
+                            global_max + state.snapshot.n_config_rels + 4
+                        ),
+                        wildcard_rel=state.snapshot.wildcard_rel,
+                        n_config_rels=max(state.snapshot.n_config_rels, 1),
+                        frontier_cap=max(frontier_cap, 1024),
+                        has_delta=state.has_delta,
+                    )
+                hit, wcause, fstats = unpack_filter_results(
+                    # ketolint: allow[host-sync] reason=this IS the filter walk's designated sync point: resolve is the synchronize phase of the split-phase contract, and the single-buffer design makes this readback the ONE device->host transfer for the whole candidate column
+                    np.asarray(flat), C,
+                )
+                self._record_list_launch(
+                    "filter", C, len(vp), fstats, launch_id
+                )
+                if wcause == 0:
+                    # clean completed walk: hits are members, unmarked
+                    # candidates are definitive NOT_MEMBER
+                    pos = np.searchsorted(uniq, c_obj[vp])
+                    value[vp] = hit[pos]
+                    resolved[vp] = True
+                    n_frontier = len(vp)
+                else:
+                    name = CAUSE_NAMES.get(wcause, CAUSE_NAME_UNINDEXED)
+                    causes[name] = causes.get(name, 0) + len(vp)
+
+        # -- 3. exact host replay for everything still unresolved -------------
+        host_idx = np.flatnonzero(~resolved)
+        if len(host_idx):
+            unindexed = len(host_idx) - sum(causes.values())
+            if unindexed > 0:
+                # candidates whose vocabulary never encoded (under a
+                # non-monotone config, where unknown is not a verdict)
+                causes[CAUSE_NAME_UNINDEXED] = (
+                    causes.get(CAUSE_NAME_UNINDEXED, 0) + unindexed
+                )
+            host_verdicts = self._filter_host(
+                namespace, relation, subject,
+                # ketolint: allow[host-sync] reason=host_idx is host numpy (np.flatnonzero over a host mask) — these int() coercions never touch a device value
+                [objects[int(i)] for i in host_idx], max_depth,
+            )
+            value[host_idx] = host_verdicts
+            resolved[host_idx] = True
+        self._count_filter(n_closure, n_frontier, len(host_idx), causes)
+        return value.tolist()
+
     # -- check API ------------------------------------------------------------
 
     def check_is_member(

@@ -133,6 +133,16 @@ class NotImplementedYetError(KetoError):
     default_message = "not yet implemented"
 
 
+class FilterTooLargeError(KetoError):
+    # BatchFilter admission (resilience.admit_filter): the candidate
+    # list exceeds `filter.max_objects`. A typed 400 BEFORE any device
+    # work — an unbounded candidate column would buy unbounded device
+    # launches; clients split the list and chain snaptokens instead.
+    status = 400
+    code = "bad_request"
+    default_message = "filter candidate list exceeds filter.max_objects"
+
+
 class DeadlineExceededError(KetoError):
     # Resilience plane (keto_tpu/resilience.py): the request's end-to-end
     # deadline (REST x-request-timeout-ms / native gRPC deadline /

@@ -742,6 +742,44 @@ class Metrics:
             "index build (the R·D product's row count on device)",
             registry=self.registry,
         )
+        # bulk ACL filtering (engine/filter_kernel.py): one subject,
+        # thousands of candidate objects, one device ride
+        self.filter_requests_total = prom.Counter(
+            "keto_tpu_filter_requests_total",
+            "BatchFilter evaluations (engine.filter_batch calls — one "
+            "per API request regardless of how many chunks the "
+            "candidate list split into)",
+            registry=self.registry,
+        )
+        self.filter_request_objects = prom.Histogram(
+            "keto_tpu_filter_request_objects",
+            "Candidate-list size per BatchFilter request (the workload's "
+            "defining dimension: per-object cost amortizes over it)",
+            buckets=(16, 64, 256, 1024, 4096, 10000, 16384, 65536),
+            registry=self.registry,
+        )
+        self.filter_objects_total = prom.Counter(
+            "keto_tpu_filter_objects_total",
+            "Candidate objects answered, by resolution path: `closure` "
+            "(one batched Leopard membership gather — no BFS at all), "
+            "`frontier` (the shared-frontier reverse walk intersected "
+            "the whole leftover column in one launch), `vocab` (name "
+            "unknown to graph+config under a monotone-only config — "
+            "definitively invisible, zero work), `host` (cause-coded "
+            "exact oracle replay: AND/NOT islands, dirty rows, "
+            "overflow, unknown vocabulary under non-monotone configs)",
+            ["path"],
+            registry=self.registry,
+        )
+        self.filter_shed_total = prom.Counter(
+            "keto_tpu_filter_shed_total",
+            "Filter requests rejected before any device work, by "
+            "reason: `max_objects` (candidate list over "
+            "filter.max_objects — typed 400 so oversized requests "
+            "cannot buy unbounded device work)",
+            ["reason"],
+            registry=self.registry,
+        )
         # hot-path cache: (transport, method) -> (duration child,
         # {code: counter child})
         self._observe_cache: dict = {}

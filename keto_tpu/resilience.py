@@ -40,7 +40,12 @@ import threading
 import time
 from typing import Iterator, Optional
 
-from .errors import DeadlineExceededError, MalformedInputError, OverloadedError
+from .errors import (
+    DeadlineExceededError,
+    FilterTooLargeError,
+    MalformedInputError,
+    OverloadedError,
+)
 
 # -- deadlines ----------------------------------------------------------------
 
@@ -144,6 +149,30 @@ def admit_check(registry, batcher, rt=None) -> None:
         )
     if batcher is not None:
         batcher.admit(dl)
+
+
+DEFAULT_FILTER_MAX_OBJECTS = 65536
+
+
+def admit_filter(registry, n_objects: int, rt=None) -> None:
+    """The BatchFilter admission gate, run by all three transports
+    BEFORE any filter work: the shared draining/expired checks
+    (admit_check semantics — typed 429/504), plus the candidate-list
+    bound from `filter.max_objects` — an oversized request sheds a typed
+    400 (FilterTooLargeError) rather than buying unbounded device work.
+    Byte-identical bodies across REST/gRPC/aio because all planes map
+    the same KetoError."""
+    admit_check(registry, None, rt)
+    max_objects = int(
+        registry.config.get("filter.max_objects", DEFAULT_FILTER_MAX_OBJECTS)
+    )
+    if n_objects > max_objects:
+        registry.metrics().filter_shed_total.labels("max_objects").inc()
+        raise FilterTooLargeError(
+            f"filter candidate list has {n_objects} objects; "
+            f"filter.max_objects allows {max_objects} — split the list "
+            "and chain the response snaptoken"
+        )
 
 
 def retry_after_header_value(retry_after_s: Optional[float]) -> str:

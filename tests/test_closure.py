@@ -307,6 +307,68 @@ class TestChurn:
         assert engine.stats.get("closure_hits", 0) > 0
         assert engine.stats.get("closure_fallback", {}).get("dirty", 0) > 0
 
+    def test_refresh_reads_proportional_to_dirty_set(self):
+        """The ROADMAP item 3 scale fix: a dirty refresh must fetch only
+        the dirty nodes' consulting regions (indexed per-object reads),
+        NOT re-read the whole store per pass — on a many-chain topology
+        a one-chain perturbation reads ~one chain's rows."""
+        tuples, owners = deep_tuples(n_chains=24)
+        engine = make_engine(tuples)
+        oracle = ReferenceEngine(engine.manager, engine.config)
+        assert engine.closure_ensure_built()
+        idx = engine.closure_index()
+        store_rows = len(tuples)
+        # perturb ONE chain, then refresh
+        engine.manager.write_relation_tuples([
+            RelationTuple.from_string(f"deep:c3f{DEPTH}#owner@fresh")
+        ])
+        assert engine.closure_ensure_built()
+        assert idx.stats.get("scoped_refreshes", 0) == 1
+        rows = idx.stats.get("refresh_rows_read", 0)
+        # one chain is DEPTH parent edges + owners — far under the
+        # 24-chain store (the old full read would count store_rows)
+        assert 0 < rows <= 3 * (DEPTH + 2), (rows, store_rows)
+        assert rows < store_rows / 4
+        # and the refreshed index answers the overlay-era subject right
+        res = engine.check_batch([
+            RelationTuple.from_string("deep:c3f0#viewer@fresh")
+        ])
+        want = oracle.check_relation_tuple(
+            RelationTuple.from_string("deep:c3f0#viewer@fresh")
+        )
+        assert res[0].membership == want.membership
+
+    def test_scoped_refresh_marks_future_writes(self):
+        """After a region-scoped refresh installs the MERGED dependency
+        graph, a write at an object only the refreshed rows reach must
+        still dirty its ancestors (under-marking would serve stale
+        covered answers)."""
+        tuples, owners = deep_tuples(n_chains=4)
+        engine = make_engine(tuples)
+        oracle = ReferenceEngine(engine.manager, engine.config)
+        assert engine.closure_ensure_built()
+        # extend chain 1 with an overlay-era tail object, refresh it in
+        engine.manager.write_relation_tuples([
+            RelationTuple.from_string(
+                f"deep:c1f{DEPTH}#parent@(deep:newtail#...)"
+            ),
+            RelationTuple.from_string("deep:newtail#owner@tailowner"),
+        ])
+        assert engine.closure_ensure_built()
+        q = RelationTuple.from_string("deep:c1f0#viewer@tailowner")
+        res = engine.check_batch([q])[0]
+        assert res.membership == Membership.IS_MEMBER
+        # now write at the overlay-era object: the merged dependency
+        # graph must mark chain 1 dirty, and answers stay oracle-exact
+        engine.manager.delete_relation_tuples([
+            RelationTuple.from_string("deep:newtail#owner@tailowner")
+        ])
+        assert engine.closure_ensure_built()
+        res = engine.check_batch([q])[0]
+        want = oracle.check_relation_tuple(q)
+        assert res.membership == want.membership
+        assert want.membership == Membership.NOT_MEMBER
+
     def test_held_tail_lag_gating(self):
         # lag budget 0: the submit path may never catch up inline, so a
         # lagging index must refuse (cause=lag) and answers ride BFS

@@ -1,0 +1,89 @@
+"""Append the keto_tpu_filter.proto descriptor to keto_descriptors.binpb.
+
+The build image ships no protoc, so the bulk-ACL-filter extension's
+FileDescriptorProto is constructed programmatically here (the
+gen_reverse_descriptor.py twin) and appended to the checked-in
+descriptor set — idempotently: an existing entry with the same file name
+is replaced, so the tool can re-run after edits. Run from the repo root:
+
+    python tools/gen_filter_descriptor.py
+
+api/descriptors.py then materializes the message classes from the same
+descriptor pool as every other message — no generated *_pb2.py code.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+from google.protobuf import descriptor_pb2
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+_BINPB = _REPO / "keto_tpu" / "api" / "protos" / "keto_descriptors.binpb"
+
+_STR = descriptor_pb2.FieldDescriptorProto.TYPE_STRING
+_I32 = descriptor_pb2.FieldDescriptorProto.TYPE_INT32
+_MSG = descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE
+_OPT = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+_REP = descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED
+
+_SUBJECT = ".ory.keto.relation_tuples.v1alpha2.Subject"
+
+
+def _message(fd, name: str, fields):
+    m = fd.message_type.add()
+    m.name = name
+    for number, (fname, ftype, label, type_name) in enumerate(fields, 1):
+        f = m.field.add()
+        f.name = fname
+        f.number = number
+        f.type = ftype
+        f.label = label
+        if type_name:
+            f.type_name = type_name
+    return m
+
+
+def build_file() -> descriptor_pb2.FileDescriptorProto:
+    fd = descriptor_pb2.FileDescriptorProto()
+    fd.name = "keto_tpu_filter.proto"
+    fd.package = "keto_tpu.filter.v1"
+    fd.syntax = "proto3"
+    fd.dependency.append("keto.proto")
+    _message(fd, "FilterRequest", [
+        ("namespace", _STR, _OPT, None),
+        ("relation", _STR, _OPT, None),
+        ("subject", _MSG, _OPT, _SUBJECT),
+        ("objects", _STR, _REP, None),
+        ("max_depth", _I32, _OPT, None),
+        ("snaptoken", _STR, _OPT, None),
+    ])
+    _message(fd, "FilterResponse", [
+        ("allowed_objects", _STR, _REP, None),
+        ("snaptoken", _STR, _OPT, None),
+    ])
+    svc = fd.service.add()
+    svc.name = "FilterService"
+    m = svc.method.add()
+    m.name = "Filter"
+    m.input_type = f".{fd.package}.FilterRequest"
+    m.output_type = f".{fd.package}.FilterResponse"
+    return fd
+
+
+def main() -> int:
+    fds = descriptor_pb2.FileDescriptorSet()
+    fds.ParseFromString(_BINPB.read_bytes())
+    new = build_file()
+    kept = [f for f in fds.file if f.name != new.name]
+    del fds.file[:]
+    fds.file.extend(kept)
+    fds.file.append(new)
+    _BINPB.write_bytes(fds.SerializeToString())
+    print(f"wrote {new.name} into {_BINPB} ({len(fds.file)} files)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

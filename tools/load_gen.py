@@ -7,11 +7,18 @@ the read plane OPEN-loop: requests are scheduled on a fixed timeline at
 `--rate` regardless of completions, so latency-under-load and the
 saturation knee are visible.
 
-Two request shapes:
+Three request shapes:
   --mode single   one check per RPC (the v1alpha2 parity surface)
   --mode batch    one BatchCheck RPC per tick carrying --batch checks
                   (the keto_tpu extension; offered checks/s =
                   rate * batch)
+  --mode filter   one BatchFilter RPC per tick carrying a
+                  --filter-objects candidate column for one subject
+                  (the bulk-ACL-filtering workload; offered objects/s =
+                  rate * filter-objects). --filter-hit-rate biases how
+                  many candidates come from the subject's own folder
+                  (the rest are random documents), so saturation curves
+                  can sweep sparse vs dense result shapes.
 
     python tools/load_gen.py --addr 127.0.0.1:4466 --rate 200 \
         --seconds 10 --mode batch --batch 512
@@ -43,17 +50,57 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def build_filter_workload(
+    objects_per_request: int, hit_rate: float, n_requests: int = 64,
+    seed: int = 9,
+):
+    """(subject, candidate list) request pool for `--mode filter`,
+    derived from the bench dataset's cat-videos topology: the subject is
+    a folder owner, `hit_rate` of the candidates come from folders they
+    own (reachable via the parent TTU) and the rest are random other
+    documents — the sparse/dense search-result-shape knob."""
+    import bench
+
+    _, tuples, _ = bench.build_dataset()
+    rng = random.Random(seed)
+    owner_folders: dict[str, list[str]] = {}
+    all_files: list[str] = []
+    for t in tuples:
+        if t.relation == "owner" and t.subject_id and "/" not in t.object[1:]:
+            owner_folders.setdefault(t.subject_id, []).append(t.object)
+        elif t.relation == "parent":
+            all_files.append(t.object)
+    owners = [s for s, folders in owner_folders.items() if folders]
+    pool = []
+    for _ in range(n_requests):
+        sub = owners[rng.randrange(len(owners))]
+        owned_prefixes = tuple(p + "/" for p in owner_folders[sub])
+        owned = [
+            f for f in all_files if f.startswith(owned_prefixes)
+        ] or all_files
+        cands = [
+            (
+                owned[rng.randrange(len(owned))]
+                if rng.random() < hit_rate
+                else all_files[rng.randrange(len(all_files))]
+            )
+            for _ in range(objects_per_request)
+        ]
+        pool.append((sub, cands))
+    return pool
+
+
 def run_step(
     clients, queries, rate: float, seconds: float,
     mode: str = "single", batch: int = 512, timeout: float = 30.0,
-    workers: int = 64,
+    workers: int = 64, filter_queries=None,
 ) -> dict:
     """One open-loop step at a fixed offered rate; returns the result
     record (achieved QPS, scheduled-send latency percentiles, errors,
     shed ticks). `clients` is a pool of ReadClients reused across steps
     so channel setup never lands inside a timed window."""
     rng = random.Random(0)
-    qn = len(queries)
+    qn = len(queries) if queries else 0
     lock = threading.Lock()
     lat: list[float] = []
     errors = [0]
@@ -67,6 +114,12 @@ def run_step(
                 q = queries[rng.randrange(qn)]
                 client.check(q, timeout=timeout)
                 n = 1
+            elif mode == "filter":
+                sub, cands = filter_queries[
+                    rng.randrange(len(filter_queries))
+                ]
+                client.filter("videos", "view", sub, cands, timeout=timeout)
+                n = len(cands)
             else:
                 start = rng.randrange(qn)
                 qs = [queries[(start + j) % qn] for j in range(batch)]
@@ -107,10 +160,15 @@ def run_step(
 
     import numpy as np
 
+    per_tick = 1
+    if mode == "batch":
+        per_tick = batch
+    elif mode == "filter":
+        per_tick = len(filter_queries[0][1]) if filter_queries else 0
     out = {
         "mode": mode,
         "offered_rps": rate,
-        "offered_checks_per_s": rate * (1 if mode == "single" else batch),
+        "offered_checks_per_s": rate * per_tick,
         "achieved_checks_per_s": round(checks_done[0] / wall, 1),
         "completed_rpcs": len(lat),
         "errors": errors[0],
@@ -130,14 +188,14 @@ def run_step(
 def run_curve(
     addr: str, rates, seconds: float, mode: str = "single",
     batch: int = 512, timeout: float = 30.0, workers: int = 64,
-    queries=None, n_clients: int = 8,
+    queries=None, n_clients: int = 8, filter_queries=None,
 ) -> dict:
     """The stepped saturation ladder as a callable (replica_smoke's
     committed-artifact path imports this): one open-loop step per
     offered rate, one shared client pool, results under "curve"."""
     from keto_tpu.api import ReadClient, open_channel
 
-    if queries is None:
+    if queries is None and mode != "filter":
         import bench
 
         _, _, queries = bench.build_dataset()
@@ -147,6 +205,7 @@ def run_curve(
             run_step(
                 clients, queries, rate, seconds,
                 mode=mode, batch=batch, timeout=timeout, workers=workers,
+                filter_queries=filter_queries,
             )
             for rate in rates
         ]
@@ -170,8 +229,19 @@ def main() -> int:
     ap.add_argument("--rate", type=float, default=100.0,
                     help="request ticks per second (open-loop schedule)")
     ap.add_argument("--seconds", type=float, default=10.0)
-    ap.add_argument("--mode", choices=("single", "batch"), default="single")
+    ap.add_argument(
+        "--mode", choices=("single", "batch", "filter"), default="single",
+        help="filter = one BatchFilter RPC per tick (--workload filter)",
+    )
+    # alias so `--workload filter` reads naturally beside --mode
+    ap.add_argument("--workload", choices=("single", "batch", "filter"),
+                    default=None, help="alias for --mode")
     ap.add_argument("--batch", type=int, default=512)
+    ap.add_argument("--filter-objects", type=int, default=1024,
+                    help="candidate-list size per filter RPC")
+    ap.add_argument("--filter-hit-rate", type=float, default=0.1,
+                    help="fraction of candidates drawn from the "
+                         "subject's own folders (rest are random)")
     ap.add_argument("--timeout", type=float, default=30.0)
     ap.add_argument("--workers", type=int, default=64,
                     help="in-flight cap (past it, ticks count as shed)")
@@ -192,7 +262,15 @@ def main() -> int:
     from keto_tpu.api import ReadClient, open_channel
     from keto_tpu.ketoapi import RelationTuple
 
-    if args.queries:
+    if args.workload is not None:
+        args.mode = args.workload
+    filter_queries = None
+    if args.mode == "filter":
+        filter_queries = build_filter_workload(
+            args.filter_objects, args.filter_hit_rate
+        )
+        queries = None
+    elif args.queries:
         with open(args.queries) as f:
             queries = [RelationTuple.from_dict(d) for d in json.load(f)]
     else:
@@ -207,7 +285,7 @@ def main() -> int:
         out = run_curve(
             args.addr, rates, args.seconds, mode=args.mode,
             batch=args.batch, timeout=args.timeout, workers=args.workers,
-            queries=queries,
+            queries=queries, filter_queries=filter_queries,
         )
     else:
         # a small client pool: gRPC channels multiplex, but one channel's
@@ -217,11 +295,14 @@ def main() -> int:
             out = run_step(
                 clients, queries, args.rate, args.seconds,
                 mode=args.mode, batch=args.batch, timeout=args.timeout,
-                workers=args.workers,
+                workers=args.workers, filter_queries=filter_queries,
             )
         finally:
             for c in clients:
                 c.close()
+    if args.mode == "filter":
+        out["filter_objects"] = args.filter_objects
+        out["filter_hit_rate"] = args.filter_hit_rate
     print(json.dumps(out))
     if args.record:
         with open(args.record, "w") as f:
