@@ -529,7 +529,7 @@ class _AioReadServices:
             with self._svc.metrics.observe_request("grpc", method) as outcome:
                 try:
                     with self._svc.registry.tracer().span(
-                        f"grpc.{method}", ctx=rt.ctx
+                        f"grpc.{method}", ctx=rt.ctx, root=True
                     ):
                         return await coro_fn(req, context)
                 except KetoError as e:
@@ -555,18 +555,63 @@ class _AioReadServices:
     async def check(self, req, context):
         async def body(req, context):
             from ..engine.snaptoken import encode_snaptoken
-            from ..resilience import admit_check
+            from ..resilience import admit_check, admit_explain
 
             # admission gate BEFORE any work (typed 429/504, identical
             # mapping to the threaded planes); the aio batcher's pending
-            # count is loop-local, so the bound check is exact
-            admit_check(
-                self._svc.registry, self._batcher, current_request_trace()
-            )
+            # count is loop-local, so the bound check is exact. explain
+            # rides the explain.max_per_s token bucket instead.
+            explain = bool(getattr(req, "explain", False))
+            if explain:
+                admit_explain(self._svc.registry, current_request_trace())
+            else:
+                admit_check(
+                    self._svc.registry, self._batcher,
+                    current_request_trace(),
+                )
             t = self._svc._check_tuple(req)
             self._svc.registry.validate_namespaces(t)
             nid = self._svc._nid(context)
             max_depth = int(req.max_depth)
+            if explain:
+                # §5m explain: the engine explain path is blocking
+                # (device ride + host witness re-walk), so it runs on
+                # the blocking executor with the request's contextvars
+                # — same canonical DecisionTrace bytes as the sync plane
+                from ..engine.explain import canonical_json, serve_explain
+
+                rt = current_request_trace()
+                if self._worker is not None:
+                    from .replica import resolve_version
+
+                    worker = self._worker
+                    loop = asyncio.get_running_loop()
+                    _t, version = await loop.run_in_executor(
+                        self._blocking,
+                        lambda: resolve_version(
+                            worker.group, worker, nid, req.snaptoken, rt
+                        ),
+                    )
+                else:
+                    version = self._svc._enforce_snaptoken(
+                        req.snaptoken, nid
+                    )
+                loop = asyncio.get_running_loop()
+                cvctx = contextvars.copy_context()
+                res, trace = await loop.run_in_executor(
+                    self._blocking,
+                    lambda: cvctx.run(
+                        serve_explain, self._svc.registry, nid, t,
+                        max_depth, version, rt,
+                    ),
+                )
+                if res.error is not None:
+                    raise res.error
+                return pb.CheckResponse(
+                    allowed=res.allowed,
+                    snaptoken=encode_snaptoken(version, nid),
+                    decision_trace=canonical_json(trace).decode(),
+                )
             if self._worker is not None:
                 # replica mode: the routing rule's fast path (applied
                 # version satisfies the token) stays entirely in-loop;

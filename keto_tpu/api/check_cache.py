@@ -238,7 +238,10 @@ class CheckCache:
         if rt is not None:
             rt.add_stage("cache", dur)
         if self.metrics is not None:
-            self.metrics.observe_stage("cache", dur)
+            self.metrics.observe_stage(
+                "cache", dur,
+                trace_id=rt.ctx.trace_id if rt is not None else None,
+            )
         return e.result
 
     def store(

@@ -121,6 +121,16 @@ class _BaseClient:
         self.channel.close()
 
 
+class ExplainedCheck(NamedTuple):
+    """ReadClient.check_explain's result: the verdict, the response
+    snaptoken, and the parsed DecisionTrace (None when the server does
+    not implement the explain extension)."""
+
+    allowed: bool
+    snaptoken: str
+    decision_trace: Optional[dict]
+
+
 class WatchStreamEvent(NamedTuple):
     """One event off ReadClient.watch(): a committed store version
     ("change") or an explicit gap signal ("reset")."""
@@ -146,8 +156,18 @@ class ReadClient(_BaseClient):
 
     def check(
         self, t: RelationTuple, max_depth: int = 0, timeout=None,
-        snaptoken: str = "", traceparent: str = "",
-    ) -> bool:
+        snaptoken: str = "", traceparent: str = "", explain: bool = False,
+    ):
+        """Allowed verdict for one tuple (bool). With `explain=True`
+        (keto_tpu §5m extension) the server evaluates the slow explain
+        path and the return value becomes an ExplainedCheck named tuple
+        (allowed, snaptoken, decision_trace dict) — NOT a bare bool, so
+        never truth-test the explained form directly; read `.allowed`."""
+        if explain:
+            return self.check_explain(
+                t, max_depth, timeout=timeout, snaptoken=snaptoken,
+                traceparent=traceparent,
+            )
         return self.check_with_token(
             t, max_depth, timeout=timeout, snaptoken=snaptoken,
             traceparent=traceparent,
@@ -169,6 +189,33 @@ class ReadClient(_BaseClient):
             metadata=self._trace_metadata(traceparent),
         )
         return resp.allowed, resp.snaptoken
+
+    def check_explain(
+        self, t: RelationTuple, max_depth: int = 0, timeout=None,
+        snaptoken: str = "", traceparent: str = "",
+    ) -> "ExplainedCheck":
+        """One Check with a DecisionTrace (keto_tpu §5m extension):
+        ExplainedCheck(allowed, snaptoken, decision_trace) where
+        decision_trace is the parsed dict — answering tier + cause,
+        witness path / exhaustion summary, per-stage ms, launch ids.
+        Rate-bounded server-side (explain.max_per_s): over the bound
+        the RPC fails RESOURCE_EXHAUSTED with a retry-after hint. Only
+        this framework's server fills the field; a stock Keto
+        deployment returns an empty trace (None here)."""
+        import json as _json
+
+        req = pb.CheckRequest(
+            max_depth=max_depth, snaptoken=snaptoken, explain=True
+        )
+        req.tuple.CopyFrom(tuple_to_proto(t))
+        resp = self._rpc(
+            CHECK_SERVICE, "Check", req, pb.CheckResponse, timeout,
+            metadata=self._trace_metadata(traceparent),
+        )
+        trace = (
+            _json.loads(resp.decision_trace) if resp.decision_trace else None
+        )
+        return ExplainedCheck(resp.allowed, resp.snaptoken, trace)
 
     def check_batch(
         self,

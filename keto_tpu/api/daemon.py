@@ -656,6 +656,11 @@ class Daemon:
         # end the check cache's invalidation thread (daemon thread, but
         # a clean stop keeps test teardowns quiet)
         self.registry.close_check_cache()
+        # flush + stop the OTLP span exporter: the drain's own spans are
+        # the last ones worth having at the collector (a bounded flush —
+        # a dead collector costs at most its POST timeout, never a hang)
+        if self.registry._span_exporter is not None:
+            self.registry._span_exporter.close()
         # persist any pending device-mirror checkpoints (default network
         # AND all tenant engines) before exiting so the next start
         # warm-restarts from the latest compaction

@@ -174,6 +174,7 @@ class _Services:
             self.metrics,
             self.registry.config.get("log.slow_query_ms"),
             "grpc", method, rt, code, duration,
+            sample_rate=self.registry.config.get("log.request_sample_rate"),
         )
 
     def _observed(self, method, context, fn, request):
@@ -185,9 +186,10 @@ class _Services:
             with self.metrics.observe_request("grpc", method) as outcome:
                 try:
                     # span-per-RPC (ref: otelgrpc interceptors,
-                    # daemon.go:360-380)
+                    # daemon.go:360-380); root=True: this span anchors
+                    # the exported trace (see rest_server._route)
                     with self.registry.tracer().span(
-                        f"grpc.{method}", ctx=rt.ctx
+                        f"grpc.{method}", ctx=rt.ctx, root=True
                     ):
                         return fn(request, context)
                 except KetoError as e:
@@ -243,15 +245,48 @@ class _Services:
 
     def check(self, req, context):
         from ..engine.snaptoken import encode_snaptoken
-        from ..resilience import admit_check
+        from ..resilience import admit_check, admit_explain
 
         # admission gate BEFORE any work (typed 429/504; see
-        # resilience.admit_check): shed/expired requests cost nothing
-        admit_check(self.registry, self.batcher, current_request_trace())
+        # resilience.admit_check): shed/expired requests cost nothing.
+        # explain=true rides its own token bucket (explain.max_per_s)
+        # instead of the batcher's queue bound — it never queues there.
+        explain = bool(getattr(req, "explain", False))
+        if explain:
+            admit_explain(self.registry, current_request_trace())
+        else:
+            admit_check(self.registry, self.batcher, current_request_trace())
         t = self._check_tuple(req)
         self.registry.validate_namespaces(t)
         nid = self._nid(context)
         max_depth = int(req.max_depth)
+        if explain:
+            # §5m explain plane: cache bypassed, engine explain path,
+            # DecisionTrace serialized as canonical JSON bytes — the
+            # SAME bytes the aio plane returns and the REST body embeds
+            # (tri-plane parity is canonical-byte equality)
+            from ..engine.explain import canonical_json, serve_explain
+
+            if self.worker is not None:
+                from .replica import resolve_version
+
+                _target, version = resolve_version(
+                    self.worker.group, self.worker, nid, req.snaptoken,
+                    current_request_trace(),
+                )
+            else:
+                version = self._enforce_snaptoken(req.snaptoken, nid)
+            res, trace = serve_explain(
+                self.registry, nid, t, max_depth, version,
+                current_request_trace(),
+            )
+            if res.error is not None:
+                raise res.error
+            return pb.CheckResponse(
+                allowed=res.allowed,
+                snaptoken=encode_snaptoken(version, nid),
+                decision_trace=canonical_json(trace).decode(),
+            )
         if self.worker is not None:
             # replica mode: snaptoken routing (hold for catch-up ->
             # route to a fresh worker -> escalate, never stale) + the

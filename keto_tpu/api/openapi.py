@@ -81,7 +81,62 @@ def _schemas() -> dict:
         "checkResponse": {
             "type": "object",
             "required": ["allowed"],
-            "properties": {"allowed": {"type": "boolean"}},
+            "properties": {
+                "allowed": {"type": "boolean"},
+                "decision_trace": {
+                    "$ref": "#/components/schemas/decisionTrace"
+                },
+            },
+        },
+        "decisionTrace": {
+            "type": "object",
+            "description": "why a Check answered what it did (keto_tpu "
+                           "§5m explain plane; present only when the "
+                           "request set explain=true): the answering "
+                           "tier + cause, a host-re-walked witness path "
+                           "for ALLOW (differential-checked against the "
+                           "authoritative device verdict), an "
+                           "exhaustion summary for DENY, per-stage ms, "
+                           "and flight-recorder launch ids",
+            "properties": {
+                "allowed": {"type": "boolean"},
+                "tier": {
+                    "type": "string",
+                    "description": "which tier answered: closure "
+                                   "(Leopard one-step probe) | device "
+                                   "(BFS kernel) | host (exact oracle "
+                                   "replay) | vocab (name outside the "
+                                   "configured vocabulary)",
+                },
+                "cause": {"type": ["string", "null"]},
+                "closure_fallback": {"type": ["string", "null"]},
+                "version": {"type": "integer"},
+                "enforce_version": {"type": "integer"},
+                "snaptoken": {"type": "string"},
+                "max_depth": {"type": ["integer", "null"]},
+                "witness": {
+                    "type": "array",
+                    "description": "the edge/rewrite chain proving "
+                                   "ALLOW, query -> direct tuple, one "
+                                   "hop per traversal rule with the "
+                                   "tuple it rode and the rest-depth",
+                    "items": {"type": "object"},
+                },
+                "exhaustion": {
+                    "type": ["object", "null"],
+                    "description": "DENY only: depth guards hit, nodes "
+                                   "visited, tuples scanned, AND/NOT "
+                                   "islands consulted",
+                },
+                "witness_verdict": {"type": "boolean"},
+                "witness_consistent": {"type": "boolean"},
+                "witness_racy": {"type": "boolean"},
+                "cache_bypassed": {"type": "boolean"},
+                "stages_ms": {"type": "object"},
+                "launch_ids": {
+                    "type": "array", "items": {"type": "integer"},
+                },
+            },
         },
         "batchCheckRequest": {
             "type": "object",
@@ -300,9 +355,21 @@ def build_spec(version: str = "", kind: str | None = None) -> dict:
                            "evaluated against (keto_tpu extension)",
         }
     }
+    explain_param = {
+        "name": "explain", "in": "query",
+        "schema": {"type": "boolean"},
+        "description": "return a DecisionTrace beside the verdict "
+                       "(keto_tpu §5m extension): answering tier, "
+                       "witness path / exhaustion summary, stage ms, "
+                       "launch ids. Bypasses the check cache; "
+                       "rate-bounded by explain.max_per_s (429 over "
+                       "the bound). POST also accepts an `explain` "
+                       "body field",
+    }
     check_op = {
         "parameters": _SUBJECT_QUERY_PARAMS + [_MAX_DEPTH_PARAM,
-                                               snaptoken_param],
+                                               snaptoken_param,
+                                               explain_param],
         "responses": {
             "200": {
                 **_json_response("membership verdict", "checkResponse"),
@@ -324,7 +391,7 @@ def build_spec(version: str = "", kind: str | None = None) -> dict:
     }
     # POST check takes the subject tuple from the JSON body ONLY (the
     # handler ignores subject query params on POST, like the reference's
-    # postCheck vs getCheck split, rest_server._check_tuple_from_request)
+    # postCheck vs getCheck split, rest_server._Handler._check)
     # — so the POST operations carry a required body and just max-depth
     check_body = {
         "required": True,
@@ -334,11 +401,11 @@ def build_spec(version: str = "", kind: str | None = None) -> dict:
     }
     check_op_post = {
         **check_op, "requestBody": check_body,
-        "parameters": [_MAX_DEPTH_PARAM, snaptoken_param],
+        "parameters": [_MAX_DEPTH_PARAM, snaptoken_param, explain_param],
     }
     check_bare_post = {
         **check_bare, "requestBody": check_body,
-        "parameters": [_MAX_DEPTH_PARAM, snaptoken_param],
+        "parameters": [_MAX_DEPTH_PARAM, snaptoken_param, explain_param],
     }
     paths = {
         READ_ROUTE_BASE: {

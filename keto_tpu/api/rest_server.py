@@ -271,9 +271,14 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 try:
                     # span-per-request (ref: otelx.TraceHandler,
-                    # daemon.go:131-133)
+                    # daemon.go:131-133); root=True makes this span the
+                    # request's trace ROOT — it takes rt.ctx's span id,
+                    # so the batcher/engine/store spans parent-link to
+                    # it and IT parent-links to the caller's client span
+                    # from the ingested traceparent (the OTLP export
+                    # plane's hierarchy)
                     with self.registry.tracer().span(
-                        f"http.{label}", ctx=rt.ctx
+                        f"http.{label}", ctx=rt.ctx, root=True
                     ):
                         resolved[1]()
                     # handlers that WRITE an error status directly (503
@@ -302,6 +307,9 @@ class _Handler(BaseHTTPRequestHandler):
                 time.perf_counter() - t0,
                 skip_slow=(
                     resolved is not None and resolved[0] == WATCH_ROUTE
+                ),
+                sample_rate=self.registry.config.get(
+                    "log.request_sample_rate"
                 ),
             )
 
@@ -341,11 +349,7 @@ class _Handler(BaseHTTPRequestHandler):
 
         if self.kind == "metrics":
             if method == "GET" and path == METRICS_PATH:
-                return METRICS_PATH, lambda: self._write(
-                    200,
-                    self.registry.metrics().export(),
-                    content_type="text/plain; version=0.0.4; charset=utf-8",
-                )
+                return METRICS_PATH, self._metrics_export
             if path == PROFILING_ROUTE:
                 if method == "GET":
                     return PROFILING_ROUTE, self._profiling_status
@@ -420,14 +424,6 @@ class _Handler(BaseHTTPRequestHandler):
         )
         self._json(200, GetResponse(tuples, next_token).to_dict())
 
-    def _check_tuple_from_request(self, method: str) -> RelationTuple:
-        if method == "GET":
-            return RelationTuple.from_url_query(self._params())
-        body = self._body_json()
-        if not isinstance(body, dict):
-            raise MalformedInputError("could not unmarshal json: expected object")
-        return RelationTuple.from_dict(body)
-
     def _enforce_snaptoken(self, token: str, nid: str) -> int:
         from ..engine.snaptoken import enforce_snaptoken
 
@@ -457,18 +453,47 @@ class _Handler(BaseHTTPRequestHandler):
         token surface at all): a `snaptoken` query param pins the read,
         and the response carries the evaluated version's token in the
         X-Keto-Snaptoken header — a header, so the parity JSON body
-        stays byte-identical to the reference's {"allowed": ...}."""
-        from ..engine.snaptoken import encode_snaptoken
-        from ..resilience import admit_check
+        stays byte-identical to the reference's {"allowed": ...}.
 
-        # deadline ingestion + admission gate BEFORE any work: shed
-        # requests answer a typed 429 (Retry-After attached), expired
-        # ones a typed 504 — the same error surface the gRPC planes map
+        `explain=true` (query param, or an `explain` body field on POST
+        — keto_tpu extension, §5m) returns a DecisionTrace beside the
+        verdict: answering tier + cause, host-re-walked witness path
+        (differential-checked against the authoritative device
+        verdict), exhaustion summary for DENY, per-stage ms, launch
+        ids. Explain bypasses the check cache and is admission-bounded
+        by the explain.max_per_s token bucket (typed 429)."""
+        from ..engine.snaptoken import encode_snaptoken
+        from ..resilience import admit_check, admit_explain
+
+        # deadline ingestion + admission gate BEFORE any work — body
+        # parsing included: a shed/draining POST must cost nothing (the
+        # overload path is exactly what this gate protects). The explain
+        # flag picks the gate: explain rides the token bucket, never the
+        # batcher's queue accounting. The query param decides PRE-parse;
+        # a POST that opts in via the body field instead pays one extra
+        # advisory batcher check (state-free) and then the token gate.
         rt = self._ingest_deadline()
-        admit_check(self.registry, self.batcher, rt)
         params = self._params()
+        explain = params.get("explain", "").lower() in ("1", "true")
+        if explain:
+            admit_explain(self.registry, rt)
+        else:
+            admit_check(self.registry, self.batcher, rt)
+        body = None
+        if method != "GET":
+            body = self._body_json()
+            if not isinstance(body, dict):
+                raise MalformedInputError(
+                    "could not unmarshal json: expected object"
+                )
+            if not explain and body.get("explain"):
+                explain = True
+                admit_explain(self.registry, rt)
         max_depth = _get_max_depth(params)
-        t = self._check_tuple_from_request(method)
+        if method == "GET":
+            t = RelationTuple.from_url_query(params)
+        else:
+            t = RelationTuple.from_dict(body)
         nid = self._nid()
         token = params.get("snaptoken", "")
         if self.worker is not None:
@@ -490,7 +515,33 @@ class _Handler(BaseHTTPRequestHandler):
         except NamespaceNotFoundError:
             # unknown namespace => allowed=false, not 404 (handler.go:156-161)
             code = 403 if mirror_status else 200
-            self._json(code, {"allowed": False}, extra_headers=token_hdr)
+            payload: dict = {"allowed": False}
+            if explain:
+                # the REST-only swallowed corner never reaches the
+                # engine: the trace says so (vocab tier — the name is
+                # outside the configured vocabulary)
+                from ..engine.explain import vocab_trace
+
+                self.registry.metrics().explain_requests_total.inc()
+                payload["decision_trace"] = vocab_trace(
+                    version, encode_snaptoken(version, nid),
+                    "namespace_not_found",
+                )
+            self._json(code, payload, extra_headers=token_hdr)
+            return
+        if explain:
+            from ..engine.explain import serve_explain
+
+            res, trace = serve_explain(
+                self.registry, nid, t, max_depth, version, rt
+            )
+            if res.error is not None:
+                raise res.error
+            code = 403 if (mirror_status and not res.allowed) else 200
+            self._json(
+                code, {"allowed": res.allowed, "decision_trace": trace},
+                extra_headers=token_hdr,
+            )
             return
         if target is not None:
             res = serve_on(target, nid, t, max_depth, version, rt)
@@ -907,6 +958,25 @@ class _Handler(BaseHTTPRequestHandler):
         artifact = self.registry.profiler().stop()
         self._json(200, {"running": False, "artifact": artifact})
 
+    def _metrics_export(self) -> None:
+        """GET /metrics/prometheus: classic text exposition by default;
+        an Accept header asking for `application/openmetrics-text` gets
+        the OpenMetrics format instead — the one that carries the
+        EXEMPLARS (trace_id per stage-histogram bucket) linking the
+        metrics plane to the trace plane."""
+        metrics = self.registry.metrics()
+        accept = self.headers.get("Accept") or ""
+        if "application/openmetrics-text" in accept:
+            self._write(
+                200, metrics.export_openmetrics(),
+                content_type=metrics.OPENMETRICS_CONTENT_TYPE,
+            )
+            return
+        self._write(
+            200, metrics.export(),
+            content_type="text/plain; version=0.0.4; charset=utf-8",
+        )
+
     def _flightrec_dump(self) -> None:
         """GET /admin/flightrec: the live launch ring plus
         per-built-engine HBM/staleness snapshots. Entries come back in
@@ -918,9 +988,16 @@ class _Handler(BaseHTTPRequestHandler):
         messages; entry ages are derivable from `now_mono` - entry
         `t_mono` (monotonic stamps — wall clocks are banned repo-wide).
         Reads only already-built state: no engine or device mirror is
-        instantiated from the admin plane."""
+        instantiated from the admin plane.
+
+        Filters (the ring now holds 7 launch kinds — dumping everything
+        to find one filter launch is noise): `?kind=` keeps entries of
+        one launch kind (check | closure | expand | list_objects |
+        list_subjects | filter | filter_closure), `?trace_id=` keeps
+        entries whose riders carried that trace id. Both compose."""
         import time as _time
 
+        params = self._params()
         fr = self.registry.flight_recorder()
         hbm = {}
         for nid, engine in self.registry.built_engines().items():
@@ -930,6 +1007,15 @@ class _Handler(BaseHTTPRequestHandler):
         entries = sorted(
             fr.entries(), key=lambda e: e.get("launch_id") or 0
         )
+        kind = params.get("kind", "")
+        if kind:
+            entries = [e for e in entries if e.get("kind") == kind]
+        trace_id = params.get("trace_id", "")
+        if trace_id:
+            entries = [
+                e for e in entries
+                if trace_id in (e.get("trace_ids") or ())
+            ]
         self._json(200, {
             "enabled": fr.enabled,
             "capacity": fr.capacity,

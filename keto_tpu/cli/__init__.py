@@ -433,6 +433,23 @@ def cmd_check(args) -> int:
     )
     client = _read_client(args)
     try:
+        if getattr(args, "explain", False):
+            # §5m explain plane: the DecisionTrace says WHY — answering
+            # tier, witness path / exhaustion, stage ms, launch ids
+            import json as _json
+
+            out = client.check_explain(
+                t, max_depth=args.max_depth, snaptoken=args.snaptoken or ""
+            )
+            allowed, token, trace = out
+            verdict = "Allowed" if allowed else "Denied"
+            _print_formatted(
+                args,
+                {"allowed": allowed, "snaptoken": token,
+                 "decision_trace": trace},
+                f"{verdict}\n{_json.dumps(trace, indent=2, sort_keys=True)}",
+            )
+            return 0
         allowed, token = client.check_with_token(
             t, max_depth=args.max_depth, snaptoken=args.snaptoken or ""
         )
@@ -774,6 +791,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--print-snaptoken", action="store_true",
         help="also print the evaluated snapshot's token",
+    )
+    p.add_argument(
+        "--explain", action="store_true",
+        help="return the DecisionTrace beside the verdict (keto_tpu "
+             "extension): answering tier + cause, witness path for "
+             "ALLOW, exhaustion summary for DENY, per-stage ms, "
+             "flight-recorder launch ids — rate-bounded server-side "
+             "(explain.max_per_s)",
     )
     _add_remote_flags(p, read=True)
     _add_format_flag(p)

@@ -1,0 +1,243 @@
+"""Decision explain plane: the DecisionTrace every Check can answer with.
+
+Zanzibar's operators debug authorization through Expand-based derivation
+traces, and its descendants made that first-class (SpiceDB's per-Check
+debug trace, OpenFGA's /expand+trace surface). This module is that
+capability for the keto_tpu serving stack: `explain=true` on Check (REST
+query/body param, gRPC request field, ReadClient, CLI --explain) returns
+a structured DecisionTrace beside the verdict —
+
+  - the answering TIER with its cause code (closure probe | device BFS |
+    host oracle replay, plus the kernel CAUSE_* that sent it there),
+  - a concrete WITNESS PATH for ALLOW: the edge/rewrite chain proving
+    the verdict, one hop per traversal rule with the tuple it rode and
+    the rest-depth it was taken at, reconstructed by a host re-walk
+    (reference.explain_check) and DIFFERENTIALLY CHECKED against the
+    authoritative device verdict (witness_consistent),
+  - an EXHAUSTION summary for DENY (depth guards hit, nodes visited,
+    tuples scanned, AND/NOT islands consulted),
+  - per-stage milliseconds, flight-recorder launch ids, and the
+    resolved store version + snaptoken.
+
+Serialization contract: `canonical_json` (sorted keys, compact
+separators) is THE byte encoding of a DecisionTrace — the gRPC/aio
+planes carry exactly these bytes in CheckResponse.decision_trace, and
+the REST plane embeds the same dict under "decision_trace", so the
+tri-plane parity tests compare canonical bytes across all three.
+
+Explain requests bypass the check cache (a cached verdict has no fresh
+witness) and are admission-bounded by the `explain.max_per_s` token
+bucket (typed 429) — the slow path cannot be weaponized against the
+serve plane.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional
+
+logger = logging.getLogger("keto_tpu")
+
+
+def canonical_json(obj) -> bytes:
+    """THE DecisionTrace byte encoding: sorted keys, compact separators,
+    no NaN laundering — identical input dict => identical bytes on every
+    plane (the tri-plane parity contract)."""
+    return json.dumps(
+        obj, sort_keys=True, separators=(",", ":"), allow_nan=False
+    ).encode()
+
+
+def build_decision_trace(
+    engine_trace: dict, snaptoken: str, enforce_version: int
+) -> dict:
+    """The wire DecisionTrace: the engine's explain record plus the
+    request's snaptoken surface. `enforce_version` is the version the
+    response snaptoken is minted from (the same value an unexplained
+    check answers with); the engine's `version` says which store version
+    the VERDICT is authoritative at (they differ when a host replay read
+    a live store that moved)."""
+    out = dict(engine_trace)
+    out["snaptoken"] = snaptoken
+    out["enforce_version"] = enforce_version
+    return out
+
+
+def serve_explain(registry, nid: str, t, max_depth: int, version: int, rt):
+    """The transports' shared explain path (REST _check, sync-gRPC
+    check, aio check all call this): count the request, run the engine's
+    explain evaluation (device verdict authoritative, host witness
+    re-walk differential-checked), and attach the response snaptoken.
+    Returns (CheckResult, trace dict). The caller maps res.error exactly
+    like an unexplained check — an errored check errors, explained or
+    not."""
+    from .snaptoken import encode_snaptoken
+
+    metrics = registry.metrics()
+    metrics.explain_requests_total.inc()
+    engine = registry.check_engine(nid)
+    # the transport's rt rides along so the explain evaluation joins
+    # the caller's trace: engine spans under the transport root,
+    # launch ids on the request log, trace id on the flightrec entry
+    res, engine_trace = engine.explain_check(t, max_depth, rt=rt)
+    trace = build_decision_trace(
+        engine_trace, encode_snaptoken(version, nid), version
+    )
+    return res, trace
+
+
+def base_trace(**overrides) -> dict:
+    """THE DecisionTrace key set, in one place: every builder (the
+    engine's explain_check, the host facade, the vocab corner) starts
+    from this skeleton and overrides what it knows — so a new/renamed
+    field cannot silently fork the tri-plane parity contract per tier.
+    `snaptoken`/`enforce_version` join at the serve layer
+    (build_decision_trace); the openapi `decisionTrace` schema mirrors
+    this shape."""
+    out = {
+        "allowed": False,
+        "tier": "host",
+        "cause": None,
+        "closure_fallback": None,
+        "version": None,
+        "max_depth": None,
+        "witness": [],
+        "exhaustion": None,
+        "witness_verdict": False,
+        "witness_consistent": True,
+        "witness_racy": False,
+        "cache_bypassed": True,
+        "stages_ms": {},
+        "launch_ids": [],
+    }
+    unknown = set(overrides) - set(out) - {"error"}
+    if unknown:
+        raise ValueError(f"unknown DecisionTrace fields: {sorted(unknown)}")
+    out.update(overrides)
+    return out
+
+
+def vocab_trace(version: int, snaptoken: str, cause: str) -> dict:
+    """DecisionTrace for verdicts that never reach the engine — the
+    REST plane's swallowed unknown-namespace corner: the name is
+    outside the configured vocabulary, so the answer is a free
+    definitive deny (`vocab` tier, the same shortcut family the filter
+    plane counts)."""
+    out = base_trace(tier="vocab", cause=cause, version=version)
+    out["snaptoken"] = snaptoken
+    out["enforce_version"] = version
+    return out
+
+
+# -- witness replay ------------------------------------------------------------
+
+
+def _tuple_fields(d: dict):
+    """(namespace, object, relation, subject_id, subject_set-tuple) from
+    a witness hop's serialized tuple dict."""
+    sset = d.get("subject_set")
+    sk = (
+        (sset["namespace"], sset["object"], sset["relation"])
+        if sset else None
+    )
+    return d.get("namespace"), d.get("object"), d.get("relation"), \
+        d.get("subject_id"), sk
+
+
+def _exists(manager, d: dict, nid: str) -> bool:
+    from ..ketoapi import RelationTuple
+
+    return manager.relation_tuple_exists(
+        RelationTuple.from_dict(d), nid=nid
+    )
+
+
+def replay_witness(
+    manager, query_tuple, witness: list, nid: str,
+    subject_key: Optional[tuple] = None,
+) -> bool:
+    """Step-by-step replay of an ALLOW witness against the store — the
+    differential suite's acceptance check: every hop's tuple must exist,
+    every hop must continue the chain from the node the previous hop
+    left it at, depths must decrement exactly where the semantics charge
+    them, and the chain must bottom out in a direct tuple naming the
+    query's subject. Returns True iff the whole chain validates; any
+    violation returns False (tests assert True for every device ALLOW).
+
+    `subject_key` threads the query subject through intersection-branch
+    recursion; leave it None at the top."""
+    ns, obj, rel = (
+        query_tuple.namespace, query_tuple.object, query_tuple.relation
+    )
+    if subject_key is None:
+        sset = query_tuple.subject_set
+        subject_key = (
+            ("set", sset.namespace, sset.object, sset.relation)
+            if sset is not None else ("id", query_tuple.subject_id)
+        )
+    depth = None  # hops carry their own rest-depth; validate monotonicity
+    for hop in witness:
+        rule = hop.get("rule")
+        d = hop.get("depth")
+        if d is None or (depth is not None and d > depth):
+            return False  # depth may only stay or shrink along the chain
+        depth = d
+        if rule == "direct":
+            tns, tobj, trel, sid, sk = _tuple_fields(hop.get("tuple") or {})
+            if (tns, tobj, trel) != (ns, obj, rel):
+                return False
+            hop_subject = ("set", *sk) if sk else ("id", sid)
+            if hop_subject != subject_key:
+                return False
+            return _exists(manager, hop["tuple"], nid)
+        if rule == "expand_subject":
+            via = hop.get("via") or {}
+            tns, tobj, trel, _sid, sk = _tuple_fields(via)
+            if (tns, tobj, trel) != (ns, obj, rel) or sk is None:
+                return False
+            if not _exists(manager, via, nid):
+                return False
+            ns, obj, rel = sk
+        elif rule == "computed_subject_set":
+            rel = hop.get("relation")
+            if not rel:
+                return False
+        elif rule == "tuple_to_subject_set":
+            via = hop.get("via") or {}
+            tns, tobj, _trel, _sid, sk = _tuple_fields(via)
+            # the via tuple lives AT the current object (its relation is
+            # the ttu relation, which the hop does not re-verify against
+            # config — existence + location is the store-level contract)
+            if (tns, tobj) != (ns, obj) or sk is None:
+                return False
+            if not _exists(manager, via, nid):
+                return False
+            ns, obj = sk[0], sk[1]
+            rel = hop.get("relation")
+            if not rel:
+                return False
+        elif rule == "intersection":
+            branches = hop.get("branches")
+            if not branches:
+                return False
+            from ..ketoapi import RelationTuple
+
+            node = RelationTuple(
+                namespace=ns, object=obj, relation=rel,
+                subject_id=query_tuple.subject_id,
+                subject_set=query_tuple.subject_set,
+            )
+            return all(
+                replay_witness(manager, node, bp, nid,
+                               subject_key=subject_key)
+                for bp in branches
+            )
+        elif rule == "not":
+            # membership-by-absence: nothing in the store to replay —
+            # the differential suite validates the VERDICT against the
+            # oracle instead (a NOT witness terminates the chain)
+            return True
+        else:
+            return False
+    return False  # a witness that never bottomed out proves nothing

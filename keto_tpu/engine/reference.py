@@ -641,6 +641,252 @@ class ReferenceEngine:
         # entries requiring more depth than the clamp can never fire
         return monotone_ok, {k: v for k, v in best.items() if v <= depth}
 
+    # -- decision explain (keto_tpu extension; the §5m witness walk) ----------
+    #
+    # Zanzibar operators debug authorization with Expand-derived
+    # derivation traces; SpiceDB ships a per-Check debug trace. This is
+    # that capability for the explain plane: the SAME recursive walk as
+    # _check_is_allowed, instrumented to return (a) a concrete WITNESS
+    # PATH for ALLOW — the ordered edge/rewrite chain from the query
+    # node down to the proving direct tuple, one hop per traversal rule
+    # with the tuple it rode and the rest-depth it was taken at — and
+    # (b) an EXHAUSTION summary for DENY (how many depth guards fired,
+    # nodes visited, tuples scanned, AND/NOT islands consulted).
+    #
+    # Invariant the witness replay relies on: _explain_allowed and its
+    # helpers leave `path` EXACTLY as they found it when they return
+    # False — every hop appended on the way into a branch is popped when
+    # that branch fails — so a True return leaves precisely the proving
+    # chain, in query -> direct order. (Exception paths may leave
+    # partial hops; explain_check only emits the path for clean ALLOWs.)
+
+    def explain_check(
+        self, r: RelationTuple, max_depth: int = 0, nid: str = DEFAULT_NETWORK
+    ) -> dict:
+        """Instrumented check: {"allowed", "max_depth", "witness",
+        "exhaustion"[, "error"]}. Witness hops are dicts with "rule"
+        (direct | expand_subject | computed_subject_set |
+        tuple_to_subject_set | intersection | not), the store tuple the
+        hop rode ("via"/"tuple"), the rewrite relation where one
+        applies, and the rest-depth at the hop. Depth bookkeeping is
+        bit-identical to check_relation_tuple; visited-set pruning
+        follows self.visited_pruning exactly like check does (the
+        engine's explain path walks with pruning OFF — the complete-walk
+        semantics the device kernels implement)."""
+        rest_depth = self._clamp_depth(max_depth)
+        st = {
+            "nodes_visited": 0,
+            "depth_exhausted": 0,
+            "islands_consulted": 0,
+            "tuples_scanned": 0,
+        }
+        path: list[dict] = []
+        error = None
+        try:
+            allowed = self._explain_allowed(
+                r, rest_depth, set(), nid, st, path
+            )
+        except Exception as e:  # error-as-value, like check_relation_tuple
+            allowed = False
+            error = e
+        out = {
+            "allowed": allowed,
+            "max_depth": rest_depth,
+            "witness": path if allowed else [],
+            "exhaustion": dict(st),
+        }
+        if error is not None:
+            out["error"] = str(error)
+        return out
+
+    def _explain_allowed(
+        self, r: RelationTuple, rest_depth: int, visited: set, nid: str,
+        st: dict, path: list,
+    ) -> bool:
+        # mirrors _check_is_allowed's OR schedule: direct,
+        # expand-subject, rewrite — same guards, same depths
+        if rest_depth < 0:
+            st["depth_exhausted"] += 1
+            return False
+        st["nodes_visited"] += 1
+
+        # direct (d-1): the guard is _check_direct's own Unknown
+        if rest_depth - 1 < 0:
+            st["depth_exhausted"] += 1
+        elif self.manager.relation_tuple_exists(r, nid=nid):
+            path.append({
+                "rule": "direct", "tuple": r.to_dict(), "depth": rest_depth,
+            })
+            return True
+
+        # expand-subject (recurse d-1 per hop)
+        query = RelationQuery(
+            namespace=r.namespace, object=r.object, relation=r.relation
+        )
+        page_token = ""
+        while True:
+            subjects, page_token = self.manager.get_relation_tuples(
+                query, page_token=page_token, nid=nid
+            )
+            for s in subjects:
+                st["tuples_scanned"] += 1
+                uid = subject_visited_key(s.subject)
+                if self.visited_pruning:
+                    if uid in visited:
+                        continue
+                    visited.add(uid)
+                sset = s.subject_set
+                if sset is None or sset.relation == WILDCARD_RELATION:
+                    continue
+                path.append({
+                    "rule": "expand_subject", "via": s.to_dict(),
+                    "depth": rest_depth,
+                })
+                if self._explain_allowed(
+                    RelationTuple(
+                        namespace=sset.namespace,
+                        object=sset.object,
+                        relation=sset.relation,
+                        subject_id=r.subject_id,
+                        subject_set=r.subject_set,
+                    ),
+                    rest_depth - 1, visited, nid, st, path,
+                ):
+                    return True
+                path.pop()
+            if not page_token:
+                break
+
+        # userset rewrites (errors — RelationNotFoundError — propagate
+        # exactly as _check_is_allowed raises res.error)
+        relation = self._ast_relation_for(r, nid)
+        if relation is not None and relation.subject_set_rewrite is not None:
+            if self._explain_rewrite(
+                r, relation.subject_set_rewrite, rest_depth, visited, nid,
+                st, path,
+            ):
+                return True
+        return False
+
+    def _explain_rewrite(
+        self, r: RelationTuple, rewrite: ast.SubjectSetRewrite,
+        rest_depth: int, visited: set, nid: str, st: dict, path: list,
+    ) -> bool:
+        if rest_depth < 0:
+            st["depth_exhausted"] += 1
+            return False
+        if rewrite.operation == ast.Operator.AND:
+            # intersection island: every branch must prove membership;
+            # the witness hop carries ONE chain per branch (a path alone
+            # cannot prove an AND)
+            st["islands_consulted"] += 1
+            branches: list[list] = []
+            for child in rewrite.children:
+                bp: list[dict] = []
+                if not self._explain_child(
+                    r, child, rest_depth, visited, nid, st, bp
+                ):
+                    return False
+                branches.append(bp)
+            path.append({
+                "rule": "intersection", "depth": rest_depth,
+                "branches": branches,
+            })
+            return True
+        for child in rewrite.children:
+            if self._explain_child(r, child, rest_depth, visited, nid, st, path):
+                return True
+        return False
+
+    def _explain_child(
+        self, r: RelationTuple, child: ast.Child, rest_depth: int,
+        visited: set, nid: str, st: dict, path: list,
+    ) -> bool:
+        if isinstance(child, ast.TupleToSubjectSet):
+            if rest_depth < 0:
+                st["depth_exhausted"] += 1
+                return False
+            query = RelationQuery(
+                namespace=r.namespace, object=r.object,
+                relation=child.relation,
+            )
+            page_token = ""
+            while True:
+                tuples, page_token = self.manager.get_relation_tuples(
+                    query, page_token=page_token, nid=nid
+                )
+                for t in tuples:
+                    st["tuples_scanned"] += 1
+                    sset = t.subject_set
+                    if sset is None:
+                        continue
+                    path.append({
+                        "rule": "tuple_to_subject_set", "via": t.to_dict(),
+                        "relation": child.computed_subject_set_relation,
+                        "depth": rest_depth,
+                    })
+                    if self._explain_allowed(
+                        RelationTuple(
+                            namespace=sset.namespace,
+                            object=sset.object,
+                            relation=child.computed_subject_set_relation,
+                            subject_id=r.subject_id,
+                            subject_set=r.subject_set,
+                        ),
+                        rest_depth - 1, visited, nid, st, path,
+                    ):
+                        return True
+                    path.pop()
+                if not page_token:
+                    break
+            return False
+        if isinstance(child, ast.ComputedSubjectSet):
+            if rest_depth < 0:
+                st["depth_exhausted"] += 1
+                return False
+            path.append({
+                "rule": "computed_subject_set", "relation": child.relation,
+                "depth": rest_depth,
+            })
+            if self._explain_allowed(
+                RelationTuple(
+                    namespace=r.namespace,
+                    object=r.object,
+                    relation=child.relation,
+                    subject_id=r.subject_id,
+                    subject_set=r.subject_set,
+                ),
+                rest_depth, visited, nid, st, path,  # SAME depth (cost 0)
+            ):
+                return True
+            path.pop()
+            return False
+        if isinstance(child, ast.SubjectSetRewrite):
+            # nested group: transparent for OR (the chain continues),
+            # one intersection hop for AND (handled by _explain_rewrite)
+            return self._explain_rewrite(
+                r, child, rest_depth, visited, nid, st, path
+            )
+        if isinstance(child, ast.InvertResult):
+            # NOT island: membership is proven by the CHILD's
+            # non-membership — there is no positive chain to record, so
+            # the hop is the island itself; the child's verdict comes
+            # from the exact un-instrumented machinery
+            st["islands_consulted"] += 1
+            if rest_depth < 0:
+                st["depth_exhausted"] += 1
+                return False
+            res = self._check_rewrite_child(
+                r, child.child, rest_depth, visited, nid
+            )
+            if res.error is not None:
+                raise res.error
+            if res.membership == Membership.NOT_MEMBER:
+                path.append({"rule": "not", "depth": rest_depth})
+                return True
+            return False
+        raise NotImplementedError(f"unknown rewrite child {type(child)}")
+
     # -- expand (ref: internal/expand/engine.go) ------------------------------
 
     def _build_tree(

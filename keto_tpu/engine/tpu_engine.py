@@ -1528,12 +1528,26 @@ class TPUCheckEngine:
     def _record_list_launch(
         self, kind: str, B: int, n: int, stats, launch_id: int
     ) -> None:
-        """Flight-recorder entry for a reverse/expand launch: lighter
-        than the check entry (no stage breakdown — these legs resolve
-        inline), but the same counter vocabulary. The caller allocates
-        `launch_id` BEFORE its kernel dispatch so ids keep advancing
-        while recording is disabled and id order tracks dispatch order
-        across launch kinds."""
+        """Flight-recorder entry for a reverse/expand/filter launch:
+        lighter than the check entry (no stage breakdown — these legs
+        resolve inline), but the same counter vocabulary. The caller
+        allocates `launch_id` BEFORE its kernel dispatch so ids keep
+        advancing while recording is disabled and id order tracks
+        dispatch order across launch kinds.
+
+        These legs evaluate ON the request thread (no batcher handoff),
+        so the executing request's trace rides the ambient contextvar:
+        the entry gets the trace id (the `?trace_id=` flightrec filter
+        and the exported trace join on it) and the request's trace gets
+        the launch id (slow-query lines and request logs then point at
+        this entry, exactly like check launches)."""
+        from ..observability import current_request_trace
+
+        rt = current_request_trace()
+        if rt is not None:
+            ids = getattr(rt, "launch_ids", None)
+            if ids is not None:
+                ids.append(launch_id)
         fr = self.flightrec
         if fr is None or not fr.enabled:
             return
@@ -1545,6 +1559,8 @@ class TPUCheckEngine:
             "n": n,
             "occupancy": round((n / B) if B else 1.0, 4),
         }
+        if rt is not None:
+            entry["trace_ids"] = [rt.ctx.trace_id]
         if stats is not None:
             entry.update(launch_stats_dict(stats))
         fr.record(entry)
@@ -2187,9 +2203,101 @@ class TPUCheckEngine:
             self.metrics.checks_total.labels("host").inc(len(tuples))
         return results
 
+    def explain_check(self, t: RelationTuple, max_depth: int = 0, rt=None):
+        """One Check with a DecisionTrace beside the verdict — the §5m
+        explain plane's engine half. The DEVICE verdict stays
+        authoritative: the query rides the normal submit/resolve path
+        (closure probe first, BFS kernel, cause-coded host replay) with
+        the explain sink recording which tier answered; a host re-walk
+        (reference.explain_check, complete-walk semantics — exactly what
+        the kernels implement) then reconstructs the WITNESS PATH for
+        ALLOW / the exhaustion summary for DENY, and is DIFFERENTIALLY
+        CHECKED against the device verdict (`witness_consistent`; a
+        store write racing the re-walk sets `witness_racy` instead of
+        crying wolf). Returns (CheckResult, engine trace dict) — the
+        serve helper (engine/explain.py) adds the snaptoken surface.
+
+        Deliberately the slow path: no check-cache consult (a cached
+        verdict has no fresh witness), one extra exact host walk per
+        call — which is why the transports admission-bound it
+        (`explain.max_per_s`).
+
+        `rt` is the TRANSPORT's RequestTrace when serving (None for
+        embedders): riding the caller's trace keeps the joins this
+        plane exists for — the engine spans parent-link to the
+        transport root in the exported trace, the flight-recorder entry
+        carries the request's trace id (`?trace_id=` filter), and the
+        launch ids land on the request log / slow-query line."""
+        from ..observability import RequestTrace
+
+        if rt is None:
+            rt = RequestTrace()
+        sink: list = [None]
+        v_before = self.manager.version(nid=self.nid)
+        try:
+            handle = self.check_batch_submit(
+                [t], max_depth, telemetry=[rt], explain_sink=sink
+            )
+            results, versions = self.check_batch_resolve_v(handle)
+            res, version = results[0], versions[0]
+            tier_info = sink[0] or {"tier": "device"}
+        except Exception:
+            # a failing device path must not take explain down with it:
+            # the exact host oracle answers (the breaker-degrade route's
+            # semantics), tier-coded so the trace says what happened
+            res = self.reference.check_relation_tuple(
+                t, max_depth, self.nid
+            )
+            version = None
+            tier_info = {"tier": "host", "cause": "engine_error"}
+        if version is None:
+            # host replays read the LIVE store — the answer's version is
+            # the store version at resolve (same rule the check cache
+            # applies to unpinned answers)
+            version = self.manager.version(nid=self.nid)
+        allowed = res.error is None and res.allowed
+        checker = self.reference._complete_checker()
+        wx = checker.explain_check(t, max_depth, self.nid)
+        v_after = self.manager.version(nid=self.nid)
+        racy = v_after != v_before
+        consistent = res.error is None and wx["allowed"] == allowed
+        if not consistent and not racy and res.error is None:
+            # a quiet-store witness/verdict disagreement is exactly the
+            # divergence the differential suite hunts — log it loudly
+            # (the trace still reports the device verdict as the answer)
+            import logging
+
+            logging.getLogger("keto_tpu").warning(
+                "explain witness mismatch: device=%s host_walk=%s "
+                "tuple=%s tier=%s", allowed, wx["allowed"], t,
+                tier_info.get("tier"),
+            )
+        from .explain import base_trace
+
+        trace = base_trace(
+            allowed=allowed,
+            tier=tier_info.get("tier"),
+            cause=tier_info.get("cause"),
+            closure_fallback=tier_info.get("closure_fallback"),
+            version=version,
+            max_depth=wx.get("max_depth"),
+            witness=wx.get("witness", []) if allowed else [],
+            exhaustion=None if allowed else wx.get("exhaustion"),
+            witness_verdict=wx["allowed"],
+            witness_consistent=consistent,
+            witness_racy=racy,
+            stages_ms={
+                k: round(v * 1e3, 3) for k, v in rt.stages.items()
+            },
+            launch_ids=list(rt.launch_ids),
+        )
+        if res.error is not None:
+            trace["error"] = str(res.error)
+        return res, trace
+
     def check_batch_submit(
         self, tuples: Sequence[RelationTuple], max_depth: int = 0,
-        telemetry=None, allow_closure: bool = True,
+        telemetry=None, allow_closure: bool = True, explain_sink=None,
     ):
         """Launch the device kernel for one batch WITHOUT synchronizing.
 
@@ -2204,6 +2312,13 @@ class TPUCheckEngine:
         device_wait/host_fallback at resolve) is added to every rider —
         batch-shared stages, attributed identically to each request in
         the batch — and emitted as per-request engine spans when tracing.
+
+        `explain_sink` is an optional per-tuple list the RESOLVE phase
+        fills with each query's ANSWERING TIER ({"tier": closure |
+        device | host, "cause": kernel CAUSE_* for host replays}) — the
+        explain plane's plumb-through. Supported for batches that fit
+        one bucket (explain rides 1-item batches); oversized multi-split
+        batches ignore it.
         """
         n = len(tuples)
         if n == 0:
@@ -2215,7 +2330,8 @@ class TPUCheckEngine:
         launch_id = next_launch_id()
         try:
             return self._check_batch_submit_inner(
-                tuples, max_depth, telemetry, launch_id, allow_closure
+                tuples, max_depth, telemetry, launch_id, allow_closure,
+                explain_sink=explain_sink,
             )
         except Exception as e:
             # don't clobber an id a recursive split-slice submit already
@@ -2227,6 +2343,7 @@ class TPUCheckEngine:
     def _check_batch_submit_inner(
         self, tuples: Sequence[RelationTuple], max_depth: int,
         telemetry, launch_id: int, allow_closure: bool = True,
+        explain_sink=None,
     ):
         n = len(tuples)
         # fault-injection point (keto_tpu/faults.py): a stall here models
@@ -2351,6 +2468,7 @@ class TPUCheckEngine:
                             "dispatch": t_done - t_launch,
                         },
                         "telemetry": telemetry,
+                        "explain_sink": explain_sink,
                         "launch_id": launch_id,
                         "t_submit": t_submit,
                         "kind": "closure",
@@ -2450,6 +2568,7 @@ class TPUCheckEngine:
                     "dispatch": t_done - t_launch,
                 },
                 "telemetry": telemetry,
+                "explain_sink": explain_sink,
                 # flight-recorder fields, read back at the resolve sync
                 # point together with the device stats vector
                 "launch_id": launch_id,
@@ -2524,10 +2643,12 @@ class TPUCheckEngine:
         )
         device_wait_s = time.perf_counter() - t_resolve
 
+        sink = meta.get("explain_sink")
         results: list = [None] * n
         versions: list = [None] * n
         covered = state.covered_version
         leftover: list[int] = []
+        leftover_cause: dict[int, str] = {}
         causes: dict[str, int] = {}
         for i in range(n):
             c = int(cause[i])
@@ -2536,9 +2657,12 @@ class TPUCheckEngine:
                     RESULT_IS_MEMBER if member[i] else RESULT_NOT_MEMBER
                 )
                 versions[i] = covered
+                if sink is not None:
+                    sink[i] = {"tier": "closure"}
             else:
                 leftover.append(i)
                 name = CL_CAUSE_NAMES.get(c, "uncovered")
+                leftover_cause[i] = name
                 causes[name] = causes.get(name, 0) + 1
         n_hits = n - len(leftover)
         self.stats["closure_hits"] = (
@@ -2558,6 +2682,7 @@ class TPUCheckEngine:
             meta, device_wait_s, 0.0, n, B, stats=stats, host_causes=causes
         )
         if leftover:
+            sub_sink = [None] * len(leftover) if sink is not None else None
             sub_handle = self.check_batch_submit(
                 [tuples[i] for i in leftover],
                 max_depth,
@@ -2565,11 +2690,18 @@ class TPUCheckEngine:
                     [telemetry[i] for i in leftover] if telemetry else None
                 ),
                 allow_closure=False,
+                explain_sink=sub_sink,
             )
             sub_res, sub_ver = self.check_batch_resolve_v(sub_handle)
             for j, i in enumerate(leftover):
                 results[i] = sub_res[j]
                 versions[i] = sub_ver[j]
+                if sink is not None:
+                    info = dict(sub_sink[j] or {"tier": "device"})
+                    # the explain trace says WHY the closure probe
+                    # declined this query before the BFS ride answered
+                    info["closure_fallback"] = leftover_cause.get(i)
+                    sink[i] = info
         return results, versions
 
     def _check_batch_resolve_v_inner(self, outputs, meta):
@@ -2625,6 +2757,7 @@ class TPUCheckEngine:
         # bare list comprehension over the verdict array instead of the
         # per-item bookkeeping loop (~3x less host time per batch, and
         # the host loop serializes against the next launch's encode)
+        sink = meta.get("explain_sink")
         if (
             n <= B
             and bool(q_valid[:n].all())
@@ -2636,6 +2769,9 @@ class TPUCheckEngine:
                     RESULT_IS_MEMBER if m else RESULT_NOT_MEMBER
                     for m in member[:n].tolist()
                 ]
+            if sink is not None:
+                for i in range(n):
+                    sink[i] = {"tier": "device"}
             self.stats["device_checks"] += n
             if self.metrics is not None:
                 self.metrics.check_batch_size.observe(n)
@@ -2665,6 +2801,8 @@ class TPUCheckEngine:
                         RESULT_IS_MEMBER if member[i] else RESULT_NOT_MEMBER
                     )
                     versions.append(covered)
+                    if sink is not None:
+                        sink[i] = {"tier": "device"}
                 else:
                     n_host += 1
                     # cause bookkeeping: the kernel reports a CAUSE_* code
@@ -2694,6 +2832,8 @@ class TPUCheckEngine:
                         replay_memo[key] = res
                     results.append(res)
                     versions.append(None)
+                    if sink is not None:
+                        sink[i] = {"tier": "host", "cause": cause}
             sp.set_attribute("host_replays", n_host)
         self.stats["device_checks"] += n - n_host
         self.stats["host_checks"] += n_host
@@ -2729,12 +2869,21 @@ class TPUCheckEngine:
         stage_s["device_wait"] = device_wait_s
         if host_s > 0.0:
             stage_s["host_fallback"] = host_s
+        telemetry = meta.get("telemetry")
         if self.metrics is not None:
+            # exemplar: the first rider's trace id rides the stage
+            # histogram buckets (OpenMetrics exemplars — the metrics ->
+            # trace join); batch-shared stages observe once, so one
+            # representative trace id per batch is the honest grain
+            exemplar_tid = None
+            for rt in (telemetry or ()):
+                if rt is not None:
+                    exemplar_tid = rt.ctx.trace_id
+                    break
             for name, dur in stage_s.items():
-                self.metrics.observe_stage(name, dur)
+                self.metrics.observe_stage(name, dur, trace_id=exemplar_tid)
             self.metrics.batch_occupancy.set(n / B if B else 1.0)
         self._record_launch(meta, stats, n, B, host_causes, stage_s)
-        telemetry = meta.get("telemetry")
         if not telemetry:
             return
         spans = getattr(self.tracer, "active", False)
@@ -2749,8 +2898,12 @@ class TPUCheckEngine:
             for name, dur in stage_s.items():
                 rt.add_stage(name, dur)
                 if spans:
+                    # launch_id rides the span: the OTLP exporter turns
+                    # it into a `flightrec.launch` span EVENT, so a
+                    # trace at the collector points at its ring entry
                     self.tracer.record(
-                        f"engine.{name}", ctx=rt.ctx, duration_s=dur, batch=B
+                        f"engine.{name}", ctx=rt.ctx, duration_s=dur,
+                        batch=B, launch_id=launch_id,
                     )
 
     def _record_launch(

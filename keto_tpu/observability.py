@@ -17,6 +17,14 @@ slow-query log (`log.slow_query_ms`).
 Everything here degrades gracefully: metrics use a dedicated
 CollectorRegistry (so embedders/tests never hit duplicate-collector
 errors), and tracing is a no-op unless `tracing.enabled` is set.
+
+The §5m export plane rides the same machinery: setting
+`observability.otlp.endpoint` turns the tracer into an exporting
+recorder — completed spans leave the process as OTLP/HTTP-JSON through
+the bounded, never-blocking SpanExporter, transport spans anchor the
+trace as parent-linked roots, engine stage spans carry flight-recorder
+launch ids as span events, and the check-stage histogram attaches
+trace_id exemplars served via OpenMetrics content negotiation.
 """
 
 from __future__ import annotations
@@ -54,17 +62,26 @@ CHECK_STAGES = (
 
 class SpanContext:
     """One W3C trace-context vertex: (trace_id, span_id). `child()` mints
-    a new span id under the same trace — the propagation primitive."""
+    a new span id under the same trace — the propagation primitive.
+    `parent_span_id` remembers the span this one was minted under (the
+    caller's span id for a context ingested from `traceparent`): the
+    OTLP exporter needs it so the transport ROOT span can parent-link to
+    the caller's client span instead of dangling."""
 
-    __slots__ = ("trace_id", "span_id", "sampled")
+    __slots__ = ("trace_id", "span_id", "sampled", "parent_span_id")
 
-    def __init__(self, trace_id: str, span_id: str, sampled: bool = True):
+    def __init__(self, trace_id: str, span_id: str, sampled: bool = True,
+                 parent_span_id: Optional[str] = None):
         self.trace_id = trace_id
         self.span_id = span_id
         self.sampled = sampled
+        self.parent_span_id = parent_span_id
 
     def child(self) -> "SpanContext":
-        return SpanContext(self.trace_id, secrets.token_hex(8), self.sampled)
+        return SpanContext(
+            self.trace_id, secrets.token_hex(8), self.sampled,
+            parent_span_id=self.span_id,
+        )
 
     def to_traceparent(self) -> str:
         return (
@@ -780,6 +797,36 @@ class Metrics:
             ["reason"],
             registry=self.registry,
         )
+        # decision explain plane + OTLP span export (this module's
+        # SpanExporter + engine/explain.py): the observability plane's
+        # own health counters
+        self.explain_requests_total = prom.Counter(
+            "keto_tpu_explain_requests_total",
+            "Check requests served with explain=true (the DecisionTrace "
+            "slow path: cache bypassed, host witness re-walk beside the "
+            "authoritative device verdict) — admission-bounded by the "
+            "explain.max_per_s token bucket, so this counts served "
+            "explains, not shed ones (those land in "
+            "keto_tpu_requests_shed_total{explain_rate})",
+            registry=self.registry,
+        )
+        self.otlp_exported_total = prom.Counter(
+            "keto_tpu_otlp_exported_total",
+            "Spans successfully POSTed to observability.otlp.endpoint "
+            "as OTLP/HTTP-JSON by the background SpanExporter",
+            registry=self.registry,
+        )
+        self.otlp_dropped_total = prom.Counter(
+            "keto_tpu_otlp_dropped_total",
+            "Spans dropped by the OTLP exporter instead of blocking a "
+            "request thread, by reason: queue_full (the bounded export "
+            "queue was at capacity at enqueue) or post_error (the "
+            "collector POST failed/timed out and the batch was "
+            "abandoned) — export is observability; dropping beats "
+            "back-pressure",
+            ["reason"],
+            registry=self.registry,
+        )
         # hot-path cache: (transport, method) -> (duration child,
         # {code: counter child})
         self._observe_cache: dict = {}
@@ -787,8 +834,21 @@ class Metrics:
         # constants, so this cache is bounded by construction)
         self._stage_cache: dict = {}
 
+    OPENMETRICS_CONTENT_TYPE = (
+        "application/openmetrics-text; version=1.0.0; charset=utf-8"
+    )
+
     def export(self) -> bytes:
         return prom.generate_latest(self.registry)
+
+    def export_openmetrics(self) -> bytes:
+        """OpenMetrics exposition — the format that carries EXEMPLARS
+        (the trace_id attached to check-stage histogram buckets, linking
+        the metrics plane to the trace plane); served by the metrics
+        listener when the scraper's Accept header asks for it."""
+        from prometheus_client.openmetrics import exposition as om
+
+        return om.generate_latest(self.registry)
 
     def observe_launch(
         self,
@@ -808,15 +868,26 @@ class Metrics:
         self.launch_edge_rows.observe(edge_rows)
         self.launch_padding_waste.observe(padding_waste)
 
-    def observe_stage(self, stage: str, seconds: float) -> None:
+    def observe_stage(
+        self, stage: str, seconds: float, trace_id: Optional[str] = None
+    ) -> None:
         """One per-stage sample (cached label child; see observe_request
-        for why `.labels()` is avoided on the serve hot path)."""
+        for why `.labels()` is avoided on the serve hot path).
+
+        `trace_id` attaches an OpenMetrics EXEMPLAR to the bucket this
+        observation lands in: a scrape of the stage histogram then
+        carries a concrete trace id per bucket — the metrics->trace join
+        Grafana/Tempo navigate on. Costs one small dict per exemplared
+        observation; callers pass it only when a request context exists."""
         child = self._stage_cache.get(stage)
         if child is None:
             child = self._stage_cache[stage] = (
                 self.check_stage_duration.labels(stage)
             )
-        child.observe(seconds)
+        if trace_id:
+            child.observe(seconds, exemplar={"trace_id": trace_id})
+        else:
+            child.observe(seconds)
 
     def observe_request(self, transport: str, method: str):
         """Times a request and counts its outcome code.
@@ -896,7 +967,7 @@ class _NoopTracer:
     # False lets hot paths skip per-request span bookkeeping entirely
     active = False
 
-    def span(self, name: str, ctx=None, **attrs):
+    def span(self, name: str, ctx=None, root: bool = False, **attrs):
         # singleton CM: no generator frame per call on the serve path
         return _NOOP_SPAN
 
@@ -926,29 +997,55 @@ class RecordingTracer:
     Spans carry trace correlation: an explicit `ctx` (SpanContext) or,
     when absent, the executing request's CURRENT_TRACE — so persistence
     spans recorded deep in a handler share the request's trace_id
-    without any signature changes."""
+    without any signature changes.
+
+    `root=True` marks the request's TRANSPORT span: it takes the
+    context's OWN span id (instead of minting a child) so every other
+    span in the request — batcher.queue, engine stages, store ops —
+    parent-links to it, and its own parent is the caller's span id from
+    the ingested `traceparent` (ctx.parent_span_id). That's what turns
+    the flat recording into a real parent-linked trace at an OTel
+    collector.
+
+    `exporter` (SpanExporter | None) receives every COMPLETED span that
+    carries a trace id — the OTLP/HTTP-JSON export plane. Enqueue is
+    non-blocking by contract (bounded queue, drop counter)."""
 
     active = True
 
-    def __init__(self, cap: int = 4096):
+    def __init__(self, cap: int = 4096, exporter=None):
         import collections
 
         self.spans = collections.deque(maxlen=cap)
+        self.exporter = exporter
 
     @staticmethod
-    def _trace_attrs(ctx, attrs: dict) -> dict:
+    def _trace_attrs(ctx, attrs: dict, root: bool = False) -> dict:
         if ctx is None:
             rt = CURRENT_TRACE.get()
             ctx = rt.ctx if rt is not None else None
         if ctx is not None:
             attrs["trace_id"] = ctx.trace_id
-            attrs["parent_span_id"] = ctx.span_id
-            attrs["span_id"] = secrets.token_hex(8)
+            if root:
+                # the transport span IS the request's span: ctx.span_id
+                # is what every nested span parents to, and the caller's
+                # client span (parent_span_id) is what THIS span parents
+                # to across the process boundary
+                attrs["span_id"] = ctx.span_id
+                if ctx.parent_span_id:
+                    attrs["parent_span_id"] = ctx.parent_span_id
+            else:
+                attrs["parent_span_id"] = ctx.span_id
+                attrs["span_id"] = secrets.token_hex(8)
         return attrs
 
+    def _export(self, s: "RecordedSpan") -> None:
+        if self.exporter is not None and "trace_id" in s.attrs:
+            self.exporter.enqueue(s)
+
     @contextlib.contextmanager
-    def span(self, name: str, ctx=None, **attrs):
-        s = RecordedSpan(name, self._trace_attrs(ctx, dict(attrs)))
+    def span(self, name: str, ctx=None, root: bool = False, **attrs):
+        s = RecordedSpan(name, self._trace_attrs(ctx, dict(attrs), root))
         self.spans.append(s)
         start = time.perf_counter()
         try:
@@ -957,6 +1054,11 @@ class RecordingTracer:
             s.attrs["duration_ms"] = round(
                 (time.perf_counter() - start) * 1e3, 3
             )
+            # monotonic END stamp: the exporter anchors it to the epoch
+            # (wall clocks are banned repo-wide; one anchored conversion
+            # at the export boundary is the OTLP wire requirement)
+            s.attrs.setdefault("t_mono", time.monotonic())
+            self._export(s)
 
     def record(self, name: str, ctx=None, duration_s=None, **attrs):
         """Retroactive span: stages measured after the fact (batcher
@@ -965,7 +1067,10 @@ class RecordingTracer:
         attrs = self._trace_attrs(ctx, dict(attrs))
         if duration_s is not None:
             attrs["duration_ms"] = round(duration_s * 1e3, 3)
-        self.spans.append(RecordedSpan(name, attrs))
+        attrs.setdefault("t_mono", time.monotonic())
+        s = RecordedSpan(name, attrs)
+        self.spans.append(s)
+        self._export(s)
 
     def span_names(self) -> list:
         return [s.name for s in self.spans]
@@ -1037,7 +1142,7 @@ class _OtelTracer:
         self._tracer = trace.get_tracer(service_name)
 
     @contextlib.contextmanager
-    def span(self, name: str, ctx=None, **attrs):
+    def span(self, name: str, ctx=None, root: bool = False, **attrs):
         with self._tracer.start_as_current_span(name) as s:
             if ctx is not None:
                 s.set_attribute("keto.trace_id", ctx.trace_id)
@@ -1054,9 +1159,283 @@ class _OtelTracer:
             pass
 
 
-def build_tracer(config):
+# -- OTLP/HTTP-JSON span export ------------------------------------------------
+
+
+def _otlp_value(v) -> dict:
+    """One attribute value in OTLP AnyValue JSON shape."""
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}  # OTLP JSON carries int64 as string
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+# span-record attrs that are structural (identity/timing), not payload —
+# everything else exports as OTLP span attributes
+_SPAN_STRUCTURAL = frozenset(
+    ("trace_id", "span_id", "parent_span_id", "duration_ms", "t_mono",
+     "launch_id", "launch_ids")
+)
+
+
+class SpanExporter:
+    """Background OTLP/HTTP-JSON span exporter — stdlib wire format, no
+    OTel SDK. The missing half of the PR-3 telemetry plane: the spans
+    the RecordingTracer already correlates per trace_id leave the
+    process as real OTLP `resourceSpans`, so the trace_id a client sent
+    as `traceparent` comes back out as a parent-linked multi-span trace
+    in any OTel collector/Jaeger.
+
+    Contract with the serve hot path:
+      - `enqueue` NEVER blocks: a bounded queue.Queue absorbs bursts,
+        overflow increments `keto_tpu_otlp_dropped_total{queue_full}`
+        and the span is gone — export is observability, dropping beats
+        back-pressuring a request thread.
+      - one daemon worker thread drains the queue in batches (at most
+        `batch_max` spans per POST, at least every `flush_interval_s`)
+        and POSTs to `observability.otlp.endpoint` with a bounded
+        timeout; a failed POST counts its batch as
+        dropped{post_error} and moves on — a dead collector costs
+        drops, never latency.
+      - timestamps: spans carry MONOTONIC end stamps (wall clocks are
+        banned repo-wide, ketolint clock-monotonic); ONE epoch anchor
+        captured at construction converts them to the unixNano the OTLP
+        wire requires (time.time_ns is the sanctioned single wall-clock
+        read — it is never used for interval math).
+      - flight-recorder correlation: a span's `launch_id`/`launch_ids`
+        attr becomes OTLP span EVENTS (name `flightrec.launch`), so a
+        trace in Jaeger points straight at its GET /admin/flightrec
+        ring entries.
+
+    `flush(timeout)` blocks until everything enqueued so far has been
+    POSTed (tests, daemon drain); `close()` stops the worker after a
+    final flush attempt."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        metrics=None,
+        queue_size: int = 2048,
+        flush_interval_s: float = 0.2,
+        batch_max: int = 512,
+        post_timeout_s: float = 2.0,
+        service_name: str = "keto_tpu",
+        instance_id: str = "",
+    ):
+        import os
+        import queue as _queue
+
+        self.endpoint = endpoint
+        self.metrics = metrics
+        self.flush_interval_s = max(float(flush_interval_s), 0.01)
+        self.batch_max = max(int(batch_max), 1)
+        self.post_timeout_s = float(post_timeout_s)
+        self.service_name = service_name
+        self.instance_id = instance_id or str(os.getpid())
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=max(int(queue_size), 1))
+        self._stop = threading.Event()
+        # flush accounting: enqueued vs settled (exported OR dropped);
+        # flush() waits for settled to catch up under one condition
+        self._mu = threading.Lock()
+        self._settle_cond = threading.Condition(self._mu)
+        self._enqueued = 0
+        self._settled = 0
+        self.stats = {"exported": 0, "dropped_queue_full": 0,
+                      "dropped_post_error": 0, "posts": 0}
+        # the ONE wall-clock read: an epoch anchor for OTLP unixNano
+        # stamps; every span time is anchor + (its monotonic - anchor's)
+        self._anchor_epoch_ns = time.time_ns()
+        self._anchor_mono = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._loop, name="keto-otlp-export", daemon=True
+        )
+        self._thread.start()
+
+    # -- hot-path surface ------------------------------------------------------
+
+    def enqueue(self, span) -> bool:
+        """Queue one completed RecordedSpan for export. Non-blocking:
+        False (+ drop counter) when the bounded queue is full."""
+        import queue as _queue
+
+        if self._stop.is_set():
+            return False
+        with self._mu:
+            self._enqueued += 1
+        try:
+            self._q.put_nowait(span)
+            return True
+        except _queue.Full:
+            self._drop(1, "queue_full")
+            return False
+
+    # -- bookkeeping -----------------------------------------------------------
+
+    def _settle(self, n: int) -> None:
+        with self._settle_cond:
+            self._settled += n
+            self._settle_cond.notify_all()
+
+    def _drop(self, n: int, reason: str) -> None:
+        self.stats[f"dropped_{reason}"] += n
+        if self.metrics is not None:
+            self.metrics.otlp_dropped_total.labels(reason).inc(n)
+        self._settle(n)
+
+    def _mark_exported(self, n: int) -> None:
+        self.stats["exported"] += n
+        if self.metrics is not None:
+            self.metrics.otlp_exported_total.inc(n)
+        self._settle(n)
+
+    # -- worker ----------------------------------------------------------------
+
+    def _loop(self) -> None:
+        import queue as _queue
+
+        # TICK-based, not wake-per-span: a blocking q.get would wake
+        # this worker (json.dumps + POST, GIL-holding) the instant a
+        # request thread enqueues — measured 1.2x serve latency on a
+        # 2-core box. Sleeping the flush interval and draining in
+        # batches decouples export work from request threads entirely;
+        # the cost is at most one interval of added export delay.
+        while True:
+            stopped = self._stop.wait(self.flush_interval_s)
+            while True:
+                batch = []
+                while len(batch) < self.batch_max:
+                    try:
+                        batch.append(self._q.get_nowait())
+                    except _queue.Empty:
+                        break
+                if not batch:
+                    break
+                self._post(batch)
+            if stopped:
+                return
+
+    def _epoch_ns(self, mono: float) -> int:
+        return self._anchor_epoch_ns + int(
+            (mono - self._anchor_mono) * 1e9
+        )
+
+    def _otlp_span(self, s) -> dict:
+        attrs = s.attrs
+        end_mono = attrs.get("t_mono", self._anchor_mono)
+        end_ns = self._epoch_ns(end_mono)
+        dur_ms = float(attrs.get("duration_ms", 0.0) or 0.0)
+        start_ns = end_ns - int(dur_ms * 1e6)
+        out = {
+            "traceId": attrs.get("trace_id", ""),
+            "spanId": attrs.get("span_id", ""),
+            "name": s.name,
+            "kind": 2,  # SPAN_KIND_SERVER-side work
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(end_ns),
+            "attributes": [
+                {"key": k, "value": _otlp_value(v)}
+                for k, v in attrs.items()
+                if k not in _SPAN_STRUCTURAL
+            ],
+        }
+        parent = attrs.get("parent_span_id")
+        if parent:
+            out["parentSpanId"] = parent
+        # flight-recorder launch ids ride as span EVENTS: the join key
+        # into GET /admin/flightrec, visible per span in the collector
+        launch_ids = tuple(
+            lid for lid in (attrs.get("launch_ids") or ()) if lid is not None
+        )
+        if attrs.get("launch_id") is not None:
+            launch_ids = (*launch_ids, attrs["launch_id"])
+        if launch_ids:
+            out["events"] = [
+                {
+                    "timeUnixNano": str(end_ns),
+                    "name": "flightrec.launch",
+                    "attributes": [
+                        {"key": "launch_id", "value": _otlp_value(int(lid))}
+                    ],
+                }
+                for lid in launch_ids
+            ]
+        return out
+
+    def payload(self, spans: list) -> bytes:
+        """The OTLP/HTTP-JSON request body for one span batch (public:
+        the smoke validates the wire shape without a collector)."""
+        import json as _json
+
+        return _json.dumps({
+            "resourceSpans": [{
+                "resource": {
+                    "attributes": [
+                        {"key": "service.name",
+                         "value": {"stringValue": self.service_name}},
+                        {"key": "service.instance.id",
+                         "value": {"stringValue": self.instance_id}},
+                    ]
+                },
+                "scopeSpans": [{
+                    "scope": {"name": "keto_tpu"},
+                    "spans": [self._otlp_span(s) for s in spans],
+                }],
+            }]
+        }).encode()
+
+    def _post(self, batch: list) -> None:
+        import urllib.request
+
+        try:
+            body = self.payload(batch)
+            req = urllib.request.Request(
+                self.endpoint, data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=self.post_timeout_s):
+                pass
+            self.stats["posts"] += 1
+            self._mark_exported(len(batch))
+        except Exception as e:  # noqa: BLE001 — a dead collector must
+            # never fail (or slow) anything but this counter
+            logger.debug("otlp export POST failed: %s", e)
+            self._drop(len(batch), "post_error")
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Block until every span enqueued BEFORE this call is settled
+        (exported or dropped); False on timeout."""
+        deadline = time.monotonic() + timeout
+        with self._settle_cond:
+            target = self._enqueued
+            while self._settled < target:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._settle_cond.wait(remaining)
+        return True
+
+    def close(self, timeout: float = 2.0) -> None:
+        self.flush(timeout)
+        self._stop.set()
+        # wake the worker out of its queue.get wait
+        self._thread.join(timeout=max(self.flush_interval_s * 2, 0.5))
+
+
+def build_tracer(config, exporter=None):
     """ref: otelx tracer built once from config (registry_default.go:118-129).
-    `tracing.provider: memory` selects the in-process recording sink."""
+    `tracing.provider: memory` selects the in-process recording sink.
+    A SpanExporter (built by the registry when
+    `observability.otlp.endpoint` is set) forces the recording sink —
+    the export plane reads our RecordedSpan objects — regardless of
+    provider: setting the endpoint IS the opt-in."""
+    if exporter is not None:
+        return RecordingTracer(exporter=exporter)
     if config.get("tracing.enabled", False):
         if config.get("tracing.provider", "otel") == "memory":
             return RecordingTracer()
@@ -1201,6 +1580,7 @@ def finish_request_telemetry(
     code: str,
     duration_s: float,
     skip_slow: bool = False,
+    sample_rate=None,
 ) -> None:
     """Shared end-of-request bookkeeping for every transport (REST
     _route, sync-gRPC _observed, aio _observed): computes the transport
@@ -1208,18 +1588,34 @@ def finish_request_telemetry(
     rode the check pipeline (scrapes/lists/writes have no breakdown and
     would pollute the Check attribution), then emits the request and
     slow-query logs. `skip_slow` exempts by-design-long requests (SSE
-    watch streams)."""
+    watch streams).
+
+    `sample_rate` (log.request_sample_rate, default 1.0) probabilistically
+    samples the per-request INFO `request handled` line: at 1M checks/s
+    the unconditional line is itself an overload source, so operators
+    can dial it down without losing the slow-query WARNINGs — those
+    ALWAYS emit (a sampled-out slow request would be exactly the
+    evidence an incident needs)."""
     rode_pipeline = bool(rt.stages)
     rt.add_stage(
         "transport", max(0.0, duration_s - sum(rt.stages.values()))
     )
     if rode_pipeline and metrics is not None:
-        metrics.observe_stage("transport", rt.stages["transport"])
+        metrics.observe_stage(
+            "transport", rt.stages["transport"], trace_id=rt.ctx.trace_id
+        )
     launch_ids = getattr(rt, "launch_ids", None)
-    request_log(
-        transport, method, code, duration_s,
-        trace_id=rt.ctx.trace_id, stages=rt.stages, launch_ids=launch_ids,
-    )
+    sampled_in = True
+    if sample_rate is not None and float(sample_rate) < 1.0:
+        import random as _random
+
+        sampled_in = _random.random() < float(sample_rate)
+    if sampled_in:
+        request_log(
+            transport, method, code, duration_s,
+            trace_id=rt.ctx.trace_id, stages=rt.stages,
+            launch_ids=launch_ids,
+        )
     if not skip_slow:
         slow_query_log(
             threshold_ms, transport, method, code, duration_s,

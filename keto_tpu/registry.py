@@ -132,6 +132,9 @@ class Registry:
         )
         self._metrics = None
         self._tracer = None
+        self._span_exporter = None
+        self._span_exporter_built = False
+        self._explain_limiter = None
         self._profiler = None
         self._flightrec = None
         self._scrubber = None
@@ -179,8 +182,12 @@ class Registry:
                         legacy_namespaces=self.config.legacy_namespace_ids(),
                     )
                 # span-per-store-op when tracing (ref: otel spans in every
-                # persister method, relationtuples.go:203-205)
-                if self.config.get("tracing.enabled", False):
+                # persister method, relationtuples.go:203-205); the OTLP
+                # endpoint alone also turns these on — an exported trace
+                # without its store-op spans is missing its leaves
+                if self.config.get("tracing.enabled", False) or self.config.get(
+                    "observability.otlp.endpoint"
+                ):
                     from .observability import TracedManager
 
                     self._manager = TracedManager(self._manager, self.tracer())
@@ -427,8 +434,65 @@ class Registry:
             if self._tracer is None:
                 from .observability import build_tracer
 
-                self._tracer = build_tracer(self.config)
+                self._tracer = build_tracer(
+                    self.config, exporter=self.span_exporter()
+                )
             return self._tracer
+
+    def span_exporter(self):
+        """The process-wide OTLP span exporter
+        (observability.SpanExporter), or None when
+        `observability.otlp.endpoint` is unset. Setting the endpoint is
+        the opt-in: the tracer then records spans AND exports them —
+        bounded queue, background batched POSTs, drop counters — so the
+        trace_id a client sent as `traceparent` leaves the process as a
+        real multi-span OTLP trace. The daemon flushes + closes it on
+        stop."""
+        with self._lock:
+            if not self._span_exporter_built:
+                endpoint = self.config.get("observability.otlp.endpoint")
+                if endpoint:
+                    from .observability import SpanExporter
+
+                    self._span_exporter = SpanExporter(
+                        str(endpoint),
+                        metrics=self.metrics(),
+                        queue_size=int(
+                            self.config.get("observability.otlp.queue", 2048)
+                        ),
+                        flush_interval_s=float(
+                            self.config.get(
+                                "observability.otlp.flush_interval_ms", 200
+                            )
+                        ) / 1e3,
+                        service_name=str(
+                            self.config.get(
+                                "tracing.service_name", "keto_tpu"
+                            )
+                        ),
+                    )
+                self._span_exporter_built = True
+            return self._span_exporter
+
+    def explain_limiter(self):
+        """The explain plane's token bucket (resilience.TokenBucket,
+        `explain.max_per_s`): one process-wide bucket shared by every
+        transport, so the cache-bypassing witness-re-walk slow path is
+        rate-bounded no matter which plane the requests arrive on."""
+        with self._lock:
+            if self._explain_limiter is None:
+                from .resilience import (
+                    DEFAULT_EXPLAIN_MAX_PER_S,
+                    TokenBucket,
+                )
+
+                rate = float(
+                    self.config.get(
+                        "explain.max_per_s", DEFAULT_EXPLAIN_MAX_PER_S
+                    )
+                )
+                self._explain_limiter = TokenBucket(rate)
+            return self._explain_limiter
 
     def circuit_breaker(self):
         """The process-wide device-path circuit breaker
@@ -583,6 +647,36 @@ class _HostEngineFacade:
             self.metrics.check_batch_size.observe(len(tuples))
             self.metrics.checks_total.labels("host").inc(len(tuples))
         return [self.check_relation_tuple(t, max_depth) for t in tuples]
+
+    def explain_check(self, t, max_depth: int = 0, rt=None):
+        """Explain on the host engine: verdict and witness come from the
+        same walk family, tier is always `host` (there is no device to
+        differ from, so witness_consistent is the walk agreeing with
+        the pruned check — still a real differential on cyclic graphs).
+        `rt` accepted for surface parity; the host walk records no
+        engine stages or launch ids."""
+        from .engine.explain import base_trace
+
+        res = self.check_relation_tuple(t, max_depth)
+        allowed = res.error is None and res.allowed
+        wx = self.reference._complete_checker().explain_check(
+            t, max_depth, self.nid
+        )
+        trace = base_trace(
+            allowed=allowed,
+            tier="host",
+            version=self.reference.manager.version(nid=self.nid),
+            max_depth=wx.get("max_depth"),
+            witness=wx.get("witness", []) if allowed else [],
+            exhaustion=None if allowed else wx.get("exhaustion"),
+            witness_verdict=wx["allowed"],
+            witness_consistent=(
+                res.error is None and wx["allowed"] == allowed
+            ),
+        )
+        if res.error is not None:
+            trace["error"] = str(res.error)
+        return res, trace
 
     def expand(self, subject, max_depth: int = 0):
         return self.reference.expand(subject, max_depth, self.nid)

@@ -175,6 +175,61 @@ def admit_filter(registry, n_objects: int, rt=None) -> None:
         )
 
 
+DEFAULT_EXPLAIN_MAX_PER_S = 10.0
+
+
+class TokenBucket:
+    """Plain token-bucket rate limiter (monotonic clock, thread-safe):
+    `rate` tokens refill per second up to `burst`. `try_take()` is the
+    whole hot surface — (admitted, retry_after_s). Built for the explain
+    plane's admission bound, generic by construction."""
+
+    def __init__(self, rate_per_s: float, burst: Optional[float] = None,
+                 clock=time.monotonic):
+        self.rate = max(float(rate_per_s), 1e-6)
+        self.burst = float(burst) if burst is not None else max(
+            self.rate, 1.0
+        )
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._tokens = self.burst
+        self._last = clock()
+
+    def try_take(self, n: float = 1.0):
+        """(True, 0.0) and one token consumed, or (False, seconds until
+        a token will exist) with nothing consumed."""
+        with self._mu:
+            now = self._clock()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._last) * self.rate
+            )
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True, 0.0
+            return False, (n - self._tokens) / self.rate
+
+
+def admit_explain(registry, rt=None) -> None:
+    """The explain plane's admission gate: the shared draining/expired
+    checks (admit_check semantics, typed 429/504), plus the
+    `explain.max_per_s` token bucket — explain bypasses the check cache
+    and pays a host witness re-walk per request, so the slow path is
+    rate-bounded before any work (a typed 429 with the bucket's refill
+    time as Retry-After; counted under
+    keto_tpu_requests_shed_total{explain_rate}). Byte-identical bodies
+    across REST/gRPC/aio because all planes map the same KetoError."""
+    admit_check(registry, None, rt)
+    admitted, retry_after = registry.explain_limiter().try_take()
+    if not admitted:
+        registry.metrics().requests_shed_total.labels("explain_rate").inc()
+        raise OverloadedError(
+            "explain rate limit exceeded (explain.max_per_s) — retry "
+            "later or lower the explain volume",
+            retry_after_s=retry_after,
+        )
+
+
 def retry_after_header_value(retry_after_s: Optional[float]) -> str:
     """Retry-After is specified in whole seconds; round up so the hint
     never invites an immediately-reshed retry."""

@@ -1,0 +1,660 @@
+"""Decision explain plane (§5m): the differential witness suite plus the
+serve surface.
+
+Contract under test: every ALLOW's witness path replays step-by-step
+through the store to the same verdict (engine/explain.replay_witness —
+each hop's tuple exists, each hop continues the chain, depths decrement
+exactly where the semantics charge them, the chain bottoms out in a
+direct tuple naming the query subject), every DENY's exhaustion claims
+equal an independent oracle walk, the device verdict stays
+authoritative (witness_consistent differential), explain bypasses the
+check cache, the explain.max_per_s token bucket sheds typed 429s, and
+the DecisionTrace serializes to the SAME canonical bytes across
+REST/gRPC/aio (modulo the per-evaluation stages_ms/launch_ids — each
+plane's explain is its own ride)."""
+
+import json
+import random
+import urllib.error
+import urllib.request
+
+import pytest
+
+from keto_tpu.config import Config
+from keto_tpu.engine.explain import (
+    canonical_json,
+    replay_witness,
+    vocab_trace,
+)
+from keto_tpu.engine.reference import ReferenceEngine
+from keto_tpu.engine.tpu_engine import TPUCheckEngine
+from keto_tpu.ketoapi import RelationTuple
+from keto_tpu.namespace import Namespace
+from keto_tpu.namespace.ast import (
+    ComputedSubjectSet,
+    InvertResult,
+    Operator,
+    Relation,
+    SubjectSetRewrite,
+    TupleToSubjectSet,
+)
+from keto_tpu.storage.memory import MemoryManager
+
+NID = "default"
+
+CAT_NS = [
+    Namespace(name="videos", relations=[
+        Relation(name="owner"),
+        Relation(name="parent"),
+        Relation(name="view", subject_set_rewrite=SubjectSetRewrite(children=[
+            ComputedSubjectSet(relation="owner"),
+            TupleToSubjectSet(relation="parent",
+                              computed_subject_set_relation="view"),
+        ])),
+    ]),
+    Namespace(name="groups", relations=[Relation(name="member")]),
+]
+
+CAT_TUPLES = [
+    "videos:/d1#owner@alice",
+    "videos:/d1/v1#parent@(videos:/d1#...)",
+    "videos:/d2#owner@bob",
+    "videos:/d2/v1#parent@(videos:/d2#...)",
+    "videos:/d1#view@(groups:eng#member)",
+    "groups:eng#member@carol",
+    "groups:eng#member@(groups:leads#member)",
+    "groups:leads#member@dana",
+]
+
+
+def make_engine(tuples, namespaces=None, max_depth=8, closure=False):
+    manager = MemoryManager()
+    manager.write_relation_tuples(
+        [RelationTuple.from_string(s) for s in tuples]
+    )
+    cfg_dict = {"limit": {"max_read_depth": max_depth}}
+    if closure:
+        cfg_dict["closure"] = {"enabled": True}
+    config = Config(cfg_dict)
+    config.set_namespaces(
+        namespaces
+        if namespaces is not None
+        else [Namespace(name=n) for n in ("files", "groups")]
+    )
+    engine = TPUCheckEngine(manager, config)
+    return engine, ReferenceEngine(manager, config, visited_pruning=False)
+
+
+def assert_explained(engine, reference, t, max_depth=0):
+    """The differential acceptance check for ONE query: device verdict
+    equals the oracle; ALLOW => witness replays to True and the trace is
+    self-consistent; DENY => exhaustion equals an independent oracle
+    walk. Returns the trace."""
+    res, trace = engine.explain_check(t, max_depth)
+    want = reference.check_relation_tuple(t, max_depth, NID)
+    if want.error is not None:
+        assert res.error is not None
+        return trace
+    assert res.error is None
+    assert res.allowed == want.allowed, (t, trace)
+    assert trace["allowed"] == res.allowed
+    assert trace["witness_consistent"], trace
+    if res.allowed:
+        assert trace["witness"], trace
+        assert replay_witness(engine.manager, t, trace["witness"], NID), trace
+        assert trace["exhaustion"] is None
+    else:
+        assert trace["witness"] == []
+        oracle_walk = reference.explain_check(t, max_depth, NID)
+        assert trace["exhaustion"] == oracle_walk["exhaustion"], trace
+    return trace
+
+
+class TestReferenceWitness:
+    """The host witness walk in isolation."""
+
+    def _ref(self, tuples, ns=None, max_depth=8):
+        _, r = make_engine(tuples, ns, max_depth=max_depth)
+        return r
+
+    def test_direct_hit_is_one_hop(self):
+        r = self._ref(["files:a#owner@alice"])
+        wx = r.explain_check(
+            RelationTuple("files", "a", "owner", subject_id="alice"), 0, NID
+        )
+        assert wx["allowed"] is True
+        assert [h["rule"] for h in wx["witness"]] == ["direct"]
+        assert wx["witness"][0]["tuple"]["subject_id"] == "alice"
+
+    def test_expand_subject_chain_ordered_query_to_direct(self):
+        r = self._ref([
+            "groups:g1#member@alice",
+            "groups:g2#member@(groups:g1#member)",
+            "files:a#owner@(groups:g2#member)",
+        ])
+        wx = r.explain_check(
+            RelationTuple("files", "a", "owner", subject_id="alice"), 0, NID
+        )
+        rules = [h["rule"] for h in wx["witness"]]
+        assert rules == ["expand_subject", "expand_subject", "direct"]
+        depths = [h["depth"] for h in wx["witness"]]
+        assert depths == sorted(depths, reverse=True)  # strictly spent
+
+    def test_rewrite_hops_recorded(self):
+        r = self._ref(CAT_TUPLES, CAT_NS)
+        wx = r.explain_check(
+            RelationTuple("videos", "/d1/v1", "view", subject_id="alice"),
+            0, NID,
+        )
+        assert wx["allowed"] is True
+        rules = [h["rule"] for h in wx["witness"]]
+        assert "tuple_to_subject_set" in rules  # the parent-folder hop
+        assert "computed_subject_set" in rules  # view -> owner
+        assert rules[-1] == "direct"
+
+    def test_intersection_witness_carries_every_branch(self):
+        ns = [Namespace(name="acl", relations=[
+            Relation(name="allow"),
+            Relation(name="paid"),
+            Relation(name="access", subject_set_rewrite=SubjectSetRewrite(
+                operation=Operator.AND,
+                children=[ComputedSubjectSet(relation="allow"),
+                          ComputedSubjectSet(relation="paid")])),
+        ])]
+        r = self._ref(["acl:d1#allow@u1", "acl:d1#paid@u1"], ns)
+        wx = r.explain_check(
+            RelationTuple("acl", "d1", "access", subject_id="u1"), 0, NID
+        )
+        assert wx["allowed"] is True
+        isect = [h for h in wx["witness"] if h["rule"] == "intersection"]
+        assert len(isect) == 1 and len(isect[0]["branches"]) == 2
+        for branch in isect[0]["branches"]:
+            assert branch[-1]["rule"] == "direct"
+
+    def test_not_island_membership_by_absence(self):
+        ns = [Namespace(name="n", relations=[
+            Relation(name="allow"),
+            Relation(name="deny"),
+            Relation(name="access", subject_set_rewrite=SubjectSetRewrite(
+                operation=Operator.AND,
+                children=[
+                    ComputedSubjectSet(relation="allow"),
+                    InvertResult(child=ComputedSubjectSet(relation="deny")),
+                ])),
+        ])]
+        r = self._ref(["n:d1#allow@u1"], ns)
+        wx = r.explain_check(
+            RelationTuple("n", "d1", "access", subject_id="u1"), 0, NID
+        )
+        assert wx["allowed"] is True
+        isect = [h for h in wx["witness"] if h["rule"] == "intersection"][0]
+        assert any(
+            b and b[0]["rule"] == "not" for b in isect["branches"]
+        )
+        assert wx["exhaustion"]["islands_consulted"] >= 2  # AND + NOT
+
+    def test_deny_exhaustion_counts_depth_guards(self):
+        chain = ["groups:g0#member@alice"] + [
+            f"groups:g{i}#member@(groups:g{i - 1}#member)"
+            for i in range(1, 6)
+        ]
+        r = self._ref(chain, max_depth=3)  # too shallow to reach g0
+        wx = r.explain_check(
+            RelationTuple("groups", "g5", "member", subject_id="alice"),
+            0, NID,
+        )
+        assert wx["allowed"] is False
+        assert wx["exhaustion"]["depth_exhausted"] > 0
+        assert wx["witness"] == []
+
+    def test_failed_branches_leave_no_hops(self):
+        # two dead-end groups before the proving one: the pop-on-fail
+        # invariant keeps them out of the witness
+        r = self._ref([
+            "files:a#owner@(groups:dead1#member)",
+            "files:a#owner@(groups:dead2#member)",
+            "files:a#owner@(groups:live#member)",
+            "groups:live#member@alice",
+        ])
+        wx = r.explain_check(
+            RelationTuple("files", "a", "owner", subject_id="alice"), 0, NID
+        )
+        assert wx["allowed"] is True
+        via = [
+            h["via"]["subject_set"]["object"]
+            for h in wx["witness"] if h["rule"] == "expand_subject"
+        ]
+        assert via == ["live"]
+
+
+class TestEngineExplainDifferential:
+    """engine.explain_check vs the oracle across the acceptance graph
+    families: random, deep-20 chain, cycles, AND/NOT islands."""
+
+    def test_random_graphs(self):
+        rng = random.Random(14)
+        for trial in range(3):
+            groups = [f"g{i}" for i in range(8)]
+            users = ["u1", "u2", "u3"]
+            tuples = []
+            for g in groups:
+                for u in users:
+                    if rng.random() < 0.3:
+                        tuples.append(f"groups:{g}#member@{u}")
+                if rng.random() < 0.5:
+                    other = rng.choice(groups)
+                    if other != g:
+                        tuples.append(
+                            f"groups:{g}#member@(groups:{other}#member)"
+                        )
+            for i in range(6):
+                g = rng.choice(groups)
+                tuples.append(f"files:f{i}#owner@(groups:{g}#member)")
+            e, r = make_engine(sorted(set(tuples)))
+            for u in users + ["ghost"]:
+                for i in range(6):
+                    assert_explained(
+                        e, r,
+                        RelationTuple("files", f"f{i}", "owner",
+                                      subject_id=u),
+                    )
+
+    def test_deep_20_chain_witness(self):
+        chain = ["groups:g0#member@alice"] + [
+            f"groups:g{i}#member@(groups:g{i - 1}#member)"
+            for i in range(1, 21)
+        ]
+        e, r = make_engine(chain, max_depth=25)
+        t = RelationTuple("groups", "g20", "member", subject_id="alice")
+        trace = assert_explained(e, r, t)
+        assert len(trace["witness"]) == 21  # 20 expand hops + direct
+        assert trace["tier"] in ("device", "host")
+        # a stranger denies with the full frontier walked
+        assert_explained(
+            e, r, RelationTuple("groups", "g20", "member", subject_id="bob")
+        )
+
+    def test_cycles(self):
+        e, r = make_engine([
+            "groups:a#member@(groups:b#member)",
+            "groups:b#member@(groups:a#member)",
+            "groups:b#member@alice",
+        ])
+        for sub in ("alice", "bob"):
+            for g in ("a", "b"):
+                assert_explained(
+                    e, r,
+                    RelationTuple("groups", g, "member", subject_id=sub),
+                )
+
+    def test_and_not_islands(self):
+        ns = [Namespace(name="n", relations=[
+            Relation(name="allow"),
+            Relation(name="deny"),
+            Relation(name="access", subject_set_rewrite=SubjectSetRewrite(
+                operation=Operator.AND,
+                children=[
+                    ComputedSubjectSet(relation="allow"),
+                    InvertResult(child=ComputedSubjectSet(relation="deny")),
+                ])),
+        ])]
+        e, r = make_engine(
+            ["n:d1#allow@u1", "n:d2#allow@u1", "n:d2#deny@u1"], ns
+        )
+        t1 = assert_explained(
+            e, r, RelationTuple("n", "d1", "access", subject_id="u1")
+        )
+        # AND islands ride the device's island circuits; NOT-bearing
+        # regions host-replay — either way the tier is reported
+        assert t1["tier"] in ("device", "host")
+        t2 = assert_explained(
+            e, r, RelationTuple("n", "d2", "access", subject_id="u1")
+        )
+        assert t2["allowed"] is False
+        assert t2["exhaustion"]["islands_consulted"] >= 1
+
+    def test_closure_tier_answers_covered_deep_chain(self):
+        chain = ["groups:g0#member@alice"] + [
+            f"groups:g{i}#member@(groups:g{i - 1}#member)"
+            for i in range(1, 6)
+        ]
+        e, r = make_engine(chain, max_depth=10, closure=True)
+        assert e.closure_ensure_built()
+        t = RelationTuple("groups", "g5", "member", subject_id="alice")
+        trace = assert_explained(e, r, t)
+        assert trace["tier"] == "closure"
+        assert trace["witness"]  # closure hit still carries the witness
+
+    def test_host_tier_carries_cause(self):
+        # unknown vocabulary rides the host replay, cause-coded
+        e, r = make_engine(["files:a#owner@alice"])
+        res, trace = e.explain_check(
+            RelationTuple("files", "zzz", "owner", subject_id="nobody")
+        )
+        assert res.allowed is False
+        assert trace["tier"] == "host"
+        assert trace["cause"] == "unindexed"
+
+    def test_stage_ms_and_launch_ids_present(self):
+        e, r = make_engine(["files:a#owner@alice"])
+        _res, trace = e.explain_check(
+            RelationTuple("files", "a", "owner", subject_id="alice")
+        )
+        assert "device_wait" in trace["stages_ms"]
+        assert trace["launch_ids"], trace
+        assert trace["cache_bypassed"] is True
+
+
+class TestTokenBucket:
+    def test_rate_and_burst(self):
+        from keto_tpu.resilience import TokenBucket
+
+        clock = [0.0]
+        b = TokenBucket(2.0, burst=2.0, clock=lambda: clock[0])
+        assert b.try_take() == (True, 0.0)
+        assert b.try_take() == (True, 0.0)
+        ok, retry = b.try_take()
+        assert not ok and retry == pytest.approx(0.5)
+        clock[0] += 0.5
+        assert b.try_take()[0] is True
+
+    def test_admit_explain_sheds_typed_429(self):
+        from keto_tpu.errors import OverloadedError
+        from keto_tpu.registry import Registry
+        from keto_tpu.resilience import TokenBucket, admit_explain
+
+        reg = Registry(Config({"dsn": "memory"}))
+        reg._explain_limiter = TokenBucket(0.001, burst=1.0)
+        admit_explain(reg)  # the one burst token
+        with pytest.raises(OverloadedError) as ei:
+            admit_explain(reg)
+        assert ei.value.status == 429
+        assert ei.value.retry_after_s > 0
+
+
+class TestVocabTrace:
+    def test_shape_matches_decision_trace_keys(self):
+        vt = vocab_trace(3, "tok", "namespace_not_found")
+        assert vt["tier"] == "vocab" and vt["allowed"] is False
+        # canonical encoding round-trips
+        assert json.loads(canonical_json(vt)) == vt
+
+
+# -- serve surface -------------------------------------------------------------
+
+SERVE_NS = [
+    {"name": "videos", "relations": [{"name": "owner"}]},
+    {"name": "groups", "relations": [{"name": "member"}]},
+]
+
+SERVE_TUPLES = [
+    "videos:v1#owner@(groups:eng#member)",
+    "groups:eng#member@alice",
+]
+
+
+@pytest.fixture(scope="module")
+def daemon():
+    from keto_tpu.api.daemon import Daemon
+    from keto_tpu.registry import Registry
+
+    cfg = Config({
+        "dsn": "memory",
+        "check": {"engine": "tpu"},  # cache ON: the bypass is under test
+        "tracing": {"enabled": True, "provider": "memory"},
+        "serve": {
+            "read": {"host": "127.0.0.1", "port": 0,
+                     "grpc": {"host": "127.0.0.1", "port": 0, "aio": True}},
+            "write": {"host": "127.0.0.1", "port": 0},
+            "metrics": {"host": "127.0.0.1", "port": 0},
+        },
+        "namespaces": SERVE_NS,
+    })
+    reg = Registry(cfg)
+    reg.relation_tuple_manager().write_relation_tuples(
+        [RelationTuple.from_string(s) for s in SERVE_TUPLES]
+    )
+    d = Daemon(reg)
+    d.start()
+    yield d
+    d.stop()
+
+
+def _rest(port, path):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}"
+        ) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        raw = e.read()
+        return e.code, json.loads(raw) if raw else None, dict(e.headers)
+
+
+CHECK_QS = "namespace=videos&object=v1&relation=owner&subject_id=alice"
+
+
+def _deterministic(trace: dict) -> dict:
+    """The parity view: everything except the per-evaluation timing/
+    launch measurements (each plane's explain is its own ride)."""
+    out = dict(trace)
+    out.pop("stages_ms", None)
+    out.pop("launch_ids", None)
+    return out
+
+
+class TestExplainServeSurface:
+    def test_triplane_canonical_parity(self, daemon):
+        from keto_tpu.api import ReadClient, open_channel
+
+        status, body, _ = _rest(
+            daemon.read_port,
+            f"/relation-tuples/check/openapi?{CHECK_QS}&explain=true",
+        )
+        assert status == 200 and body["allowed"] is True
+        rest_trace = body["decision_trace"]
+        assert rest_trace["tier"] in ("device", "closure")
+        assert rest_trace["snaptoken"]
+
+        t = RelationTuple("videos", "v1", "owner", subject_id="alice")
+        rc = ReadClient(open_channel(f"127.0.0.1:{daemon.read_port}"))
+        arc = ReadClient(open_channel(f"127.0.0.1:{daemon.read_grpc_port}"))
+        try:
+            g = rc.check_explain(t)
+            a = arc.check_explain(t)
+        finally:
+            rc.close()
+            arc.close()
+        assert g.allowed is True and a.allowed is True
+        # canonical-byte parity over the deterministic fields
+        assert (
+            canonical_json(_deterministic(rest_trace))
+            == canonical_json(_deterministic(g.decision_trace))
+            == canonical_json(_deterministic(a.decision_trace))
+        )
+        # every plane carried the full key set, stages included
+        for tr in (rest_trace, g.decision_trace, a.decision_trace):
+            assert "stages_ms" in tr and "launch_ids" in tr
+
+    def test_plain_check_unchanged(self, daemon):
+        from keto_tpu.api import ReadClient, open_channel
+        from keto_tpu.api.descriptors import pb
+        from keto_tpu.api.messages import tuple_to_proto
+
+        status, body, _ = _rest(
+            daemon.read_port, f"/relation-tuples/check/openapi?{CHECK_QS}"
+        )
+        assert status == 200 and body == {"allowed": True}
+        rc = ReadClient(open_channel(f"127.0.0.1:{daemon.read_port}"))
+        try:
+            req = pb.CheckRequest()
+            req.tuple.CopyFrom(tuple_to_proto(
+                RelationTuple("videos", "v1", "owner", subject_id="alice")
+            ))
+            resp = rc._rpc(
+                "ory.keto.relation_tuples.v1alpha2.CheckService", "Check",
+                req, pb.CheckResponse, 5,
+            )
+            assert resp.decision_trace == ""  # absent unless requested
+        finally:
+            rc.close()
+
+    def test_explain_bypasses_check_cache(self, daemon):
+        from keto_tpu.api import ReadClient, open_channel
+
+        reg = daemon.registry
+        cache = reg.check_cache()
+        assert cache is not None
+        t = RelationTuple("videos", "v1", "owner", subject_id="alice")
+        rc = ReadClient(open_channel(f"127.0.0.1:{daemon.read_port}"))
+        try:
+            rc.check(t)  # prime the cache
+            rc.check(t)  # a plain repeat hits
+            hits_before = cache.counts["hit"]
+            out = rc.check_explain(t)
+            assert out.decision_trace["cache_bypassed"] is True
+            assert out.decision_trace["tier"] != "cache"
+            assert cache.counts["hit"] == hits_before  # no cache consult
+        finally:
+            rc.close()
+
+    def test_rate_limit_typed_429_rest_and_grpc(self, daemon):
+        import grpc
+
+        from keto_tpu.api import ReadClient, open_channel
+        from keto_tpu.resilience import TokenBucket
+
+        reg = daemon.registry
+        original = reg.explain_limiter()
+        reg._explain_limiter = TokenBucket(0.001, burst=1.0)
+        try:
+            status, body, headers = _rest(
+                daemon.read_port,
+                f"/relation-tuples/check/openapi?{CHECK_QS}&explain=true",
+            )
+            assert status == 200  # the burst token
+            status, body, headers = _rest(
+                daemon.read_port,
+                f"/relation-tuples/check/openapi?{CHECK_QS}&explain=true",
+            )
+            assert status == 429
+            assert "Retry-After" in headers
+            assert body["error"]["code"] == 429
+            shed = reg.metrics().requests_shed_total.labels(
+                "explain_rate"
+            )._value.get()
+            assert shed >= 1
+            rc = ReadClient(open_channel(f"127.0.0.1:{daemon.read_port}"))
+            try:
+                with pytest.raises(grpc.RpcError) as ei:
+                    rc.check_explain(
+                        RelationTuple("videos", "v1", "owner",
+                                      subject_id="alice")
+                    )
+                assert ei.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+            finally:
+                rc.close()
+        finally:
+            reg._explain_limiter = original
+
+    def test_unknown_namespace_rest_explains_vocab_tier(self, daemon):
+        status, body, _ = _rest(
+            daemon.read_port,
+            "/relation-tuples/check/openapi?namespace=nope&object=x"
+            "&relation=y&subject_id=alice&explain=true",
+        )
+        assert status == 200 and body["allowed"] is False
+        assert body["decision_trace"]["tier"] == "vocab"
+
+    def test_explain_rides_the_callers_trace(self, daemon):
+        """The explain evaluation must JOIN the request's trace, not
+        mint an orphan: engine spans under the transport root, the
+        flight-recorder entry carrying the caller's trace id, and the
+        trace's launch ids resolving to ring entries — the
+        metrics->trace->flightrec joins the plane exists for."""
+        from keto_tpu.observability import new_trace
+
+        ctx = new_trace()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{daemon.read_port}"
+            f"/relation-tuples/check/openapi?{CHECK_QS}&explain=true",
+            headers={"traceparent": ctx.to_traceparent()},
+        )
+        with urllib.request.urlopen(req) as r:
+            body = json.loads(r.read())
+        trace = body["decision_trace"]
+        assert trace["launch_ids"], trace
+        spans = daemon.registry.tracer().spans_for_trace(ctx.trace_id)
+        names = {s.name for s in spans}
+        assert any(n.startswith("engine.") for n in names), names
+        fr = daemon.registry.flight_recorder()
+        mine = [
+            e for e in fr.entries()
+            if e.get("launch_id") in trace["launch_ids"]
+        ]
+        assert mine, "explain launch ids must resolve to ring entries"
+        assert any(
+            ctx.trace_id in (e.get("trace_ids") or ()) for e in mine
+        ), mine
+
+    def test_explain_counter_counts(self, daemon):
+        before = daemon.registry.metrics().explain_requests_total._value.get()
+        status, _body, _ = _rest(
+            daemon.read_port,
+            f"/relation-tuples/check/openapi?{CHECK_QS}&explain=true",
+        )
+        assert status == 200
+        after = daemon.registry.metrics().explain_requests_total._value.get()
+        assert after == before + 1
+
+    def test_post_body_explain_flag(self, daemon):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{daemon.read_port}"
+            "/relation-tuples/check/openapi",
+            data=json.dumps({
+                "namespace": "videos", "object": "v1", "relation": "owner",
+                "subject_id": "alice", "explain": True,
+            }).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req) as r:
+            body = json.loads(r.read())
+        assert body["allowed"] is True
+        assert body["decision_trace"]["witness"]
+
+    def test_openapi_advertises_explain(self, daemon):
+        _status, spec, _ = _rest(
+            daemon.read_port, "/.well-known/openapi.json"
+        )
+        assert "decisionTrace" in spec["components"]["schemas"]
+        params = spec["paths"]["/relation-tuples/check"]["get"]["parameters"]
+        assert any(p.get("name") == "explain" for p in params)
+
+    def test_cli_explain(self, daemon, capsys):
+        from keto_tpu.cli import main
+
+        code = main([
+            "check", "alice", "owner", "videos", "v1", "--explain",
+            "--read-remote", f"127.0.0.1:{daemon.read_port}",
+            "--format", "json",
+        ])
+        out = capsys.readouterr().out
+        assert code == 0
+        parsed = json.loads(out)
+        assert parsed["allowed"] is True
+        assert parsed["decision_trace"]["witness"]
+
+
+class TestExplainProtoSurface:
+    def test_fields_exist_and_stay_off_the_wire_unless_set(self):
+        from keto_tpu.api.descriptors import pb
+
+        assert pb.CheckRequest().SerializeToString() == b""
+        req = pb.CheckRequest(explain=True)
+        assert req.explain is True
+        # proto3 default-false explain stays absent: old clients'
+        # requests are byte-identical to pre-explain builds
+        req2 = pb.CheckRequest(explain=False)
+        assert req2.SerializeToString() == b""
+        resp = pb.CheckResponse(allowed=True)
+        assert resp.decision_trace == ""

@@ -355,6 +355,9 @@ def _daemon_config(aio=False):
         grpc_listener["aio"] = True
     return Config({
         "dsn": "memory",
+        # memory tracer: the traceparent-propagation test reads the
+        # filter ride's spans back by trace id
+        "tracing": {"enabled": True, "provider": "memory"},
         "serve": {
             "read": {
                 "host": "127.0.0.1", "port": 0, "grpc": grpc_listener,
@@ -586,3 +589,70 @@ class TestFilterAPI:
         assert "/relation-tuples/filter" in spec["paths"]
         op = spec["paths"]["/relation-tuples/filter"]["post"]
         assert op["operationId"] == "postFilter"
+
+
+class TestFilterTraceparent:
+    """W3C traceparent propagation through the BatchFilter path — the
+    §5m acceptance hole: previously asserted only in smokes, now tier-1.
+    A traceparent-carrying REST filter yields correlated spans for the
+    transport root AND the engine's filter evaluation under ONE trace id
+    (the engine spans inherit CURRENT_TRACE; the flight recorder's
+    filter-kind entries carry the same id)."""
+
+    TUPLES = [
+        "videos:v1#owner@alice",
+        "videos:v2#owner@alice",
+        "videos:v3#owner@bob",
+    ]
+
+    def test_rest_filter_joins_caller_trace(self, daemons):
+        from keto_tpu.observability import new_trace
+
+        sync_d, _ = daemons
+        _seed(sync_d, self.TUPLES)
+        ctx = new_trace()
+        status, body, _ = http(
+            "POST", sync_d.read_port, "/relation-tuples/filter",
+            body={"namespace": "videos", "relation": "owner",
+                  "subject_id": "alice", "objects": ["v1", "v2", "v3"]},
+            headers={"traceparent": ctx.to_traceparent()},
+        )
+        assert status == 200
+        assert body["allowed_objects"] == ["v1", "v2"]
+        spans = sync_d.registry.tracer().spans_for_trace(ctx.trace_id)
+        names = {s.name for s in spans}
+        assert any(
+            n.startswith("http.POST /relation-tuples/filter")
+            for n in names
+        ), names
+        assert any(n.startswith("engine.filter") for n in names), names
+        # every span of the ride shares the caller's trace id, and the
+        # transport span is the ROOT (it carries the request's span id,
+        # so the engine spans parent-link to it)
+        root = [s for s in spans if s.name.startswith("http.")][0]
+        children = [s for s in spans if not s.name.startswith("http.")]
+        assert children and all(
+            s.attrs.get("parent_span_id") == root.attrs["span_id"]
+            for s in children
+        )
+
+    def test_filter_launch_entries_carry_trace_id(self, daemons):
+        from keto_tpu.observability import new_trace
+
+        sync_d, _ = daemons
+        _seed(sync_d, self.TUPLES)
+        ctx = new_trace()
+        status, _body, _ = http(
+            "POST", sync_d.read_port, "/relation-tuples/filter",
+            body={"namespace": "videos", "relation": "owner",
+                  "subject_id": "alice", "objects": ["v1", "v3"]},
+            headers={"traceparent": ctx.to_traceparent()},
+        )
+        assert status == 200
+        fr = sync_d.registry.flight_recorder()
+        mine = [
+            e for e in fr.entries()
+            if ctx.trace_id in (e.get("trace_ids") or ())
+        ]
+        assert mine, "the filter launch must join the caller's trace"
+        assert all(e["kind"].startswith("filter") for e in mine)

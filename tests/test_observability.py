@@ -543,3 +543,282 @@ class TestMetricsDocsGolden:
             capture_output=True, text=True,
         )
         assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# the explain/export plane (§5m): OTLP span exporter, exemplars,
+# request-log sampling, flight-recorder filters
+# ---------------------------------------------------------------------------
+
+
+class _StubCollector:
+    """Stdlib OTLP collector stand-in: records every POSTed JSON body."""
+
+    def __init__(self):
+        import threading
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        received = self.received = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                received.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.srv = HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+
+    @property
+    def endpoint(self):
+        return f"http://127.0.0.1:{self.srv.server_address[1]}/v1/traces"
+
+    def spans(self):
+        out = []
+        for payload in self.received:
+            for rs in payload.get("resourceSpans", ()):
+                for ss in rs.get("scopeSpans", ()):
+                    out.extend(ss.get("spans", ()))
+        return out
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+class TestSpanExporter:
+    def _tracer(self, exporter):
+        from keto_tpu.observability import RecordingTracer
+
+        return RecordingTracer(exporter=exporter)
+
+    def test_exports_wellformed_parent_linked_spans(self):
+        from keto_tpu.observability import SpanExporter, new_trace
+
+        collector = _StubCollector()
+        exp = SpanExporter(collector.endpoint, flush_interval_s=0.02)
+        try:
+            tracer = self._tracer(exp)
+            ctx = new_trace().child()  # like a transport ingesting one
+            with tracer.span("http.test", ctx=ctx, root=True):
+                pass
+            tracer.record(
+                "engine.device_wait", ctx=ctx, duration_s=0.003,
+                launch_id=41,
+            )
+            assert exp.flush(5.0)
+            spans = collector.spans()
+            by_name = {s["name"]: s for s in spans}
+            assert set(by_name) == {"http.test", "engine.device_wait"}
+            root = by_name["http.test"]
+            child = by_name["engine.device_wait"]
+            assert root["traceId"] == child["traceId"] == ctx.trace_id
+            # the root takes the ctx's own span id; the child parents
+            # to it; the root parents to the ORIGINAL caller span
+            assert root["spanId"] == ctx.span_id
+            assert child["parentSpanId"] == ctx.span_id
+            assert root["parentSpanId"] == ctx.parent_span_id
+            # launch ids ride as span events (the flightrec join)
+            ev = child["events"][0]
+            assert ev["name"] == "flightrec.launch"
+            assert ev["attributes"][0]["value"]["intValue"] == "41"
+            # timestamps are real epoch nanos, end >= start
+            assert int(child["endTimeUnixNano"]) >= int(
+                child["startTimeUnixNano"]
+            )
+            assert exp.stats["exported"] == 2
+        finally:
+            exp.close()
+            collector.close()
+
+    def test_queue_overflow_drops_counted_never_blocks(self):
+        from keto_tpu.observability import (
+            RecordedSpan,
+            SpanExporter,
+        )
+
+        # unroutable endpoint + tiny queue: every POST fails, overflow
+        # drops count, and enqueue stays non-blocking throughout
+        exp = SpanExporter(
+            "http://127.0.0.1:9/v1/traces", queue_size=2,
+            flush_interval_s=30.0, post_timeout_s=0.2,
+        )
+        try:
+            t0 = time.perf_counter()
+            results = [
+                exp.enqueue(RecordedSpan("s", {
+                    "trace_id": "ab" * 16, "span_id": "cd" * 8,
+                    "t_mono": time.monotonic(),
+                }))
+                for _ in range(10)
+            ]
+            took = time.perf_counter() - t0
+            assert took < 0.5, "enqueue must never block"
+            assert results.count(False) >= 8  # queue bound 2
+            assert exp.stats["dropped_queue_full"] >= 8
+        finally:
+            exp.close(timeout=0.1)
+
+    def test_post_error_drops_counted(self):
+        from keto_tpu.observability import RecordedSpan, SpanExporter
+
+        exp = SpanExporter(
+            "http://127.0.0.1:9/v1/traces", flush_interval_s=0.02,
+            post_timeout_s=0.2,
+        )
+        try:
+            exp.enqueue(RecordedSpan("s", {
+                "trace_id": "ab" * 16, "span_id": "cd" * 8,
+                "t_mono": time.monotonic(),
+            }))
+            assert exp.flush(5.0)
+            assert exp.stats["dropped_post_error"] == 1
+            assert exp.stats["exported"] == 0
+        finally:
+            exp.close(timeout=0.1)
+
+    def test_endpoint_config_builds_exporting_tracer(self):
+        from keto_tpu.observability import RecordingTracer
+
+        collector = _StubCollector()
+        try:
+            cfg = Config({
+                "dsn": "memory",
+                "observability": {"otlp": {"endpoint": collector.endpoint}},
+            })
+            reg = Registry(cfg)
+            tracer = reg.tracer()
+            assert isinstance(tracer, RecordingTracer)
+            assert tracer.exporter is reg.span_exporter()
+            reg.span_exporter().close(timeout=0.5)
+        finally:
+            collector.close()
+
+    def test_no_endpoint_no_exporter(self):
+        reg = Registry(Config({"dsn": "memory"}))
+        assert reg.span_exporter() is None
+
+
+class TestExemplars:
+    def test_stage_histogram_carries_trace_exemplar(self, daemon):
+        from keto_tpu.observability import new_trace
+
+        ctx = new_trace()
+        client = ReadClient(open_channel(f"127.0.0.1:{daemon.read_port}"))
+        try:
+            client.check(
+                RelationTuple.from_string(TUPLE),
+                traceparent=ctx.to_traceparent(),
+            )
+        finally:
+            client.close()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{daemon.metrics_port}/metrics/prometheus",
+            headers={"Accept": "application/openmetrics-text"},
+        )
+        with urllib.request.urlopen(req) as r:
+            assert "openmetrics" in r.headers["Content-Type"]
+            text = r.read().decode()
+        exemplar_lines = [
+            line for line in text.splitlines()
+            if "keto_tpu_check_stage_duration_seconds_bucket" in line
+            and "# {" in line and "trace_id=" in line
+        ]
+        assert exemplar_lines, "stage buckets must carry trace exemplars"
+        # the classic exposition stays the default (no exemplars there)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{daemon.metrics_port}/metrics/prometheus"
+        ) as r:
+            classic = r.read().decode()
+        assert "# {" not in classic
+
+
+class TestRequestLogSampling:
+    def _one_check(self, daemon):
+        client = ReadClient(open_channel(f"127.0.0.1:{daemon.read_port}"))
+        try:
+            client.check(RelationTuple.from_string(TUPLE))
+        finally:
+            client.close()
+
+    def test_default_rate_is_one_every_request_logged(self, daemon, caplog):
+        # schema default 1.0 pinned: with the key unset, the INFO line
+        # emits unconditionally (exactly the pre-sampling behavior)
+        assert daemon.registry.config.get("log.request_sample_rate") is None
+        with caplog.at_level(logging.INFO, logger="keto_tpu"):
+            self._one_check(daemon)
+        assert any(
+            r.getMessage() == "request handled" for r in caplog.records
+        )
+
+    def test_rate_zero_suppresses_info_keeps_slow_warning(
+        self, daemon, caplog
+    ):
+        daemon.registry.config.set("log.request_sample_rate", 0.0)
+        daemon.registry.config.set("log.slow_query_ms", 0)
+        try:
+            with caplog.at_level(logging.INFO, logger="keto_tpu"):
+                self._one_check(daemon)
+            assert not any(
+                r.getMessage() == "request handled"
+                and getattr(r, "transport", "") == "grpc"
+                for r in caplog.records
+            )
+            # the slow-query WARNING always emits — sampling must never
+            # swallow incident evidence
+            assert any(
+                r.getMessage().startswith("slow request")
+                for r in caplog.records
+            )
+        finally:
+            daemon.registry.config.set("log.request_sample_rate", 1.0)
+            daemon.registry.config.set("log.slow_query_ms", None)
+
+    def test_rate_validates_in_schema(self):
+        Config({"log": {"request_sample_rate": 0.25}})
+        with pytest.raises(ConfigError):
+            Config({"log": {"request_sample_rate": 1.5}})
+
+
+class TestFlightrecFilters:
+    def _dump(self, daemon, query=""):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{daemon.metrics_port}/admin/flightrec{query}"
+        ) as r:
+            return json.loads(r.read())
+
+    def test_kind_and_trace_id_filters(self, daemon):
+        from keto_tpu.observability import new_trace
+
+        ctx = new_trace()
+        client = ReadClient(open_channel(f"127.0.0.1:{daemon.read_port}"))
+        try:
+            client.check(
+                RelationTuple.from_string(TUPLE),
+                traceparent=ctx.to_traceparent(),
+            )
+            client.check(RelationTuple.from_string(TUPLE))
+        finally:
+            client.close()
+        full = self._dump(daemon)
+        assert full["entries"], "ring must hold the check launches"
+        by_kind = self._dump(daemon, "?kind=check")
+        assert by_kind["entries"]
+        assert all(e["kind"] == "check" for e in by_kind["entries"])
+        none_kind = self._dump(daemon, "?kind=filter")
+        assert none_kind["entries"] == []
+        by_trace = self._dump(daemon, f"?trace_id={ctx.trace_id}")
+        assert by_trace["entries"], "trace filter must find the ride"
+        assert all(
+            ctx.trace_id in e["trace_ids"] for e in by_trace["entries"]
+        )
+        # filters compose
+        both = self._dump(daemon, f"?kind=check&trace_id={ctx.trace_id}")
+        assert {e["launch_id"] for e in both["entries"]} == {
+            e["launch_id"] for e in by_trace["entries"]
+        }

@@ -663,3 +663,57 @@ class TestPartialFaults:
             assert spec.probability == 0.25
         finally:
             faults.clear()
+
+
+class TestHedgeTraceparentJoin(TestHedgedRide):
+    """Traceparent propagation through the hedge ride (§5m acceptance
+    hole: previously asserted only in replica_smoke, now tier-1): the
+    hedge duplicate carries a CHILD RequestTrace — same trace id as the
+    caller's ingested traceparent, a fresh span id parented to the
+    caller's request span — and its launch ids merge back onto the
+    caller's trace whatever the outcome."""
+
+    def test_hedge_ride_child_trace_joins_parent(self):
+        from keto_tpu.observability import RequestTrace, parse_traceparent
+
+        engine = _StallOnceEngine(0.8)
+        reg, group = self._group(engine)
+        try:
+            for _ in range(HedgePolicy.WARMUP):
+                group.hedge.observe(0.005)
+            captured = []
+            for w in group.workers:
+                orig = w.batcher.submit
+
+                def wrapped(tuple, max_depth=0, nid=None, rt=None,
+                            _orig=orig, _w=w):
+                    captured.append((_w, rt))
+                    if rt is not None:
+                        # stand-in for the engine stamping a launch id
+                        # on this ride (the stub engine records none)
+                        rt.launch_ids.append(9000 + len(captured))
+                    return _orig(tuple, max_depth, nid=nid, rt=rt)
+
+                w.batcher.submit = wrapped
+            caller_ctx = parse_traceparent(
+                "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+            )
+            rt = RequestTrace(caller_ctx.child())
+            res, _ver = _hedged_ride(
+                group, group.workers[0], FIXTURE[0], 0, None, rt
+            )
+            assert res.allowed is True
+            assert len(captured) == 2, "primary + hedge must both submit"
+            (_, primary_rt), (_, hedge_rt) = captured
+            assert primary_rt is rt
+            assert hedge_rt is not rt
+            # child trace: SAME trace id, fresh span id, parented to
+            # the caller's request span
+            assert hedge_rt.ctx.trace_id == rt.ctx.trace_id == "ab" * 16
+            assert hedge_rt.ctx.span_id != rt.ctx.span_id
+            assert hedge_rt.ctx.parent_span_id == rt.ctx.span_id
+            # the hedge ride's launch ids merged onto the caller's
+            # trace: one trace id joins BOTH rides' flightrec entries
+            assert 9002 in rt.launch_ids
+        finally:
+            self._teardown(group)

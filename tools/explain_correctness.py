@@ -1,0 +1,295 @@
+#!/usr/bin/env python
+"""Explain-plane correctness smoke (CI) + the hot-path A/B artifact.
+
+Differential contract, enforced with zero tolerated mismatches across
+memory AND sqlite stores under write churn:
+
+  - every engine verdict (explain path) equals the exact host oracle;
+  - every ALLOW's witness path replays step-by-step through the store
+    (engine/explain.replay_witness) to the same verdict;
+  - every DENY's exhaustion summary equals an independent oracle walk;
+  - witness_consistent holds on every quiet-store explain (the tool is
+    single-threaded: no witness_racy excuses here);
+  - graph families: random, deep-20 chain, cycles, AND/NOT islands —
+    the acceptance list.
+
+`--artifact out.json` additionally measures the hot-path cost of the
+explain plumbing and the explain slow path itself:
+
+  - flat check_batch throughput with the sink plumbing DORMANT (sink
+    None — the serving hot path as shipped) vs ACTIVE (a live per-item
+    sink list), per-call alternated medians: the dormant-vs-active
+    ratio bounds the plumbing's cost from ABOVE (pre-PR code is the
+    dormant path minus one dict-get per resolve), and the acceptance
+    bar is 2%;
+  - explain_check per-call ms (the documented slow path);
+  - the committed same-backend baseline's flat qps as a cross-run
+    reference (ratio reported, not gated — different boxes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import statistics
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from keto_tpu.config import Config  # noqa: E402
+from keto_tpu.engine.explain import replay_witness  # noqa: E402
+from keto_tpu.engine.reference import ReferenceEngine  # noqa: E402
+from keto_tpu.engine.tpu_engine import TPUCheckEngine  # noqa: E402
+from keto_tpu.ketoapi import RelationQuery, RelationTuple  # noqa: E402
+from keto_tpu.namespace import Namespace  # noqa: E402
+from keto_tpu.namespace.ast import (  # noqa: E402
+    ComputedSubjectSet,
+    InvertResult,
+    Operator,
+    Relation,
+    SubjectSetRewrite,
+)
+
+NID = "default"
+
+NAMESPACES = [
+    Namespace(name="files"),
+    Namespace(name="groups"),
+    Namespace(name="acl", relations=[
+        Relation(name="allow"),
+        Relation(name="deny"),
+        Relation(name="access", subject_set_rewrite=SubjectSetRewrite(
+            operation=Operator.AND,
+            children=[
+                ComputedSubjectSet(relation="allow"),
+                InvertResult(child=ComputedSubjectSet(relation="deny")),
+            ])),
+    ]),
+]
+
+CHECKED = {"checks": 0, "allows": 0, "denies": 0, "replays": 0}
+
+
+def _manager(kind: str, tmpdir: str):
+    if kind == "memory":
+        from keto_tpu.storage.memory import MemoryManager
+
+        return MemoryManager()
+    from keto_tpu.storage.sqlite import SQLPersister
+
+    return SQLPersister(f"sqlite://{tmpdir}/explain_{id(tmpdir)}.db")
+
+
+def _graph_families(rng: random.Random):
+    """[(name, tuples, queries)] — the acceptance graph list."""
+    fams = []
+    # random group/file graphs
+    groups = [f"g{i}" for i in range(8)]
+    users = ["u1", "u2", "u3", "ghost"]
+    tuples = set()
+    for g in groups:
+        for u in users[:3]:
+            if rng.random() < 0.3:
+                tuples.add(f"groups:{g}#member@{u}")
+        other = rng.choice(groups)
+        if other != g and rng.random() < 0.6:
+            tuples.add(f"groups:{g}#member@(groups:{other}#member)")
+    for i in range(6):
+        tuples.add(f"files:f{i}#owner@(groups:{rng.choice(groups)}#member)")
+    queries = [
+        RelationTuple("files", f"f{i}", "owner", subject_id=u)
+        for i in range(6) for u in users
+    ]
+    fams.append(("random", sorted(tuples), queries))
+    # deep-20 chain
+    chain = ["groups:c0#member@u1"] + [
+        f"groups:c{i}#member@(groups:c{i - 1}#member)" for i in range(1, 21)
+    ]
+    fams.append(("deep20", chain, [
+        RelationTuple("groups", "c20", "member", subject_id=u)
+        for u in ("u1", "u2")
+    ]))
+    # cycle
+    fams.append(("cycle", [
+        "groups:a#member@(groups:b#member)",
+        "groups:b#member@(groups:a#member)",
+        "groups:b#member@u1",
+    ], [
+        RelationTuple("groups", g, "member", subject_id=u)
+        for g in ("a", "b") for u in ("u1", "u2")
+    ]))
+    # AND/NOT islands
+    fams.append(("islands", [
+        "acl:d1#allow@u1", "acl:d2#allow@u1", "acl:d2#deny@u1",
+    ], [
+        RelationTuple("acl", d, "access", subject_id=u)
+        for d in ("d1", "d2") for u in ("u1", "u2")
+    ]))
+    return fams
+
+
+def _assert(cond, msg):
+    if not cond:
+        print(f"explain_correctness: FAIL — {msg}")
+        sys.exit(1)
+
+
+def _check_one(engine, oracle, manager, t):
+    res, trace = engine.explain_check(t)
+    want = oracle.check_relation_tuple(t, 0, NID)
+    CHECKED["checks"] += 1
+    if want.error is not None:
+        _assert(res.error is not None, f"error parity at {t}")
+        return
+    _assert(res.error is None, f"unexpected error at {t}: {res.error}")
+    _assert(
+        res.allowed == want.allowed,
+        f"verdict mismatch at {t}: engine={res.allowed} oracle={want.allowed}",
+    )
+    _assert(
+        trace["witness_consistent"],
+        f"witness inconsistent on a quiet store at {t}: {trace}",
+    )
+    if res.allowed:
+        CHECKED["allows"] += 1
+        _assert(trace["witness"], f"ALLOW without witness at {t}")
+        _assert(
+            replay_witness(manager, t, trace["witness"], NID),
+            f"witness replay failed at {t}: {trace['witness']}",
+        )
+        CHECKED["replays"] += 1
+    else:
+        CHECKED["denies"] += 1
+        walk = oracle.explain_check(t, 0, NID)
+        _assert(
+            trace["exhaustion"] == walk["exhaustion"],
+            f"exhaustion mismatch at {t}: {trace['exhaustion']} "
+            f"vs {walk['exhaustion']}",
+        )
+
+
+def run_store(kind: str, tmpdir: str):
+    rng = random.Random(14)
+    manager = _manager(kind, tmpdir)
+    cfg = Config({"limit": {"max_read_depth": 25}})
+    cfg.set_namespaces(NAMESPACES)
+    for name, tuples, queries in _graph_families(rng):
+        manager.delete_all_relation_tuples(RelationQuery(), nid=NID)
+        manager.write_relation_tuples(
+            [RelationTuple.from_string(s) for s in tuples], nid=NID
+        )
+        engine = TPUCheckEngine(manager, cfg)
+        oracle = ReferenceEngine(manager, cfg, visited_pruning=False)
+        for t in queries:
+            _check_one(engine, oracle, manager, t)
+        # churn: delete/re-add an edge mid-family, re-verify everything
+        victim = RelationTuple.from_string(tuples[0])
+        manager.delete_relation_tuples([victim], nid=NID)
+        for t in queries:
+            _check_one(engine, oracle, manager, t)
+        manager.write_relation_tuples([victim], nid=NID)
+        for t in queries:
+            _check_one(engine, oracle, manager, t)
+        print(f"explain_correctness: {kind}/{name} ok")
+    close = getattr(manager, "close", None)
+    if close:
+        close()
+
+
+AB_CALLS = 40
+AB_BATCH = 256
+
+
+def measure_artifact() -> dict:
+    """The hot-path A/B: flat check_batch with the explain sink DORMANT
+    vs ACTIVE, per-call alternated medians over identical batches."""
+    from keto_tpu.storage.memory import MemoryManager
+
+    rng = random.Random(7)
+    manager = MemoryManager()
+    users = [f"u{i}" for i in range(64)]
+    tuples = [
+        RelationTuple("files", f"f{i}", "owner",
+                      subject_id=rng.choice(users))
+        for i in range(2048)
+    ]
+    manager.write_relation_tuples(tuples, nid=NID)
+    cfg = Config({"limit": {"max_read_depth": 8}})
+    cfg.set_namespaces(NAMESPACES)
+    engine = TPUCheckEngine(manager, cfg)
+    batch = [
+        RelationTuple("files", f"f{rng.randrange(2048)}", "owner",
+                      subject_id=rng.choice(users))
+        for _ in range(AB_BATCH)
+    ]
+    engine.check_batch(batch)  # compile + state build outside the clock
+    dormant, active = [], []
+    for i in range(AB_CALLS * 2):
+        sink = None if i % 2 == 0 else [None] * AB_BATCH
+        t0 = time.perf_counter()
+        handle = engine.check_batch_submit(batch, explain_sink=sink)
+        engine.check_batch_resolve(handle)
+        dt = time.perf_counter() - t0
+        (dormant if sink is None else active).append(dt)
+    m_dormant = statistics.median(dormant)
+    m_active = statistics.median(active)
+    t0 = time.perf_counter()
+    for t in batch[:20]:
+        engine.explain_check(t)
+    explain_ms = (time.perf_counter() - t0) / 20 * 1e3
+    flat_qps = AB_BATCH / m_dormant
+    record = {
+        "metric": "explain_ab",
+        "ab_calls_per_arm": AB_CALLS,
+        "batch": AB_BATCH,
+        "flat_qps_sink_dormant": round(flat_qps, 1),
+        "flat_qps_sink_active": round(AB_BATCH / m_active, 1),
+        "sink_active_vs_dormant": round(m_active / m_dormant, 4),
+        "explain_check_per_call_ms": round(explain_ms, 3),
+        "device": "cpu",
+    }
+    baseline_path = os.path.join(REPO, "BENCH_r10_cpu.json")
+    if os.path.exists(baseline_path):
+        base = json.load(open(baseline_path))
+        record["baseline_flat_qps_bench_r10"] = base.get("value")
+        if base.get("value"):
+            record["vs_baseline_cross_run"] = round(
+                flat_qps / base["value"], 3
+            )
+    _assert(
+        record["sink_active_vs_dormant"] <= 1.02
+        or m_active - m_dormant < 0.0005,
+        f"explain plumbing cost over the 2% bar: {record}",
+    )
+    return record
+
+
+def main() -> int:
+    artifact_path = None
+    if "--artifact" in sys.argv:
+        artifact_path = sys.argv[sys.argv.index("--artifact") + 1]
+    with tempfile.TemporaryDirectory() as tmpdir:
+        for kind in ("memory", "sqlite"):
+            run_store(kind, tmpdir)
+    print(f"explain_correctness: differential totals {CHECKED}")
+    _assert(CHECKED["allows"] > 0 and CHECKED["denies"] > 0,
+            "degenerate suite: need both verdicts exercised")
+    if artifact_path:
+        record = measure_artifact()
+        with open(artifact_path, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"explain_correctness: artifact -> {artifact_path}")
+        print(json.dumps(record))
+    print("explain_correctness: ALL GREEN")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

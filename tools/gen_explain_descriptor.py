@@ -1,0 +1,86 @@
+"""Add the explain fields to keto.proto inside keto_descriptors.binpb.
+
+The build image ships no protoc, so the §5m explain surface — a
+`bool explain = 9` request flag on CheckRequest and a
+`string decision_trace = 3` on CheckResponse carrying the canonical-JSON
+DecisionTrace — is patched into the CHECKED-IN descriptor set
+programmatically (the gen_filter_descriptor.py family's approach applied
+to an existing file instead of a new one). Both additions are
+wire-compatible proto3 extensions: new field numbers, absent from the
+wire unless set, so existing clients and the reference's own stubs are
+byte-unaffected. Idempotent — re-running after the fields exist is a
+no-op. Run from the repo root:
+
+    python tools/gen_explain_descriptor.py
+
+Keep keto_tpu/api/protos/keto.proto (the human-readable contract) in
+sync by hand; tests/test_explain.py pins the runtime fields.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+
+from google.protobuf import descriptor_pb2
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent
+_BINPB = _REPO / "keto_tpu" / "api" / "protos" / "keto_descriptors.binpb"
+
+_BOOL = descriptor_pb2.FieldDescriptorProto.TYPE_BOOL
+_STR = descriptor_pb2.FieldDescriptorProto.TYPE_STRING
+_OPT = descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+
+# (message, field name, number, type) — numbers chosen past every field
+# the reference's v1alpha2 proto declares today
+_ADDITIONS = (
+    ("CheckRequest", "explain", 9, _BOOL),
+    ("CheckResponse", "decision_trace", 3, _STR),
+)
+
+
+def _patch(fd: descriptor_pb2.FileDescriptorProto) -> int:
+    patched = 0
+    by_name = {m.name: m for m in fd.message_type}
+    for msg_name, fname, number, ftype in _ADDITIONS:
+        msg = by_name.get(msg_name)
+        if msg is None:
+            raise SystemExit(f"message {msg_name} not found in {fd.name}")
+        existing = {f.name for f in msg.field}
+        numbers = {f.number for f in msg.field}
+        if fname in existing:
+            continue  # idempotent
+        if number in numbers:
+            raise SystemExit(
+                f"{msg_name} field number {number} already taken"
+            )
+        f = msg.field.add()
+        f.name = fname
+        f.number = number
+        f.type = ftype
+        f.label = _OPT
+        patched += 1
+    return patched
+
+
+def main() -> int:
+    fds = descriptor_pb2.FileDescriptorSet()
+    fds.ParseFromString(_BINPB.read_bytes())
+    patched = 0
+    for fd in fds.file:
+        if fd.name == "keto.proto":
+            patched = _patch(fd)
+            break
+    else:
+        raise SystemExit("keto.proto not found in the descriptor set")
+    if patched:
+        _BINPB.write_bytes(fds.SerializeToString())
+    print(
+        f"{'patched' if patched else 'already present'}: "
+        f"{patched} field(s) into keto.proto ({_BINPB})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

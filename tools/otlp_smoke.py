@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""OTLP export smoke (CI): a stdlib stub collector receives well-formed
+OTLP/HTTP-JSON for a served check; exporter-queue overflow drops are
+counted without blocking; export on vs off leaves request latency
+within the 2% bar.
+
+Four scenarios against one real daemon:
+
+  1. TRACE CORRECTNESS — a traceparent-carrying check + explain ride
+     produce, at the stub collector, a parent-linked multi-span trace
+     under the CALLER's trace id: transport roots (parented to the
+     caller's span), batcher.queue, >=3 engine stages with
+     flight-recorder launch ids attached as `flightrec.launch` span
+     EVENTS, and persistence store-op spans (the explain ride's host
+     witness walk reads the store on the request thread).
+  2. EXEMPLARS — /metrics/prometheus served with the OpenMetrics Accept
+     header carries a trace_id exemplar on the check-stage histogram.
+  3. OVERFLOW — a bounded exporter against a dead endpoint: enqueue
+     never blocks, drops land in keto_tpu_otlp_dropped_total.
+  4. LATENCY A/B — per-call-alternated export on/off over the SAME
+     served endpoint (the exporter detached/reattached between calls):
+     median-on vs median-off within 2%.
+
+Exit 0 = all green. CPU-only, memory store, no external deps.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from keto_tpu.api import ReadClient, open_channel  # noqa: E402
+from keto_tpu.api.daemon import Daemon  # noqa: E402
+from keto_tpu.config import Config  # noqa: E402
+from keto_tpu.ketoapi import RelationTuple  # noqa: E402
+from keto_tpu.observability import new_trace  # noqa: E402
+from keto_tpu.registry import Registry  # noqa: E402
+
+NAMESPACES = [
+    {"name": "videos", "relations": [{"name": "owner"}]},
+    {"name": "groups", "relations": [{"name": "member"}]},
+]
+TUPLES = [
+    "videos:v1#owner@(groups:eng#member)",
+    "groups:eng#member@alice",
+]
+AB_CALLS_PER_ARM = 300
+AB_BAR = 1.02
+
+
+class StubCollector:
+    def __init__(self):
+        received = self.received = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                received.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.srv = HTTPServer(("127.0.0.1", 0), Handler)
+        threading.Thread(target=self.srv.serve_forever, daemon=True).start()
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.srv.server_address[1]}/v1/traces"
+
+    def spans(self) -> list:
+        out = []
+        for payload in self.received:
+            for rs in payload.get("resourceSpans", ()):
+                for ss in rs.get("scopeSpans", ()):
+                    out.extend(ss.get("spans", ()))
+        return out
+
+    def resource_attrs(self) -> dict:
+        for payload in self.received:
+            for rs in payload.get("resourceSpans", ()):
+                return {
+                    a["key"]: a["value"]
+                    for a in rs["resource"]["attributes"]
+                }
+        return {}
+
+    def close(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+def require(cond, msg):
+    if not cond:
+        print(f"otlp_smoke: FAIL — {msg}")
+        sys.exit(1)
+    print(f"otlp_smoke: ok — {msg}")
+
+
+def scenario_trace(daemon, collector, client):
+    ctx = new_trace()
+    tp = ctx.to_traceparent()
+    t = RelationTuple("videos", "v1", "owner", subject_id="alice")
+    allowed = client.check(t, traceparent=tp)
+    require(allowed is True, "served check answered")
+    out = client.check_explain(t, traceparent=tp)
+    require(
+        out.decision_trace is not None
+        and out.decision_trace["witness"],
+        "explain ride answered with a witness",
+    )
+    exporter = daemon.registry.span_exporter()
+    require(exporter.flush(10.0), "exporter flushed")
+    spans = [
+        s for s in collector.spans() if s["traceId"] == ctx.trace_id
+    ]
+    names = {s["name"] for s in spans}
+    require(
+        any(n.startswith("grpc.Check") for n in names),
+        f"transport span exported ({sorted(names)})",
+    )
+    require("batcher.queue" in names, "batcher.queue span exported")
+    engine_stages = {n for n in names if n.startswith("engine.")}
+    require(
+        len(engine_stages) >= 3,
+        f"engine stage spans exported ({sorted(engine_stages)})",
+    )
+    require(
+        any(n.startswith("persistence.") for n in names),
+        f"store-op spans exported ({sorted(names)})",
+    )
+    # parent linkage: every root parents to the CALLER's span, every
+    # non-root parents to a root's span id
+    roots = [s for s in spans if s["name"].startswith("grpc.")]
+    require(
+        roots and all(
+            s.get("parentSpanId") == ctx.span_id for s in roots
+        ),
+        "transport roots parent-link to the caller's span",
+    )
+    root_ids = {s["spanId"] for s in roots}
+    inner = [s for s in spans if not s["name"].startswith("grpc.")]
+    require(
+        inner and all(s.get("parentSpanId") in root_ids for s in inner),
+        "inner spans parent-link to their transport root",
+    )
+    events = [
+        e for s in spans for e in s.get("events", ())
+        if e.get("name") == "flightrec.launch"
+    ]
+    require(events, "flight-recorder launch ids present as span events")
+    launch_ids = {
+        int(e["attributes"][0]["value"]["intValue"]) for e in events
+    }
+    ring_ids = {
+        e.get("launch_id")
+        for e in daemon.registry.flight_recorder().entries()
+    }
+    require(
+        launch_ids & ring_ids,
+        "span-event launch ids resolve to flightrec ring entries",
+    )
+    attrs = collector.resource_attrs()
+    require(
+        attrs.get("service.name", {}).get("stringValue") == "keto_tpu"
+        and attrs.get("service.instance.id"),
+        "resource attrs carry service name + instance id",
+    )
+
+
+def scenario_exemplars(daemon):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{daemon.metrics_port}/metrics/prometheus",
+        headers={"Accept": "application/openmetrics-text"},
+    )
+    with urllib.request.urlopen(req) as r:
+        text = r.read().decode()
+    lines = [
+        line for line in text.splitlines()
+        if "keto_tpu_check_stage_duration_seconds_bucket" in line
+        and "# {" in line and "trace_id=" in line
+    ]
+    require(lines, "exemplar-bearing stage histogram in /metrics/prometheus")
+
+
+def scenario_overflow(daemon):
+    from keto_tpu.observability import RecordedSpan, SpanExporter
+
+    metrics = daemon.registry.metrics()
+    exp = SpanExporter(
+        "http://127.0.0.1:9/v1/traces", metrics=metrics, queue_size=2,
+        flush_interval_s=30.0, post_timeout_s=0.2,
+    )
+    try:
+        t0 = time.perf_counter()
+        for _ in range(50):
+            exp.enqueue(RecordedSpan("s", {
+                "trace_id": "ab" * 16, "span_id": "cd" * 8,
+                "t_mono": time.monotonic(),
+            }))
+        took = time.perf_counter() - t0
+        require(took < 0.5, f"50 enqueues non-blocking ({took * 1e3:.1f} ms)")
+        require(
+            exp.stats["dropped_queue_full"] >= 48,
+            f"overflow drops counted ({exp.stats})",
+        )
+        scraped = metrics.export().decode()
+        require(
+            'keto_tpu_otlp_dropped_total{reason="queue_full"}' in scraped,
+            "drop counter scrapable",
+        )
+    finally:
+        exp.close(timeout=0.1)
+
+
+def scenario_latency_ab(daemon, client):
+    tracer = daemon.registry.tracer()
+    exporter = daemon.registry.span_exporter()
+    t = RelationTuple("videos", "v1", "owner", subject_id="alice")
+    on, off = [], []
+    for i in range(AB_CALLS_PER_ARM * 2):
+        arm_on = i % 2 == 0
+        tracer.exporter = exporter if arm_on else None
+        t0 = time.perf_counter()
+        client.check(t)
+        (on if arm_on else off).append(time.perf_counter() - t0)
+    tracer.exporter = exporter
+    m_on, m_off = statistics.median(on), statistics.median(off)
+    ratio = m_on / m_off if m_off else 1.0
+    print(
+        f"otlp_smoke: latency A/B: on={m_on * 1e3:.3f} ms "
+        f"off={m_off * 1e3:.3f} ms on_vs_off={ratio:.4f}"
+    )
+    require(
+        ratio <= AB_BAR,
+        f"export-on within {AB_BAR:.0%} of export-off ({ratio:.4f})",
+    )
+
+
+def main() -> int:
+    collector = StubCollector()
+    cfg = Config({
+        "dsn": "memory",
+        "check": {"engine": "tpu", "cache": {"enabled": False}},
+        "observability": {"otlp": {
+            "endpoint": collector.endpoint,
+            "flush_interval_ms": 50,
+        }},
+        "serve": {
+            "read": {"host": "127.0.0.1", "port": 0,
+                     "grpc": {"host": "127.0.0.1", "port": 0}},
+            "write": {"host": "127.0.0.1", "port": 0},
+            "metrics": {"host": "127.0.0.1", "port": 0},
+        },
+        "namespaces": NAMESPACES,
+    })
+    reg = Registry(cfg)
+    reg.relation_tuple_manager().write_relation_tuples(
+        [RelationTuple.from_string(s) for s in TUPLES]
+    )
+    daemon = Daemon(reg)
+    daemon.start()
+    client = ReadClient(open_channel(f"127.0.0.1:{daemon.read_port}"))
+    try:
+        scenario_trace(daemon, collector, client)
+        scenario_exemplars(daemon)
+        scenario_overflow(daemon)
+        scenario_latency_ab(daemon, client)
+    finally:
+        client.close()
+        daemon.stop()
+        collector.close()
+    print("otlp_smoke: ALL GREEN")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Perf gate: compare a fresh bench record against the committed
+same-backend baseline artifact and FAIL on regression.
+
+ROADMAP item 1's "confirm-or-correct" discipline in executable form:
+every bench leg claim in the repo is a committed JSON artifact, so a
+fresh `bench.py` run can be diffed against the baseline mechanically —
+a named metric dropping more than the threshold (default 20%) exits
+non-zero with the exact numbers.
+
+    python bench.py --platform cpu | tee /tmp/bench.out
+    python tools/perf_gate.py --record /tmp/bench.out \
+        --baseline BENCH_r10_cpu.json
+
+`--record` accepts a bare JSON file OR a mixed log whose LAST
+JSON-parseable line is the record (bench.py prints the record as its
+final line, so `| tee` output feeds straight in). Metrics compared by
+default: checks/s (`value`), deep-20 (`deep20_qps`), and — when both
+artifacts carry it — bulk filtering (`filter_objects_per_sec`). A
+metric absent from EITHER side is reported and skipped, not failed: the
+gate compares what both runs measured. Backends must match (`device`),
+because cross-backend ratios are meaningless.
+
+Wired into CI as an ADVISORY step (continue-on-error): shared CI boxes
+are noisy; the gate's job is to make a regression LOUD in the log, not
+to hard-block on scheduler jitter. Run it locally (or on pinned
+hardware) as a hard gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+DEFAULT_METRICS = ("value", "deep20_qps", "filter_objects_per_sec")
+
+
+def load_record(path: str) -> dict:
+    """A JSON object from `path`: the whole file if it parses, else the
+    LAST line that parses as a JSON object (bench.py | tee logs)."""
+    text = pathlib.Path(path).read_text()
+    try:
+        obj = json.loads(text)
+        if isinstance(obj, dict):
+            return obj
+    except json.JSONDecodeError:
+        pass
+    for line in reversed(text.splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict):
+            return obj
+    raise SystemExit(f"no JSON record found in {path}")
+
+
+def compare(record: dict, baseline: dict, metrics, threshold: float):
+    """[(name, fresh, base, ratio, regressed)] for metrics present in
+    both records; skipped names are returned separately."""
+    rows, skipped = [], []
+    for name in metrics:
+        fresh, base = record.get(name), baseline.get(name)
+        if not isinstance(fresh, (int, float)) or not isinstance(
+            base, (int, float)
+        ) or base <= 0:
+            skipped.append(name)
+            continue
+        ratio = fresh / base
+        rows.append((name, fresh, base, ratio, ratio < 1.0 - threshold))
+    return rows, skipped
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--record", required=True,
+                    help="fresh bench output (json file or bench|tee log)")
+    ap.add_argument("--baseline", required=True,
+                    help="committed baseline artifact (e.g. BENCH_r10_cpu.json)")
+    ap.add_argument("--metrics", nargs="*", default=list(DEFAULT_METRICS))
+    ap.add_argument("--threshold", type=float, default=0.20,
+                    help="max tolerated fractional regression (default 0.20)")
+    args = ap.parse_args()
+
+    record = load_record(args.record)
+    baseline = load_record(args.baseline)
+
+    rb, bb = record.get("device"), baseline.get("device")
+    if rb and bb and rb != bb:
+        print(
+            f"perf_gate: backend mismatch (record={rb!r} baseline={bb!r}) "
+            "— cross-backend ratios are meaningless; pick the same-backend "
+            "baseline artifact"
+        )
+        return 1
+
+    rows, skipped = compare(record, baseline, args.metrics, args.threshold)
+    rc = 0
+    for name, fresh, base, ratio, regressed in rows:
+        tag = "REGRESSED" if regressed else "ok"
+        print(
+            f"perf_gate: {name}: fresh={fresh:.1f} baseline={base:.1f} "
+            f"ratio={ratio:.3f} [{tag}]"
+        )
+        if regressed:
+            rc = 1
+    for name in skipped:
+        print(f"perf_gate: {name}: absent from one side — skipped")
+    if not rows:
+        print("perf_gate: nothing comparable — check the metric names")
+        return 1
+    if rc:
+        print(
+            f"perf_gate: FAIL — at least one metric regressed more than "
+            f"{args.threshold:.0%} vs {args.baseline}"
+        )
+    else:
+        print(f"perf_gate: ok (threshold {args.threshold:.0%})")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
