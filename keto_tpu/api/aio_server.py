@@ -310,7 +310,15 @@ class AioCheckBatcher:
         if self.metrics is not None:
             self.metrics.inflight_launches.dec()
 
-    def _record_device_failure(self, cause: str) -> None:
+    def _record_device_failure(self, cause: str, err=None) -> None:
+        from ..errors import StoreUnavailableError
+
+        if isinstance(err, StoreUnavailableError):
+            # a STORE outage is not device-health evidence (same rule
+            # as the threaded batcher): the store breaker owns it
+            if self.metrics is not None:
+                self.metrics.check_batch_failed_total.labels("store").inc()
+            return
         if self.breaker is not None:
             self.breaker.record_failure()
         if self.metrics is not None:
@@ -415,12 +423,12 @@ class AioCheckBatcher:
                 self._executor,
                 self._submit_fn(engine, submit, slots, depth),
             )
-        except Exception:
+        except Exception as e:
             if guard.claim():
                 if watchdog is not None:
                     watchdog.cancel()
                 self._release_inflight()
-                self._record_device_failure("device")
+                self._record_device_failure("device", err=e)
                 await self._host_fallback(engine, slots, depth)
             return
         await self._finish(engine, handle, slots, depth, guard, watchdog)
@@ -466,12 +474,12 @@ class AioCheckBatcher:
                     self._executor, engine.check_batch_resolve, handle
                 )
                 versions = [None] * len(results)
-        except Exception:
+        except Exception as e:
             if guard is None or guard.claim():
                 if watchdog is not None:
                     watchdog.cancel()
                 self._release_inflight()
-                self._record_device_failure("device")
+                self._record_device_failure("device", err=e)
                 await self._host_fallback(engine, slots, depth)
             return
         if guard is not None and not guard.claim():
@@ -700,15 +708,34 @@ class _AioReadServices:
 
             sub.add_notify(_wake)
             hub = svc.registry.watch_hub()
+            # in-band keep-alives (watch.heartbeat_s — same contract as
+            # the sync plane's frames): detect half-open connections,
+            # free the subscriber ring via the finally below
+            heartbeat_s = float(
+                svc.registry.config.get("watch.heartbeat_s", 5.0)
+            )
+            last_write = loop.time()
             try:
                 while not context.cancelled():
+                    # every iteration (not only idle ones): a stream
+                    # whose events are all namespace-filtered out is
+                    # busy AND wire-silent without this
+                    if loop.time() - last_write >= heartbeat_s:
+                        last_write = loop.time()
+                        yield pb.WatchResponse(event_type="heartbeat")
                     event, needs_resume = sub.pop_nowait()
                     if needs_resume:
-                        # overflow resume re-reads the store changelog —
-                        # off-loop, like subscribe
-                        event = await loop.run_in_executor(
-                            self._blocking, hub._resume, sub
-                        )
+                        try:
+                            # overflow resume re-reads the store
+                            # changelog — off-loop, like subscribe
+                            event = await loop.run_in_executor(
+                                self._blocking, hub._resume, sub
+                            )
+                        except KetoError as e:
+                            # typed end-of-stream (store outage during
+                            # an overflow resume): the client
+                            # re-subscribes from its cursor
+                            await context.abort(_grpc_code(e), e.message)
                     if event is None:
                         if sub.closed:  # daemon drain ends the stream
                             break
@@ -722,6 +749,7 @@ class _AioReadServices:
                     if event is None:
                         continue
                     yield svc.watch_event_to_proto(event)
+                    last_write = loop.time()
             finally:
                 sub.close()
         finally:

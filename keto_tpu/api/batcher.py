@@ -494,7 +494,18 @@ class CheckBatcher:
                 if not p.future.done():
                     p.future.set_result((res, None))
 
-    def _record_device_failure(self, cause: str) -> None:
+    def _record_device_failure(self, cause: str, err=None) -> None:
+        from ..errors import StoreUnavailableError
+
+        if isinstance(err, StoreUnavailableError):
+            # a STORE outage reaching the submit path is not
+            # device-health evidence: the store breaker owns it — the
+            # DEVICE breaker must not trip (breaker-open host serving
+            # would read the same dead store), and the flight recorder
+            # must not dump per failed batch through a whole outage
+            if self.metrics is not None:
+                self.metrics.check_batch_failed_total.labels("store").inc()
+            return
         if self.breaker is not None:
             self.breaker.record_failure()
         if self.metrics is not None:
@@ -584,12 +595,12 @@ class CheckBatcher:
             else:
                 results = engine.check_batch_resolve(handle)
                 versions = [None] * len(results)
-        except Exception:
+        except Exception as e:
             if guard is None or guard.claim():
                 if watchdog is not None:
                     watchdog.cancel()
                 self._release_inflight()
-                self._record_device_failure("device")
+                self._record_device_failure("device", err=e)
                 self._host_fallback_slots(engine, slots, depth)
             return
         if guard is not None and not guard.claim():
@@ -716,14 +727,16 @@ class CheckBatcher:
                 )
             else:
                 handle = submit([s[0].tuple for s in slots], depth)
-        except Exception:
+        except Exception as e:
             if guard.claim():
                 if watchdog is not None:
                     watchdog.cancel()
                 self._release_inflight()
-                self._record_device_failure("device")
+                self._record_device_failure("device", err=e)
                 # graceful degradation: the riders are answered by the
-                # exact host oracle instead of failing
+                # exact host oracle instead of failing (a store-outage
+                # submit failure ends there too — the oracle's reads
+                # yield the typed per-item 503)
                 self._host_fallback_slots(engine, slots, depth)
             return
         self._pool.submit(

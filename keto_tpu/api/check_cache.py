@@ -69,6 +69,25 @@ def _fastpath_begin(cache, nid, t, max_depth, version, rt):
     return None, cache.generation()
 
 
+def require_answer_floor(computed_v, version) -> None:
+    """The store-outage no-time-travel backstop: an answer pinned to a
+    version OLDER than the request's enforce-time version would ship
+    under a snaptoken that overstates its freshness. Impossible while
+    the store is healthy (the engine syncs to >= the enforce-time read
+    before evaluating); reachable only when the store dies between the
+    transport's version read and the engine's — then the typed 503
+    wins over a stale-claiming answer."""
+    if computed_v is not None and version is not None and computed_v < version:
+        from ..errors import StoreUnavailableError
+
+        raise StoreUnavailableError(
+            f"store became unavailable mid-request: the answer is "
+            f"pinned to v{computed_v} but the response snaptoken was "
+            f"minted at v{version}",
+            breaker_open=True,
+        )
+
+
 def cached_check(registry, batcher, nid, t, max_depth, version, rt):
     """The transports' shared serve fast path: consult the cache, ride
     the batcher (or the bare engine) on a miss, store the verdict.
@@ -84,6 +103,7 @@ def cached_check(registry, batcher, nid, t, max_depth, version, rt):
     else:
         res = registry.check_engine(nid).check_relation_tuple(t, max_depth)
         computed_v = None
+    require_answer_floor(computed_v, version)
     if cache is not None:
         cache.store(nid, t, max_depth, res, computed_v, version, gen=gen)
     return res
@@ -97,6 +117,7 @@ async def cached_check_async(registry, batcher, nid, t, max_depth, version, rt):
     if res is not None:
         return res
     res, computed_v = await batcher.check_versioned(t, max_depth, nid=nid, rt=rt)
+    require_answer_floor(computed_v, version)
     if cache is not None:
         cache.store(nid, t, max_depth, res, computed_v, version, gen=gen)
     return res
@@ -270,7 +291,16 @@ class CheckCache:
             return
         version = computed_version
         if version is None:
-            if self._manager.version(nid=nid) != enforce_version:
+            from ..errors import StoreUnavailableError
+
+            try:
+                current = self._manager.version(nid=nid)
+            except StoreUnavailableError:
+                # store outage: the raced-write re-check cannot run, so
+                # the unpinned answer is simply not cached (the caller
+                # already has it; caching is an optimization)
+                return
+            if current != enforce_version:
                 return
             version = enforce_version
         key = _key_for(nid, t, max_depth)

@@ -338,7 +338,11 @@ class ReadClient(_BaseClient):
         RelationTuple) pairs and `snaptoken` is the resumable cursor to
         persist; an `event_type == "reset"` event signals an
         unrecoverable gap (overflow / trimmed changelog): re-read your
-        downstream state, then keep iterating. Resume after a disconnect
+        downstream state, then keep iterating. An `event_type ==
+        "degraded"` event signals a server-side STORE OUTAGE (the
+        stream is alive but cannot advance until the store recovers);
+        server keep-alive `heartbeat` frames are consumed here and
+        never surfaced. Resume after a disconnect
         by passing the last event's snaptoken. Blocks between events;
         `timeout` bounds the whole stream (gRPC deadline) and
         `max_events` ends it after N events. Abandoning the iterator
@@ -357,6 +361,12 @@ class ReadClient(_BaseClient):
         yielded = 0
         try:
             for resp in call:
+                if resp.event_type == "heartbeat":
+                    # server keep-alive (watch.heartbeat_s — the gRPC
+                    # twin of the SSE comment frame): connection-health
+                    # plumbing, not data; never surfaced, never counted
+                    # toward max_events
+                    continue
                 yield WatchStreamEvent(
                     event_type=resp.event_type,
                     snaptoken=resp.snaptoken,

@@ -18,6 +18,7 @@ import selectors
 import socket
 import threading
 
+from ..errors import KetoError
 from .batcher import CheckBatcher
 from .grpc_server import build_grpc_server
 from .rest_server import RESTServer
@@ -232,6 +233,23 @@ class Daemon:
         # succeeding against a recycled pid)
         self.pid_file = pid_file
         cfg = registry.config
+        # fail-fast store probe BEFORE any listener or batcher exists:
+        # an unreachable/misconfigured DSN (bad path, unknown scheme,
+        # absent network driver, locked/corrupt file) exits `keto-tpu
+        # serve` with ONE typed line instead of a raw stack trace from
+        # the middle of listener startup (the CLI prints KetoError
+        # messages and returns non-zero)
+        try:
+            registry.relation_tuple_manager().version(nid=registry.nid)
+        except KetoError:
+            raise  # already typed (dialect/StoreUnavailable family)
+        except Exception as e:
+            from ..config import ConfigError
+
+            raise ConfigError(
+                f"store DSN {cfg.dsn!r} failed its startup probe: "
+                f"{type(e).__name__}: {e}"
+            ) from e
         self.read_addr = cfg.read_api_address()
         self.write_addr = cfg.write_api_address()
         self.metrics_addr = cfg.metrics_api_address()

@@ -600,9 +600,34 @@ class _Services:
                 sub = self.watch_subscribe(req, context)
             except KetoError as e:
                 context.abort(_grpc_code(e), e.message)
+            # in-band keep-alives (watch.heartbeat_s, the gRPC twin of
+            # the SSE comment frame): an idle stream writes a
+            # `heartbeat` event each period, so a half-open TCP
+            # connection fails the write and the finally frees this
+            # subscriber's ring instead of pinning changelog retention
+            # forever. ReadClient.watch() filters them out.
+            heartbeat_s = float(
+                self.registry.config.get("watch.heartbeat_s", 5.0)
+            )
+            last_write = _time.monotonic()
             try:
                 while context.is_active():
-                    event = sub.get(timeout=0.5)
+                    # heartbeat check runs EVERY iteration, not only on
+                    # an idle get: a stream whose events are all
+                    # namespace-filtered out is busy AND wire-silent —
+                    # without this, a half-open peer on such a stream
+                    # would never be detected
+                    if _time.monotonic() - last_write >= heartbeat_s:
+                        last_write = _time.monotonic()
+                        yield pb.WatchResponse(event_type="heartbeat")
+                    try:
+                        event = sub.get(timeout=0.5)
+                    except KetoError as e:
+                        # e.g. an overflow resume against an unavailable
+                        # store: end the stream with the typed code, not
+                        # a raw INTERNAL (the client re-subscribes from
+                        # its cursor after recovery)
+                        context.abort(_grpc_code(e), e.message)
                     if event is None:
                         if sub.closed:  # daemon drain ends the stream
                             break
@@ -611,6 +636,7 @@ class _Services:
                     if event is None:
                         continue
                     yield self.watch_event_to_proto(event)
+                    last_write = _time.monotonic()
             finally:
                 sub.close()
         finally:

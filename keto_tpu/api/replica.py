@@ -60,7 +60,7 @@ from typing import Optional
 from ..engine.snaptoken import parse_snaptoken, require_version
 from ..errors import DeadlineExceededError, OverloadedError
 from ..observability import RequestTrace
-from .check_cache import _fastpath_begin
+from .check_cache import _fastpath_begin, require_answer_floor
 
 # catch-up hold default: long enough for the in-process push-driven tail
 # (microseconds normally), short enough that a genuinely stalled worker
@@ -406,6 +406,17 @@ def resolve_version(group: ReplicaGroup, worker: ServeWorker, nid: str,
     answer/response token is minted at). Raises
     SnaptokenUnsatisfiableError (409) only when the token is ahead of
     the STORE itself — replica lag alone never 409s, it routes."""
+    target, version = _resolve_version(group, worker, nid, token, rt)
+    if rt is not None:
+        # the store-outage no-time-travel floor (same stamp as
+        # enforce_snaptoken): a degraded mirror answer below the minted
+        # version must 503, never serve
+        rt.min_version = version
+    return target, version
+
+
+def _resolve_version(group: ReplicaGroup, worker: ServeWorker, nid: str,
+                     token: str, rt) -> tuple[ServeWorker, int]:
     min_v = parse_snaptoken(token, nid)
     local = worker.view.applied_version(nid)
     if min_v is None or min_v <= local:
@@ -544,6 +555,7 @@ def serve_on(worker: ServeWorker, nid: str, t, max_depth: int, version: int,
         res, computed_v = worker.batcher.check_versioned(
             t, max_depth, nid=nid, rt=rt
         )
+    require_answer_floor(computed_v, version)
     if cache is not None:
         cache.store(nid, t, max_depth, res, computed_v, version, gen=gen)
     worker.count_check()
@@ -586,6 +598,7 @@ async def replica_check_async(worker: ServeWorker, aio_batcher, nid: str, t,
         res, computed_v = await aio_batcher.check_versioned(
             t, max_depth, nid=nid, rt=rt
         )
+        require_answer_floor(computed_v, version)
         if cache is not None:
             cache.store(nid, t, max_depth, res, computed_v, version, gen=gen)
         worker.count_check()

@@ -794,7 +794,11 @@ class _Handler(BaseHTTPRequestHandler):
         )
 
     # SSE keep-alive cadence: also the disconnect-detection bound (a
-    # vanished client is only noticed on the next write)
+    # vanished client is only noticed on the next write). Default for
+    # the `watch.heartbeat_s` schema key — a half-open TCP connection
+    # (NAT drop, killed peer) is detected within one heartbeat, the
+    # write fails, and the finally below frees the subscriber ring
+    # instead of letting an orphaned cursor pin changelog retention.
     WATCH_HEARTBEAT_S = 5.0
 
     def _watch(self) -> None:
@@ -859,14 +863,30 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(b": stream open\n\n")
             self.wfile.flush()
+            heartbeat_s = float(
+                self.registry.config.get(
+                    "watch.heartbeat_s", self.WATCH_HEARTBEAT_S
+                )
+            )
             delivered = 0
+            last_write = time.monotonic()
             while max_events is None or delivered < max_events:
-                event = sub.get(timeout=self.WATCH_HEARTBEAT_S)
+                # keep-alives are due by WALL time, not idle-gets: a
+                # stream whose events are all namespace-filtered out is
+                # busy and would otherwise stay wire-silent forever
+                if time.monotonic() - last_write >= heartbeat_s:
+                    last_write = time.monotonic()
+                    self.wfile.write(b": keep-alive\n\n")
+                    self.wfile.flush()
+                event = sub.get(
+                    timeout=max(
+                        0.05,
+                        heartbeat_s - (time.monotonic() - last_write),
+                    )
+                )
                 if event is None:
                     if sub.closed:  # daemon drain ends the stream
                         break
-                    self.wfile.write(b": keep-alive\n\n")
-                    self.wfile.flush()
                     continue
                 event = event.filtered(namespace)
                 if event is None:
@@ -876,6 +896,7 @@ class _Handler(BaseHTTPRequestHandler):
                     f"event: {event.kind}\ndata: {payload}\n\n".encode()
                 )
                 self.wfile.flush()
+                last_write = time.monotonic()
                 delivered += 1
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away: normal end of a watch stream

@@ -28,7 +28,7 @@ tenant identifiers into client-held strings).
 
 from __future__ import annotations
 
-from ..errors import KetoError
+from ..errors import KetoError, StoreUnavailableError
 
 _PREFIX = "ktv1"
 # the reference's stub literal: accepted (and ignored) for compatibility
@@ -100,8 +100,52 @@ def enforce_snaptoken(registry, token: str, nid: str) -> int:
     by the gRPC and REST planes: the engine evaluates at >= the version
     returned here (its state sync reads the same monotone counter after
     this check), so verifying the store has reached the token's version
-    pins read-your-writes without threading versions through engines."""
+    pins read-your-writes without threading versions through engines.
+
+    STORE OUTAGE (storage/health.py): while the store-path breaker is
+    open the version read fails fast — enforcement then degrades to the
+    engine's mirror-covered version (the response token IS the
+    staleness bound, so every degraded answer is byte-identical to an
+    authoritative answer at that version). A token demanding a version
+    NEWER than covered gets the typed 503 (the store may well hold it —
+    claiming 409 would be a lie, and serving below it would
+    time-travel); no mirror at all, an over-ceiling staleness age, or a
+    mid-flight store failure (breaker not yet open) stay typed 503s.
+
+    The returned version is stamped onto the ambient RequestTrace as
+    `min_version` — the engine's degraded-serving gate refuses any
+    mirror answer below it, which closes the race where the store dies
+    between this read and the engine's own."""
     min_v = parse_snaptoken(token, nid)
-    current = registry.relation_tuple_manager().version(nid=nid)
-    require_version(current, min_v)
+    try:
+        current = registry.relation_tuple_manager().version(nid=nid)
+    except StoreUnavailableError as e:
+        current = _degraded_enforce_version(registry, nid, min_v, e)
+    else:
+        require_version(current, min_v)
+    from ..observability import current_request_trace
+
+    rt = current_request_trace()
+    if rt is not None:
+        rt.min_version = current
     return current
+
+
+def _degraded_enforce_version(registry, nid, min_v, cause) -> int:
+    """The store-outage half of enforce_snaptoken: the mirror's covered
+    version when the shared degraded-serving rule (storage/health.py
+    degraded_gate — the SAME policy the engine's serving gate applies)
+    permits it, else the typed 503 (`cause` re-raised or refined)."""
+    from ..storage.health import degraded_gate
+
+    engine = registry.check_engine(nid)
+    covered = getattr(engine, "degraded_covered_version", lambda: None)()
+    degraded_gate(
+        cause,
+        covered,
+        getattr(engine, "mirror_staleness_age_s", lambda: 0.0)(),
+        registry.config.get("serve.check.degraded.max_staleness_s"),
+        min_v,
+    )
+    registry.metrics().store_degraded_serves_total.labels("snaptoken").inc()
+    return covered

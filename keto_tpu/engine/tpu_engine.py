@@ -35,6 +35,7 @@ import numpy as np
 
 from .. import faults as _faults
 from ..config import Config
+from ..errors import StoreUnavailableError
 from ..ketoapi import RelationTuple, Subject, Tree
 from ..storage.definitions import DEFAULT_NETWORK, Manager
 from .definitions import (
@@ -172,6 +173,12 @@ class TPUCheckEngine:
         self._refresh_event: Optional[threading.Event] = None
         self._refresh_stopped = False
         self._notify_t = 0.0  # monotonic stamp of the oldest unserved poke
+        # monotonic stamp of the last time a state provably covered the
+        # store's CURRENT version (every successful _ensure_state):
+        # during a store outage `now - _synced_t` is the mirror's
+        # staleness AGE, the serve.check.degraded.max_staleness_s
+        # ceiling's measurand (0.0 = never synced)
+        self._synced_t = 0.0
         # device-path observability (served vs host-fallback checks);
         # `metrics` is an optional observability.Metrics mirror of the same.
         # host_cause splits host_checks by kernel CAUSE_* code (VERDICT r2
@@ -295,9 +302,79 @@ class TPUCheckEngine:
                     )
                     sp.set_attribute("tuples", state.snapshot.n_tuples)
             self._state = state
+            self._synced_t = time.monotonic()
+        if self.metrics is not None:
+            self.metrics.mirror_staleness_age_seconds.set(0.0)
         if persist_snap is not None:
             self._maybe_persist(persist_snap)
         return state
+
+    # -- store-outage degradation (storage/health.py's serve half) ------------
+
+    def degraded_covered_version(self):
+        """The store version the CURRENT mirror state covers, with ZERO
+        store contact (the store is down when anyone asks) — what a
+        degraded response's snaptoken is minted at. None = no state."""
+        with self._lock:
+            state = self._state
+        return None if state is None else state.covered_version
+
+    def mirror_staleness_age_s(self) -> float:
+        """Seconds since this engine last confirmed its state covered
+        the store's current version — the degraded-serving staleness
+        ceiling's measurand. Infinity when never synced."""
+        if not self._synced_t:
+            return float("inf")
+        return time.monotonic() - self._synced_t
+
+    def _degraded_state(self, cause, surface: str) -> _EngineState:
+        """The bounded-stale serving gate: the existing mirror state,
+        iff the shared degraded-serving rule (storage/health.py
+        degraded_gate — one policy for this gate AND snaptoken
+        enforcement) permits it: breaker fail-fast, a state exists, age
+        under serve.check.degraded.max_staleness_s, and the ambient
+        request's snaptoken floor (RequestTrace.min_version, stamped by
+        enforce_snaptoken) not above the state's covered version.
+        Anything else re-raises the typed 503: a degraded answer is
+        byte-identical to an authoritative answer at its snaptoken or
+        it is not served at all."""
+        from ..observability import current_request_trace
+        from ..storage.health import degraded_gate
+
+        with self._lock:
+            state = self._state
+        age = self.mirror_staleness_age_s()
+        if self.metrics is not None and state is not None:
+            self.metrics.mirror_staleness_age_seconds.set(
+                0.0 if age == float("inf") else age
+            )
+        rt = current_request_trace()
+        degraded_gate(
+            cause,
+            None if state is None else state.covered_version,
+            age,
+            self.config.get("serve.check.degraded.max_staleness_s"),
+            getattr(rt, "min_version", None) if rt is not None else None,
+        )
+        self.stats["degraded_serves"] = (
+            self.stats.get("degraded_serves", 0) + 1
+        )
+        if self.metrics is not None:
+            self.metrics.store_degraded_serves_total.labels(surface).inc()
+        return state
+
+    def _ensure_state_degraded_ok(
+        self, surface: str = "check"
+    ) -> tuple[_EngineState, bool]:
+        """(state, degraded): the normal synced state, or — when the
+        store-path breaker is open — the existing mirror state at its
+        covered version (the Zanzibar §2.4.1 bounded-staleness degrade:
+        availability decays to an older-but-valid snapshot, never to a
+        wrong answer or a hung thread)."""
+        try:
+            return self._ensure_state(), False
+        except StoreUnavailableError as e:
+            return self._degraded_state(e, surface), True
 
     def _maybe_persist(self, snap: GraphSnapshot) -> None:
         """Checkpoint the freshly-built mirror without holding the engine
@@ -1110,9 +1187,16 @@ class TPUCheckEngine:
         view, cause = idx.view_for(state)
         if view is None and cause == CAUSE_LAG:
             lag = idx.lag_versions(state.covered_version)
-            if lag <= idx.lag_budget_versions and idx.catch_up(
-                self.manager, state.covered_version
-            ):
+            try:
+                caught = lag <= idx.lag_budget_versions and idx.catch_up(
+                    self.manager, state.covered_version
+                )
+            except StoreUnavailableError:
+                # store outage mid-catch-up: the batch falls back to the
+                # BFS kernel (cause stays LAG) — a lagging index during
+                # an outage degrades latency, never correctness
+                caught = False
+            if caught:
                 view, cause = idx.view_for(state)
         if self.metrics is not None:
             self.metrics.closure_lag_versions.set(
@@ -1132,7 +1216,10 @@ class TPUCheckEngine:
         writes since then ride the overlay's dirty tables — the expand
         kernel sends queries touching dirty rows to the host, so the CSR
         needs no rebuild on the write path."""
-        state = self._ensure_state()
+        # store outage: an already-built expand mirror serves degraded
+        # at its covered version; a missing one cannot lazily build
+        # from a dead store (typed 503 from the read below)
+        state = self._ensure_state_degraded_ok("expand")[0]
         if state.expand_tables is not None:
             return state
         import jax.numpy as jnp
@@ -1206,7 +1293,9 @@ class TPUCheckEngine:
         reverse tables are built unsharded (replicated execution): the
         reverse workload is an analytical read, not the sharded check hot
         path."""
-        state = self._ensure_state()
+        # store outage: a built transposed mirror serves degraded at its
+        # covered version (same contract as the expand state above)
+        state = self._ensure_state_degraded_ok("list")[0]
         if state.reverse_tables is not None:
             return state
         import jax.numpy as jnp
@@ -1253,7 +1342,7 @@ class TPUCheckEngine:
         full-CSR mirror when available (single-device path — including
         its incremental-compaction patches); under a mesh it builds its
         own unsharded CSR."""
-        state = self._ensure_state()
+        state = self._ensure_state_degraded_ok("list")[0]
         if state.subjects_tables is not None:
             return state
         if self.mesh is None:
@@ -1649,6 +1738,22 @@ class TPUCheckEngine:
                 if n:
                     self.metrics.filter_objects_total.labels(path).inc(n)
 
+    @staticmethod
+    def _degraded_host_filter_guard(degraded: bool) -> None:
+        """Filter has no per-candidate error channel (absence from the
+        response means NOT VISIBLE), and the host oracle maps an errored
+        candidate to False — during a store outage that would silently
+        turn 'unknown' into 'hidden'. A degraded chunk that cannot fully
+        resolve on the mirror therefore sheds the typed 503 instead:
+        never wrong beats partially answered."""
+        if degraded:
+            raise StoreUnavailableError(
+                "store unavailable and this filter request needs the "
+                "exact host oracle for some candidates — retry after "
+                "recovery",
+                breaker_open=True,
+            )
+
     def _filter_host(self, namespace, relation, subject, objects, max_depth):
         """Exact host-oracle verdicts for a candidate slice (the
         complete checker — the same admission rule the device paths
@@ -1765,7 +1870,11 @@ class TPUCheckEngine:
         )
 
         n = len(objects)
-        state = self._ensure_state()
+        # store outage: the chunk serves from the mirror at its covered
+        # version (closure probe + shared-frontier walk need no store);
+        # candidates that fall to the host replay get the typed
+        # per-item error from the dead store via reference.filter_objects
+        state, degraded = self._ensure_state_degraded_ok("filter")
         global_max = self.config.max_read_depth()
         depth = max_depth if 0 < max_depth <= global_max else global_max
 
@@ -1806,6 +1915,7 @@ class TPUCheckEngine:
             # names unknown to graph+config under a non-monotone (or
             # unknown-relation) config: error semantics and NOT rewrites
             # may still apply per candidate — exact host eval
+            self._degraded_host_filter_guard(degraded)
             verdicts = self._filter_host(
                 namespace, relation, subject, objects, max_depth
             )
@@ -1974,6 +2084,7 @@ class TPUCheckEngine:
                 causes[CAUSE_NAME_UNINDEXED] = (
                     causes.get(CAUSE_NAME_UNINDEXED, 0) + unindexed
                 )
+            self._degraded_host_filter_guard(degraded)
             host_verdicts = self._filter_host(
                 namespace, relation, subject,
                 # ketolint: allow[host-sync] reason=host_idx is host numpy (np.flatnonzero over a host mask) — these int() coercions never touch a device value
@@ -2352,7 +2463,12 @@ class TPUCheckEngine:
         # what a real launch failure looks like. Disarmed: one dict miss.
         _faults.inject("device_launch")
         t_submit = time.perf_counter()
-        state = self._ensure_state()
+        # store outage: the breaker-open path serves this batch from the
+        # existing mirror + delta overlay at its covered version (the
+        # response snaptoken is the staleness bound); riders pinned to a
+        # newer version are routed to the host-replay path below, where
+        # the dead store answers them with the typed per-item 503
+        state, degraded = self._ensure_state_degraded_ok("check")
         # marker fault (keto_tpu/faults.py mirror_corrupt): flip one bit
         # in a device table before this launch — the silent-HBM-fault
         # stand-in the anti-entropy scrubber (engine/scrub.py) must
@@ -2422,6 +2538,19 @@ class TPUCheckEngine:
                 # unknown subject keeps the sentinel: traversal still runs
                 # so error flags surface, but no direct probe can hit
                 q_valid[i] = True
+
+        if degraded and telemetry:
+            # no-time-travel floor: a rider whose snaptoken enforcement
+            # ran BEFORE the outage (min_version newer than the mirror
+            # covers) must not receive a mirror answer its token would
+            # claim fresher than it is — invalidating it routes it to
+            # the host replay loop, where the dead store yields the
+            # typed per-item StoreUnavailableError
+            covered = state.covered_version
+            for i, rt in enumerate(telemetry):
+                mv = getattr(rt, "min_version", None)
+                if mv is not None and mv > covered:
+                    q_valid[i] = False
 
         # Leopard closure fast path: when the index covers this engine
         # state (same base snapshot, synced through covered_version), the

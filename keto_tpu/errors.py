@@ -188,6 +188,54 @@ class BatcherClosedError(OverloadedError, RuntimeError):
     default_message = "check batcher is closed"
 
 
+class StoreUnavailableError(KetoError):
+    # Store-outage degradation plane (storage/health.py): the tuple
+    # store is unreachable — the store-path circuit breaker is open
+    # (fail-fast, `breaker_open=True`), or an in-flight store op failed.
+    # 503 on REST (Retry-After from `retry_after_s`), UNAVAILABLE on
+    # gRPC — the retryable code ReadClient's RetryPolicy backs off on.
+    # While the breaker is open, reads the device mirror can answer at
+    # its covered version are served degraded instead (the snaptoken is
+    # the staleness bound); everything else gets this typed 503 — never
+    # a wrong answer, never a hung thread.
+    status = 503
+    code = "store_unavailable"
+    default_message = "the tuple store is unavailable, retry later"
+
+    def __init__(
+        self,
+        message: str | None = None,
+        *,
+        debug: str | None = None,
+        retry_after_s: float | None = None,
+        breaker_open: bool = False,
+    ):
+        super().__init__(message, debug=debug)
+        self.retry_after_s = retry_after_s
+        # True only for the store breaker's fail-fast rejection: the
+        # signal the degraded-serving gates key on (an in-flight op
+        # failure must NOT degrade-serve — the transport may have minted
+        # a fresher snaptoken an instant earlier, and a mirror answer
+        # below it would time-travel)
+        self.breaker_open = breaker_open
+
+
+class StoreTimeoutError(StoreUnavailableError):
+    # A store op exceeded its `store.op_timeout_ms` budget (bounded
+    # executor, storage/health.py): the op thread may still be wedged in
+    # the driver, but the serving thread is answered and freed — a hung
+    # SQL read can no longer pin a batcher or dispatch thread.
+    default_message = "tuple store operation timed out"
+
+
+class StoreBusyError(StoreUnavailableError):
+    # SQLITE_BUSY / "database is locked" mapped to the typed retryable
+    # surface (storage/sqlite.py _PrepConn): transient lock contention a
+    # client should back off and retry, not an internal error. 503 /
+    # UNAVAILABLE like its parent, so RetryPolicy retries it.
+    default_message = "the tuple store is busy (locked), retry"
+
+
 class CheckBatchFailedError(KetoError, RuntimeError):
     # Engine-batch failure classified into the typed error surface
     # (api/batcher.py classify_engine_error) instead of leaking the raw

@@ -20,6 +20,22 @@ an empty dict when nothing is armed — nanoseconds on the hot path):
     bit in a device-mirror table before launching (a silent HBM fault).
     The anti-entropy scrubber (engine/scrub.py) must detect it within
     one scrub interval and repair through the breaker-degrade path.
+  - ``store_outage``    — the WHOLE-STORE outage: runs at the entry of
+    every StoreHealthGuard-wrapped op (storage/health.py — reads AND
+    writes, all three stores, because the guard is the registry's
+    outermost manager wrapper). `error` models a dead SQL server, a
+    `stall` a wedged one. Consecutive failures trip the store-path
+    circuit breaker; while it is open the engine serves bounded-stale
+    reads from the HBM mirror and writes shed typed 503s — the
+    degradation plane tools/outage_smoke.py drives. A ``duration_s``
+    makes the outage self-clearing, so an env-armed process recovers
+    without operator action — as the ``~<seconds>`` suffix on the
+    stall/crash/on modes (``store_outage=stall:30~5`` is the env
+    spelling of a 5-second hung-store window: the op budget converts
+    the stall into typed timeouts). ``error`` messages stay VERBATIM
+    (no suffix parsing — '~' is legitimate message content), so
+    self-clearing error-mode outages are armed programmatically via
+    ``set_fault(..., duration_s=...)``.
 
 CRASH points (the crash-recovery plane, tools/crash_smoke.py): a
 ``crash:<exit code>`` spec makes the point die with ``os._exit(code)``
@@ -82,7 +98,7 @@ class FaultInjected(RuntimeError):
 class FaultSpec:
     __slots__ = (
         "stall_s", "error", "crash", "hits", "probability", "max_hits",
-        "_rng", "_mu",
+        "expires_at", "_rng", "_mu",
     )
 
     def __init__(
@@ -93,6 +109,7 @@ class FaultSpec:
         probability: float = 1.0,
         max_hits: Optional[int] = None,
         seed: Optional[int] = None,
+        duration_s: Optional[float] = None,
     ):
         self.stall_s = float(stall_s or 0.0)
         self.error = error
@@ -106,6 +123,13 @@ class FaultSpec:
         # launches stall). Both default to the old always-on behavior.
         self.probability = min(max(float(probability), 0.0), 1.0)
         self.max_hits = max_hits if max_hits is None else int(max_hits)
+        # self-clearing faults (the store_outage window shape): past
+        # `duration_s` after arming the spec stops firing — an env-armed
+        # outage recovers on its own, like a real store coming back
+        self.expires_at = (
+            None if duration_s is None
+            else time.monotonic() + float(duration_s)
+        )
         import random
 
         self._rng = random.Random(seed)
@@ -117,6 +141,8 @@ class FaultSpec:
         concurrent launch threads can never push past `max_hits`, so the
         'exactly the first N' deterministic-bound contract holds."""
         with self._mu:
+            if self.expires_at is not None and time.monotonic() >= self.expires_at:
+                return False  # the outage window ended: store is back
             if self.max_hits is not None and self.hits >= self.max_hits:
                 return False
             if (self.probability < 1.0
@@ -128,6 +154,8 @@ class FaultSpec:
 
 POINTS = (
     "device_launch", "store_read", "batch_corrupt", "mirror_corrupt",
+    # whole-store outage (storage/health.py StoreHealthGuard — all ops)
+    "store_outage",
     # crash-recovery plane boundaries (module docstring; every one is a
     # dict miss when disarmed, like the rest)
     "store_commit_pre", "store_commit_post", "changelog_append",
@@ -147,20 +175,22 @@ def set_fault(
     probability: float = 1.0,
     max_hits: Optional[int] = None,
     seed: Optional[int] = None,
+    duration_s: Optional[float] = None,
 ) -> FaultSpec:
     """Arm one injection point; returns its spec (hits counter included).
     A spec with no stall/error/crash is a pure marker (batch_corrupt);
     `crash` makes the point os._exit with that code (kill-anywhere
     harness); `probability` < 1 makes the fault flaky (served on a
     fraction of hits), `max_hits` bounds served injections
-    (deterministic tests)."""
+    (deterministic tests), `duration_s` makes the spec self-clearing
+    (the store_outage window shape)."""
     if point not in POINTS:
         raise ValueError(
             f"unknown fault point {point!r}; known: {', '.join(POINTS)}"
         )
     spec = FaultSpec(
         stall_s=stall_s, error=error, crash=crash, probability=probability,
-        max_hits=max_hits, seed=seed,
+        max_hits=max_hits, seed=seed, duration_s=duration_s,
     )
     with _mu:
         _SPECS[point] = spec
@@ -206,28 +236,33 @@ def inject(point: str) -> None:
         raise FaultInjected(spec.error)
 
 
-def _split_suffixes(value: str) -> tuple[str, float, Optional[int]]:
-    """Strip the shared ``@<probability>`` / ``!<max_hits>`` suffixes
-    off an env-var mode value (either order), returning
-    (bare value, probability, max_hits)."""
-    probability, max_hits = 1.0, None
-    # scan from the right so a literal '@'/'!' inside an error message
-    # body (left of the first suffix) is never consumed
+def _split_suffixes(
+    value: str,
+) -> tuple[str, float, Optional[int], Optional[float]]:
+    """Strip the shared ``@<probability>`` / ``!<max_hits>`` /
+    ``~<duration_s>`` suffixes off an env-var mode value (any order),
+    returning (bare value, probability, max_hits, duration_s)."""
+    probability, max_hits, duration_s = 1.0, None, None
+    # scan from the right so a literal '@'/'!'/'~' inside an error
+    # message body (left of the first suffix) is never consumed
     while True:
         at, bang = value.rfind("@"), value.rfind("!")
-        cut = max(at, bang)
+        tilde = value.rfind("~")
+        cut = max(at, bang, tilde)
         if cut < 0:
             break
         head, tail = value[:cut], value[cut + 1:]
         try:
             if cut == at:
                 probability = float(tail)
-            else:
+            elif cut == bang:
                 max_hits = int(tail)
+            else:
+                duration_s = float(tail)
         except ValueError:
             break  # not a suffix: part of the value proper
         value = head
-    return value, probability, max_hits
+    return value, probability, max_hits, duration_s
 
 
 def configure(text: str) -> None:
@@ -248,21 +283,22 @@ def configure(text: str) -> None:
         name, _, spec = entry.partition("=")
         mode, sep, value = spec.partition(":")
         name, mode = name.strip(), mode.strip()
-        probability, max_hits = 1.0, None
+        probability, max_hits, duration_s = 1.0, None, None
         if not sep:
             # value-less modes (``on``) carry the suffixes on the mode
             # token itself: ``mirror_corrupt=on!1``
-            mode, probability, max_hits = _split_suffixes(mode)
+            mode, probability, max_hits, duration_s = _split_suffixes(mode)
         elif mode != "error":
-            # error MESSAGES are taken verbatim — '@'/'!' are legitimate
-            # message content ("error:HTTP 429!") and must never be
-            # reinterpreted as suffixes; arm flaky/bounded error faults
-            # programmatically (set_fault) instead
-            value, probability, max_hits = _split_suffixes(value)
+            # error MESSAGES are taken verbatim — '@'/'!'/'~' are
+            # legitimate message content ("error:HTTP 429!") and must
+            # never be reinterpreted as suffixes; arm flaky/bounded
+            # error faults programmatically (set_fault) instead
+            value, probability, max_hits, duration_s = _split_suffixes(value)
         if mode == "stall":
             set_fault(
                 name, stall_s=float(value),
                 probability=probability, max_hits=max_hits,
+                duration_s=duration_s,
             )
         elif mode == "error":
             set_fault(name, error=value or "injected fault")
@@ -270,9 +306,13 @@ def configure(text: str) -> None:
             set_fault(
                 name, crash=int(value or 137),
                 probability=probability, max_hits=max_hits,
+                duration_s=duration_s,
             )
         elif mode == "on":
-            set_fault(name, probability=probability, max_hits=max_hits)
+            set_fault(
+                name, probability=probability, max_hits=max_hits,
+                duration_s=duration_s,
+            )
         else:
             raise ValueError(
                 f"unknown fault mode {mode!r} in {entry!r} "

@@ -129,13 +129,19 @@ class RequestTrace:
     several), so a slow-query line or request log joins its exact
     launch record in `GET /admin/flightrec`."""
 
-    __slots__ = ("ctx", "stages", "deadline", "launch_ids")
+    __slots__ = ("ctx", "stages", "deadline", "launch_ids", "min_version")
 
     def __init__(self, ctx: Optional[SpanContext] = None, deadline=None):
         self.ctx = ctx if ctx is not None else new_trace()
         self.stages: dict[str, float] = {}
         self.deadline = deadline
         self.launch_ids: list[int] = []
+        # the store version this request's response snaptoken is minted
+        # at, stamped by snaptoken enforcement: the store-outage
+        # degradation plane's no-time-travel floor — a degraded (mirror)
+        # answer below this version must 503, never serve (the token
+        # would overstate the answer's freshness)
+        self.min_version: Optional[int] = None
 
     def add_stage(self, name: str, seconds: float) -> None:
         self.stages[name] = self.stages.get(name, 0.0) + seconds
@@ -515,6 +521,72 @@ class Metrics:
             ["to"],
             registry=self.registry,
         )
+        # store-outage degradation plane (storage/health.py): the
+        # store-path twin of the device breaker above — when SQL dies,
+        # reads degrade onto the HBM mirror at its covered version,
+        # writes shed typed 503s, and the whole episode is observable
+        self.store_breaker_state = prom.Gauge(
+            "keto_tpu_store_breaker_state",
+            "Store-path circuit breaker state: 0 closed (store serving), "
+            "1 open (reads the mirror covers served degraded at its "
+            "covered version, everything else typed 503), 2 half-open "
+            "(one probe read deciding recovery)",
+            registry=self.registry,
+        )
+        self.store_breaker_transitions_total = prom.Counter(
+            "keto_tpu_store_breaker_transitions_total",
+            "Store-path breaker transitions, labeled by the state "
+            "entered (closed | open | half_open) — the outage -> "
+            "degraded-serve -> probe -> recovery cycle is countable "
+            "from scrapes alone",
+            ["to"],
+            registry=self.registry,
+        )
+        self.store_op_timeouts_total = prom.Counter(
+            "keto_tpu_store_op_timeouts_total",
+            "Store ops that exceeded their per-op budget "
+            "(store.op_timeout_ms / store.bulk_timeout_ms on the "
+            "bounded executor) and answered the caller with a typed "
+            "StoreTimeoutError instead of pinning its thread, by op",
+            ["op"],
+            registry=self.registry,
+        )
+        self.store_op_failures_total = prom.Counter(
+            "keto_tpu_store_op_failures_total",
+            "Store ops that failed outright (driver/disk/injected "
+            "error; timeouts are counted separately) — consecutive "
+            "failures trip the store breaker, by op",
+            ["op"],
+            registry=self.registry,
+        )
+        self.store_unavailable_total = prom.Counter(
+            "keto_tpu_store_unavailable_total",
+            "Store ops rejected fail-fast with a typed 503 because the "
+            "store breaker was open (no store contact was made), by op",
+            ["op"],
+            registry=self.registry,
+        )
+        self.store_degraded_serves_total = prom.Counter(
+            "keto_tpu_store_degraded_serves_total",
+            "Requests answered in DEGRADED mode during a store outage, "
+            "by surface: snaptoken (enforcement fell back to the "
+            "mirror's covered version), check/filter/expand/list (the "
+            "engine served from the device mirror + delta overlay at "
+            "its covered version — the response snaptoken IS the "
+            "staleness bound), watch (in-band DEGRADED markers pushed "
+            "to subscribers instead of a silent stall)",
+            ["surface"],
+            registry=self.registry,
+        )
+        self.mirror_staleness_age_seconds = prom.Gauge(
+            "keto_tpu_mirror_staleness_age_seconds",
+            "Seconds since the default network's device mirror last "
+            "confirmed it covered the store's current version (0 while "
+            "healthy; grows during a store outage — the "
+            "serve.check.degraded.max_staleness_s ceiling converts a "
+            "silently-ancient mirror into typed 503s)",
+            registry=self.registry,
+        )
         self.check_batch_failed_total = prom.Counter(
             "keto_tpu_check_batch_failed_total",
             "Engine batch evaluations that failed, by cause: device "
@@ -524,7 +596,9 @@ class Metrics:
             "the host oracle), engine (a non-split-phase engine raised; "
             "riders fail with a typed KetoError), host (the host-oracle "
             "fallback itself raised), keto (a typed KetoError propagated "
-            "as-is)",
+            "as-is), store (a store outage reached the submit path — "
+            "counted here, owned by the STORE breaker, never recorded "
+            "as device-health evidence)",
             ["cause"],
             registry=self.registry,
         )

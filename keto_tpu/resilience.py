@@ -437,6 +437,16 @@ class CircuitBreaker:
             self._probe_started = now
             return True
 
+    def open_remaining_s(self) -> float:
+        """Seconds until an open breaker admits its half-open probe
+        (0.0 when not open) — the Retry-After hint a breaker-open
+        rejection carries, so clients back off until recovery is even
+        possible instead of hammering the fail-fast path."""
+        with self._lock:
+            if self._state != self.OPEN:
+                return 0.0
+            return max(0.0, self._open_until - self._clock())
+
     def record_success(self) -> None:
         with self._lock:
             self._failures = 0

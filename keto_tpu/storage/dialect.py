@@ -160,6 +160,12 @@ class Dialect:
         return "locked" in msg or "busy" in msg
 
 
+# in-driver retry window for SQLITE_BUSY before the typed error
+# surfaces (SQLiteDialect.on_connect; test-pinned in tests/test_store.py
+# beside the durability pragmas)
+BUSY_TIMEOUT_MS = 5000
+
+
 class SQLiteDialect(Dialect):
     txn_begin = None  # sqlite3's native deferred transactions
 
@@ -201,6 +207,12 @@ class SQLiteDialect(Dialect):
         conn.execute("PRAGMA journal_mode=WAL")
         conn.execute("PRAGMA synchronous=FULL")
         conn.execute("PRAGMA foreign_keys=ON")
+        #   busy_timeout=5000   — a statement hitting a sibling's lock
+        #     retries in-driver for up to 5 s before surfacing
+        #     SQLITE_BUSY (which _PrepConn then maps to the typed
+        #     retryable StoreBusyError): brief WAL-checkpoint / backup
+        #     contention resolves itself instead of failing requests
+        conn.execute(f"PRAGMA busy_timeout={BUSY_TIMEOUT_MS}")
 
 
 class PostgresDialect(Dialect):

@@ -1,0 +1,370 @@
+"""Store health plane: op timeouts + the store-path circuit breaker.
+
+Zanzibar survives its storage layer being slow or unavailable by serving
+reads at older-but-valid zookies from replicated caches (paper §2.3.2 /
+§2.4.1) — availability degrades to bounded staleness, never to wrong
+answers or hung threads. This module is the store-side twin of the
+device-path resilience plane (PR 5's breaker degrades a wedged DEVICE
+onto the store; this degrades a wedged/dead STORE onto the device
+mirror):
+
+  - `StoreHealthGuard` — the registry's OUTERMOST manager wrapper
+    (guard -> TracedManager -> store). Every serve-path READ runs under
+    a per-op budget (`store.op_timeout_ms`) on a bounded executor: a
+    hung SQL read answers the caller with a typed `StoreTimeoutError`
+    and frees the serving thread — the op thread may stay wedged in the
+    driver, but it can never pin a batcher or dispatch thread. Bulk
+    reads (`all_relation_tuples`, `all_tuple_columns`, `bulk_load`) get
+    the larger `store.bulk_timeout_ms` budget — an O(edges) mirror
+    rebuild is not a hung op.
+  - Store-path breaker — a `resilience.CircuitBreaker` singleton
+    (registry.store_breaker(), `store.breaker.{threshold,cooldown_s}`,
+    exported as `keto_tpu_store_breaker_state`): consecutive read
+    failures/timeouts trip it. While OPEN every op fails fast with a
+    typed `StoreUnavailableError(breaker_open=True)` — the marker the
+    degraded-serving gates key on (engine/snaptoken): reads the device
+    mirror covers answer at the mirror's covered version, everything
+    else is a typed 503 with the remaining cooldown as Retry-After.
+    WRITES never consume the half-open probe slot (recovery is decided
+    by a probe READ — typically the watch tailer's next poll, so the
+    breaker closes within one poll interval of the store coming back).
+  - Executor discipline: ops run on the bounded pool only when the
+    backing store can actually hang (an SQL dialect, or tests forcing
+    `use_executor=True`); the in-process dict stores (memory/columnar)
+    call inline — a dict read cannot hang, and the hot path should not
+    pay a cross-thread handoff for a non-risk. Breaker accounting and
+    fail-fast apply either way (fault injection makes dict stores
+    "fail" too — tools/outage_smoke.py's lever).
+
+Lock safety: the guard itself holds no lock across the bounded wait;
+callers that hold their own locks across store reads (the engine state
+lock, the watch hub's nid state lock — both carry reviewed
+`allow[lock-blocking-call]` reasons) now wait on a Future instead of
+directly in the driver, which is the same blocking shape with an upper
+bound — lockwatch-exempted with the same reasoning, see `_call`.
+
+`KETO_FAULTS="store_outage=error:..."` (keto_tpu/faults.py) injects at
+every guarded op — the process-wide outage the smoke harness drives.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeoutError
+from typing import Optional
+
+from .. import faults as _faults
+from ..errors import KetoError, StoreTimeoutError, StoreUnavailableError
+
+
+class _OpPool:
+    """Minimal daemon-thread op pool. NOT a ThreadPoolExecutor: its
+    workers are non-daemon and joined by an atexit hook, so one op
+    wedged in a dead SQL driver would hang PROCESS EXIT — the exact
+    "never hung" failure this plane removes. These workers are daemon
+    threads the interpreter abandons freely."""
+
+    def __init__(self, n: int, name: str):
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        for i in range(n):
+            threading.Thread(
+                target=self._run, name=f"{name}-{i}", daemon=True
+            ).start()
+
+    def _run(self) -> None:
+        while True:
+            fn, args, kwargs, fut = self._q.get()
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn(*args, **kwargs))
+            except BaseException as e:  # noqa: BLE001 — delivered to the
+                # waiting caller via the future; the worker must survive
+                fut.set_exception(e)
+
+    def submit(self, fn, args, kwargs) -> Future:
+        fut: Future = Future()
+        self._q.put((fn, args, kwargs, fut))
+        return fut
+
+
+DEFAULT_OP_TIMEOUT_MS = 1000.0
+DEFAULT_BULK_TIMEOUT_MS = 120000.0
+
+# serve-path reads: per-op budget + breaker accounting + breaker-open
+# fail-fast. `version` is the hottest (once per request at snaptoken
+# enforcement); the changelog reads feed the delta overlay and the watch
+# tail; get_relation_tuples feeds every host-oracle walk.
+_READS = (
+    "get_relation_tuples", "relation_tuple_exists", "version",
+    "changes_since", "changelog_since",
+)
+# O(edges) reads: same machinery, the bulk budget (a 1e8-tuple mirror
+# rebuild is minutes of honest work, not a hang)
+_BULK = ("all_relation_tuples", "all_tuple_columns")
+# writes (bulk_load included — it mutates): breaker-open fail-fast
+# (typed 503 — a write against a dead store must shed, not hang a
+# write-plane thread into the driver forever) + failure accounting +
+# the same typed conversion as reads (the FIRST failed write of an
+# outage is already a retryable 503, not a raw 500 — the breaker just
+# hasn't opened yet), but INLINE: post-commit hooks (watch notify,
+# push-invalidation) must keep firing on the writer thread, and a hung
+# write pins only the write-plane caller (the serve path is the read
+# side). Typed KetoErrors pass through untouched either way.
+_WRITES = (
+    "write_relation_tuples", "delete_relation_tuples",
+    "delete_all_relation_tuples", "transact_relation_tuples", "bulk_load",
+)
+
+
+def degraded_gate(cause, covered, age_s, ceiling, min_version) -> None:
+    """THE degraded-serving admission rule, shared by snaptoken
+    enforcement and the engine's serving gate (one policy, two doors —
+    they must never disagree on when a mirror answer is allowed):
+    raise unless serving at `covered` is permitted. `cause` is the
+    StoreUnavailableError that triggered degradation — only the
+    breaker's fail-fast (`breaker_open=True`) qualifies (an in-flight
+    failure while the breaker still counts re-raises: a parallel
+    transport may hold a fresher token); `covered` None = no mirror;
+    `age_s` over the `ceiling` (serve.check.degraded.max_staleness_s)
+    converts a silently-ancient mirror into the typed 503; a
+    `min_version` floor above `covered` is the no-time-travel refusal
+    (never a 409 — the store may well hold that version)."""
+    if not getattr(cause, "breaker_open", False):
+        raise cause
+    if covered is None:
+        raise cause
+    retry_after = getattr(cause, "retry_after_s", None)
+    if ceiling is not None and age_s > float(ceiling):
+        raise StoreUnavailableError(
+            "store unavailable and the device mirror is older than "
+            f"serve.check.degraded.max_staleness_s ({age_s:.1f}s > "
+            f"{float(ceiling):.1f}s)",
+            retry_after_s=retry_after,
+            breaker_open=True,
+        )
+    if min_version is not None and min_version > covered:
+        raise StoreUnavailableError(
+            f"store unavailable; snaptoken demands v{min_version} but "
+            f"the device mirror covers only v{covered}",
+            retry_after_s=retry_after,
+            breaker_open=True,
+        )
+
+
+class StoreBreakerMetrics:
+    """Adapter making resilience.CircuitBreaker (which speaks
+    `breaker_state` / `breaker_transitions_total`) export onto the
+    STORE-breaker gauges instead of the device-breaker ones — same
+    state machine, separate observability plane."""
+
+    def __init__(self, metrics):
+        self.breaker_state = metrics.store_breaker_state
+        self.breaker_transitions_total = metrics.store_breaker_transitions_total
+
+
+class StoreHealthGuard:
+    """Manager proxy: typed, bounded, breaker-gated store access (module
+    docstring). Everything not in _READS/_BULK/_WRITES delegates
+    untouched — hook registration, migration verbs, close."""
+
+    def __init__(
+        self,
+        inner,
+        breaker=None,
+        op_timeout_s: float = DEFAULT_OP_TIMEOUT_MS / 1e3,
+        bulk_timeout_s: float = DEFAULT_BULK_TIMEOUT_MS / 1e3,
+        use_executor: bool = False,
+        metrics=None,
+        max_op_threads: int = 4,
+    ):
+        self._inner = inner
+        self.breaker = breaker
+        self.op_timeout_s = float(op_timeout_s) if op_timeout_s else 0.0
+        self.bulk_timeout_s = float(bulk_timeout_s) if bulk_timeout_s else 0.0
+        self.use_executor = bool(use_executor)
+        self.metrics = metrics
+        self._max_op_threads = max(int(max_op_threads), 1)
+        # lazily spawned: a memory-store deployment never creates these
+        # threads at all
+        self._pool: Optional[_OpPool] = None
+        self._pool_mu = threading.Lock()
+        # wedged-op census: ops submitted whose caller already timed out
+        # and moved on; at _max_op_threads every worker is stuck in the
+        # driver and further executor ops fail fast instead of queueing
+        # behind the wedge (the bounded half of "bounded executor")
+        self._inflight = 0
+        self._inflight_mu = threading.Lock()
+        self.stats = {"timeouts": 0, "failures": 0, "fail_fast": 0}
+
+    # -- plumbing --------------------------------------------------------------
+
+    def _executor(self) -> _OpPool:
+        pool = self._pool
+        if pool is None:
+            with self._pool_mu:
+                pool = self._pool
+                if pool is None:
+                    pool = self._pool = _OpPool(
+                        self._max_op_threads, "keto-store-op"
+                    )
+        return pool
+
+    def _record_failure(self, op: str, kind: str) -> None:
+        self.stats["failures" if kind != "timeout" else "timeouts"] += 1
+        if self.breaker is not None:
+            self.breaker.record_failure()
+        if self.metrics is not None:
+            if kind == "timeout":
+                self.metrics.store_op_timeouts_total.labels(op).inc()
+            else:
+                self.metrics.store_op_failures_total.labels(op).inc()
+
+    def _record_success(self) -> None:
+        if self.breaker is not None:
+            self.breaker.record_success()
+
+    def _fail_fast(self, op: str) -> StoreUnavailableError:
+        self.stats["fail_fast"] += 1
+        if self.metrics is not None:
+            self.metrics.store_unavailable_total.labels(op).inc()
+        retry_after = None
+        if self.breaker is not None:
+            retry_after = self.breaker.open_remaining_s() or (
+                self.breaker.cooldown_s
+            )
+        return StoreUnavailableError(
+            "the tuple store is unavailable (store breaker open), "
+            "retry later",
+            retry_after_s=retry_after,
+            breaker_open=True,
+        )
+
+    def _timeout_for(self, op: str) -> float:
+        return self.bulk_timeout_s if op in _BULK else self.op_timeout_s
+
+    def _call(self, op: str, attr, probe_ok: bool, args, kwargs):
+        """One guarded op: fault point -> breaker gate -> bounded run ->
+        breaker accounting. `probe_ok`=False (writes) never consumes the
+        half-open probe slot — recovery is a read's verdict."""
+        breaker = self.breaker
+        if breaker is not None:
+            if probe_ok:
+                if not breaker.allow():
+                    raise self._fail_fast(op)
+            elif breaker.state != breaker.CLOSED:
+                raise self._fail_fast(op)
+        try:
+            _faults.inject("store_outage")
+            if not self.use_executor or self._timeout_for(op) <= 0:
+                out = attr(*args, **kwargs)
+            else:
+                out = self._bounded(op, attr, args, kwargs)
+        except KetoError as e:
+            # typed errors classify themselves: StoreUnavailableError
+            # family (incl. the sqlite BUSY mapping) is store-health
+            # evidence — EXCEPT pool backpressure (a saturated op pool
+            # on a healthy store must not trip the breaker); anything
+            # else (bad page token, malformed input) is the caller's
+            # error, not the store's
+            if isinstance(e, StoreUnavailableError) and not getattr(
+                e, "backpressure", False
+            ):
+                self._record_failure(
+                    op, "timeout" if isinstance(e, StoreTimeoutError)
+                    else "error",
+                )
+            raise
+        except Exception as e:
+            self._record_failure(op, "error")
+            # one typed, retryable shape for operational failures (the
+            # 503 / UNAVAILABLE family ReadClient's RetryPolicy backs
+            # off on); the original is preserved for the log/debug field
+            raise StoreUnavailableError(
+                f"store {op} failed: {type(e).__name__}: {e}",
+                debug=f"{type(e).__name__}: {e}",
+            ) from e
+        self._record_success()
+        return out
+
+    def _bounded(self, op: str, attr, args, kwargs):
+        """Run one op on the bounded pool under its budget. The caller
+        thread blocks at most the budget; the op thread stays wedged on
+        a hang (counted in `_inflight`), and a fully wedged pool fails
+        fast instead of queueing behind it."""
+        with self._inflight_mu:
+            if self._inflight >= self._max_op_threads:
+                # every op thread is already busy/wedged: queueing would
+                # just delay the typed answer by one budget per wedged
+                # op. This is BACKPRESSURE, not store-health evidence
+                # (four honest concurrent bulk reads saturate the pool
+                # on a healthy store) — the marker below keeps it out of
+                # the breaker's failure count; genuinely wedged ops trip
+                # the breaker through their own timeouts
+                err = StoreTimeoutError(
+                    f"store {op} rejected: all {self._max_op_threads} "
+                    "store-op threads are busy or wedged",
+                    retry_after_s=self._timeout_for(op),
+                )
+                err.backpressure = True
+                raise err
+            self._inflight += 1
+        fut = self._executor().submit(attr, args, kwargs)
+        fut.add_done_callback(self._dec_inflight)
+        from ..analysis import lockwatch
+
+        try:
+            # bounded wait; callers holding their own locks across store
+            # reads (engine state lock, watch nid state lock) carry
+            # reviewed allow[lock-blocking-call] reasons for the same
+            # blocking shape — the op thread only ever takes store
+            # locks, so the caller's locks cannot participate in a cycle
+            with lockwatch.allow_blocking(
+                "bounded store-op wait: the op thread takes only store "
+                "locks (never engine/hub locks), and the wait is capped "
+                "by store.op_timeout_ms — the hung-store case this "
+                "plane exists to bound"
+            ):
+                return fut.result(timeout=self._timeout_for(op))
+        except FutureTimeoutError:
+            raise StoreTimeoutError(
+                f"store {op} exceeded its "
+                f"{self._timeout_for(op) * 1e3:.0f} ms budget",
+                retry_after_s=self._timeout_for(op),
+            ) from None
+
+    def _dec_inflight(self, _fut) -> None:
+        with self._inflight_mu:
+            self._inflight -= 1
+
+    # -- proxy surface ---------------------------------------------------------
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if callable(attr):
+            if name in _READS or name in _BULK:
+                def guarded_read(*args, _a=attr, _n=name, **kwargs):
+                    return self._call(_n, _a, True, args, kwargs)
+
+                guarded_read.__name__ = name
+                # cache on the instance so the closure is built once per
+                # op name, not once per call (hot path: version())
+                object.__setattr__(self, name, guarded_read)
+                return guarded_read
+            if name in _WRITES:
+                def guarded_write(*args, _a=attr, _n=name, **kwargs):
+                    return self._call(_n, _a, False, args, kwargs)
+
+                guarded_write.__name__ = name
+                object.__setattr__(self, name, guarded_write)
+                return guarded_write
+        return attr
+
+    def close(self) -> None:
+        # op threads are daemonic and abandoned freely (a wedged one
+        # must never hold process exit hostage); only the store closes
+        inner_close = getattr(self._inner, "close", None)
+        if inner_close is not None:
+            inner_close()

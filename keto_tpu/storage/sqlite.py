@@ -362,14 +362,35 @@ class _PrepConn:
         self.raw = raw
         self._d = dialect
 
+    def _classified(self, err: Exception) -> Exception:
+        """SQLITE_BUSY / "database is locked" (and the other dialects'
+        transient-contention classes, via Dialect.is_transient) become
+        the TYPED retryable StoreBusyError — 503/UNAVAILABLE on the
+        wire, the code ReadClient's RetryPolicy backs off on — instead
+        of an opaque 500. busy_timeout (dialect.py) already retried
+        in-driver; what still surfaces is real sustained contention."""
+        if self._d.is_transient(err):
+            from ..errors import StoreBusyError
+
+            return StoreBusyError(
+                debug=f"{type(err).__name__}: {err}"
+            )
+        return err
+
     def execute(self, sql: str, params: Sequence = ()):
         cur = self.raw.cursor()
-        cur.execute(self._d.prep(sql), params)
+        try:
+            cur.execute(self._d.prep(sql), params)
+        except Exception as e:
+            raise self._classified(e) from e
         return cur
 
     def executemany(self, sql: str, rows: Sequence):
         cur = self.raw.cursor()
-        cur.executemany(self._d.prep(sql), rows)
+        try:
+            cur.executemany(self._d.prep(sql), rows)
+        except Exception as e:
+            raise self._classified(e) from e
         return cur
 
     def commit(self) -> None:

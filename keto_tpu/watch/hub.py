@@ -39,6 +39,7 @@ from collections import deque
 from typing import Callable, Optional, Sequence
 
 from ..engine.snaptoken import SnaptokenUnsatisfiableError, encode_snaptoken
+from ..errors import StoreUnavailableError
 from ..ketoapi import RelationTuple
 
 DEFAULT_BUFFER_EVENTS = 256
@@ -46,6 +47,12 @@ DEFAULT_POLL_INTERVAL = 0.25
 
 KIND_CHANGE = "change"
 KIND_RESET = "reset"
+# store-outage degradation plane (storage/health.py): the tailer cannot
+# read the changelog, so subscribers get ONE in-band marker per outage
+# episode instead of a silently stalled stream; delivery resumes from
+# the same cursors when the store recovers (a trimmed-changelog gap
+# during the outage flows through the normal RESET machinery)
+KIND_DEGRADED = "degraded"
 
 
 class WatchEvent:
@@ -75,9 +82,10 @@ class WatchEvent:
 
     def filtered(self, namespace: str) -> Optional["WatchEvent"]:
         """The event restricted to one namespace, or None when nothing
-        survives the filter (RESET events always survive — they signal
-        a gap, which a namespace filter must never hide)."""
-        if self.is_reset or not namespace:
+        survives the filter (RESET and DEGRADED events always survive —
+        they signal a gap / an outage, which a namespace filter must
+        never hide)."""
+        if self.kind != KIND_CHANGE or not namespace:
             return self
         kept = [
             (op, t) for op, t in self.changes if t.namespace == namespace
@@ -265,7 +273,7 @@ class _NidState:
 
     __slots__ = (
         "lock", "cond", "subs", "tail_version", "dirty", "pending_since",
-        "thread",
+        "thread", "degraded",
     )
 
     def __init__(self, tail_version: int):
@@ -276,6 +284,10 @@ class _NidState:
         self.dirty = False
         self.pending_since: Optional[float] = None
         self.thread: Optional[threading.Thread] = None
+        # True while the tailer is riding out a store outage (one
+        # DEGRADED marker per episode, flipped back on the first
+        # successful drain)
+        self.degraded = False
 
 
 class WatchHub:
@@ -591,7 +603,25 @@ class WatchHub:
                 if self._stopped:
                     state.thread = None
                     return
-                self._drain_locked(state, nid)
+                try:
+                    self._drain_locked(state, nid)
+                    state.degraded = False  # resumed delivery IS the recovery signal
+                except StoreUnavailableError:
+                    # store outage: never let the tailer thread die (a
+                    # dead tailer is a silently stalled stream) — push
+                    # ONE in-band DEGRADED marker per episode and keep
+                    # polling; the poll loop's next version read doubles
+                    # as the breaker's half-open probe, so recovery
+                    # closes the breaker within one poll interval
+                    if not state.degraded:
+                        state.degraded = True
+                        event = WatchEvent(
+                            KIND_DEGRADED, state.tail_version,
+                            encode_snaptoken(state.tail_version, nid),
+                        )
+                        for sub in state.subs:
+                            sub._push(event)
+                        self._count_degraded()
 
     # -- metrics helpers -------------------------------------------------------
 
@@ -605,3 +635,8 @@ class WatchHub:
         c = getattr(self.metrics, "watch_resets_total", None)
         if c is not None:
             c.inc()
+
+    def _count_degraded(self) -> None:
+        c = getattr(self.metrics, "store_degraded_serves_total", None)
+        if c is not None:
+            c.labels("watch").inc()

@@ -295,9 +295,19 @@ class TestStageMetrics:
         with pytest.raises(urllib.error.HTTPError) as e:
             urllib.request.urlopen(url)
         assert e.value.code == 403
-        text = urllib.request.urlopen(
-            f"http://127.0.0.1:{daemon.metrics_port}/metrics/prometheus"
-        ).read().decode()
+        # the counter increments when the server's observe_request block
+        # EXITS, which races the client seeing the response bytes — poll
+        # the scrape briefly instead of asserting the first read (the
+        # same post-response race PR 4 de-flaked on the request log)
+        deadline = time.monotonic() + 5
+        text = ""
+        while time.monotonic() < deadline:
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{daemon.metrics_port}/metrics/prometheus"
+            ).read().decode()
+            if 'code="403"' in text:
+                break
+            time.sleep(0.05)
         assert 'code="403"' in text
 
 

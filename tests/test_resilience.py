@@ -306,6 +306,112 @@ class TestBackoffAndRetry:
         ch.close()
 
 
+class _FlakyChannel:
+    """Fake grpc.Channel: every unary_unary callable raises UNAVAILABLE
+    `fail_times` times, then answers with the right response message for
+    its service path. Counts attempts per path so the retry contract on
+    every read surface is assertable without a server."""
+
+    def __init__(self, fail_times: int, code: str = "UNAVAILABLE"):
+        self.fail_times = fail_times
+        self.code = code
+        self.attempts: dict[str, int] = {}
+
+    def _response_for(self, path: str):
+        from keto_tpu.api.descriptors import pb
+
+        if path.endswith("/Check"):
+            return pb.CheckResponse(allowed=True, snaptoken="tok")
+        if path.endswith("/Filter"):
+            r = pb.FilterResponse(snaptoken="tok")
+            r.allowed_objects.extend(["doc"])
+            return r
+        if path.endswith("/ListObjects"):
+            r = pb.ListObjectsResponse(snaptoken="tok")
+            r.objects.extend(["doc"])
+            return r
+        if path.endswith("/ListSubjects"):
+            r = pb.ListSubjectsResponse(snaptoken="tok")
+            r.subject_ids.extend(["alice"])
+            return r
+        if path.endswith("/TransactRelationTuples"):
+            return pb.TransactRelationTuplesResponse()
+        raise AssertionError(f"unexpected path {path}")
+
+    def unary_unary(self, path, request_serializer=None,
+                    response_deserializer=None):
+        def call(req, timeout=None, metadata=None):
+            n = self.attempts.get(path, 0) + 1
+            self.attempts[path] = n
+            if n <= self.fail_times:
+                raise _FakeRpcError(self.code)
+            return self._response_for(path)
+
+        return call
+
+    def close(self):
+        pass
+
+
+class TestRetryOnNewerReadSurfaces:
+    """Satellite: RetryPolicy fires on UNAVAILABLE for the post-PR-5
+    read surfaces — filter, list_objects, list_subjects, check_explain
+    (everything riding ReadClient._rpc) — and NEVER for writes."""
+
+    def _client(self, fail_times=2):
+        ch = _FlakyChannel(fail_times)
+        pol = RetryPolicy(max_attempts=4, base_s=0.0, sleep=lambda s: None)
+        return ReadClient(ch, retry_policy=pol), ch, pol
+
+    def test_filter_retries_unavailable(self):
+        rc, ch, pol = self._client()
+        allowed, tok = rc.filter("files", "owner", "alice", ["doc", "x"])
+        assert allowed == ["doc"] and tok == "tok"
+        assert ch.attempts[f"/{_svc('FILTER_SERVICE')}/Filter"] == 3
+        assert pol.stats["retries"] == 2
+
+    def test_list_objects_retries_unavailable(self):
+        rc, ch, pol = self._client()
+        objs, _next, tok = rc.list_objects("files", "owner", "alice")
+        assert objs == ["doc"] and tok == "tok"
+        assert pol.stats["retries"] == 2
+
+    def test_list_subjects_retries_unavailable(self):
+        rc, ch, pol = self._client()
+        subs, _next, tok = rc.list_subjects("files", "doc", "owner")
+        assert subs == ["alice"] and tok == "tok"
+        assert pol.stats["retries"] == 2
+
+    def test_check_explain_retries_unavailable(self):
+        rc, ch, pol = self._client()
+        out = rc.check_explain(t("files:doc#owner@alice"))
+        assert out.allowed is True and out.snaptoken == "tok"
+        assert out.decision_trace is None  # fake answers carry no trace
+        assert pol.stats["retries"] == 2
+
+    def test_exhausted_attempts_reraise(self):
+        rc, ch, pol = self._client(fail_times=99)
+        with pytest.raises(_FakeRpcError):
+            rc.filter("files", "owner", "alice", ["doc"])
+        assert pol.stats["attempts"] == 4  # max_attempts, then re-raise
+
+    def test_writes_never_retry(self):
+        from keto_tpu.api.client import WriteClient
+
+        ch = _FlakyChannel(fail_times=99)
+        wc = WriteClient(ch)
+        with pytest.raises(_FakeRpcError):
+            wc.transact(insert=[t("files:doc#owner@alice")])
+        # exactly ONE attempt: a retried transact could double-apply
+        assert sum(ch.attempts.values()) == 1
+
+
+def _svc(name: str) -> str:
+    import keto_tpu.api.descriptors as _d
+
+    return getattr(_d, name)
+
+
 # ---------------------------------------------------------------------------
 # unit: CircuitBreaker
 # ---------------------------------------------------------------------------

@@ -662,6 +662,8 @@ class TestDurabilityPragmas:
     test pins the EFFECTIVE values back."""
 
     def test_file_backed_pragmas(self, tmp_path):
+        from keto_tpu.storage.dialect import BUSY_TIMEOUT_MS
+
         p = SQLitePersister(str(tmp_path / "durable.sqlite"))
         try:
             raw = p._conn.raw
@@ -670,6 +672,45 @@ class TestDurabilityPragmas:
             # writes survive power loss, not just kill -9)
             assert raw.execute("PRAGMA synchronous").fetchone()[0] == 2
             assert raw.execute("PRAGMA foreign_keys").fetchone()[0] == 1
+            # busy_timeout: in-driver retry under sibling-process lock
+            # contention BEFORE the typed StoreBusyError surfaces
+            assert (
+                raw.execute("PRAGMA busy_timeout").fetchone()[0]
+                == BUSY_TIMEOUT_MS
+            )
+        finally:
+            p.close()
+
+    def test_busy_errors_map_to_typed_retryable(self, tmp_path):
+        """SQLITE_BUSY / 'database is locked' surfaces as the typed
+        retryable StoreBusyError (503/UNAVAILABLE — the code the
+        client RetryPolicy backs off on), never an opaque driver
+        exception. Pinned at the _PrepConn boundary so every statement
+        — reads, writes, migrations — gets the mapping."""
+        import sqlite3
+
+        from keto_tpu.errors import StoreBusyError, StoreUnavailableError
+
+        p = SQLitePersister(str(tmp_path / "busy.sqlite"))
+        try:
+            # a second connection holding an EXCLUSIVE lock makes any
+            # statement on the persister's connection hit SQLITE_BUSY
+            # once its busy_timeout expires; shrink the window so the
+            # test doesn't wait the production 5s
+            p._conn.raw.execute("PRAGMA busy_timeout=50")
+            blocker = sqlite3.connect(str(tmp_path / "busy.sqlite"))
+            try:
+                blocker.execute("BEGIN EXCLUSIVE")
+                with pytest.raises(StoreBusyError) as e:
+                    p.write_relation_tuples(ts("a:1#r@u"))
+                assert isinstance(e.value, StoreUnavailableError)
+                assert e.value.status == 503
+            finally:
+                blocker.rollback()
+                blocker.close()
+            # contention gone: the same write succeeds
+            p.write_relation_tuples(ts("a:1#r@u"))
+            assert p.version() == 1
         finally:
             p.close()
 

@@ -1,0 +1,765 @@
+"""Store-outage degradation plane (PR 15): StoreHealthGuard op budgets
++ store-path circuit breaker, degraded snaptoken enforcement and mirror
+serving (never wrong, never hung), the no-time-travel floors, watch
+DEGRADED markers + heartbeats, and the Daemon startup probe."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import grpc
+import pytest
+
+from keto_tpu import faults
+from keto_tpu.api.daemon import Daemon
+from keto_tpu.config import Config
+from keto_tpu.engine.snaptoken import encode_snaptoken, enforce_snaptoken
+from keto_tpu.errors import (
+    InvalidPageTokenError,
+    KetoError,
+    StoreBusyError,
+    StoreTimeoutError,
+    StoreUnavailableError,
+)
+from keto_tpu.ketoapi import RelationQuery, RelationTuple
+from keto_tpu.namespace import Namespace
+from keto_tpu.observability import (
+    RequestTrace,
+    reset_request_trace,
+    set_request_trace,
+)
+from keto_tpu.registry import Registry
+from keto_tpu.resilience import CircuitBreaker
+from keto_tpu.storage.health import StoreHealthGuard
+from keto_tpu.storage.memory import MemoryManager
+
+NS = [Namespace(name="files"), Namespace(name="groups")]
+
+
+def t(s: str) -> RelationTuple:
+    return RelationTuple.from_string(s)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _seeded_manager():
+    m = MemoryManager()
+    m.write_relation_tuples([
+        t("files:doc#owner@alice"),
+        t("files:doc#view@(groups:g#member)"),
+        t("groups:g#member@bob"),
+    ])
+    return m
+
+
+def _registry(extra=None, dsn="memory"):
+    values = {
+        "dsn": dsn,
+        "check": {"engine": "tpu", "cache": {"enabled": False}},
+        "store": {"breaker": {"threshold": 2, "cooldown_s": 0.15}},
+    }
+    for key, val in (extra or {}).items():
+        cur = values
+        parts = key.split(".")
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[parts[-1]] = val
+    cfg = Config(values)
+    cfg.set_namespaces(list(NS))
+    reg = Registry(cfg)
+    reg.relation_tuple_manager().write_relation_tuples([
+        t("files:doc#owner@alice"),
+        t("files:doc#view@(groups:g#member)"),
+        t("groups:g#member@bob"),
+    ])
+    return reg
+
+
+def _trip_store_breaker(reg, n=4):
+    faults.set_fault("store_outage", error="injected outage")
+    m = reg.relation_tuple_manager()
+    for _ in range(n):
+        with pytest.raises(StoreUnavailableError):
+            m.version(nid=reg.nid)
+        if reg.store_breaker().state == "open":
+            break
+    assert reg.store_breaker().state == "open"
+
+
+# ---------------------------------------------------------------------------
+# unit: the guard
+# ---------------------------------------------------------------------------
+
+
+class TestStoreHealthGuard:
+    def test_reads_convert_to_typed_and_trip_breaker(self):
+        br = CircuitBreaker(threshold=2, cooldown_s=60)
+        g = StoreHealthGuard(_seeded_manager(), breaker=br)
+        faults.set_fault("store_outage", error="disk gone")
+        with pytest.raises(StoreUnavailableError) as e1:
+            g.version(nid="default")
+        assert not e1.value.breaker_open  # in-flight failure, not fail-fast
+        with pytest.raises(StoreUnavailableError):
+            g.version(nid="default")
+        assert br.state == "open"
+        # breaker open: fail-fast with the marker + a Retry-After hint,
+        # and ZERO store contact (the armed fault's hit counter freezes)
+        hits = faults.get("store_outage").hits
+        with pytest.raises(StoreUnavailableError) as e3:
+            g.get_relation_tuples(RelationQuery(namespace="files"))
+        assert e3.value.breaker_open
+        assert e3.value.retry_after_s and e3.value.retry_after_s > 0
+        assert faults.get("store_outage").hits == hits
+        assert g.stats["fail_fast"] >= 1
+
+    def test_writes_shed_while_open_and_never_probe(self):
+        clock = [0.0]
+        br = CircuitBreaker(
+            threshold=1, cooldown_s=1.0, clock=lambda: clock[0]
+        )
+        g = StoreHealthGuard(_seeded_manager(), breaker=br)
+        faults.set_fault("store_outage", error="down")
+        with pytest.raises(StoreUnavailableError):
+            g.version(nid="default")
+        assert br.state == "open"
+        faults.clear()
+        clock[0] = 5.0  # cooldown long past: a READ would probe now
+        with pytest.raises(StoreUnavailableError):
+            g.write_relation_tuples([t("files:doc#owner@eve")])
+        assert br.state == "open"  # the write consumed no probe slot
+        # the probe READ closes it; writes then flow again
+        assert g.version(nid="default") == 1
+        assert br.state == "closed"
+        g.write_relation_tuples([t("files:doc#owner@eve")])
+        assert g.version(nid="default") == 2
+
+    def test_write_errors_convert_typed_with_debug(self):
+        class _Boom:
+            def write_relation_tuples(self, tuples, nid="default"):
+                raise ValueError("disk full-ish")
+
+        br = CircuitBreaker(threshold=99, cooldown_s=60)
+        g = StoreHealthGuard(_Boom(), breaker=br)
+        # the FIRST failed write of an outage is already a retryable
+        # typed 503, not a raw 500 (the breaker just hasn't opened yet);
+        # the original error rides the debug field
+        with pytest.raises(StoreUnavailableError) as e:
+            g.write_relation_tuples([])
+        assert "disk full-ish" in (e.value.debug or "")
+        assert g.stats["failures"] == 1
+
+    def test_keto_errors_pass_through_without_breaker_accounting(self):
+        class _Paged:
+            def get_relation_tuples(self, *a, **k):
+                raise InvalidPageTokenError()
+
+        br = CircuitBreaker(threshold=1, cooldown_s=60)
+        g = StoreHealthGuard(_Paged(), breaker=br)
+        with pytest.raises(InvalidPageTokenError):
+            g.get_relation_tuples(None)
+        assert br.state == "closed"  # a client error is not store health
+
+    def test_busy_errors_count_as_store_health(self):
+        class _Busy:
+            def version(self, nid="default"):
+                raise StoreBusyError()
+
+        br = CircuitBreaker(threshold=2, cooldown_s=60)
+        g = StoreHealthGuard(_Busy(), breaker=br)
+        for _ in range(2):
+            with pytest.raises(StoreBusyError):
+                g.version()
+        assert br.state == "open"
+
+    def test_op_timeout_frees_the_caller(self):
+        release = threading.Event()
+
+        class _Hang:
+            def version(self, nid="default"):
+                release.wait(10)
+                return 1
+
+        g = StoreHealthGuard(
+            _Hang(), breaker=CircuitBreaker(threshold=99, cooldown_s=60),
+            op_timeout_s=0.1, use_executor=True,
+        )
+        t0 = time.monotonic()
+        with pytest.raises(StoreTimeoutError):
+            g.version()
+        assert time.monotonic() - t0 < 1.0  # the caller is FREE
+        assert g.stats["timeouts"] == 1
+        release.set()
+
+    def test_wedged_pool_fails_fast(self):
+        release = threading.Event()
+
+        class _Hang:
+            def version(self, nid="default"):
+                release.wait(10)
+                return 1
+
+        g = StoreHealthGuard(
+            _Hang(), breaker=None, op_timeout_s=0.05,
+            use_executor=True, max_op_threads=2,
+        )
+        for _ in range(2):  # wedge every op thread
+            with pytest.raises(StoreTimeoutError):
+                g.version()
+        t0 = time.monotonic()
+        with pytest.raises(StoreTimeoutError) as e:
+            g.version()
+        # rejected without waiting a full budget behind the wedge
+        assert time.monotonic() - t0 < 0.05
+        assert "wedged" in str(e.value) or "busy" in str(e.value)
+        release.set()
+
+    def test_hooks_and_untouched_methods_delegate(self):
+        m = _seeded_manager()
+        g = StoreHealthGuard(m, breaker=None)
+        seen = []
+        g.add_write_listener(seen.append)  # registration passes through
+        g.write_relation_tuples([t("files:doc2#owner@zed")])
+        assert seen == ["default"]
+        assert g.all_relation_tuples()  # bulk read path works
+
+    def test_fault_duration_self_clears(self):
+        spec = faults.set_fault(
+            "store_outage", error="brief", duration_s=0.3
+        )
+        g = StoreHealthGuard(_seeded_manager(), breaker=None)
+        with pytest.raises(StoreUnavailableError):
+            g.version()
+        time.sleep(0.45)
+        assert g.version() == 1  # the outage window expired on its own
+        # the fault table is PROCESS-GLOBAL: a background poller leaked
+        # from an earlier test in a full-suite run can consume hits on
+        # this spec too — assert it fired, not an exact count
+        assert spec.hits >= 1
+
+    def test_env_duration_suffix_parses(self):
+        faults.configure("store_outage=on~2.5")
+        spec = faults.get("store_outage")
+        assert spec is not None and spec.expires_at is not None
+
+
+# ---------------------------------------------------------------------------
+# degraded snaptoken enforcement + engine serving
+# ---------------------------------------------------------------------------
+
+
+class TestDegradedServing:
+    def test_enforce_falls_back_to_covered_version(self):
+        reg = _registry()
+        eng = reg.check_engine()
+        eng.check_batch([t("files:doc#owner@alice")])  # build the mirror
+        covered = eng.degraded_covered_version()
+        assert covered == 1
+        _trip_store_breaker(reg)
+        rt = RequestTrace()
+        token = set_request_trace(rt)
+        try:
+            assert enforce_snaptoken(reg, "", reg.nid) == covered
+            assert rt.min_version == covered
+        finally:
+            reset_request_trace(token)
+        # a token the mirror satisfies also degrades cleanly
+        ok = encode_snaptoken(covered, reg.nid)
+        assert enforce_snaptoken(reg, ok, reg.nid) == covered
+        # a token DEMANDING a newer version is a 503, never a 409 and
+        # never a stale serve
+        newer = encode_snaptoken(covered + 1, reg.nid)
+        with pytest.raises(StoreUnavailableError):
+            enforce_snaptoken(reg, newer, reg.nid)
+        assert (
+            reg.metrics().store_degraded_serves_total.labels(
+                "snaptoken"
+            )._value.get() >= 2
+        )
+
+    def test_degraded_checks_answer_from_mirror(self):
+        reg = _registry()
+        eng = reg.check_engine()
+        base = eng.check_batch(
+            [t("files:doc#owner@alice"), t("files:doc#view@bob"),
+             t("files:doc#owner@zed")]
+        )
+        _trip_store_breaker(reg)
+        res = eng.check_batch(
+            [t("files:doc#owner@alice"), t("files:doc#view@bob"),
+             t("files:doc#owner@zed")]
+        )
+        assert [r.allowed for r in res] == [r.allowed for r in base] == [
+            True, True, False,
+        ]
+        assert eng.stats.get("degraded_serves", 0) >= 1
+
+    def test_no_mirror_means_typed_503_not_wrong(self):
+        reg = _registry()
+        _trip_store_breaker(reg)  # before ANY state was built
+        eng = reg.check_engine()
+        # raw engine: the typed error propagates (the batcher's
+        # host-fallback route turns it into per-item typed errors; the
+        # REST/gRPC batch routes map it to a whole-request 503)
+        with pytest.raises(StoreUnavailableError):
+            eng.check_batch([t("files:doc#owner@alice")])
+
+    def test_rider_pinned_above_covered_gets_typed_503(self):
+        reg = _registry()
+        eng = reg.check_engine()
+        eng.check_batch([t("files:doc#owner@alice")])
+        covered = eng.degraded_covered_version()
+        _trip_store_breaker(reg)
+        fresh_rt = RequestTrace()
+        fresh_rt.min_version = covered + 1  # enforced before the outage
+        ok_rt = RequestTrace()
+        ok_rt.min_version = covered
+        handle = eng.check_batch_submit(
+            [t("files:doc#owner@alice"), t("files:doc#owner@alice")],
+            telemetry=[fresh_rt, ok_rt],
+        )
+        results, versions = eng.check_batch_resolve_v(handle)
+        assert isinstance(results[0].error, StoreUnavailableError)
+        assert results[1].error is None and results[1].allowed
+        assert versions[1] == covered
+
+    def test_staleness_ceiling_converts_to_503(self):
+        reg = _registry(
+            {"serve.check.degraded.max_staleness_s": 0.05}
+        )
+        eng = reg.check_engine()
+        eng.check_batch([t("files:doc#owner@alice")])
+        _trip_store_breaker(reg)
+        time.sleep(0.1)  # mirror age passes the ceiling
+        with pytest.raises(StoreUnavailableError) as e:
+            enforce_snaptoken(reg, "", reg.nid)
+        assert "max_staleness" in str(e.value)
+        with pytest.raises(StoreUnavailableError):
+            eng.check_batch([t("files:doc#owner@alice")])
+
+    def test_recovery_restores_fresh_serving(self):
+        reg = _registry()
+        eng = reg.check_engine()
+        eng.check_batch([t("files:doc#owner@alice")])
+        _trip_store_breaker(reg)
+        faults.clear()
+        time.sleep(0.2)  # past store.breaker.cooldown_s (0.15)
+        m = reg.relation_tuple_manager()
+        assert m.version(nid=reg.nid) == 1  # the half-open probe read
+        assert reg.store_breaker().state == "closed"
+        m.write_relation_tuples([t("files:doc#owner@eve")])
+        res = eng.check_batch([t("files:doc#owner@eve")])
+        assert res[0].allowed  # read-your-writes is back
+        assert enforce_snaptoken(reg, "", reg.nid) == 2
+
+    def test_filter_serves_built_mirror_and_refuses_host_fallback(self):
+        reg = _registry()
+        eng = reg.check_engine()
+        # build check + reverse mirrors while healthy (a filter ride
+        # lazily builds the transposed state from the store)
+        healthy = eng.filter_objects(
+            "files", "owner", "alice", ["doc", "nope"]
+        )
+        assert healthy == ["doc"]
+        _trip_store_breaker(reg)
+        # the built mirrors answer degraded: "doc" via the shared-
+        # frontier walk, "nope" via the monotone-vocab shortcut —
+        # zero store contact, same verdicts as healthy
+        out = eng.filter_objects("files", "owner", "alice", ["doc", "nope"])
+        assert out == healthy
+        # a degraded chunk that WOULD need the host oracle refuses with
+        # the typed 503 instead of mapping 'unknown' to 'hidden' (the
+        # filter surface has no per-candidate error channel)
+        with pytest.raises(StoreUnavailableError):
+            eng._degraded_host_filter_guard(True)
+        eng._degraded_host_filter_guard(False)  # healthy: no-op
+
+    def test_answer_floor_guard(self):
+        from keto_tpu.api.check_cache import require_answer_floor
+
+        require_answer_floor(None, 5)  # host answers are unpinned: fine
+        require_answer_floor(7, 5)  # fresher than the token: fine
+        with pytest.raises(StoreUnavailableError):
+            require_answer_floor(4, 5)  # stale-claiming: typed 503
+
+
+# ---------------------------------------------------------------------------
+# watch: DEGRADED markers instead of silent stalls
+# ---------------------------------------------------------------------------
+
+
+class TestWatchDegraded:
+    def test_marker_once_per_episode_then_recovery(self):
+        reg = _registry()
+        m = reg.relation_tuple_manager()
+        hub = reg.watch_hub()
+        sub = hub.subscribe(reg.nid)
+        try:
+            m.write_relation_tuples([t("files:a#owner@u1")])
+            ev = sub.get(timeout=5)
+            assert ev is not None and ev.kind == "change"
+            v_before = ev.version
+            _trip_store_breaker(reg)
+            ev = sub.get(timeout=5)
+            assert ev is not None and ev.kind == "degraded"
+            # exactly ONE marker per episode, however long it lasts
+            assert sub.get(timeout=0.6) is None
+            faults.clear()
+            time.sleep(0.2)
+            m.version(nid=reg.nid)  # probe read closes the breaker
+            m.write_relation_tuples([t("files:b#owner@u2")])
+            ev = sub.get(timeout=5)
+            assert ev is not None and ev.kind == "change"
+            assert ev.version == v_before + 1  # resumed, exactly once
+        finally:
+            sub.close()
+            hub.stop()
+
+    def test_degraded_event_survives_namespace_filter(self):
+        from keto_tpu.watch.hub import KIND_DEGRADED, WatchEvent
+
+        ev = WatchEvent(KIND_DEGRADED, 3, "tok")
+        assert ev.filtered("files") is ev
+
+
+# ---------------------------------------------------------------------------
+# daemon startup probe + config keys
+# ---------------------------------------------------------------------------
+
+
+class TestStartupProbe:
+    def test_bad_dsn_is_one_typed_error(self, tmp_path):
+        cfg = Config({
+            "dsn": f"sqlite://{tmp_path}/no/such/dir/x.db",
+            "serve": {
+                "read": {"host": "127.0.0.1", "port": 0},
+                "write": {"host": "127.0.0.1", "port": 0},
+                "metrics": {"host": "127.0.0.1", "port": 0},
+            },
+        })
+        cfg.set_namespaces(list(NS))
+        with pytest.raises(KetoError) as e:
+            Daemon(Registry(cfg))
+        assert "probe" in str(e.value) or "sqlite" in str(e.value).lower()
+
+    def test_cli_serve_exits_nonzero_with_one_line(self, tmp_path, capsys):
+        from keto_tpu.cli import main
+
+        cfg_path = tmp_path / "keto.json"
+        cfg_path.write_text(json.dumps({
+            "dsn": f"sqlite://{tmp_path}/no/such/dir/x.db",
+            "namespaces": [{"name": "files"}],
+        }))
+        rc = main(["serve", "--config", str(cfg_path)])
+        assert rc == 1
+        err = capsys.readouterr().err.strip()
+        assert err and "Traceback" not in err
+        assert len(err.splitlines()) == 1
+
+    def test_schema_accepts_store_health_keys(self):
+        Config({
+            "store": {
+                "health": {"enabled": True},
+                "op_timeout_ms": 250,
+                "bulk_timeout_ms": 60000,
+                "breaker": {"threshold": 3, "cooldown_s": 1.5},
+            },
+            "serve": {"check": {"degraded": {"max_staleness_s": 30}}},
+            "watch": {"heartbeat_s": 2.0},
+        })
+
+    def test_schema_rejects_bad_store_keys(self):
+        from keto_tpu.config import ConfigError
+
+        with pytest.raises(ConfigError):
+            Config({"store": {"op_timeout_ms": 0}})
+        with pytest.raises(ConfigError):
+            Config({"store": {"mystery_knob": 1}})
+
+    def test_health_disabled_serves_unwrapped(self):
+        reg = _registry({"store.health.enabled": False})
+        assert type(reg.relation_tuple_manager()).__name__ == "MemoryManager"
+
+    def test_sql_dsn_gets_executor_memory_does_not(self, tmp_path):
+        reg = _registry()
+        assert reg.relation_tuple_manager().use_executor is False
+        reg2 = _registry(dsn=f"sqlite://{tmp_path}/s.db")
+        assert reg2.relation_tuple_manager().use_executor is True
+
+
+# ---------------------------------------------------------------------------
+# tri-plane: degraded serving + write sheds through a live daemon
+# ---------------------------------------------------------------------------
+
+
+def _daemon(tmp_path):
+    cfg = Config({
+        "dsn": f"sqlite://{tmp_path}/outage.db",
+        "check": {"engine": "tpu"},
+        "store": {
+            "op_timeout_ms": 500,
+            "breaker": {"threshold": 2, "cooldown_s": 0.2},
+        },
+        "watch": {"heartbeat_s": 0.2, "poll_interval": 0.05},
+        "serve": {
+            "read": {
+                "host": "127.0.0.1", "port": 0,
+                "grpc": {"host": "127.0.0.1", "port": 0, "aio": True},
+            },
+            "write": {"host": "127.0.0.1", "port": 0},
+            "metrics": {"host": "127.0.0.1", "port": 0},
+        },
+    })
+    cfg.set_namespaces(list(NS))
+    reg = Registry(cfg)
+    reg.relation_tuple_manager().write_relation_tuples(
+        [t("files:doc#owner@alice")]
+    )
+    reg.check_engine().check_batch([t("files:doc#owner@alice")])
+    d = Daemon(reg)
+    d.start()
+    return d
+
+
+def _rest(url, method="GET", body=None, timeout=15):
+    req = urllib.request.Request(url, method=method)
+    data = None
+    if body is not None:
+        data = json.dumps(body).encode()
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, data, timeout=timeout) as r:
+            return r.status, r.read(), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), dict(e.headers)
+
+
+@pytest.mark.slow
+class TestTriPlaneOutage:
+    def test_outage_cycle_over_live_daemon(self, tmp_path):
+        d = _daemon(tmp_path)
+        reg = d.registry
+        base = f"http://127.0.0.1:{d.read_port}"
+        wbase = f"http://127.0.0.1:{d.write_port}"
+        try:
+            code, body, hdrs = _rest(
+                f"{base}/relation-tuples/check/openapi?namespace=files"
+                "&object=doc&relation=owner&subject_id=alice"
+            )
+            assert code == 200 and json.loads(body)["allowed"] is True
+            healthy_token = hdrs.get("X-Keto-Snaptoken")
+            # kill the store; hammer until the breaker opens
+            faults.set_fault("store_outage", error="injected outage")
+            deadline = time.monotonic() + 10
+            while (
+                reg.store_breaker().state != "open"
+                and time.monotonic() < deadline
+            ):
+                _rest(
+                    f"{base}/relation-tuples/check/openapi?namespace=files"
+                    "&object=doc&relation=owner&subject_id=alice"
+                )
+                time.sleep(0.02)
+            assert reg.store_breaker().state == "open"
+            # degraded read: correct answer, token = the staleness bound
+            code, body, hdrs = _rest(
+                f"{base}/relation-tuples/check/openapi?namespace=files"
+                "&object=doc&relation=owner&subject_id=alice"
+            )
+            assert code == 200 and json.loads(body)["allowed"] is True
+            assert hdrs.get("X-Keto-Snaptoken") == healthy_token
+            # writes shed typed 503 + Retry-After on BOTH write planes
+            code, body, hdrs = _rest(
+                f"{wbase}/admin/relation-tuples", "PUT",
+                {"namespace": "files", "object": "doc2",
+                 "relation": "owner", "subject_id": "eve"},
+            )
+            assert code == 503
+            parsed = json.loads(body)
+            assert parsed["error"]["status"] == "store_unavailable"
+            assert hdrs.get("Retry-After")
+            from keto_tpu.api.descriptors import WRITE_SERVICE, pb
+
+            ch = grpc.insecure_channel(f"127.0.0.1:{d.write_port}")
+            try:
+                stub = ch.unary_unary(
+                    f"/{WRITE_SERVICE}/TransactRelationTuples",
+                    request_serializer=lambda m: m.SerializeToString(),
+                    response_deserializer=(
+                        pb.TransactRelationTuplesResponse.FromString
+                    ),
+                )
+                req = pb.TransactRelationTuplesRequest()
+                delta = req.relation_tuple_deltas.add()
+                delta.action = 1
+                delta.relation_tuple.namespace = "files"
+                delta.relation_tuple.object = "doc2"
+                delta.relation_tuple.relation = "owner"
+                delta.relation_tuple.subject.id = "eve"
+                with pytest.raises(grpc.RpcError) as rpc_e:
+                    stub(req, timeout=15)
+                assert rpc_e.value.code() == grpc.StatusCode.UNAVAILABLE
+                assert rpc_e.value.details() == parsed["error"]["message"]
+            finally:
+                ch.close()
+            # breaker state observable on /metrics/prometheus
+            _, metrics_body, _ = _rest(
+                f"http://127.0.0.1:{d.metrics_port}/metrics/prometheus"
+            )
+            assert b"keto_tpu_store_breaker_state 1.0" in metrics_body
+            # recovery: the watch tailer's poll probes the store back
+            faults.clear()
+            deadline = time.monotonic() + 10
+            while (
+                reg.store_breaker().state != "closed"
+                and time.monotonic() < deadline
+            ):
+                # read traffic carries the half-open probe (any guarded
+                # read after the cooldown may be granted the probe slot)
+                _rest(
+                    f"{base}/relation-tuples/check/openapi?namespace=files"
+                    "&object=doc&relation=owner&subject_id=alice"
+                )
+                time.sleep(0.05)
+            assert reg.store_breaker().state == "closed"
+            code, body, _hdrs_post_write = _rest(
+                f"{wbase}/admin/relation-tuples", "PUT",
+                {"namespace": "files", "object": "doc2",
+                 "relation": "owner", "subject_id": "eve"},
+            )
+            assert code == 201
+            tok = _hdrs_post_write.get("X-Keto-Snaptoken", "")
+            code, body, _ = _rest(
+                f"{base}/relation-tuples/check/openapi?namespace=files"
+                "&object=doc2&relation=owner&subject_id=eve"
+                + (f"&snaptoken={tok}" if tok else "")
+            )
+            assert code == 200 and json.loads(body)["allowed"] is True
+        finally:
+            faults.clear()
+            d.stop()
+
+    def test_sse_heartbeat_comment_frames(self, tmp_path):
+        d = _daemon(tmp_path)
+        try:
+            url = (
+                f"http://127.0.0.1:{d.read_port}/relation-tuples/watch"
+            )
+            resp = urllib.request.urlopen(url, timeout=10)
+            try:
+                seen = b""
+                deadline = time.monotonic() + 5
+                while time.monotonic() < deadline:
+                    seen += resp.read1(4096)
+                    if seen.count(b": keep-alive") >= 2:
+                        break
+                # idle stream: at least two comment frames at the
+                # configured 0.2s cadence, well under the 5s default
+                assert seen.count(b": keep-alive") >= 2
+            finally:
+                resp.close()
+        finally:
+            d.stop()
+
+    def test_heartbeat_fires_under_filtered_out_traffic(self, tmp_path):
+        """A stream whose events are all namespace-filtered out is busy
+        but wire-silent — the heartbeat must fire by WALL time, not only
+        on idle gets, or a half-open peer on such a stream would never
+        be detected."""
+        d = _daemon(tmp_path)
+        reg = d.registry
+        stop = threading.Event()
+
+        def _writer():
+            n = 0
+            while not stop.is_set():
+                reg.relation_tuple_manager().write_relation_tuples(
+                    [t(f"files:spam{n}#owner@w")]
+                )
+                n += 1
+                time.sleep(0.02)
+
+        th = threading.Thread(target=_writer, daemon=True)
+        th.start()
+        try:
+            from keto_tpu.api.descriptors import WATCH_SERVICE, pb
+
+            ch = grpc.insecure_channel(f"127.0.0.1:{d.read_grpc_port}")
+            try:
+                stream = ch.unary_stream(
+                    f"/{WATCH_SERVICE}/Watch",
+                    request_serializer=lambda m: m.SerializeToString(),
+                    response_deserializer=pb.WatchResponse.FromString,
+                )
+                # subscribe to a namespace the writer never touches:
+                # every change event is filtered out server-side
+                call = stream(
+                    pb.WatchRequest(namespace="groups"), timeout=10
+                )
+                kinds = []
+                deadline = time.monotonic() + 5
+                for resp in call:
+                    kinds.append(resp.event_type)
+                    if (
+                        kinds.count("heartbeat") >= 2
+                        or time.monotonic() > deadline
+                    ):
+                        break
+                call.cancel()
+                assert kinds.count("heartbeat") >= 2
+                assert "change" not in kinds  # the filter held
+            finally:
+                ch.close()
+        finally:
+            stop.set()
+            th.join(timeout=5)
+            d.stop()
+
+    def test_grpc_watch_heartbeat_and_client_filter(self, tmp_path):
+        d = _daemon(tmp_path)
+        reg = d.registry
+        try:
+            from keto_tpu.api.client import ReadClient, open_channel
+            from keto_tpu.api.descriptors import WATCH_SERVICE, pb
+
+            # raw stream: heartbeat frames ARE on the wire
+            ch = grpc.insecure_channel(f"127.0.0.1:{d.read_grpc_port}")
+            try:
+                stream = ch.unary_stream(
+                    f"/{WATCH_SERVICE}/Watch",
+                    request_serializer=lambda m: m.SerializeToString(),
+                    response_deserializer=pb.WatchResponse.FromString,
+                )
+                call = stream(pb.WatchRequest(), timeout=10)
+                first = next(iter(call))
+                assert first.event_type == "heartbeat"
+                call.cancel()
+            finally:
+                ch.close()
+            # ReadClient: heartbeats consumed silently, data surfaced
+            ch2 = open_channel(f"127.0.0.1:{d.read_grpc_port}")
+            rc = ReadClient(ch2)
+            got = []
+
+            def _consume():
+                for ev in rc.watch(timeout=10, max_events=1):
+                    got.append(ev)
+
+            th = threading.Thread(target=_consume, daemon=True)
+            th.start()
+            time.sleep(0.6)  # several heartbeats pass; none surface
+            assert got == []
+            reg.relation_tuple_manager().write_relation_tuples(
+                [t("files:hb#owner@u1")]
+            )
+            th.join(timeout=10)
+            assert len(got) == 1 and got[0].event_type == "change"
+            ch2.close()
+        finally:
+            d.stop()
