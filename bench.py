@@ -1392,6 +1392,28 @@ def bench_served(namespaces, tuples, queries, serve_workers: int = 1) -> dict:
         served_launches = summarize_launches(
             daemon.registry.flight_recorder().entries()
         )
+        # workload observatory snapshot over the same phases: top-key
+        # concentration + live SLO burn state ride the artifact, so a
+        # committed bench leg also says WHAT traffic shape it measured
+        workload_snapshot = None
+        obs = daemon.registry.workload_observatory()
+        if obs is not None and obs.enabled:
+            hk = obs.hotkeys(top=5)
+            workload_snapshot = {
+                "hotkey_top_share": {
+                    kind: payload["top_share"]
+                    for kind, payload in hk["kinds"].items()
+                },
+                "slo": {
+                    name: {
+                        "burn_short": o["burn_short"],
+                        "fast_burn": o["fast_burn"],
+                    }
+                    for name, o in obs.slo_status().get(
+                        "objectives", {}
+                    ).items()
+                },
+            }
         # replica mode: the per-worker answered-checks breakdown (the
         # plain-int twin of worker_checks_total) — 1-vs-N comparisons
         # read occupancy skew straight from the artifact
@@ -1444,6 +1466,8 @@ def bench_served(namespaces, tuples, queries, serve_workers: int = 1) -> dict:
         out["served_stage_ms"] = stage_ms
     if served_launches:
         out["served_launch_telemetry"] = served_launches
+    if workload_snapshot is not None:
+        out["served_workload"] = workload_snapshot
     # each phase reports independently: a wedge between phases must not
     # discard the completed phase's measurement
     if "error" in low:

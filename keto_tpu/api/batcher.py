@@ -539,6 +539,7 @@ class CheckBatcher:
             for p in slot:
                 if p.rt is not None:
                     p.rt.add_stage("host_fallback", dur)
+                    p.rt.tier = "host"
                 if not p.future.done():
                     # host answers read the LIVE store: no pinned version
                     p.future.set_result((res, None))

@@ -88,6 +88,26 @@ def require_answer_floor(computed_v, version) -> None:
         )
 
 
+def _record_workload(registry, nid, t, res, rt) -> None:
+    """Per-(nid, relation) accounting feed: every SINGLE check that
+    clears the serve gate — cache hit or evaluated — lands one sample
+    in the workload observatory (verdict mix, answering-tier mix,
+    hot-key sketches). Errored results are the transport's problem (it
+    raises them into a status code; the SLO availability track counts
+    them at finish_request_telemetry) — they carry no verdict, so the
+    accounting skips them. Never raises: observability must not be
+    able to fail a Check."""
+    try:
+        obs = registry.workload_observatory()
+        if obs is not None and res.error is None:
+            obs.record_check(
+                nid, t, res.allowed, tier=getattr(rt, "tier", None)
+            )
+    # ketolint: allow[typed-error] reason=observability isolation on the serve fast path: an accounting bug must degrade to a lost sample, never replace the computed verdict the client is owed
+    except Exception:  # pragma: no cover - defensive isolation
+        pass
+
+
 def cached_check(registry, batcher, nid, t, max_depth, version, rt):
     """The transports' shared serve fast path: consult the cache, ride
     the batcher (or the bare engine) on a miss, store the verdict.
@@ -97,6 +117,7 @@ def cached_check(registry, batcher, nid, t, max_depth, version, rt):
     cache = registry.check_cache()
     res, gen = _fastpath_begin(cache, nid, t, max_depth, version, rt)
     if res is not None:
+        _record_workload(registry, nid, t, res, rt)
         return res
     if batcher is not None:
         res, computed_v = batcher.check_versioned(t, max_depth, nid=nid, rt=rt)
@@ -106,6 +127,7 @@ def cached_check(registry, batcher, nid, t, max_depth, version, rt):
     require_answer_floor(computed_v, version)
     if cache is not None:
         cache.store(nid, t, max_depth, res, computed_v, version, gen=gen)
+    _record_workload(registry, nid, t, res, rt)
     return res
 
 
@@ -115,11 +137,13 @@ async def cached_check_async(registry, batcher, nid, t, max_depth, version, rt):
     cache = registry.check_cache()
     res, gen = _fastpath_begin(cache, nid, t, max_depth, version, rt)
     if res is not None:
+        _record_workload(registry, nid, t, res, rt)
         return res
     res, computed_v = await batcher.check_versioned(t, max_depth, nid=nid, rt=rt)
     require_answer_floor(computed_v, version)
     if cache is not None:
         cache.store(nid, t, max_depth, res, computed_v, version, gen=gen)
+    _record_workload(registry, nid, t, res, rt)
     return res
 
 
@@ -258,6 +282,7 @@ class CheckCache:
         dur = time.perf_counter() - t0
         if rt is not None:
             rt.add_stage("cache", dur)
+            rt.tier = "cache"
         if self.metrics is not None:
             self.metrics.observe_stage(
                 "cache", dur,

@@ -342,6 +342,10 @@ class Daemon:
         from ..observability import configure_logging
 
         configure_logging(reg.config)
+        # workload observatory folder: with a daemon serving traffic,
+        # event folding moves off the request threads onto this ticker
+        # (observability_workload.WorkloadObservatory.start_folder)
+        reg.workload_observatory().start_folder()
         # internal loopback backends (ephemeral ports)
         self._grpc_write = build_grpc_server(reg, write=True)
         grpc_write_port = self._grpc_write.add_insecure_port("127.0.0.1:0")
@@ -674,6 +678,9 @@ class Daemon:
         # end the check cache's invalidation thread (daemon thread, but
         # a clean stop keeps test teardowns quiet)
         self.registry.close_check_cache()
+        # stop the workload folder with a final drain: the last served
+        # requests' accounting lands before the process reports stopped
+        self.registry.workload_observatory().stop_folder()
         # flush + stop the OTLP span exporter: the drain's own spans are
         # the last ones worth having at the collector (a bounded flush —
         # a dead collector costs at most its POST timeout, never a hang)

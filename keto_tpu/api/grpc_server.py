@@ -175,6 +175,7 @@ class _Services:
             self.registry.config.get("log.slow_query_ms"),
             "grpc", method, rt, code, duration,
             sample_rate=self.registry.config.get("log.request_sample_rate"),
+            workload=self.registry.workload_observatory(),
         )
 
     def _observed(self, method, context, fn, request):
@@ -355,11 +356,16 @@ class _Services:
             tuples.append(t)
         engine = self.registry.check_engine(nid)
         results = engine.check_batch(tuples, int(req.max_depth))
-        for i, r in zip(idx, results):
+        obs = self.registry.workload_observatory()
+        for pos, (i, r) in enumerate(zip(idx, results)):
             if r.error is not None:
                 out[i] = pb.BatchCheckResult(allowed=False, error=str(r.error))
             else:
                 out[i] = pb.BatchCheckResult(allowed=r.allowed)
+                if obs is not None:
+                    # per-item workload accounting (the batch bypasses
+                    # the single-check serve gate; no per-item tier)
+                    obs.record_check(nid, tuples[pos], r.allowed)
         resp = pb.BatchCheckResponse(snaptoken=encode_snaptoken(version, nid))
         resp.results.extend(out)
         return resp

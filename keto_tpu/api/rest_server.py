@@ -85,6 +85,12 @@ REPLICAS_ROUTE = "/admin/replicas"
 # reads counters/last-pass state, POST runs one full pass on demand and
 # returns the per-nid report
 SCRUB_ROUTE = "/admin/scrub"
+# workload observatory (metrics listener, observability_workload.py):
+# hot-key sketch top-K + cache attribution, live SLO burn rates, and the
+# capture/replay traffic profile `keto-tpu admin capture` downloads
+HOTKEYS_ROUTE = "/admin/hotkeys"
+SLO_ROUTE = "/admin/slo"
+WORKLOAD_ROUTE = "/admin/workload"
 SPEC_ROUTE = "/.well-known/openapi.json"
 
 # route -> router kind, the ONE ownership table (consumed by the spec
@@ -111,6 +117,9 @@ ROUTE_KINDS = {
     FLIGHTREC_ROUTE: "metrics",
     REPLICAS_ROUTE: "metrics",
     SCRUB_ROUTE: "metrics",
+    HOTKEYS_ROUTE: "metrics",
+    SLO_ROUTE: "metrics",
+    WORKLOAD_ROUTE: "metrics",
 }
 
 
@@ -311,6 +320,7 @@ class _Handler(BaseHTTPRequestHandler):
                 sample_rate=self.registry.config.get(
                     "log.request_sample_rate"
                 ),
+                workload=self.registry.workload_observatory(),
             )
 
     # -- routing --------------------------------------------------------------
@@ -366,6 +376,12 @@ class _Handler(BaseHTTPRequestHandler):
                     return SCRUB_ROUTE, self._scrub_status
                 if method == "POST":
                     return SCRUB_ROUTE, self._scrub_trigger
+            if method == "GET" and path == HOTKEYS_ROUTE:
+                return HOTKEYS_ROUTE, self._hotkeys_dump
+            if method == "GET" and path == SLO_ROUTE:
+                return SLO_ROUTE, self._slo_dump
+            if method == "GET" and path == WORKLOAD_ROUTE:
+                return WORKLOAD_ROUTE, self._workload_profile
             return None
 
         if self.kind == "read":
@@ -514,6 +530,12 @@ class _Handler(BaseHTTPRequestHandler):
             self.registry.validate_namespaces(t)
         except NamespaceNotFoundError:
             # unknown namespace => allowed=false, not 404 (handler.go:156-161)
+            rt.tier = "vocab"
+            obs = self.registry.workload_observatory()
+            if obs is not None:
+                # the swallowed corner never reaches the serve gate, so
+                # the workload accounting records it here
+                obs.record_check(nid, t, False, tier="vocab")
             code = 403 if mirror_status else 200
             payload: dict = {"allowed": False}
             if explain:
@@ -621,11 +643,19 @@ class _Handler(BaseHTTPRequestHandler):
             idx.append(i)
             tuples.append(t)
         engine = self.registry.check_engine(nid)
-        for i, res in zip(idx, engine.check_batch(tuples, max_depth)):
+        obs = self.registry.workload_observatory()
+        for pos, (i, res) in enumerate(
+            zip(idx, engine.check_batch(tuples, max_depth))
+        ):
             if res.error is not None:
                 out[i] = {"allowed": False, "error": str(res.error)}
             else:
                 out[i] = {"allowed": res.allowed}
+                if obs is not None:
+                    # per-item workload accounting (the batch bypasses
+                    # the single-check serve gate); the whole batch rode
+                    # one launch, so no per-item tier stamp exists here
+                    obs.record_check(nid, tuples[pos], res.allowed)
         self._json(
             200,
             {"results": out, "snaptoken": encode_snaptoken(version, nid)},
@@ -1015,7 +1045,11 @@ class _Handler(BaseHTTPRequestHandler):
         to find one filter launch is noise): `?kind=` keeps entries of
         one launch kind (check | closure | expand | list_objects |
         list_subjects | filter | filter_closure), `?trace_id=` keeps
-        entries whose riders carried that trace id. Both compose."""
+        entries whose riders carried that trace id, `?since_launch_id=`
+        keeps entries with a STRICTLY larger launch id — the tail
+        cursor: a poller passes the max id it has seen and downloads
+        only the increment instead of the whole ring (id order is the
+        documented join order, so the cursor is total). All compose."""
         import time as _time
 
         params = self._params()
@@ -1036,6 +1070,18 @@ class _Handler(BaseHTTPRequestHandler):
             entries = [
                 e for e in entries
                 if trace_id in (e.get("trace_ids") or ())
+            ]
+        since = params.get("since_launch_id", "")
+        if since:
+            try:
+                since_id = int(since)
+            except ValueError:
+                raise MalformedInputError(
+                    "since_launch_id must be an integer"
+                )
+            entries = [
+                e for e in entries
+                if (e.get("launch_id") or 0) > since_id
             ]
         self._json(200, {
             "enabled": fr.enabled,
@@ -1071,6 +1117,51 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, {"workers": [], "group_pending": 0})
             return
         self._json(200, group.stats())
+
+    def _hotkeys_dump(self) -> None:
+        """GET /admin/hotkeys: the Space-Saving sketches' live top-K
+        (object keys, subject keys, full check tuples) with counts,
+        overestimation errors, and traffic shares — plus the check-cache
+        attribution join ("the top 100 keys are X% of traffic, hit-ratio
+        Y" in one response). `?top=` bounds the per-kind entry count
+        (default 100, capped at the sketch capacity by construction)."""
+        params = self._params()
+        top = 100
+        raw = params.get("top", "")
+        if raw:
+            try:
+                top = max(1, int(raw))
+            except ValueError:
+                raise MalformedInputError("top must be an integer")
+        obs = self.registry.workload_observatory()
+        cache = self.registry.check_cache()
+        self._json(200, obs.hotkeys(
+            top=top,
+            cache_stats=cache.stats() if cache is not None else None,
+        ))
+
+    def _slo_dump(self) -> None:
+        """GET /admin/slo: live burn rates per objective over both
+        windows, event/bad counts, and the fast-burn flags — the same
+        numbers the keto_tpu_slo_* gauges export, with the window
+        arithmetic visible."""
+        self._json(200, self.registry.workload_observatory().slo_status())
+
+    def _workload_profile(self) -> None:
+        """GET /admin/workload: the capture/replay traffic profile
+        (key-popularity histograms, per-(nid, namespace, relation)
+        accounting, read/write ratio) — `keto-tpu admin capture`
+        downloads this and `tools/load_gen.py --profile` replays its
+        shape. `?top=` bounds the key-popularity histogram length."""
+        params = self._params()
+        top = 100
+        raw = params.get("top", "")
+        if raw:
+            try:
+                top = max(1, int(raw))
+            except ValueError:
+                raise MalformedInputError("top must be an integer")
+        self._json(200, self.registry.workload_observatory().profile(top=top))
 
     # -- write handlers -------------------------------------------------------
 

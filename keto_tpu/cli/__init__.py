@@ -659,6 +659,47 @@ def cmd_status(args) -> int:
         time.sleep(next(delays))
 
 
+def cmd_admin_capture(args) -> int:
+    """Download the live workload observatory profile
+    (GET /admin/workload on the metrics listener) and write it as a
+    committed-artifact traffic profile — the capture half of the
+    capture/replay loop; `tools/load_gen.py --profile <file>` replays
+    the shape (key-popularity histogram, per-nid mix, read/write
+    ratio)."""
+    import json as _json
+    import urllib.request
+
+    base = (
+        args.metrics_remote
+        or os.environ.get("KETO_METRICS_REMOTE")
+        or "http://127.0.0.1:4468"
+    ).rstrip("/")
+    if "://" not in base:
+        base = "http://" + base
+    url = f"{base}/admin/workload?top={int(args.top)}"
+    try:
+        with urllib.request.urlopen(url, timeout=args.timeout) as resp:
+            profile = _json.loads(resp.read().decode("utf-8"))
+    except Exception as e:  # noqa: BLE001 — network boundary
+        raise CLIError(f"could not capture workload profile from {url}: {e}")
+    if profile.get("schema") != "keto-tpu-workload-profile/1":
+        raise CLIError(
+            f"unexpected payload from {url}: not a workload profile "
+            f"(schema={profile.get('schema')!r})"
+        )
+    rendered = _json.dumps(profile, indent=2, sort_keys=True) + "\n"
+    if args.out == "-":
+        sys.stdout.write(rendered)
+    else:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(rendered)
+        print(
+            f"captured {profile.get('captured_requests', 0)} requests "
+            f"-> {args.out}"
+        )
+    return 0
+
+
 def cmd_clidoc(args) -> int:
     from .clidoc import generate
 
@@ -895,6 +936,32 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--endpoint", choices=["read", "write"], default="read")
     _add_remote_flags(p, read=True, write=True)
     p.set_defaults(fn=cmd_status)
+
+    p = sub.add_parser("admin", help="operator plane (metrics listener) utilities")
+    asub = p.add_subparsers(dest="admin_command", required=True)
+    ap = asub.add_parser(
+        "capture",
+        help="capture the live workload profile (traffic shape) to a file",
+        description="Downloads GET /admin/workload from the metrics "
+        "listener and writes the traffic profile artifact "
+        "(key-popularity histogram, per-namespace mix, read/write "
+        "ratio); replay the shape with tools/load_gen.py --profile.",
+    )
+    ap.add_argument(
+        "--metrics-remote", default=None,
+        help="metrics listener base URL (env KETO_METRICS_REMOTE; "
+             "default http://127.0.0.1:4468)",
+    )
+    ap.add_argument(
+        "--out", "-o", default="workload_profile.json",
+        help='output path ("-" writes to stdout)',
+    )
+    ap.add_argument(
+        "--top", type=int, default=100,
+        help="key-popularity histogram length per kind (default 100)",
+    )
+    ap.add_argument("--timeout", type=float, default=10.0)
+    ap.set_defaults(fn=cmd_admin_capture)
 
     p = sub.add_parser("version", help="print version")
     p.set_defaults(fn=cmd_version)

@@ -2788,6 +2788,8 @@ class TPUCheckEngine:
                 versions[i] = covered
                 if sink is not None:
                     sink[i] = {"tier": "closure"}
+                if telemetry is not None and telemetry[i] is not None:
+                    telemetry[i].tier = "closure"
             else:
                 leftover.append(i)
                 name = CL_CAUSE_NAMES.get(c, "uncovered")
@@ -2887,6 +2889,7 @@ class TPUCheckEngine:
         # per-item bookkeeping loop (~3x less host time per batch, and
         # the host loop serializes against the next launch's encode)
         sink = meta.get("explain_sink")
+        telemetry = meta.get("telemetry")
         if (
             n <= B
             and bool(q_valid[:n].all())
@@ -2901,6 +2904,10 @@ class TPUCheckEngine:
             if sink is not None:
                 for i in range(n):
                     sink[i] = {"tier": "device"}
+            if telemetry is not None:
+                for rt in telemetry:
+                    if rt is not None:
+                        rt.tier = "device"
             self.stats["device_checks"] += n
             if self.metrics is not None:
                 self.metrics.check_batch_size.observe(n)
@@ -2932,6 +2939,8 @@ class TPUCheckEngine:
                     versions.append(covered)
                     if sink is not None:
                         sink[i] = {"tier": "device"}
+                    if telemetry is not None and telemetry[i] is not None:
+                        telemetry[i].tier = "device"
                 else:
                     n_host += 1
                     # cause bookkeeping: the kernel reports a CAUSE_* code
@@ -2963,6 +2972,8 @@ class TPUCheckEngine:
                     versions.append(None)
                     if sink is not None:
                         sink[i] = {"tier": "host", "cause": cause}
+                    if telemetry is not None and telemetry[i] is not None:
+                        telemetry[i].tier = "host"
             sp.set_attribute("host_replays", n_host)
         self.stats["device_checks"] += n - n_host
         self.stats["host_checks"] += n_host

@@ -127,15 +127,25 @@ class RequestTrace:
     `launch_ids` collects the flight-recorder launch ids of every device
     batch this request rode (normally one; multi-split batches append
     several), so a slow-query line or request log joins its exact
-    launch record in `GET /admin/flightrec`."""
+    launch record in `GET /admin/flightrec`.
+    `tier` is the ANSWERING tier (cache | closure | device | host |
+    vocab), stamped by whichever layer produced the verdict — the check
+    cache on a hit, the engine resolve paths beside their explain-sink
+    fills, the REST unknown-namespace corner — so the request log and
+    the workload observatory see the tier on EVERY check, not just
+    explain=true ones."""
 
-    __slots__ = ("ctx", "stages", "deadline", "launch_ids", "min_version")
+    __slots__ = (
+        "ctx", "stages", "deadline", "launch_ids", "min_version", "tier",
+    )
 
     def __init__(self, ctx: Optional[SpanContext] = None, deadline=None):
         self.ctx = ctx if ctx is not None else new_trace()
         self.stages: dict[str, float] = {}
         self.deadline = deadline
         self.launch_ids: list[int] = []
+        # answering tier, stamped by the layer that produced the verdict
+        self.tier: Optional[str] = None
         # the store version this request's response snaptoken is minted
         # at, stamped by snaptoken enforcement: the store-outage
         # degradation plane's no-time-travel floor — a degraded (mirror)
@@ -901,12 +911,97 @@ class Metrics:
             ["reason"],
             registry=self.registry,
         )
+        # workload observatory + SLO plane (observability_workload.py,
+        # §5o): per-namespace accounting, hot-key sketch shares, and
+        # multi-window burn rates against the BASELINE.json objectives
+        self.workload_requests_total = prom.Counter(
+            "keto_tpu_workload_requests_total",
+            "Answered checks by (namespace, relation, answering tier, "
+            "verdict) — the per-workload accounting plane "
+            "(observability_workload.py): tier is cache | closure | "
+            "device | host | vocab | other (the §5m explain tiers, now "
+            "stamped on every check), verdict is allowed | denied. "
+            "Label cardinality is bounded by the configured vocabulary "
+            "(namespaces x relations), never by request content",
+            ["namespace", "relation", "tier", "verdict"],
+            registry=self.registry,
+        )
+        self.workload_tier_duration = prom.Histogram(
+            "keto_tpu_workload_tier_duration_seconds",
+            "Served request duration by ANSWERING tier (cache | "
+            "closure | device | host | vocab | other) — the workload "
+            "observatory's per-tier latency attribution: which tier "
+            "burns the latency budget, per scrape. OpenMetrics "
+            "exposition carries a trace_id exemplar per bucket, same "
+            "as the stage histogram",
+            ["tier"],
+            registry=self.registry,
+            buckets=(
+                0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 1.0,
+            ),
+        )
+        self.hotkey_share = prom.Gauge(
+            "keto_tpu_hotkey_share",
+            "Fraction of the sliding hot-key window's traffic answered "
+            "by the top-k keys of the Space-Saving sketch "
+            "(observability_workload.py): kind is object | subject, k "
+            "is 1 | 10 | 100 — the Zanzibar §4 hot-spot instrument as "
+            "a scrapeable gauge (join it with "
+            "keto_tpu_check_cache_ops_total for cache-hit "
+            "attribution); refreshed at most once per second from the "
+            "serve path, full detail at GET /admin/hotkeys",
+            ["kind", "k"],
+            registry=self.registry,
+        )
+        self.slo_objective_target = prom.Gauge(
+            "keto_tpu_slo_objective_target",
+            "The configured target per SLO objective "
+            "(slo.objectives.*): served_p95_ms in milliseconds, "
+            "availability as a fraction, max_staleness_s in seconds — "
+            "exported so dashboards and the perf gate judge by the "
+            "same number the live burn tracker uses",
+            ["objective"],
+            registry=self.registry,
+        )
+        self.slo_burn_rate = prom.Gauge(
+            "keto_tpu_slo_burn_rate",
+            "Error-budget burn rate per objective and window (short | "
+            "long, slo.window_short_s / slo.window_long_s): (bad "
+            "fraction over the window) / budget — 1.0 spends the "
+            "budget exactly on schedule, above slo.fast_burn_threshold "
+            "on BOTH windows is a fast burn (multi-window rule: the "
+            "short window catches the spike, the long window keeps one "
+            "blip from paging)",
+            ["objective", "window"],
+            registry=self.registry,
+        )
+        self.slo_fast_burn_active = prom.Gauge(
+            "keto_tpu_slo_fast_burn_active",
+            "1 while the objective is in fast burn (burn rate over "
+            "slo.fast_burn_threshold on both windows), else 0; every "
+            "evaluation tick spent fast-burning also emits a WARNING "
+            "log line — never sampled away",
+            ["objective"],
+            registry=self.registry,
+        )
+        self.slo_fast_burn_total = prom.Counter(
+            "keto_tpu_slo_fast_burn_total",
+            "Fast-burn EPISODES per objective (transitions into the "
+            "fast-burn state, not ticks spent in it) — the incident "
+            "counter an alert acknowledges",
+            ["objective"],
+            registry=self.registry,
+        )
         # hot-path cache: (transport, method) -> (duration child,
         # {code: counter child})
         self._observe_cache: dict = {}
         # stage -> histogram child (stage names are the CHECK_STAGES
         # constants, so this cache is bounded by construction)
         self._stage_cache: dict = {}
+        # tier -> histogram child (tier names are the TIERS constants
+        # of observability_workload.py — bounded by construction)
+        self._tier_cache: dict = {}
 
     OPENMETRICS_CONTENT_TYPE = (
         "application/openmetrics-text; version=1.0.0; charset=utf-8"
@@ -957,6 +1052,22 @@ class Metrics:
         if child is None:
             child = self._stage_cache[stage] = (
                 self.check_stage_duration.labels(stage)
+            )
+        if trace_id:
+            child.observe(seconds, exemplar={"trace_id": trace_id})
+        else:
+            child.observe(seconds)
+
+    def observe_tier(
+        self, tier: str, seconds: float, trace_id: Optional[str] = None
+    ) -> None:
+        """One served request's duration attributed to its ANSWERING
+        tier (cached label child, exemplared like observe_stage — the
+        workload observatory's per-tier latency feed)."""
+        child = self._tier_cache.get(tier)
+        if child is None:
+            child = self._tier_cache[tier] = (
+                self.workload_tier_duration.labels(tier)
             )
         if trace_id:
             child.observe(seconds, exemplar={"trace_id": trace_id})
@@ -1588,12 +1699,15 @@ def request_log(
     trace_id: str = "",
     stages: Optional[dict] = None,
     launch_ids: Optional[list] = None,
+    tier: Optional[str] = None,
 ) -> None:
     """Structured per-request log line (ref: reqlog middleware
     daemon.go:294), now carrying the trace id, the per-stage ms
-    breakdown, and the flight-recorder launch ids the request rode. The
-    isEnabledFor gate inside logger.info keeps this free on the serve
-    hot path at the default WARNING level."""
+    breakdown, the flight-recorder launch ids the request rode, and the
+    answering tier (cache | closure | device | host | vocab) — the tier
+    used to be visible only via explain=true, which bypasses the cache
+    and rate-limits. The isEnabledFor gate inside logger.info keeps
+    this free on the serve hot path at the default WARNING level."""
     if not logger.isEnabledFor(logging.INFO):
         return
     extra = {
@@ -1604,6 +1718,8 @@ def request_log(
     }
     if trace_id:
         extra["trace_id"] = trace_id
+    if tier:
+        extra["tier"] = tier
     if stages:
         extra["stages_ms"] = _stages_ms(stages)
     if launch_ids:
@@ -1620,13 +1736,15 @@ def slow_query_log(
     trace_id: str = "",
     stages: Optional[dict] = None,
     launch_ids: Optional[list] = None,
+    tier: Optional[str] = None,
 ) -> None:
     """Threshold-configurable slow-query line (`log.slow_query_ms`):
-    one structured WARNING with the trace id, per-stage ms, and the
-    launch ids of the device batches the request rode (join key into
-    `GET /admin/flightrec`), so a single slow request is attributable —
-    down to its exact launch record — without turning on full request
-    logging. None threshold = disabled; fires at duration >= threshold."""
+    one structured WARNING with the trace id, the answering tier,
+    per-stage ms, and the launch ids of the device batches the request
+    rode (join key into `GET /admin/flightrec`), so a single slow
+    request is attributable — down to its exact launch record — without
+    turning on full request logging. None threshold = disabled; fires
+    at duration >= threshold."""
     if threshold_ms is None:
         return
     duration_ms = duration_s * 1e3
@@ -1634,12 +1752,13 @@ def slow_query_log(
         return
     logger.warning(
         "slow request trace_id=%s transport=%s method=%r code=%s "
-        "duration_ms=%.3f launch_ids=%s stages_ms=%s",
+        "duration_ms=%.3f tier=%s launch_ids=%s stages_ms=%s",
         trace_id or "-",
         transport,
         method,
         code,
         duration_ms,
+        tier or "-",
         list(launch_ids or ()),
         _stages_ms(stages),
     )
@@ -1655,6 +1774,7 @@ def finish_request_telemetry(
     duration_s: float,
     skip_slow: bool = False,
     sample_rate=None,
+    workload=None,
 ) -> None:
     """Shared end-of-request bookkeeping for every transport (REST
     _route, sync-gRPC _observed, aio _observed): computes the transport
@@ -1669,7 +1789,13 @@ def finish_request_telemetry(
     the unconditional line is itself an overload source, so operators
     can dial it down without losing the slow-query WARNINGs — those
     ALWAYS emit (a sampled-out slow request would be exactly the
-    evidence an incident needs)."""
+    evidence an incident needs).
+
+    `workload` (the registry's WorkloadObservatory, or None) receives
+    every finished request: per-tier latency histogram, read/write
+    accounting, and the SLO engine's latency + availability events —
+    the same `skip_slow` flag exempts watch streams from the latency
+    objective (still counted for availability)."""
     rode_pipeline = bool(rt.stages)
     rt.add_stage(
         "transport", max(0.0, duration_s - sum(rt.stages.values()))
@@ -1679,6 +1805,12 @@ def finish_request_telemetry(
             "transport", rt.stages["transport"], trace_id=rt.ctx.trace_id
         )
     launch_ids = getattr(rt, "launch_ids", None)
+    tier = getattr(rt, "tier", None)
+    if workload is not None:
+        workload.observe_request(
+            method, code, duration_s, tier=tier,
+            trace_id=rt.ctx.trace_id, latency_eligible=not skip_slow,
+        )
     sampled_in = True
     if sample_rate is not None and float(sample_rate) < 1.0:
         import random as _random
@@ -1688,11 +1820,11 @@ def finish_request_telemetry(
         request_log(
             transport, method, code, duration_s,
             trace_id=rt.ctx.trace_id, stages=rt.stages,
-            launch_ids=launch_ids,
+            launch_ids=launch_ids, tier=tier,
         )
     if not skip_slow:
         slow_query_log(
             threshold_ms, transport, method, code, duration_s,
             trace_id=rt.ctx.trace_id, stages=rt.stages,
-            launch_ids=launch_ids,
+            launch_ids=launch_ids, tier=tier,
         )

@@ -137,6 +137,8 @@ class Registry:
         self._explain_limiter = None
         self._profiler = None
         self._flightrec = None
+        self._workload = None
+        self._workload_built = False
         self._scrubber = None
         self._closure_maintainer = None
         self._watch_hub = None
@@ -661,6 +663,60 @@ class Registry:
         if store_breaker is not None:
             ctx["store_breaker"] = store_breaker.state
         return ctx
+
+    def workload_observatory(self):
+        """The process-wide workload observatory + SLO plane
+        (observability_workload.WorkloadObservatory). ONE instance
+        shared by every transport: per-(nid, relation) accounting and
+        the hot-key sketches feed from the check serve gate, the SLO
+        engine feeds from finish_request_telemetry. `workload.enabled`
+        and `slo.enabled` gate the two halves internally (the object
+        always exists, so the A/B off arm is one attribute test).
+
+        Lock-free after the first call (every finished request consults
+        this): the built flag is written LAST under the lock, so a
+        reader seeing it set also sees the observatory reference — the
+        check cache's publication pattern."""
+        if self._workload_built:
+            return self._workload
+        with self._lock:
+            if not self._workload_built:
+                from .observability_workload import build_observatory
+
+                self._workload = build_observatory(
+                    self.config,
+                    metrics=self.metrics(),
+                    staleness_probe=self._mirror_staleness_age,
+                )
+                self._workload_built = True
+            return self._workload
+
+    def _mirror_staleness_age(self):
+        """Max mirror staleness age (seconds) across ALREADY-BUILT
+        engines, for the SLO max_staleness_s objective — never builds
+        an engine (sampled once per SLO eval tick; a probe must not
+        construct device mirrors), returns None when no built engine
+        reports one (host facade, nothing built yet)."""
+        worst = None
+        for eng in self.built_engines().values():
+            probe = getattr(eng, "mirror_staleness_age_s", None)
+            if probe is None:
+                continue
+            try:
+                age = probe()
+            # ketolint: allow[typed-error] reason=SLO staleness probe isolation: one engine's introspection failure must cost that engine's sample, never the whole evaluation tick (the probe runs inside the SLO engine's lock-held tick path)
+            except Exception:  # pragma: no cover - defensive isolation
+                continue
+            # a NEVER-synced engine reports inf — that is "no sync has
+            # happened yet" (cold start, first batch still compiling),
+            # not "the mirror is infinitely stale": nothing has been
+            # served from it. Counting it latched a spurious
+            # max_staleness_s fast burn on every cold start.
+            if age is None or age == float("inf"):
+                continue
+            if worst is None or age > worst:
+                worst = age
+        return worst
 
     def built_engines(self) -> dict:
         """Engines that already exist (default network + tenant LRU),

@@ -417,3 +417,66 @@ class TestReverseCLI:
         )
         assert code == 0
         assert "<no subjects>" in out
+
+
+class TestAdminCaptureCLI:
+    """`keto-tpu admin capture`: the capture half of the workload
+    capture/replay loop — downloads GET /admin/workload from the
+    metrics listener and writes the traffic-profile artifact that
+    `tools/load_gen.py --profile` replays."""
+
+    def _drive(self, capsys, tmp_path, remotes):
+        f = tmp_path / "tuples.json"
+        f.write_text(json.dumps([{
+            "namespace": "videos", "object": "v1",
+            "relation": "owner", "subject_id": "alice",
+        }]))
+        code, _, _ = run(
+            capsys, ["relation-tuple", "create", str(f), *remotes]
+        )
+        assert code == 0
+        code, out, _ = run(
+            capsys, ["check", "alice", "owner", "videos", "v1", *remotes]
+        )
+        assert code == 0 and "Allowed" in out
+
+    def test_capture_writes_profile_artifact(
+        self, capsys, tmp_path, daemon, remotes
+    ):
+        self._drive(capsys, tmp_path, remotes)
+        out_path = tmp_path / "profile.json"
+        code, out, _ = run(capsys, [
+            "admin", "capture",
+            "--metrics-remote", f"127.0.0.1:{daemon.metrics_port}",
+            "--out", str(out_path), "--top", "10",
+        ])
+        assert code == 0
+        assert "captured" in out
+        profile = json.loads(out_path.read_text())
+        assert profile["schema"] == "keto-tpu-workload-profile/1"
+        assert profile["captured_requests"] >= 1
+        assert profile["per_namespace"]["videos#owner"]["requests"] >= 1
+        objects = {
+            e["key"] for e in profile["key_popularity"]["object"]
+        }
+        assert "videos:v1" in objects
+        assert 0.0 <= profile["read_share"] <= 1.0
+
+    def test_capture_to_stdout(self, capsys, tmp_path, daemon, remotes):
+        self._drive(capsys, tmp_path, remotes)
+        code, out, _ = run(capsys, [
+            "admin", "capture",
+            "--metrics-remote", f"127.0.0.1:{daemon.metrics_port}",
+            "--out", "-",
+        ])
+        assert code == 0
+        assert json.loads(out)["schema"] == "keto-tpu-workload-profile/1"
+
+    def test_capture_unreachable_is_typed_error(self, capsys):
+        code, _, err = run(capsys, [
+            "admin", "capture",
+            "--metrics-remote", "127.0.0.1:1",  # nothing listens here
+            "--out", "-", "--timeout", "0.5",
+        ])
+        assert code == 1
+        assert "could not capture workload profile" in err

@@ -832,3 +832,33 @@ class TestFlightrecFilters:
         assert {e["launch_id"] for e in both["entries"]} == {
             e["launch_id"] for e in by_trace["entries"]
         }
+
+    def test_since_launch_id_cursor(self, daemon):
+        client = ReadClient(open_channel(f"127.0.0.1:{daemon.read_port}"))
+        try:
+            client.check(RelationTuple.from_string(TUPLE))
+        finally:
+            client.close()
+        full = self._dump(daemon)
+        ids = [e["launch_id"] for e in full["entries"]]
+        assert ids == sorted(ids), "dump must be in launch-id order"
+        cursor = ids[len(ids) // 2]
+        tail = self._dump(daemon, f"?since_launch_id={cursor}")
+        # STRICTLY-greater semantics: the poller passes the max id it
+        # has seen and receives only the increment
+        assert [e["launch_id"] for e in tail["entries"]] == [
+            i for i in ids if i > cursor
+        ]
+        # a cursor at the ring's tail yields the empty increment
+        empty = self._dump(daemon, f"?since_launch_id={max(ids)}")
+        assert empty["entries"] == []
+        # composes with ?kind=
+        both = self._dump(daemon, f"?kind=check&since_launch_id={cursor}")
+        assert all(
+            e["kind"] == "check" and e["launch_id"] > cursor
+            for e in both["entries"]
+        )
+        # a non-integer cursor is typed client error, not a 500
+        with pytest.raises(urllib.error.HTTPError) as e:
+            self._dump(daemon, "?since_launch_id=abc")
+        assert e.value.code == 400

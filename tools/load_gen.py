@@ -90,17 +90,71 @@ def build_filter_workload(
     return pool
 
 
+def load_profile(path: str):
+    """(queries, weights) from a captured workload profile
+    (`keto-tpu admin capture` / GET /admin/workload): the profile's
+    check-key popularity histogram becomes a weighted query pool, so a
+    replay drives the server with the MEASURED key skew instead of a
+    uniform synthetic mix — the replay half of the capture/replay
+    loop."""
+    from keto_tpu.ketoapi import RelationTuple
+
+    with open(path) as f:
+        profile = json.load(f)
+    if profile.get("schema") != "keto-tpu-workload-profile/1":
+        raise SystemExit(
+            f"{path} is not a workload profile "
+            f"(schema={profile.get('schema')!r})"
+        )
+    queries: list = []
+    weights: list[int] = []
+    for e in (profile.get("key_popularity") or {}).get("check") or []:
+        try:
+            queries.append(RelationTuple.from_string(e["key"]))
+        except Exception:
+            continue  # a malformed key skips one entry, never the replay
+        weights.append(max(int(e.get("count", 1)), 1))
+    if not queries:
+        raise SystemExit(f"{path} carries no replayable check keys")
+    return queries, weights
+
+
+def _make_sampler(rng, qn: int, weights=None):
+    """Index sampler over the query pool: uniform without weights,
+    popularity-proportional (cumulative + bisect, O(log n) per draw)
+    when a profile supplied them."""
+    if not weights:
+        return lambda: rng.randrange(qn)
+    import bisect
+
+    cum: list[int] = []
+    acc = 0
+    for w in weights[:qn]:
+        acc += w
+        cum.append(acc)
+    total = acc
+
+    def pick() -> int:
+        return min(
+            bisect.bisect_right(cum, rng.random() * total), qn - 1
+        )
+
+    return pick
+
+
 def run_step(
     clients, queries, rate: float, seconds: float,
     mode: str = "single", batch: int = 512, timeout: float = 30.0,
-    workers: int = 64, filter_queries=None,
+    workers: int = 64, filter_queries=None, weights=None,
 ) -> dict:
     """One open-loop step at a fixed offered rate; returns the result
     record (achieved QPS, scheduled-send latency percentiles, errors,
     shed ticks). `clients` is a pool of ReadClients reused across steps
-    so channel setup never lands inside a timed window."""
+    so channel setup never lands inside a timed window. `weights`
+    (from --profile) makes query sampling popularity-proportional."""
     rng = random.Random(0)
     qn = len(queries) if queries else 0
+    pick = _make_sampler(rng, qn, weights) if qn else None
     lock = threading.Lock()
     lat: list[float] = []
     errors = [0]
@@ -111,7 +165,7 @@ def run_step(
     def fire(scheduled: float, client) -> None:
         try:
             if mode == "single":
-                q = queries[rng.randrange(qn)]
+                q = queries[pick()]
                 client.check(q, timeout=timeout)
                 n = 1
             elif mode == "filter":
@@ -120,6 +174,12 @@ def run_step(
                 ]
                 client.filter("videos", "view", sub, cands, timeout=timeout)
                 n = len(cands)
+            elif weights:
+                # profile replay: each batch item drawn by popularity
+                # (a contiguous slice would flatten the skew)
+                qs = [queries[pick()] for _ in range(batch)]
+                client.check_batch(qs, timeout=timeout)
+                n = batch
             else:
                 start = rng.randrange(qn)
                 qs = [queries[(start + j) % qn] for j in range(batch)]
@@ -188,7 +248,7 @@ def run_step(
 def run_curve(
     addr: str, rates, seconds: float, mode: str = "single",
     batch: int = 512, timeout: float = 30.0, workers: int = 64,
-    queries=None, n_clients: int = 8, filter_queries=None,
+    queries=None, n_clients: int = 8, filter_queries=None, weights=None,
 ) -> dict:
     """The stepped saturation ladder as a callable (replica_smoke's
     committed-artifact path imports this): one open-loop step per
@@ -205,7 +265,7 @@ def run_curve(
             run_step(
                 clients, queries, rate, seconds,
                 mode=mode, batch=batch, timeout=timeout, workers=workers,
-                filter_queries=filter_queries,
+                filter_queries=filter_queries, weights=weights,
             )
             for rate in rates
         ]
@@ -253,6 +313,12 @@ def main() -> int:
     ap.add_argument("--queries", default=None,
                     help="JSON file of relation tuples; default: the "
                          "bench dataset's query mix")
+    ap.add_argument("--profile", default=None, metavar="PROFILE_JSON",
+                    help="replay a captured workload profile (keto-tpu "
+                         "admin capture): the check-key popularity "
+                         "histogram becomes a WEIGHTED query pool, so "
+                         "the drive reproduces the measured skew; "
+                         "overrides --queries")
     ap.add_argument("--record", default=None, metavar="OUT_JSON",
                     help="also write the result record to this file — "
                          "the committed-artifact mode (saturation curves "
@@ -265,11 +331,14 @@ def main() -> int:
     if args.workload is not None:
         args.mode = args.workload
     filter_queries = None
+    weights = None
     if args.mode == "filter":
         filter_queries = build_filter_workload(
             args.filter_objects, args.filter_hit_rate
         )
         queries = None
+    elif args.profile:
+        queries, weights = load_profile(args.profile)
     elif args.queries:
         with open(args.queries) as f:
             queries = [RelationTuple.from_dict(d) for d in json.load(f)]
@@ -286,6 +355,7 @@ def main() -> int:
             args.addr, rates, args.seconds, mode=args.mode,
             batch=args.batch, timeout=args.timeout, workers=args.workers,
             queries=queries, filter_queries=filter_queries,
+            weights=weights,
         )
     else:
         # a small client pool: gRPC channels multiplex, but one channel's
@@ -296,6 +366,7 @@ def main() -> int:
                 clients, queries, args.rate, args.seconds,
                 mode=args.mode, batch=args.batch, timeout=args.timeout,
                 workers=args.workers, filter_queries=filter_queries,
+                weights=weights,
             )
         finally:
             for c in clients:

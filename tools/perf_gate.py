@@ -78,6 +78,24 @@ def compare(record: dict, baseline: dict, metrics, threshold: float):
     return rows, skipped
 
 
+def slo_advisory(record: dict, served_p95_ms: float) -> None:
+    """Advisory SLO check: the fresh record's served p95 vs the
+    configured objective (`slo.objectives.served_p95_ms`, BASELINE.json's
+    north-star default) — the CI bench smoke and the live SLO engine
+    judging by ONE number. Advisory by design: prints, never fails (the
+    regression gate above owns the exit code), and skips when the record
+    carries no served leg (engine-only runs have no served p95)."""
+    fresh = record.get("served_c8_p95_ms")
+    if not isinstance(fresh, (int, float)):
+        print("perf_gate: slo: no served leg in record — skipped")
+        return
+    tag = "within" if fresh <= served_p95_ms else "OVER"
+    print(
+        f"perf_gate: slo: served p95 {fresh:.2f} ms vs objective "
+        f"{served_p95_ms:.2f} ms [{tag}] (advisory)"
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--record", required=True,
@@ -87,9 +105,14 @@ def main() -> int:
     ap.add_argument("--metrics", nargs="*", default=list(DEFAULT_METRICS))
     ap.add_argument("--threshold", type=float, default=0.20,
                     help="max tolerated fractional regression (default 0.20)")
+    ap.add_argument("--slo-served-p95-ms", type=float, default=10.0,
+                    help="served-p95 SLO objective to judge the fresh "
+                         "record against (advisory line; default 10, "
+                         "the slo.objectives.served_p95_ms default)")
     args = ap.parse_args()
 
     record = load_record(args.record)
+    slo_advisory(record, args.slo_served_p95_ms)
     # SKIP-ADVISORY, not error, when there is nothing honest to compare
     # against: a missing baseline artifact or a different-backend one
     # (a fresh repo clone, a first run on new hardware, a CPU run

@@ -1,0 +1,452 @@
+#!/usr/bin/env python
+"""Workload observatory + SLO plane smoke: CPU-runnable, CI-wired.
+
+Four legs against ONE live daemon (memory store, TPU-engine code path
+pinned to CPU, check cache ON — the serve fast path the observatory
+taps):
+
+  1. HOT KEYS — a Zipfian (s=1.1) single-check drive over 200 objects,
+     with EXACT per-key send counts as ground truth (the drive samples
+     the keys itself, so the true top-10 is the actual traffic's, not a
+     theoretical distribution's); `GET /admin/hotkeys` must recover
+     >= 9 of the true top-10 hot objects from a Space-Saving sketch at
+     capacity 128 < 200 distinct keys (genuinely lossy — every key
+     cannot just be tracked), and the `keto_tpu_hotkey_share` gauges
+     must be live in /metrics/prometheus.
+  2. CAPTURE -> REPLAY — `keto-tpu admin capture` (the real CLI, as a
+     subprocess, against the live metrics listener) writes the traffic
+     profile; `tools/load_gen.py --profile` replays it open-loop with
+     zero errors — the capture/replay loop round-trips end to end.
+  3. SLO BURN — an injected `store_read` stall (0.6 s against a 150 ms
+     served-p95 objective, windows smoke-tightened to 1 s / 4 s) must
+     drive a fast burn: the always-emitted WARNING lines captured, a
+     burn-rate excursion above threshold on `GET /admin/slo` AND on the
+     `keto_tpu_slo_burn_rate` gauge; after the fault clears, healthy
+     traffic must recover it (fast_burn false, burn back under
+     threshold, the recovery INFO line observed).
+  4. ON/OFF A/B — per-call-alternated observatory on vs off over the
+     cache-hit check path (the hottest path the plane touches); median
+     latencies must agree within --ab-tolerance. CI runs 0.10 for
+     shared-box noise; the committed WORKLOAD_AB_r18.json ran the
+     0.02 bar.
+
+Exit 0 prints one JSON summary line; any violation exits 1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import re
+import statistics
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_KEYS = 200
+ZIPF_S = 1.1
+N_DRAWS = 4000
+SKETCH_CAPACITY = 128
+SLO_P95_MS = 150.0
+SLO_THRESHOLD = 5.0
+STALL_S = 0.6
+
+
+class _Capture(logging.Handler):
+    def __init__(self):
+        super().__init__(level=logging.INFO)
+        self.lock2 = threading.Lock()
+        self.records: list[logging.LogRecord] = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        with self.lock2:
+            self.records.append(record)
+
+    def slo_lines(self, prefix: str, objective: str) -> int:
+        with self.lock2:
+            return sum(
+                1
+                for r in self.records
+                if str(r.msg).startswith(prefix)
+                and r.args
+                and r.args[0] == objective
+            )
+
+
+def _get_json(url: str, timeout: float = 10.0) -> dict:
+    return json.load(urllib.request.urlopen(url, timeout=timeout))
+
+
+def _zipf_draws(rng) -> list[int]:
+    """N_DRAWS key indices, Zipf(s=ZIPF_S) over N_KEYS via inverse CDF."""
+    weights = [1.0 / (i + 1) ** ZIPF_S for i in range(N_KEYS)]
+    total = sum(weights)
+    cum, acc = [], 0.0
+    for w in weights:
+        acc += w
+        cum.append(acc / total)
+    import bisect
+
+    return [
+        min(bisect.bisect_right(cum, rng.random()), N_KEYS - 1)
+        for _ in range(N_DRAWS)
+    ]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--ab-tolerance", type=float, default=0.02,
+        help="allowed relative excess of the observatory-ON median "
+             "per-call latency over OFF (CI passes 0.10 for shared-box "
+             "noise; the committed artifact bar is 0.02)",
+    )
+    ap.add_argument("--ab-calls", type=int, default=400,
+                    help="measured calls PER ARM in the on/off A/B")
+    ap.add_argument("--record", default=None, metavar="OUT_JSON",
+                    help="also write the result record to this file "
+                         "(the committed-artifact mode)")
+    args = ap.parse_args()
+
+    import random
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import bench
+    from keto_tpu import faults
+    from keto_tpu.api import ReadClient, open_channel
+    from keto_tpu.api.daemon import Daemon
+    from keto_tpu.config import Config
+    from keto_tpu.ketoapi import RelationTuple
+    from keto_tpu.registry import Registry
+
+    namespaces, _, _ = bench.build_dataset()
+    zipf_tuples = [
+        RelationTuple.from_string(f"videos:zipf-{i}#owner@zuser-{i}")
+        for i in range(N_KEYS)
+    ]
+    cfg = Config({
+        "dsn": "memory",
+        "check": {"engine": "tpu"},
+        "limit": {"max_read_depth": 5},
+        "log": {"level": "info"},
+        # capacity < distinct keys so the sketch is lossy; one long
+        # window so nothing rotates away mid-assertion
+        "workload": {
+            "hotkeys": {"capacity": SKETCH_CAPACITY, "window_s": 300.0},
+        },
+        # smoke-tightened SLO: 1 s / 4 s windows so a 7 s fault episode
+        # saturates BOTH (the multi-window rule stays exercised), and a
+        # served-p95 objective healthy CPU traffic clears but the
+        # injected stall cannot
+        "slo": {
+            "window_short_s": 1.0,
+            "window_long_s": 4.0,
+            "fast_burn_threshold": SLO_THRESHOLD,
+            "objectives": {
+                "served_p95_ms": SLO_P95_MS,
+                "availability": 0.999,
+                "max_staleness_s": 60.0,
+            },
+        },
+        "serve": {
+            "read": {"host": "127.0.0.1", "port": 0},
+            "write": {"host": "127.0.0.1", "port": 0},
+            "metrics": {"host": "127.0.0.1", "port": 0},
+        },
+    })
+    cfg.set_namespaces(namespaces)
+    reg = Registry(cfg)
+    reg.relation_tuple_manager().write_relation_tuples(zipf_tuples)
+    # XLA warm-up on the bucket sizes the serve path will ride
+    reg.check_engine().check_batch(zipf_tuples[:1])
+    reg.check_engine().check_batch(zipf_tuples[:64])
+
+    capture = _Capture()
+    logging.getLogger("keto_tpu").addHandler(capture)
+
+    out: dict = {"ab_tolerance": args.ab_tolerance}
+    oks: dict[str, bool] = {}
+    d = Daemon(reg)
+    d.start()
+    clients = []
+    try:
+        addr = f"127.0.0.1:{d.read_port}"
+        mbase = f"http://127.0.0.1:{d.metrics_port}"
+        clients = [ReadClient(open_channel(addr)) for _ in range(8)]
+
+        # ---- leg 1: Zipfian drive -> /admin/hotkeys top-10 recovery
+        draws = _zipf_draws(random.Random(18))
+        true_counts: dict[str, int] = {}
+        for i in draws:
+            k = f"videos:zipf-{i}"
+            true_counts[k] = true_counts.get(k, 0) + 1
+        errors = [0]
+
+        def drive(slice_, client):
+            for i in slice_:
+                try:
+                    client.check(zipf_tuples[i], timeout=30.0)
+                except Exception:
+                    errors[0] += 1
+
+        nthreads = len(clients)
+        threads = [
+            threading.Thread(
+                target=drive, args=(draws[t::nthreads], clients[t]),
+                daemon=True,
+            )
+            for t in range(nthreads)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=300)
+        true_top10 = [
+            k for k, _ in sorted(
+                true_counts.items(), key=lambda kv: kv[1], reverse=True
+            )[:10]
+        ]
+        hot = _get_json(f"{mbase}/admin/hotkeys?top=10")
+        sketch_top10 = [
+            e["key"] for e in hot["kinds"]["object"]["top"]
+        ]
+        overlap = len(set(true_top10) & set(sketch_top10))
+        prom = urllib.request.urlopen(
+            f"{mbase}/metrics/prometheus", timeout=10
+        ).read().decode()
+        out["hotkeys"] = {
+            "drive_errors": errors[0],
+            "distinct_keys": N_KEYS,
+            "draws": N_DRAWS,
+            "sketch_capacity": SKETCH_CAPACITY,
+            "true_top10": true_top10,
+            "sketch_top10": sketch_top10,
+            "overlap": overlap,
+            "top10_share": hot["kinds"]["object"]["top_share"]["10"],
+            "cache_join": "check_cache" in hot,
+        }
+        oks["hotkeys_ok"] = (
+            errors[0] == 0 and overlap >= 9
+            and "keto_tpu_hotkey_share{" in prom
+            and "check_cache" in hot
+        )
+
+        # ---- leg 2: CLI capture -> load_gen --profile replay
+        tmp = tempfile.mkdtemp(prefix="workload_smoke")
+        profile_path = os.path.join(tmp, "profile.json")
+        env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+        cap = subprocess.run(
+            [
+                sys.executable, "-m", "keto_tpu.cli", "admin", "capture",
+                "--metrics-remote", f"127.0.0.1:{d.metrics_port}",
+                "--out", profile_path,
+            ],
+            capture_output=True, text=True, timeout=120, env=env,
+        )
+        profile = {}
+        if cap.returncode == 0 and os.path.exists(profile_path):
+            with open(profile_path) as f:
+                profile = json.load(f)
+        replay = subprocess.run(
+            [
+                sys.executable, os.path.join(REPO, "tools", "load_gen.py"),
+                "--addr", addr, "--profile", profile_path,
+                "--rate", "150", "--seconds", "2", "--mode", "single",
+            ],
+            capture_output=True, text=True, timeout=300, env=env,
+        )
+        replay_rec = {}
+        if replay.returncode == 0:
+            replay_rec = json.loads(replay.stdout.strip().splitlines()[-1])
+        out["capture_replay"] = {
+            "capture_rc": cap.returncode,
+            "profile_schema": profile.get("schema"),
+            "captured_requests": profile.get("captured_requests", 0),
+            "read_share": profile.get("read_share", 0.0),
+            "profile_check_keys": len(
+                (profile.get("key_popularity") or {}).get("check") or []
+            ),
+            "replay_rc": replay.returncode,
+            "replay_achieved_checks_per_s": replay_rec.get(
+                "achieved_checks_per_s", 0.0
+            ),
+            "replay_errors": replay_rec.get("errors", -1),
+        }
+        oks["capture_replay_ok"] = (
+            cap.returncode == 0
+            and profile.get("schema") == "keto-tpu-workload-profile/1"
+            and profile.get("captured_requests", 0) > 0
+            and profile.get("read_share", 0.0) > 0.9
+            and out["capture_replay"]["profile_check_keys"] > 0
+            and replay.returncode == 0
+            and replay_rec.get("errors", -1) == 0
+            and replay_rec.get("achieved_checks_per_s", 0.0) > 0
+        )
+        if not oks["capture_replay_ok"]:
+            out["capture_replay"]["capture_stderr"] = cap.stderr[-1000:]
+            out["capture_replay"]["replay_stderr"] = replay.stderr[-1000:]
+
+        # ---- leg 3: on/off per-call-alternated A/B on the cache-hit path
+        obs = reg.workload_observatory()
+        hot_q = zipf_tuples[0]
+        client = clients[0]
+        for _ in range(50):  # warm the cache + the channel
+            client.check(hot_q, timeout=30.0)
+        lat_on: list[float] = []
+        lat_off: list[float] = []
+        slo_saved = obs.slo
+        try:
+            for i in range(2 * args.ab_calls):
+                on = i % 2 == 0
+                obs.enabled = on
+                obs.slo = slo_saved if on else None
+                t0 = time.perf_counter()
+                client.check(hot_q, timeout=30.0)
+                (lat_on if on else lat_off).append(
+                    time.perf_counter() - t0
+                )
+        finally:
+            obs.enabled = True
+            obs.slo = slo_saved
+        med_on = statistics.median(lat_on) * 1e3
+        med_off = statistics.median(lat_off) * 1e3
+        ratio = med_on / med_off if med_off > 0 else float("inf")
+        out["ab"] = {
+            "calls_per_arm": args.ab_calls,
+            "on_median_ms": round(med_on, 4),
+            "off_median_ms": round(med_off, 4),
+            "ratio": round(ratio, 4),
+        }
+        oks["ab_ok"] = ratio - 1.0 <= args.ab_tolerance
+
+        # ---- leg 4: SLO fast burn under an injected store_read stall
+        objective = "served_p95_ms"
+        warn_before = capture.slo_lines("slo fast burn", objective)
+        list_url = (
+            f"http://127.0.0.1:{d.read_port}/relation-tuples"
+            "?namespace=videos&relation=owner&object=zipf-0"
+        )
+        stop = threading.Event()
+        read_errors = [0]
+
+        def read_loop(pace: float):
+            while not stop.is_set():
+                try:
+                    urllib.request.urlopen(list_url, timeout=30).read()
+                except Exception:
+                    read_errors[0] += 1
+                if pace:
+                    stop.wait(pace)
+
+        def poll_burn(seconds: float):
+            """Max burn seen + whether fast_burn was observed active."""
+            peak_s = peak_l = 0.0
+            fast_seen = False
+            deadline = time.monotonic() + seconds
+            while time.monotonic() < deadline:
+                st = _get_json(f"{mbase}/admin/slo")["objectives"][objective]
+                peak_s = max(peak_s, st["burn_short"])
+                peak_l = max(peak_l, st["burn_long"])
+                fast_seen = fast_seen or st["fast_burn"]
+                time.sleep(0.5)
+            return peak_s, peak_l, fast_seen
+
+        faults.set_fault("store_read", stall_s=STALL_S)
+        threads = [
+            threading.Thread(target=read_loop, args=(0.0,), daemon=True)
+            for _ in range(4)
+        ]
+        for th in threads:
+            th.start()
+        # 7 s of stalled reads: long enough that the 4 s long window
+        # holds only fault-era traffic (the multi-window AND condition)
+        peak_s, peak_l, fast_seen = poll_burn(7.0)
+        prom_burn = None
+        m = re.search(
+            r'keto_tpu_slo_burn_rate\{objective="served_p95_ms",'
+            r'window="short"\}\s+([0-9.e+-]+)',
+            urllib.request.urlopen(
+                f"{mbase}/metrics/prometheus", timeout=10
+            ).read().decode(),
+        )
+        if m:
+            prom_burn = float(m.group(1))
+        faults.clear("store_read")
+        stop.set()
+        for th in threads:
+            th.join(timeout=60)
+        warn_during = capture.slo_lines("slo fast burn", objective)
+
+        # recovery: healthy traffic until the bad events age out of both
+        # windows, then the engine must report the burn over
+        stop = threading.Event()
+        threads = [
+            threading.Thread(target=read_loop, args=(0.05,), daemon=True)
+            for _ in range(4)
+        ]
+        for th in threads:
+            th.start()
+        time.sleep(6.5)
+        rec = _get_json(f"{mbase}/admin/slo")["objectives"][objective]
+        stop.set()
+        for th in threads:
+            th.join(timeout=60)
+        recovered_lines = capture.slo_lines("slo burn recovered", objective)
+        out["slo"] = {
+            "objective": objective,
+            "threshold": SLO_THRESHOLD,
+            "stall_s": STALL_S,
+            "peak_burn_short": round(peak_s, 2),
+            "peak_burn_long": round(peak_l, 2),
+            "fast_burn_observed": fast_seen,
+            "prom_burn_short_during_fault": prom_burn,
+            "warnings_during_fault": warn_during - warn_before,
+            "read_errors": read_errors[0],
+            "recovered_burn_short": round(rec["burn_short"], 2),
+            "recovered_fast_burn": rec["fast_burn"],
+            "recovery_lines": recovered_lines,
+        }
+        oks["slo_ok"] = (
+            fast_seen
+            and peak_s > SLO_THRESHOLD
+            and peak_l > SLO_THRESHOLD
+            and prom_burn is not None
+            and prom_burn > SLO_THRESHOLD
+            and warn_during - warn_before > 0
+            and read_errors[0] == 0
+            and not rec["fast_burn"]
+            and rec["burn_short"] <= SLO_THRESHOLD
+            and recovered_lines > 0
+        )
+    finally:
+        faults.clear("store_read")
+        for c in clients:
+            c.close()
+        logging.getLogger("keto_tpu").removeHandler(capture)
+        d.stop()
+
+    out.update(oks)
+    out["ok"] = all(oks.values())
+    print(json.dumps(out))
+    if args.record:
+        with open(args.record, "w") as f:
+            json.dump(out, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return 0 if out.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
