@@ -1089,6 +1089,227 @@ def bench_closure_ab() -> dict:
     }
 
 
+def _deep_columns(n_chains: int, depth: int = 20, n_users: int = 128,
+                  seed: int = 9, n_direct: int = 0):
+    """The deep-20 drive topology at COLUMNAR scale: the same
+    chain-of-parents shape as `_deep_dataset`, but synthesized as numpy
+    string columns and bulk-loaded (a MemoryManager write at 1e6 rows
+    is minutes of host dict churn). Chosen over `synth_columns`' flat
+    videos topology because the closure powers the DEEP universe.
+
+    `n_direct` appends that many direct viewer grants on random chain
+    nodes: they thicken the powered subject sets (real closure content)
+    WITHOUT adding universe nodes, so the tuple count can hit a target
+    (1e6) while the interesting-node universe — ~2 nodes per chain
+    object — stays inside MAX_CLOSURE_NODES."""
+    from keto_tpu.storage.columns import TupleColumns, concat_columns
+
+    rng = np.random.default_rng(seed)
+    n_par = n_chains * depth
+    chain = np.repeat(np.arange(n_chains), depth)
+    level = np.tile(np.arange(depth), n_chains)
+    stem = np.char.add(np.char.add("c", chain.astype("U8")), "f")
+    obj = np.char.add(stem, level.astype("U3"))
+    sobj = np.char.add(stem, (level + 1).astype("U3"))
+    par = TupleColumns(
+        ns=np.full(n_par, "deep", dtype="U4"),
+        obj=obj,
+        rel=np.full(n_par, "parent", dtype="U6"),
+        skind=np.ones(n_par, dtype=np.int8),
+        sns=np.full(n_par, "deep", dtype="U4"),
+        sobj=sobj,
+        srel=np.full(n_par, "...", dtype="U3"),
+    )
+    tails = np.char.add(
+        np.char.add("c", np.arange(n_chains).astype("U8")),
+        "f" + str(depth),
+    )
+    owner_names = np.char.add(
+        "u", rng.integers(0, n_users, n_chains).astype("U8")
+    )
+    own = TupleColumns(
+        ns=np.full(n_chains, "deep", dtype="U4"),
+        obj=tails,
+        rel=np.full(n_chains, "owner", dtype="U5"),
+        skind=np.zeros(n_chains, dtype=np.int8),
+        sns=np.full(n_chains, "", dtype="U1"),
+        sobj=owner_names,
+        srel=np.full(n_chains, "", dtype="U1"),
+    )
+    parts = [own, par]
+    if n_direct:
+        dc = rng.integers(0, n_chains, n_direct)
+        dl = rng.integers(0, depth + 1, n_direct)
+        dobj = np.char.add(
+            np.char.add(np.char.add("c", dc.astype("U8")), "f"),
+            dl.astype("U3"),
+        )
+        dusers = np.char.add(
+            "u", rng.integers(0, n_users, n_direct).astype("U8")
+        )
+        parts.append(TupleColumns(
+            ns=np.full(n_direct, "deep", dtype="U4"),
+            obj=dobj,
+            rel=np.full(n_direct, "viewer", dtype="U6"),
+            skind=np.zeros(n_direct, dtype=np.int8),
+            sns=np.full(n_direct, "", dtype="U1"),
+            sobj=dusers,
+            srel=np.full(n_direct, "", dtype="U1"),
+        ))
+    return concat_columns(parts), owner_names
+
+
+def _powering_context(target_tuples: int):
+    """Build the deep columnar store once and extract the powering
+    operands (graph + base snapshot) that both powering legs share."""
+    from keto_tpu.config import Config
+    from keto_tpu.engine.closure import extract_graph
+    from keto_tpu.engine.tpu_engine import TPUCheckEngine
+    from keto_tpu.storage.columnar import ColumnarStore
+
+    depth = 20
+    # the universe runs ~2 interesting nodes per chain object; cap the
+    # chain population so it stays under MAX_CLOSURE_NODES with slack,
+    # and make up the tuple-count target with direct viewer grants
+    max_chains = 960_000 // (2 * (depth + 1))
+    n_chains = max(1, min(target_tuples // (depth + 1), max_chains))
+    n_direct = max(0, target_tuples - n_chains * (depth + 1))
+    cols, _ = _deep_columns(n_chains, depth, n_direct=n_direct)
+    store = ColumnarStore()
+    store.bulk_load(cols)
+    m, cfg, _ = _deep_dataset()  # only for the namespace config
+    del m
+    engine = TPUCheckEngine(store, cfg, frontier_cap=BATCH)
+    t0 = time.perf_counter()
+    state = engine._ensure_state()
+    snapshot_s = time.perf_counter() - t0
+    graph = extract_graph(state.snapshot)
+    assert graph is not None, "deep topology must fit the closure caps"
+    meta = {
+        "tuples": int(cols.obj.shape[0]),
+        "chains": n_chains,
+        "depth": depth,
+        "closure_nodes": int(graph.universe.shape[0]),
+        "closure_edges": int(graph.e_dst.shape[0]),
+        "snapshot_build_s": round(snapshot_s, 3),
+        "max_depth": cfg.max_read_depth(),
+    }
+    return graph, state.snapshot, state.base_version, meta
+
+
+def _build_sweep_entry(msr: int, build, rec: dict) -> dict:
+    return {
+        "max_set_rows": msr,
+        "build_s": round(rec["build_s"], 3),
+        "covered_nodes": int(build.covered_keys.shape[0]),
+        "entries": int(build.ent_obj.shape[0]),
+        "waves": rec["waves"],
+        "steps": rec["steps"],
+        "lanes": rec["lanes"],
+        "hbm_bytes": {k: int(v) for k, v in rec["hbm"].items()},
+        "hbm_total_bytes": int(sum(rec["hbm"].values())),
+    }
+
+
+def bench_closure_build(context=None, msrs=(4, 64, 4096)) -> dict:
+    """Device-powering build leg: GraphBLAS closure powering over the
+    deep topology at ~1e6 tuples, swept across `closure.max_set_rows` —
+    the knob that trades coverage for index size. Records build seconds
+    plus the packed-adjacency / bit-matrix / scratch HBM footprint the
+    kernel actually reserved (the numbers `hbm_snapshot` accounts live
+    under the closure_power family)."""
+    from keto_tpu.engine.closure_power import power_closure_device
+
+    target = int(os.environ.get("KETO_BENCH_CLOSURE_TUPLES", "1000000"))
+    graph, snap, base_version, meta = (
+        context if context is not None else _powering_context(target)
+    )
+    sweep = []
+    for msr in msrs:
+        build, rec = power_closure_device(
+            graph, snap, meta["max_depth"], msr, base_version
+        )
+        sweep.append(_build_sweep_entry(msr, build, rec))
+    return {"metric": "closure_build", **meta, "sweep": sweep}
+
+
+def bench_powering_ab() -> dict:
+    """Host-vs-device powering A/B (the --ab-closure protocol applied
+    to the BUILDER): the same graph and snapshot powered by the numpy
+    host builder and the bit-packed device kernel, compared field by
+    field. The device contract is bit-identity — covered sets, entry
+    rows, AND first-discovery req depths must match exactly — so every
+    mismatch field must read zero. The max_set_rows sweep rides along
+    as the build-cost curve."""
+    from keto_tpu.engine.closure import power_closure
+    from keto_tpu.engine.closure_power import power_closure_device
+
+    target = int(os.environ.get("KETO_BENCH_CLOSURE_TUPLES", "1000000"))
+    ctx = _powering_context(target)
+    graph, snap, base_version, meta = ctx
+    msr = 4096
+
+    t0 = time.perf_counter()
+    hb = power_closure(graph, snap, meta["max_depth"], msr, base_version)
+    host_s = time.perf_counter() - t0
+    db, rec = power_closure_device(
+        graph, snap, meta["max_depth"], msr, base_version
+    )
+
+    covered_mismatches = int(
+        np.setxor1d(hb.covered_keys, db.covered_keys).shape[0]
+    )
+    fields = ("ent_obj", "ent_rel", "ent_skind", "ent_sa", "ent_sb")
+    exact = all(
+        np.array_equal(getattr(hb, f), getattr(db, f)) for f in fields
+    )
+    if exact:
+        subject_mm = 0
+        req_mm = int(np.count_nonzero(hb.ent_req != db.ent_req))
+    else:
+        # identity failed somewhere: count as SETS so the record says
+        # how wrong, not just that ordering differed
+        def rows(b):
+            m = np.ascontiguousarray(np.stack(
+                [getattr(b, f).astype(np.int64) for f in fields], axis=1
+            ))
+            return m.view([("", np.int64)] * len(fields)).ravel()
+
+        hv, dv = rows(hb), rows(db)
+        subject_mm = int(
+            np.setdiff1d(hv, dv).shape[0] + np.setdiff1d(dv, hv).shape[0]
+        )
+        hs, hi = np.unique(hv, return_index=True)
+        pos = np.searchsorted(hs, dv)
+        pos = np.clip(pos, 0, len(hs) - 1)
+        hit = hs[pos] == dv
+        req_mm = int(np.count_nonzero(
+            hb.ent_req[hi[pos[hit]]] != db.ent_req[np.flatnonzero(hit)]
+        ))
+
+    return {
+        "metric": "powering_ab",
+        **meta,
+        "max_set_rows": msr,
+        "host_build_s": round(host_s, 3),
+        "device_build_s": round(rec["build_s"], 3),
+        "host_vs_device": round(host_s / max(rec["build_s"], 1e-9), 4),
+        "covered_nodes": int(db.covered_keys.shape[0]),
+        "entries": int(db.ent_obj.shape[0]),
+        "subject_set_mismatches": subject_mm,
+        "req_depth_mismatches": req_mm,
+        "covered_key_mismatches": covered_mismatches,
+        "device_waves": rec["waves"],
+        "device_steps": rec["steps"],
+        "device_lanes": rec["lanes"],
+        "device_hbm_bytes": {k: int(v) for k, v in rec["hbm"].items()},
+        # the A/B's own device build IS the sweep's top point — one
+        # fewer multi-minute powering on the 1-core bench host
+        "build_sweep": bench_closure_build(context=ctx, msrs=(4, 64))
+        ["sweep"] + [_build_sweep_entry(msr, db, rec)],
+    }
+
+
 def bench_grpc_echo_ceiling(seconds: float = 3.0, n_threads: int = 32) -> dict:
     """The HOST PLATFORM's gRPC ceiling: a zero-logic echo server and
     closed-loop clients, all in this process tree. On the 1-core bench
@@ -1569,6 +1790,14 @@ def main() -> int:
              "plus the flat-contrast acceptance ratio) and print its "
              "JSON record",
     )
+    ap.add_argument(
+        "--ab-powering", action="store_true",
+        help="run ONLY the closure-powering A/B leg (host numpy builder "
+             "vs the on-device bit-packed GraphBLAS kernel over the "
+             "~1e6-tuple deep topology: bit-identity mismatch counts, "
+             "build seconds, and the max_set_rows HBM sweep) and print "
+             "its JSON record",
+    )
     args = ap.parse_args()
 
     platform = args.platform
@@ -1633,6 +1862,12 @@ def main() -> int:
 
         if args.ab_filter:
             ab = bench_filter()
+            ab["device"] = str(jax.devices()[0])
+            print(json.dumps(ab))
+            return 0
+
+        if args.ab_powering:
+            ab = bench_powering_ab()
             ab["device"] = str(jax.devices()[0])
             print(json.dumps(ab))
             return 0

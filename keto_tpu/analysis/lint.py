@@ -78,7 +78,7 @@ _BOUNDARY_FILES = {"rest_server.py", "grpc_server.py", "aio_server.py"}
 # engine modules whose hot-path functions the host-sync pass inspects
 _HOT_FILES = {
     "tpu_engine.py", "kernel.py", "reverse_kernel.py", "expand_kernel.py",
-    "closure_kernel.py",
+    "closure_kernel.py", "closure_power.py",
 }
 # `_inner` variants: the public hot entry points wrap their bodies in a
 # launch-id-stamping try/except (engine flight recorder); the moved-out
@@ -87,7 +87,7 @@ _HOT_FILES = {
 # by accident
 _HOT_FUNCS = re.compile(
     r"^_?(check_batch_submit|check_batch_resolve(_v)?|check_batch"
-    r"|closure_batch_resolve(_v)?"
+    r"|closure_batch_resolve(_v)?|closure_power_resolve"
     r"|list_objects_batch|list_subjects_batch|expand_batch"
     r"|filter_batch|filter_chunk)(_inner)?$"
 )

@@ -38,10 +38,20 @@ import numpy as np
 
 from .snapshot import GraphSnapshot
 
-FORMAT_VERSION = 3  # v3: bucketized probe sequence (snapshot.probe_slot)
-# — v2 files hold tables built with the old (h1 + j*h2) slot layout and
-# would mis-probe; a version mismatch just triggers a rebuild.
+FORMAT_VERSION = 4  # v4: backend-keyed table layout — the meta vector
+# grew a layout code (bucketized vs compact r04, snapshot.table_layout)
+# because the two layouts place keys in DIFFERENT slots: a checkpoint
+# written under one layout loaded under the other would mis-probe every
+# table, so a layout mismatch degrades to a rebuild exactly like a
+# version mismatch.
+# v3: bucketized probe sequence (snapshot.probe_slot) — v2 files hold
+# tables built with the old (h1 + j*h2) slot layout and would mis-probe;
+# a version mismatch just triggers a rebuild.
 # v2: island circuits (AND/NOT device programs)
+
+# layout code riding last in the meta vector (v4+)
+_LAYOUT_CODES = {"bucketized": 0, "compact": 1}
+_LAYOUT_NAMES = {v: k for k, v in _LAYOUT_CODES.items()}
 
 # vocabularies larger than this reload as ArrayMaps, not Python dicts
 _ARRAY_VOCAB_THRESHOLD = 200_000
@@ -103,11 +113,15 @@ def save_snapshot(snapshot: GraphSnapshot, path: str) -> None:
         for (ns, obj), slot in snapshot.obj_slots.items():
             obj_ns[slot] = ns
             obj_names[slot] = obj
+    from .snapshot import table_layout
+
     payload = {k: getattr(snapshot, k) for k in _ARRAY_FIELDS}
     payload.update(
         {
             "meta": np.array(
-                [FORMAT_VERSION] + [int(getattr(snapshot, k)) for k in _INT_FIELDS],
+                [FORMAT_VERSION]
+                + [int(getattr(snapshot, k)) for k in _INT_FIELDS]
+                + [_LAYOUT_CODES[table_layout()]],
                 dtype=np.int64,
             ),
             "ns_names": _names_by_id(snapshot.ns_ids, len(snapshot.ns_ids)),
@@ -266,6 +280,8 @@ def checkpoint_info(path: str) -> Optional[dict]:
     rebuild on)."""
     if not os.path.exists(path):
         return None
+    from .snapshot import table_layout
+
     try:
         with np.load(path, allow_pickle=False) as z:
             meta = z["meta"]
@@ -273,10 +289,16 @@ def checkpoint_info(path: str) -> Optional[dict]:
                 "format_version": int(meta[0]),
                 "loadable": int(meta[0]) == FORMAT_VERSION,
             }
-            if len(meta) == len(_INT_FIELDS) + 1:
+            if len(meta) == len(_INT_FIELDS) + 2:
                 info.update(
                     {k: int(meta[i + 1]) for i, k in enumerate(_INT_FIELDS)}
                 )
+                layout = _LAYOUT_NAMES.get(int(meta[-1]))
+                info["table_layout"] = layout
+                # a cross-layout checkpoint exists but cannot be probed
+                # by THIS process — its tables' keys live in other slots
+                if layout != table_layout():
+                    info["loadable"] = False
             else:
                 info["loadable"] = False
             return info
@@ -290,10 +312,19 @@ def load_snapshot(path: str) -> Optional[GraphSnapshot]:
     fsync ordering save_snapshot now enforces, or a stray partial copy)
     degrades to the same rebuild path as a missing one, never an error
     through Daemon.start."""
+    from .snapshot import table_layout
+
     try:
         with np.load(path, allow_pickle=False) as z:
             meta = z["meta"]
             if int(meta[0]) != FORMAT_VERSION:
+                return None
+            if len(meta) != len(_INT_FIELDS) + 2 or (
+                _LAYOUT_NAMES.get(int(meta[-1])) != table_layout()
+            ):
+                # layout mismatch: the tables were built for the OTHER
+                # probe sequence — loading them would mis-probe every
+                # key, so degrade to a rebuild like any incompatibility
                 return None
             ints = {k: int(meta[i + 1]) for i, k in enumerate(_INT_FIELDS)}
             arrays = {k: z[k] for k in _ARRAY_FIELDS}

@@ -42,12 +42,15 @@ Correctness contract (the version-gating proof, docs §5k):
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from .snapshot import (
     EMPTY,
@@ -495,6 +498,21 @@ def _lookup_spans(sorted_keys: np.ndarray, ptr: np.ndarray, queries: np.ndarray)
     return starts, counts
 
 
+def node_poison_keys(graph: ClosureGraph, keys: np.ndarray) -> np.ndarray:
+    """Per-node folded base poison: key (o, r) is poisoned when the
+    0-cost-folded (ns(o), r) cell is — relation-not-found and userset
+    operators the closure cannot represent (AND/NOT islands). Shared by
+    the host builder and the device powering kernel so both judge
+    coverage from the identical mask."""
+    obj = (keys // graph.R).astype(np.int64)
+    rel = (keys % graph.R).astype(np.int64)
+    ns = graph.fpoison.shape[0]
+    slot_ns = graph.objslot_ns
+    nss = slot_ns[np.clip(obj, 0, len(slot_ns) - 1)]
+    nss = np.clip(nss, 0, ns - 1)
+    return graph.fpoison[nss, np.clip(rel, 0, graph.fpoison.shape[1] - 1)]
+
+
 def power_closure(
     graph: ClosureGraph,
     snapshot: GraphSnapshot,
@@ -536,15 +554,6 @@ def power_closure(
         return build
 
     uncovered = np.zeros(n_src, dtype=bool)
-
-    def node_poison(keys: np.ndarray) -> np.ndarray:
-        obj = (keys // R).astype(np.int64)
-        rel = (keys % R).astype(np.int64)
-        ns = graph.fpoison.shape[0]
-        slot_ns = graph.objslot_ns
-        nss = slot_ns[np.clip(obj, 0, len(slot_ns) - 1)]
-        nss = np.clip(nss, 0, ns - 1)
-        return graph.fpoison[nss, np.clip(rel, 0, graph.fpoison.shape[1] - 1)]
 
     # reach pairs as (src_index << 32) | dst_key with dst_key < 2^31
     def pair(src_idx, dst):
@@ -606,7 +615,7 @@ def power_closure(
 
     # poison propagation: any reachable poisoned node uncovers the source
     if len(r_dst):
-        bad = node_poison(r_dst)
+        bad = node_poison_keys(graph, r_dst)
         if bad.any():
             uncovered[np.unique(r_src[bad])] = True
 
@@ -773,12 +782,24 @@ class ClosureIndex:
         lag_budget_versions: int = DEFAULT_LAG_BUDGET,
         metrics=None,
         cache_path: Optional[str] = None,
+        powering: str = "host",
+        flightrec=None,
     ):
         self.nid = nid
         self.max_set_rows = int(max_set_rows)
         self.lag_budget_versions = int(lag_budget_versions)
         self.metrics = metrics
         self.cache_path = cache_path
+        # "host" (numpy builder, the differential oracle) or "device"
+        # (GraphBLAS bit-packed powering, engine/closure_power.py); the
+        # device path falls back to host on any failure — counted,
+        # never wrong
+        self.powering = str(powering)
+        self.flightrec = flightrec
+        # last device build's buffer estimate — the hbm_snapshot()
+        # `closure_power` family (powering scratch is transient, so this
+        # reports the high-water shape of the most recent build)
+        self._power_hbm: dict = {}
         self._mu = threading.Lock()
         self._graph: Optional[ClosureGraph] = None
         self._build: Optional[ClosureBuild] = None
@@ -801,7 +822,57 @@ class ClosureIndex:
         self.stats = {
             "builds": 0, "applied_ops": 0, "dirty_nodes": 0,
             "cache_loads": 0, "rebuild_pending": 0,
+            "device_builds": 0, "device_fallbacks": 0,
+            "power_waves": 0, "power_steps": 0,
         }
+
+    def _power(
+        self, graph: ClosureGraph, snap, max_depth: int,
+        base_version: int, sources=None,
+    ) -> ClosureBuild:
+        """Route one powering through the configured builder. The device
+        kernel honors the exact host contract (bit-identical builds);
+        any device-path failure — unsupported shape, compile error,
+        device loss — falls back to the host builder for THIS powering
+        and is counted, so `closure.powering = "device"` can never cost
+        correctness, only the speedup."""
+        if self.powering == "device":
+            from .closure_power import (
+                PoweringUnsupported,
+                power_closure_device,
+            )
+
+            try:
+                build, record = power_closure_device(
+                    graph, snap, max_depth, self.max_set_rows,
+                    base_version, sources=sources,
+                    flightrec=self.flightrec, nid=self.nid,
+                )
+            except PoweringUnsupported as exc:
+                logger.warning(
+                    "device powering unsupported (%s); host fallback", exc
+                )
+            except Exception:
+                logger.exception("device powering failed; host fallback")
+            else:
+                self.stats["device_builds"] += 1
+                self.stats["power_waves"] += record["waves"]
+                self.stats["power_steps"] += record["steps"]
+                self._power_hbm = dict(record["hbm"])
+                if self.metrics is not None:
+                    self.metrics.closure_power_builds_total.inc()
+                    self.metrics.closure_power_steps_total.inc(
+                        record["steps"]
+                    )
+                    self.metrics.closure_power_bytes.set(
+                        sum(record["hbm"].values())
+                    )
+                return build
+            self.stats["device_fallbacks"] += 1
+        return power_closure(
+            graph, snap, max_depth, self.max_set_rows, base_version,
+            sources=sources,
+        )
 
     # -- build / rebuild -------------------------------------------------------
 
@@ -1113,9 +1184,7 @@ class ClosureIndex:
         if graph is not None:
             build = self._load_cached(snap, base_version, max_depth)
             if build is None:
-                build = power_closure(
-                    graph, snap, max_depth, self.max_set_rows, base_version
-                )
+                build = self._power(graph, snap, max_depth, base_version)
                 self._persist(build)
                 powered = True
                 # counted only for REAL powerings: the metric (and the
@@ -1345,9 +1414,8 @@ class ClosureIndex:
             self.mark_stale()
             return False
         keys = np.array(sorted(refresh), dtype=np.int64)
-        fresh = power_closure(
-            g2, snap, max_depth, self.max_set_rows, build.base_version,
-            sources=keys,
+        fresh = self._power(
+            g2, snap, max_depth, build.base_version, sources=keys
         )
         merged = self._merge_refresh(build, graph, keys, fresh)
         tables, cc_probes, ch_probes = pack_closure_tables(merged, graph.R)

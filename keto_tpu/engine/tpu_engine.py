@@ -1069,6 +1069,18 @@ class TPUCheckEngine:
         # families (the Leopard index lives in HBM beside the check
         # tables; capacity planning must see it separately)
         closure_keys = per_key(self.closure_device_tables())
+        # device-powering working set (engine/closure_power.py): packed
+        # adjacency operands + bit matrices + unpacked step scratch of
+        # the LAST device build — transient buffers, reported at their
+        # high-water shape so capacity planning sees the build's
+        # footprint beside the resident index it produces
+        power_keys = {}
+        with self._closure_mu:
+            if self._closure is not None:
+                power_keys = {
+                    k: int(v)
+                    for k, v in self._closure._power_hbm.items()
+                }
         buffers = {
             "check": check_keys,
             "expand": per_key(state.expand_tables),
@@ -1080,6 +1092,7 @@ class TPUCheckEngine:
             "closure_delta": {
                 k: v for k, v in closure_keys.items() if k == "cd_pack"
             },
+            "closure_power": power_keys,
         }
         totals = {
             name: sum(keys.values()) for name, keys in buffers.items()
@@ -1143,6 +1156,10 @@ class TPUCheckEngine:
                     ),
                     metrics=self.metrics,
                     cache_path=cache_path,
+                    powering=str(
+                        self.config.get("closure.powering", "host")
+                    ),
+                    flightrec=self.flightrec,
                 )
             return self._closure
 

@@ -843,6 +843,30 @@ class Metrics:
             "index build (the R·D product's row count on device)",
             registry=self.registry,
         )
+        # on-device GraphBLAS powering (engine/closure_power.py): the
+        # closure built AS bit-packed boolean matmul on the accelerator
+        # when closure.powering = "device" (host stays the fallback)
+        self.closure_power_builds_total = prom.Counter(
+            "keto_tpu_closure_power_builds_total",
+            "Closure powerings completed BY the device GraphBLAS kernel "
+            "(closure.powering = device; host-fallback powerings count "
+            "under keto_tpu_closure_builds_total only)",
+            registry=self.registry,
+        )
+        self.closure_power_steps_total = prom.Counter(
+            "keto_tpu_closure_power_steps_total",
+            "frontier×adjacency powering steps executed on device across "
+            "all waves (each step is one bit-packed boolean matmul level "
+            "under the shared bounded loop)",
+            registry=self.registry,
+        )
+        self.closure_power_bytes = prom.Gauge(
+            "keto_tpu_closure_power_bytes",
+            "Device working-set bytes of the most recent device powering "
+            "(packed adjacency operands + seen/frontier bit matrices + "
+            "unpacked step scratch; transient — freed after the build)",
+            registry=self.registry,
+        )
         # bulk ACL filtering (engine/filter_kernel.py): one subject,
         # thousands of candidate objects, one device ride
         self.filter_requests_total = prom.Counter(

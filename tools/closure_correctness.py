@@ -20,7 +20,15 @@ oracle's. This smoke drives that loop deterministically:
                        columnar stores (the closure builder's three
                        ingest shapes).
 
+`--powering device` runs the same loop with `closure.powering =
+"device"` — every (re)build routed through the bit-packed GraphBLAS
+kernel (engine/closure_power.py) — and additionally requires the
+builds to be OBSERVABLY device-powered: `device_builds > 0` and zero
+`device_fallbacks` per store kind, so a silently host-falling-back
+kernel cannot pass.
+
 Run: python tools/closure_correctness.py  (exit 0 = all invariants held)
+     python tools/closure_correctness.py --powering device
 """
 
 from __future__ import annotations
@@ -98,11 +106,15 @@ def make_store(kind: str, tmpdir: str):
 
 
 def run_churn(store_kind: str, tmpdir: str, rounds: int = 30,
-              hold_tail: bool = False) -> dict:
+              hold_tail: bool = False, powering: str = "host") -> dict:
     rng = random.Random(42)
     cfg = Config({
         "limit": {"max_read_depth": DEPTH + 4},
-        "closure": {"enabled": True, "lag_budget_versions": 0 if hold_tail else 64},
+        "closure": {
+            "enabled": True,
+            "lag_budget_versions": 0 if hold_tail else 64,
+            "powering": powering,
+        },
     })
     cfg.set_namespaces(deep_namespaces())
     manager = make_store(store_kind, tmpdir)
@@ -179,10 +191,23 @@ def run_churn(store_kind: str, tmpdir: str, rounds: int = 30,
 
 
 def main() -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--powering", choices=("host", "device"), default="host",
+        help="closure builder under test: 'host' (numpy powering) or "
+             "'device' (engine/closure_power.py GraphBLAS kernel — the "
+             "same churn loop, plus the requirement that builds "
+             "OBSERVABLY ran through the kernel with zero fallbacks "
+             "to host)",
+    )
+    args = ap.parse_args()
+
     failures = []
     with tempfile.TemporaryDirectory() as tmpdir:
         for kind in ("memory", "sqlite", "columnar"):
-            rec = run_churn(kind, tmpdir)
+            rec = run_churn(kind, tmpdir, powering=args.powering)
             print(f"[churn/{kind}] {rec}")
             if rec["wrong"]:
                 failures.append(f"{kind}: {rec['wrong']} wrong answers")
@@ -196,8 +221,22 @@ def main() -> int:
                 failures.append(
                     f"{kind}: no fallback->catch-up->hit transition observed"
                 )
+            if args.powering == "device":
+                # the kernel must have actually powered the index —
+                # silent host fallbacks would pass every answer check
+                # while testing nothing
+                if rec["index"].get("device_builds", 0) == 0:
+                    failures.append(
+                        f"{kind}: device powering never built the index"
+                    )
+                if rec["index"].get("device_fallbacks", 0):
+                    failures.append(
+                        f"{kind}: {rec['index']['device_fallbacks']} "
+                        "device powerings fell back to host"
+                    )
 
-        held = run_churn("memory", tmpdir, hold_tail=True)
+        held = run_churn("memory", tmpdir, hold_tail=True,
+                         powering=args.powering)
         print(f"[held-tail] {held}")
         if held["wrong"]:
             failures.append(f"held-tail: {held['wrong']} wrong answers")
@@ -215,8 +254,9 @@ def main() -> int:
         for f in failures:
             print(f"  - {f}")
         return 1
-    print("OK: zero wrong answers under churn; fallback/catch-up/hit "
-          "transitions observable; held tail degraded safely")
+    print(f"OK[{args.powering}]: zero wrong answers under churn; "
+          "fallback/catch-up/hit transitions observable; held tail "
+          "degraded safely")
     return 0
 
 

@@ -96,6 +96,35 @@ def slo_advisory(record: dict, served_p95_ms: float) -> None:
     )
 
 
+def closure_build_advisory(record: dict) -> None:
+    """Advisory closure-build note: when the fresh record carries
+    powering-build timings (the --ab-closure / --ab-powering legs),
+    print the build seconds so a slowing index rebuild is LOUD in the
+    CI log. Advisory by design — build time trades against coverage
+    knobs (`closure.max_set_rows`) and backend, so the regression gate's
+    thresholded metrics stay the only exit-code owners. Skips records
+    with no closure-build leg."""
+    noted = False
+    for key in ("closure_build_s", "host_build_s", "device_build_s"):
+        val = record.get(key)
+        if isinstance(val, (int, float)):
+            print(f"perf_gate: closure: {key} {val:.3f} s (advisory)")
+            noted = True
+    for entry in record.get("build_sweep") or ():
+        if isinstance(entry, dict) and isinstance(
+            entry.get("build_s"), (int, float)
+        ):
+            print(
+                "perf_gate: closure: device build "
+                f"{entry['build_s']:.3f} s @ max_set_rows="
+                f"{entry.get('max_set_rows')} "
+                f"hbm={entry.get('hbm_total_bytes')} B (advisory)"
+            )
+            noted = True
+    if not noted:
+        print("perf_gate: closure: no build leg in record — skipped")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--record", required=True,
@@ -113,6 +142,7 @@ def main() -> int:
 
     record = load_record(args.record)
     slo_advisory(record, args.slo_served_p95_ms)
+    closure_build_advisory(record)
     # SKIP-ADVISORY, not error, when there is nothing honest to compare
     # against: a missing baseline artifact or a different-backend one
     # (a fresh repo clone, a first run on new hardware, a CPU run
