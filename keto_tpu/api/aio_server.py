@@ -711,6 +711,8 @@ class _AioReadServices:
             # in-band keep-alives (watch.heartbeat_s — same contract as
             # the sync plane's frames): detect half-open connections,
             # free the subscriber ring via the finally below
+            from ..engine.snaptoken import encode_snaptoken
+
             heartbeat_s = float(
                 svc.registry.config.get("watch.heartbeat_s", 5.0)
             )
@@ -722,7 +724,13 @@ class _AioReadServices:
                     # busy AND wire-silent without this
                     if loop.time() - last_write >= heartbeat_s:
                         last_write = loop.time()
-                        yield pb.WatchResponse(event_type="heartbeat")
+                        # cursor snaptoken rides the frame (HA follower
+                        # plane): idle version discovery, same as the
+                        # sync plane
+                        yield pb.WatchResponse(
+                            event_type="heartbeat",
+                            snaptoken=encode_snaptoken(sub.cursor, sub.nid),
+                        )
                     event, needs_resume = sub.pop_nowait()
                     if needs_resume:
                         try:

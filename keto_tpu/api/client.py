@@ -331,6 +331,7 @@ class ReadClient(_BaseClient):
         namespace: str = "",
         timeout=None,
         max_events: Optional[int] = None,
+        yield_heartbeats: bool = False,
     ) -> Iterator["WatchStreamEvent"]:
         """keto_tpu watch extension (WatchService): iterate the server's
         changelog stream. Each yielded event is one committed store
@@ -342,7 +343,11 @@ class ReadClient(_BaseClient):
         "degraded"` event signals a server-side STORE OUTAGE (the
         stream is alive but cannot advance until the store recovers);
         server keep-alive `heartbeat` frames are consumed here and
-        never surfaced. Resume after a disconnect
+        never surfaced — unless `yield_heartbeats` is set, in which
+        case they are yielded (empty `changes`, snaptoken = the
+        server's cursor) and still never counted toward `max_events`:
+        the HA follower tail (api/follower.py) uses them for liveness
+        detection and idle version discovery. Resume after a disconnect
         by passing the last event's snaptoken. Blocks between events;
         `timeout` bounds the whole stream (gRPC deadline) and
         `max_events` ends it after N events. Abandoning the iterator
@@ -364,8 +369,14 @@ class ReadClient(_BaseClient):
                 if resp.event_type == "heartbeat":
                     # server keep-alive (watch.heartbeat_s — the gRPC
                     # twin of the SSE comment frame): connection-health
-                    # plumbing, not data; never surfaced, never counted
-                    # toward max_events
+                    # plumbing, not data; never counted toward
+                    # max_events, surfaced only on request
+                    if yield_heartbeats:
+                        yield WatchStreamEvent(
+                            event_type=resp.event_type,
+                            snaptoken=resp.snaptoken,
+                            changes=[],
+                        )
                     continue
                 yield WatchStreamEvent(
                     event_type=resp.event_type,

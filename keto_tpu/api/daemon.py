@@ -286,6 +286,7 @@ class Daemon:
         self._muxes = {}
         self._worker_grpc: list = []
         self._worker_rest: list = []
+        self._follower_plane = None
         self._started = False
 
     def _make_batcher(self, pending_total=None, drain_ways: int = 1):
@@ -428,6 +429,19 @@ class Daemon:
         # version-gating at submit keeps answers correct without it
         if bool(cfg.get("closure.enabled", False)):
             reg.closure_maintainer().start()
+        # HA follower plane (api/follower.py): restore the follower
+        # checkpoint, then tail the LEADER's watch changelog into the
+        # network-fed store. Started after the hub (apply_remote's
+        # write hooks must fan out to local subscribers) and before
+        # readiness flips — a follower is "ready" as soon as it can
+        # answer at SOME version; the snaptoken gate refuses anything
+        # it has not reached yet
+        if bool(cfg.get("follower.enabled", False)):
+            from .follower import FollowerPlane
+
+            self._follower_plane = FollowerPlane(reg)
+            reg.ha_plane = self._follower_plane
+            self._follower_plane.start()
         if self.pid_file:
             import os as _os
 
@@ -645,6 +659,11 @@ class Daemon:
         # end watch streams first so draining servers aren't pinned by
         # parked subscriber threads (this also ends the replica views'
         # changelog tails — the hub closes their subscriptions)
+        # stop the follower replication tail BEFORE the hub: its
+        # apply_remote commits fan out through hub write hooks, and the
+        # shutdown checkpoint must capture a store nobody is advancing
+        if self._follower_plane is not None:
+            self._follower_plane.stop()
         # stop the closure maintainer BEFORE the hub: its subscriptions
         # close with it, so the hub's stop never waits on a tailer that
         # is mid-pass against a store about to be torn down

@@ -1,0 +1,681 @@
+"""Watch-fed follower daemon plane (Zanzibar §2.4 multi-cluster serving).
+
+A follower daemon serves reads from its own device mirror without ever
+owning the tuple store: it cold-starts from a checkpoint, then advances
+by tailing the LEADER's Watch changelog over the network (api/client.py
+ReadClient.watch) — the Leopard-style "changelog-fed replica" the paper
+describes, generalized across processes. Steady state performs ZERO
+SQL/full-store reads: every commit arrives as a watch "change" frame and
+is applied through the same delta/compaction path local writes take
+(FollowerStore pins the per-nid store version to the LEADER's commit
+version, so snaptokens minted here are interchangeable with the
+leader's and the per-request snaptoken gate — engine/snaptoken
+enforce_snaptoken — needs no changes to be failover-safe: a token the
+follower hasn't reached yet is a typed 409 the front router
+(api/router.py) fails over on, never a stale answer).
+
+Liveness rides the watch heartbeat extension (watch/hub.py
+KIND_HEARTBEAT, `watch.heartbeat_s` on the leader): a silently severed
+connection — kill -9, dropped NAT entry, half-open TCP — produces no
+error, only silence, so the plane treats "no frame within
+follower.liveness_s" as death, force-closes the channel, and re-resumes
+at its last applied snaptoken with decorrelated-jitter backoff. A
+server RESET frame (trimmed changelog / overflow) forces a full
+re-bootstrap sweep; those sweeps are the ONLY full reads and are
+counted (`keto_tpu_ha_bootstrap_reads_total`) so the HA smoke can pin
+steady state as changelog-fed.
+
+Durability: the plane persists its own tuple-level checkpoint
+(follower-<nid>.json under follower.state_dir, atomic rename) so a
+restart resumes from the saved snaptoken instead of re-sweeping the
+leader; the engine's device-mirror checkpoint (engine/checkpoint.py)
+then warm-loads on top when its fingerprint matches. The cold-start
+audit uses the STRICT restore path (restore_snapshot): an intact but
+incompatible mirror file surfaces the typed CheckpointIncompatibleError
+instead of crashing or silently mis-answering.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+from typing import Optional
+
+from ..config import ConfigError
+from ..errors import CheckpointIncompatibleError, StoreUnavailableError
+from ..ketoapi import RelationQuery, RelationTuple
+from ..storage.definitions import DEFAULT_NETWORK
+from ..storage.memory import MemoryManager, _NetworkStore
+
+logger = logging.getLogger("keto_tpu")
+
+# follower checkpoint format (tuple-level JSON, distinct from the
+# engine's npz mirror checkpoint): bump on incompatible layout changes
+STATE_FORMAT = 1
+
+# ha_tail_state gauge values (docs/architecture.md metrics table)
+STATE_DISCONNECTED, STATE_BOOTSTRAPPING, STATE_TAILING = 0, 1, 2
+_STATE_NAMES = {
+    STATE_DISCONNECTED: "disconnected",
+    STATE_BOOTSTRAPPING: "bootstrapping",
+    STATE_TAILING: "tailing",
+}
+
+
+class ReadOnlyFollowerError(StoreUnavailableError):
+    """Local write against a follower daemon: a POLICY refusal (writes
+    go to the leader; the router never sends one here), typed onto the
+    503/UNAVAILABLE surface so stock clients treat it as retryable —
+    against the leader. `read_only` marks it as NOT store-health
+    evidence for StoreHealthGuard: a healthy follower rejecting a stray
+    write must not trip the store breaker and poison its own reads."""
+
+    read_only = True
+    default_message = (
+        "this daemon is a read-only follower; send writes to the leader"
+    )
+
+
+class FollowerStore(MemoryManager):
+    """MemoryManager whose versions are PINNED to the leader's.
+
+    `apply_remote` applies one committed leader version — the ops a
+    watch "change" frame carried — and sets the per-nid store version to
+    the LEADER's commit version instead of self-incrementing, appending
+    the same ops to the local changelog at that version. Everything
+    above (engine delta refresh, local watch hub, check cache
+    invalidation, snaptoken enforcement) consumes the store through the
+    exact same surface as on the leader and needs no follower-awareness.
+
+    `bootstrap_replace` swaps in a full sweep at a known version; the
+    local changelog cannot prove completeness across that discontinuity,
+    so `changelog_since` answers None (forcing local consumers through
+    their own rebuild/RESET path) for any cursor below the bootstrap
+    floor.
+
+    All LOCAL write verbs raise ReadOnlyFollowerError."""
+
+    def __init__(self):
+        super().__init__()
+        # nid -> version at/below which the local log is discontinuous
+        # (bootstrap sweep replaced content without log entries)
+        self._log_floor: dict[str, int] = {}
+
+    # -- replication surface (the ONLY writers) -----------------------------
+
+    def apply_remote(
+        self,
+        version: int,
+        changes,
+        nid: str = DEFAULT_NETWORK,
+    ) -> bool:
+        """Apply one leader commit: `changes` is [("insert"|"delete",
+        RelationTuple), ...] from a watch frame, `version` the leader
+        version it committed as. Idempotent: a version at or below the
+        applied one (re-delivered after a reconnect resume) is a no-op.
+        Log entries are appended for EVERY op — including content
+        no-ops, so the local changelog stays an exact copy of the
+        leader's slice and local watch subscribers see the same frames
+        a leader subscriber would."""
+        version = int(version)
+        with self._lock:
+            net = self._net(nid)
+            if version <= net.version:
+                return False
+            # _insert/_delete tag their log entries `net.version + 1`:
+            # park the counter one below the leader version so every
+            # entry of this frame lands at exactly `version`
+            net.version = version - 1
+            for op, t in changes:
+                if op == "insert":
+                    if not self._insert(net, nid, t):
+                        net.log.append((version, "insert", t))
+                elif op == "delete":
+                    if not self._delete(net, nid, t):
+                        net.log.append((version, "delete", t))
+            net.version = version
+        self._notify_write(nid, True)
+        return True
+
+    def bootstrap_replace(
+        self,
+        tuples,
+        version: int,
+        nid: str = DEFAULT_NETWORK,
+    ) -> None:
+        """Replace the nid's content with a full sweep taken at (or
+        after) leader `version`; tailing resumes from `version`, and
+        replaying frames the sweep already contains is idempotent."""
+        version = int(version)
+        fresh = _NetworkStore()
+        for t in tuples:
+            self._insert(fresh, nid, t)
+        fresh.log.clear()  # no history across the discontinuity
+        fresh.version = version
+        with self._lock:
+            self._networks[nid] = fresh
+            self._log_floor[nid] = version
+        self._notify_write(nid, True)
+
+    def snapshot_state(
+        self, nid: str = DEFAULT_NETWORK
+    ) -> tuple[list[RelationTuple], int]:
+        """(tuples, applied version) read atomically — the checkpoint
+        writer needs the pair from ONE lock hold (a version for someone
+        else's tuple set would resume the tail at the wrong cursor)."""
+        with self._lock:
+            net = self._net_ro(nid)
+            return [net.by_shard[sid] for sid in net.order], net.version
+
+    # -- changelog discontinuity --------------------------------------------
+
+    def changelog_since(self, version: int, nid: str = DEFAULT_NETWORK):
+        with self._lock:
+            if version < self._log_floor.get(nid, 0):
+                return None  # bootstrap replaced content: gap is explicit
+        return super().changelog_since(version, nid=nid)
+
+    # -- local writes: refused ----------------------------------------------
+
+    def write_relation_tuples(self, tuples, nid: str = DEFAULT_NETWORK):
+        raise ReadOnlyFollowerError()
+
+    def delete_relation_tuples(self, tuples, nid: str = DEFAULT_NETWORK):
+        raise ReadOnlyFollowerError()
+
+    def delete_all_relation_tuples(self, query, nid: str = DEFAULT_NETWORK):
+        raise ReadOnlyFollowerError()
+
+    def transact_relation_tuples(
+        self, insert, delete, nid: str = DEFAULT_NETWORK
+    ):
+        raise ReadOnlyFollowerError()
+
+
+def _default_client_factory(addr: str):
+    from .client import ReadClient, open_channel
+
+    return ReadClient(open_channel(addr))
+
+
+def _token_version(token: str) -> Optional[int]:
+    """Version a snaptoken encodes, None for empty/unparseable — the
+    tail's frames come from ITS leader, so the nid-digest check
+    (engine/snaptoken.parse_snaptoken) is the server's job, not ours."""
+    if not token:
+        return None
+    try:
+        return int(token.rsplit("_", 1)[1])
+    except (IndexError, ValueError):
+        return None
+
+
+class FollowerPlane:
+    """The follower daemon's replication plane: one tail thread feeding
+    FollowerStore from the leader's watch stream, one monitor thread
+    enforcing stream liveness and writing periodic checkpoints.
+
+    `client_factory(addr)` builds the leader client (tests inject
+    scripted fakes); the monitor severs a silent stream by closing the
+    CURRENT client, which makes the blocked watch iterator raise in the
+    tail thread — the only cross-thread cancellation gRPC offers."""
+
+    def __init__(
+        self,
+        registry,
+        store: Optional[FollowerStore] = None,
+        client_factory=None,
+        clock=time.monotonic,
+        rng: Optional[random.Random] = None,
+    ):
+        cfg = registry.config
+        self.registry = registry
+        self.store = store if store is not None else registry.follower_store()
+        if self.store is None:
+            raise ConfigError(
+                debug="FollowerPlane requires follower.enabled "
+                "(the registry must build a FollowerStore)"
+            )
+        self.nid = registry.nid
+        self.leader = str(cfg.get("follower.leader") or "")
+        if not self.leader:
+            raise ConfigError(
+                debug="follower.enabled requires follower.leader "
+                "(host:port of the daemon to tail)"
+            )
+        self.liveness_s = max(float(cfg.get("follower.liveness_s", 10.0)), 0.1)
+        self.checkpoint_s = float(cfg.get("follower.checkpoint_s", 30.0))
+        self.page_size = int(cfg.get("follower.bootstrap_page_size", 2000))
+        self.state_dir = cfg.get("follower.state_dir")
+        self.rpc_timeout_s = float(cfg.get("follower.rpc_timeout_s", 5.0))
+        self.metrics = registry.metrics()
+        self._client_factory = client_factory or _default_client_factory
+        self._clock = clock
+        self._rng = rng or random.Random()
+
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._tail_thread: Optional[threading.Thread] = None
+        self._monitor_thread: Optional[threading.Thread] = None
+        self._client = None
+        self._forced_close = False
+        self._need_bootstrap = False
+        self._state = STATE_DISCONNECTED
+        self._last_frame = self._clock()
+        self._applied = 0
+        self._leader_seen = 0
+        self._saved_version = 0
+        self._last_ckpt = self._clock()
+        self.bootstrap_reads = 0
+        self.heartbeats_seen = 0
+        self.resets_seen = 0
+        self.reconnects: dict[str, int] = {}
+        self.restored_from_checkpoint = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._restore_checkpoint()
+        self._audit_engine_checkpoint()
+        self._tail_thread = threading.Thread(
+            target=self._run_tail, name="keto-follower-tail", daemon=True
+        )
+        self._monitor_thread = threading.Thread(
+            target=self._run_monitor, name="keto-follower-monitor", daemon=True
+        )
+        self._tail_thread.start()
+        self._monitor_thread.start()
+        logger.info(
+            "follower plane started: leader=%s nid=%s applied=v%d "
+            "(restored=%s) liveness=%.1fs",
+            self.leader, self.nid, self._applied,
+            self.restored_from_checkpoint, self.liveness_s,
+        )
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._sever("stop")
+        for t in (self._tail_thread, self._monitor_thread):
+            if t is not None:
+                t.join(timeout=5.0)
+        self._save_checkpoint()
+        self._set_state(STATE_DISCONNECTED)
+
+    # -- status / metrics ----------------------------------------------------
+
+    def status(self) -> dict:
+        with self._mu:
+            applied, seen = self._applied, self._leader_seen
+            state = self._state
+            age = self._clock() - self._last_frame
+        return {
+            "role": "follower",
+            "nid": self.nid,
+            "leader": self.leader,
+            "state": _STATE_NAMES[state],
+            "applied_version": applied,
+            "leader_version_seen": max(seen, applied),
+            "version_lag": max(0, seen - applied),
+            "last_frame_age_s": round(age, 3),
+            "bootstrap_reads": self.bootstrap_reads,
+            "heartbeats_seen": self.heartbeats_seen,
+            "resets_seen": self.resets_seen,
+            "reconnects": dict(self.reconnects),
+            "checkpoint": {
+                "path": self._state_path(),
+                "saved_version": self._saved_version,
+                "restored": self.restored_from_checkpoint,
+            },
+        }
+
+    def _set_state(self, state: int) -> None:
+        with self._mu:
+            self._state = state
+        self.metrics.ha_tail_state.labels(self.nid).set(state)
+
+    def _set_applied(self, version: int) -> None:
+        with self._mu:
+            if version > self._applied:
+                self._applied = version
+            if version > self._leader_seen:
+                self._leader_seen = version
+            applied, seen = self._applied, self._leader_seen
+        self.metrics.ha_applied_version.labels(self.nid).set(applied)
+        self.metrics.ha_version_lag.labels(self.nid).set(
+            max(0, seen - applied)
+        )
+
+    def _observe_leader(self, version: Optional[int]) -> None:
+        if version is None:
+            return
+        with self._mu:
+            if version > self._leader_seen:
+                self._leader_seen = version
+            applied, seen = self._applied, self._leader_seen
+        self.metrics.ha_version_lag.labels(self.nid).set(
+            max(0, seen - applied)
+        )
+
+    def _mark_frame(self) -> None:
+        with self._mu:
+            self._last_frame = self._clock()
+
+    def _count_reconnect(self, cause: str) -> None:
+        self.reconnects[cause] = self.reconnects.get(cause, 0) + 1
+        self.metrics.ha_stream_reconnects_total.labels(cause).inc()
+
+    # -- tail thread ---------------------------------------------------------
+
+    def _run_tail(self) -> None:
+        delay = 0.05
+        while not self._stop.is_set():
+            try:
+                client = self._client_factory(self.leader)
+            except Exception as e:  # noqa: BLE001
+                logger.warning(
+                    "follower: cannot reach leader %s: %s", self.leader, e
+                )
+                self._count_reconnect("error")
+                delay = self._backoff(delay)
+                continue
+            with self._mu:
+                self._client = client
+                self._forced_close = False
+                self._last_frame = self._clock()
+            cause = "error"
+            try:
+                cause = self._tail_session(client)
+                delay = 0.05  # the session made progress: reset backoff
+            except Exception as e:  # noqa: BLE001
+                cause = self._classify_stream_error(e)
+                if not self._stop.is_set():
+                    logger.info(
+                        "follower: watch stream to %s ended (%s): %s",
+                        self.leader, cause, e,
+                    )
+            finally:
+                with self._mu:
+                    self._client = None
+                try:
+                    client.close()
+                # ketolint: allow[typed-error] reason=double-close of a grpc channel the monitor already severed
+                except Exception:  # noqa: BLE001
+                    pass
+            if self._stop.is_set():
+                break
+            self._set_state(STATE_DISCONNECTED)
+            self._count_reconnect(cause)
+            delay = self._backoff(delay)
+
+    def _backoff(self, prev: float) -> float:
+        """Decorrelated jitter (resilience.RetryPolicy's curve): a fleet
+        of followers losing one leader must not re-dial in lockstep."""
+        delay = min(2.0, self._rng.uniform(0.05, prev * 3.0))
+        self._stop.wait(delay)
+        return delay
+
+    def _classify_stream_error(self, err) -> str:
+        with self._mu:
+            forced = self._forced_close
+        if forced:
+            return "silent"
+        code = getattr(err, "code", None)
+        name = ""
+        if callable(code):
+            try:
+                name = code().name
+            except Exception:  # noqa: BLE001
+                name = ""
+        if name == "FAILED_PRECONDITION":
+            # our resume snaptoken is AHEAD of the leader: the leader
+            # lost state (restored backup, wiped store). Our mirror is
+            # from a future that no longer exists — full resync.
+            self._need_bootstrap = True
+            return "stale"
+        return "error"
+
+    def _tail_session(self, client) -> str:
+        """One connected session: bootstrap if needed, then consume the
+        stream until it ends. Returns the reconnect cause."""
+        with self._mu:
+            applied = self._applied
+        if self._need_bootstrap or applied == 0:
+            self._bootstrap(client)
+            with self._mu:
+                applied = self._applied
+        from ..engine.snaptoken import encode_snaptoken
+
+        stream = client.watch(
+            snaptoken=encode_snaptoken(applied, self.nid),
+            yield_heartbeats=True,
+        )
+        self._set_state(STATE_TAILING)
+        for ev in stream:
+            self._mark_frame()
+            if self._stop.is_set():
+                return "stop"
+            if ev.event_type == "heartbeat":
+                self.heartbeats_seen += 1
+                self._observe_leader(_token_version(ev.snaptoken))
+                continue
+            if ev.event_type == "degraded":
+                # leader's STORE is out but the leader itself is alive:
+                # nothing to apply, nothing to tear down — our mirror
+                # keeps serving at its (now frozen) applied version
+                continue
+            if ev.event_type == "reset":
+                # explicit gap: the leader could not prove continuity
+                # from our cursor. Content must be re-swept.
+                self.resets_seen += 1
+                self._need_bootstrap = True
+                return "reset"
+            version = _token_version(ev.snaptoken)
+            if version is None:
+                continue
+            self.store.apply_remote(version, ev.changes, nid=self.nid)
+            self._set_applied(version)
+        return "error"  # server ended the stream without a reason
+
+    def _bootstrap(self, client) -> None:
+        """Full sweep: discover the leader's CURRENT version from the
+        first watch frame (a heartbeat on an idle leader — the
+        watch.heartbeat_s contract — or the next change), then page the
+        whole tuple set and swap it in at that version. Pages read
+        AFTER the version mark can only be NEWER; re-applying the
+        covered frames on resume is idempotent, so the mirror converges
+        to the leader exactly."""
+        self._set_state(STATE_BOOTSTRAPPING)
+        v0: Optional[int] = None
+        stream = client.watch(snaptoken="", yield_heartbeats=True)
+        try:
+            for ev in stream:
+                self._mark_frame()
+                v0 = _token_version(ev.snaptoken)
+                if v0 is not None:
+                    break
+        finally:
+            stream.close()
+        if v0 is None:
+            raise StoreUnavailableError(
+                "follower bootstrap: leader watch stream ended before "
+                "a version-bearing frame"
+            )
+        tuples: list[RelationTuple] = []
+        token = ""
+        while True:
+            resp = client.list_relation_tuples(
+                RelationQuery(),
+                page_size=self.page_size,
+                page_token=token,
+                timeout=self.rpc_timeout_s,
+            )
+            self._mark_frame()
+            tuples.extend(resp.relation_tuples)
+            token = resp.next_page_token
+            if not token:
+                break
+        self.bootstrap_reads += 1
+        self.metrics.ha_bootstrap_reads_total.inc()
+        self.store.bootstrap_replace(tuples, v0, nid=self.nid)
+        self._need_bootstrap = False
+        self._set_applied(v0)
+        logger.info(
+            "follower: bootstrapped %d tuples at v%d from %s",
+            len(tuples), v0, self.leader,
+        )
+
+    # -- monitor thread (liveness + checkpoints) ------------------------------
+
+    def _run_monitor(self) -> None:
+        tick = min(0.25, self.liveness_s / 4)
+        while not self._stop.wait(tick):
+            with self._mu:
+                active = self._client is not None
+                silent_for = self._clock() - self._last_frame
+            if active and silent_for > self.liveness_s:
+                logger.warning(
+                    "follower: no frame from %s in %.1fs "
+                    "(follower.liveness_s=%.1fs) — severing stream",
+                    self.leader, silent_for, self.liveness_s,
+                )
+                self._sever("liveness")
+            if (
+                self.checkpoint_s > 0
+                and self._clock() - self._last_ckpt >= self.checkpoint_s
+            ):
+                self._save_checkpoint()
+                self._last_ckpt = self._clock()
+
+    def _sever(self, why: str) -> None:
+        """Close the current client from OUTSIDE the tail thread; its
+        blocked watch iterator raises and the tail loop reconnects,
+        resuming at the last applied snaptoken."""
+        with self._mu:
+            client = self._client
+            if client is not None and why != "stop":
+                self._forced_close = True
+        if client is not None:
+            try:
+                client.close()
+            # ketolint: allow[typed-error] reason=racing the tail thread's own close on shutdown
+            except Exception:  # noqa: BLE001
+                pass
+
+    # -- follower checkpoint ---------------------------------------------------
+
+    def _state_path(self) -> Optional[str]:
+        if not self.state_dir:
+            return None
+        return os.path.join(str(self.state_dir), f"follower-{self.nid}.json")
+
+    def _save_checkpoint(self) -> None:
+        path = self._state_path()
+        if path is None:
+            return
+        tuples, version = self.store.snapshot_state(nid=self.nid)
+        if version <= self._saved_version:
+            return  # nothing new to persist
+        doc = {
+            "format": STATE_FORMAT,
+            "nid": self.nid,
+            "applied_version": version,
+            "tuples": [t.to_dict() for t in tuples],
+        }
+        tmp = f"{path}.tmp"
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(doc, f, separators=(",", ":"))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)  # atomic publish: never a torn read
+        except OSError:
+            logger.warning(
+                "follower checkpoint write failed (%s); the leader "
+                "remains the durability", path, exc_info=True,
+            )
+            self.metrics.checkpoint_write_failures_total.inc()
+            return
+        self._saved_version = version
+
+    def _restore_checkpoint(self) -> None:
+        path = self._state_path()
+        if path is None or not os.path.exists(path):
+            return
+        try:
+            with open(path, encoding="utf-8") as f:
+                doc = json.load(f)
+            if int(doc.get("format", -1)) != STATE_FORMAT:
+                raise CheckpointIncompatibleError(
+                    debug=f"follower checkpoint format "
+                    f"{doc.get('format')!r} != {STATE_FORMAT}"
+                )
+            if doc.get("nid") != self.nid:
+                raise CheckpointIncompatibleError(
+                    debug="follower checkpoint belongs to another network"
+                )
+            tuples = [RelationTuple.from_dict(d) for d in doc["tuples"]]
+            version = int(doc["applied_version"])
+        except CheckpointIncompatibleError:
+            # intact but unusable: the typed refusal — start cold (the
+            # bootstrap sweep rebuilds), never crash, never load garbage
+            logger.warning(
+                "follower checkpoint %s incompatible; cold-starting",
+                path, exc_info=True,
+            )
+            self.metrics.checkpoint_load_fallbacks_total.labels(
+                "incompatible"
+            ).inc()
+            return
+        except Exception:  # noqa: BLE001
+            logger.warning(
+                "follower checkpoint %s unreadable (torn write?); "
+                "cold-starting", path, exc_info=True,
+            )
+            self.metrics.checkpoint_load_fallbacks_total.labels(
+                "corrupt"
+            ).inc()
+            return
+        self.store.bootstrap_replace(tuples, version, nid=self.nid)
+        with self._mu:
+            self._applied = version
+            self._leader_seen = max(self._leader_seen, version)
+        self._saved_version = version
+        self.restored_from_checkpoint = True
+        self.metrics.ha_applied_version.labels(self.nid).set(version)
+        logger.info(
+            "follower: restored %d tuples at v%d from checkpoint %s",
+            len(tuples), version, path,
+        )
+
+    def _audit_engine_checkpoint(self) -> None:
+        """Cold-start audit of the engine's device-mirror checkpoint via
+        the STRICT restore path: an intact-but-incompatible file (format
+        drift, cross-layout build) is surfaced as the typed
+        CheckpointIncompatibleError HERE, at startup, with a counter —
+        instead of the engine later silently discarding it (or worse).
+        The engine still performs its own (lazy, fingerprint-gated)
+        load; this is detection, not loading."""
+        cache_dir = self.registry.config.get("check.mirror_cache")
+        if not cache_dir:
+            return
+        from ..engine.checkpoint import mirror_cache_path, restore_snapshot
+
+        path = mirror_cache_path(str(cache_dir), self.nid)
+        if not os.path.exists(path):
+            return
+        try:
+            restore_snapshot(path)
+        except CheckpointIncompatibleError as e:
+            logger.warning(
+                "engine mirror checkpoint %s is incompatible with this "
+                "process (%s); the engine will rebuild from the mirror "
+                "store", path, e.debug or e,
+            )
+            self.metrics.checkpoint_load_fallbacks_total.labels(
+                "incompatible"
+            ).inc()

@@ -612,6 +612,8 @@ class _Services:
             # connection fails the write and the finally frees this
             # subscriber's ring instead of pinning changelog retention
             # forever. ReadClient.watch() filters them out.
+            from ..engine.snaptoken import encode_snaptoken
+
             heartbeat_s = float(
                 self.registry.config.get("watch.heartbeat_s", 5.0)
             )
@@ -625,7 +627,14 @@ class _Services:
                     # would never be detected
                     if _time.monotonic() - last_write >= heartbeat_s:
                         last_write = _time.monotonic()
-                        yield pb.WatchResponse(event_type="heartbeat")
+                        # the frame carries the cursor's snaptoken (HA
+                        # follower plane): an idle tail learns the store
+                        # version it is current THROUGH without a single
+                        # change having been delivered
+                        yield pb.WatchResponse(
+                            event_type="heartbeat",
+                            snaptoken=encode_snaptoken(sub.cursor, sub.nid),
+                        )
                     try:
                         event = sub.get(timeout=0.5)
                     except KetoError as e:

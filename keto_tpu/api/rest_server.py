@@ -85,6 +85,10 @@ REPLICAS_ROUTE = "/admin/replicas"
 # reads counters/last-pass state, POST runs one full pass on demand and
 # returns the per-nid report
 SCRUB_ROUTE = "/admin/scrub"
+# multi-daemon HA plane (metrics listener, api/follower.py): role,
+# applied/observed leader versions, tail state, bootstrap/reconnect
+# counters on a follower; store version + watch heartbeat on a leader
+HA_ROUTE = "/admin/ha"
 # workload observatory (metrics listener, observability_workload.py):
 # hot-key sketch top-K + cache attribution, live SLO burn rates, and the
 # capture/replay traffic profile `keto-tpu admin capture` downloads
@@ -117,6 +121,7 @@ ROUTE_KINDS = {
     FLIGHTREC_ROUTE: "metrics",
     REPLICAS_ROUTE: "metrics",
     SCRUB_ROUTE: "metrics",
+    HA_ROUTE: "metrics",
     HOTKEYS_ROUTE: "metrics",
     SLO_ROUTE: "metrics",
     WORKLOAD_ROUTE: "metrics",
@@ -371,6 +376,8 @@ class _Handler(BaseHTTPRequestHandler):
                 return FLIGHTREC_ROUTE, self._flightrec_dump
             if method == "GET" and path == REPLICAS_ROUTE:
                 return REPLICAS_ROUTE, self._replicas_status
+            if method == "GET" and path == HA_ROUTE:
+                return HA_ROUTE, self._ha_status
             if path == SCRUB_ROUTE:
                 if method == "GET":
                     return SCRUB_ROUTE, self._scrub_status
@@ -1117,6 +1124,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(200, {"workers": [], "group_pending": 0})
             return
         self._json(200, group.stats())
+
+    def _ha_status(self) -> None:
+        """GET /admin/ha: this daemon's HA-plane view. On a follower
+        (follower.enabled): role, leader address, tail state, applied vs
+        observed leader version (the per-daemon staleness the router's
+        snaptoken rule keys on), last-frame age, and the bootstrap /
+        reconnect counters the HA smoke pins (zero full reads in steady
+        state). On a leader: role + live store version + watch
+        heartbeat config — the ground truth followers converge to."""
+        self._json(200, self.registry.ha_status())
 
     def _hotkeys_dump(self) -> None:
         """GET /admin/hotkeys: the Space-Saving sketches' live top-K

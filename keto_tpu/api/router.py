@@ -1,0 +1,387 @@
+"""HA front router: hold / route / escalate across DAEMONS.
+
+The cross-process generalization of api/replica.py's snaptoken routing
+(which spreads one process's checks across serve workers): a client-side
+router holding one ReadClient per daemon — the leader plus any number of
+Watch-fed followers (api/follower.py) — and picking, per check, a daemon
+whose APPLIED version covers the request's snaptoken:
+
+  - no token (or an already-covered one): round-robin across every
+    daemon in rotation — the aggregate-QPS scaling the HA smoke curves;
+  - a token NEWER than every follower: HOLD briefly (hold_ms) for a
+    follower tail to catch up, then ESCALATE to the leader (authority
+    for every version it ever minted — its answer is never stale);
+  - a follower that answers 409 (typed SnaptokenUnsatisfiable — it IS
+    healthy, just behind): try the next candidate; its breaker is NOT
+    punished;
+  - a daemon that stops answering (kill -9, network partition): its
+    per-target CircuitBreaker (resilience.py — the same machinery as
+    the device and store breakers) trips after `breaker_threshold`
+    consecutive failures and the daemon is DRAINED from rotation;
+    background probes keep testing it and re-admit it on recovery.
+    Mid-call, the failed attempt simply falls through to the next
+    candidate — the failover the smoke bounds (keto_tpu_ha_failovers_
+    total + the recorded failover latency).
+
+Writes NEVER fail over: they go to the leader, single-shot (a blind
+retry could double-apply; followers reject them with a typed 503
+anyway). Everything here is client-side policy: constructor kwargs, no
+config-file surface.
+
+Snaptoken safety does not depend on the router being right: a stale
+routing decision lands on a daemon whose snaptoken gate refuses (409)
+or whose answer carries its own version token — the response token IS
+the staleness bound, exactly as on a single daemon (PR 15's contract,
+now per-daemon)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ..errors import StoreUnavailableError
+from ..resilience import CircuitBreaker
+
+_LEADER = "leader"
+
+
+def _token_version(token: str) -> Optional[int]:
+    if not token:
+        return None
+    try:
+        return int(token.rsplit("_", 1)[1])
+    except (IndexError, ValueError):
+        return None
+
+
+def _grpc_code_name(err) -> str:
+    code = getattr(err, "code", None)
+    if not callable(code):
+        return ""
+    try:
+        return code().name
+    except Exception:  # noqa: BLE001
+        return ""
+
+
+def _default_read_factory(addr: str):
+    from .client import ReadClient, open_channel
+
+    return ReadClient(open_channel(addr))
+
+
+def _default_write_factory(addr: str):
+    from .client import WriteClient, open_channel
+
+    return WriteClient(open_channel(addr))
+
+
+class _Target:
+    """One backend daemon: its read client, health breaker, and the
+    newest applied version we have OBSERVED (from response/probe
+    snaptokens — learned passively, no control-plane RPC)."""
+
+    __slots__ = ("name", "addr", "client", "breaker", "applied", "checks")
+
+    def __init__(self, name: str, addr: str, client, breaker):
+        self.name = name
+        self.addr = addr
+        self.client = client
+        self.breaker = breaker
+        self.applied = 0
+        self.checks = 0
+
+    def observe(self, token: str) -> None:
+        v = _token_version(token)
+        if v is not None and v > self.applied:
+            self.applied = v
+
+    def in_rotation(self) -> bool:
+        # OPEN = drained; CLOSED and HALF_OPEN stay eligible (the
+        # half-open call IS the recovery probe)
+        return self.breaker.state != CircuitBreaker.OPEN
+
+
+class HaRouter:
+    """Client-side HA router over one leader + N follower daemons.
+
+    `probe_tuple` (a RelationTuple the deployment's namespaces can
+    check — existence not required) powers the background health/version
+    probe; without one the probe falls back to the health RPC (liveness
+    only — version freshness then rides entirely on response tokens)."""
+
+    def __init__(
+        self,
+        leader: str,
+        followers=(),
+        leader_write: Optional[str] = None,
+        hold_ms: float = 150.0,
+        probe_interval_s: float = 0.5,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 1.0,
+        rpc_timeout_s: float = 2.0,
+        probe_tuple=None,
+        metrics=None,
+        read_client_factory=None,
+        write_client_factory=None,
+        clock=time.monotonic,
+    ):
+        self.hold_s = max(float(hold_ms), 0.0) / 1e3
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_tuple = probe_tuple
+        self.metrics = metrics
+        self._clock = clock
+        read_factory = read_client_factory or _default_read_factory
+
+        def _breaker():
+            return CircuitBreaker(
+                threshold=int(breaker_threshold),
+                cooldown_s=float(breaker_cooldown_s),
+            )
+
+        self.leader = _Target(_LEADER, leader, read_factory(leader), _breaker())
+        # the daemon serves Write on its own listener (serve.write.port);
+        # reads and writes therefore carry separate addresses
+        self.write_addr = leader_write if leader_write else leader
+        self.followers = [
+            _Target(f"follower-{i}", addr, read_factory(addr), _breaker())
+            for i, addr in enumerate(followers)
+        ]
+        self._write_factory = write_client_factory or _default_write_factory
+        self._write_client = None
+        self._mu = threading.Lock()
+        self._rr = 0
+        self._stop = threading.Event()
+        self._probe_thread: Optional[threading.Thread] = None
+        self.stats = {
+            "checks": 0,
+            "held": 0,
+            "escalated": 0,
+            "failovers": 0,
+            "rejected_409": 0,
+        }
+        self.failover_ms: list[float] = []
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start_probes(self) -> None:
+        if self._probe_thread is not None:
+            return
+        self._probe_thread = threading.Thread(
+            target=self._run_probes, name="keto-ha-router-probe", daemon=True
+        )
+        self._probe_thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=3.0)
+        for t in self._targets():
+            try:
+                t.client.close()
+            # ketolint: allow[typed-error] reason=closing an already-dead channel on shutdown
+            except Exception:  # noqa: BLE001
+                pass
+        if self._write_client is not None:
+            try:
+                self._write_client.close()
+            # ketolint: allow[typed-error] reason=closing an already-dead channel on shutdown
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _targets(self):
+        return [*self.followers, self.leader]
+
+    # -- read path -----------------------------------------------------------
+
+    def check(
+        self, t, snaptoken: str = "", timeout=None, max_depth: int = 0
+    ) -> tuple[bool, str, str]:
+        """(allowed, response snaptoken, answering target name). Tries
+        covering daemons in rotation order, holds for hold_ms when only
+        lagging followers exist, escalates to the leader, and fails over
+        past dead daemons — raising only when EVERY daemon failed."""
+        self.stats["checks"] += 1
+        min_v = _token_version(snaptoken)
+        started = self._clock()
+        rpc_timeout = timeout if timeout is not None else self.rpc_timeout_s
+        failed_first = False
+        last_err: Optional[Exception] = None
+        tried_leader = False
+        for target in self._candidates(min_v):
+            if target is self.leader:
+                tried_leader = True
+            try:
+                allowed, token = target.client.check_with_token(
+                    t, max_depth=max_depth, snaptoken=snaptoken,
+                    timeout=rpc_timeout,
+                )
+            except Exception as e:  # noqa: BLE001
+                code = _grpc_code_name(e)
+                if code == "FAILED_PRECONDITION":
+                    # healthy but behind our token: routing miss, not
+                    # daemon failure — never breaker evidence
+                    self.stats["rejected_409"] += 1
+                    last_err = e
+                    continue
+                target.breaker.record_failure()
+                last_err = e
+                failed_first = True
+                continue
+            target.breaker.record_success()
+            target.observe(token)
+            target.checks += 1
+            if failed_first:
+                # answered AFTER at least one dead/failing daemon: this
+                # call's whole latency is the failover latency
+                self.stats["failovers"] += 1
+                self.failover_ms.append((self._clock() - started) * 1e3)
+                if self.metrics is not None:
+                    self.metrics.ha_failovers_total.inc()
+            return allowed, token, target.name
+        if not tried_leader and self.leader.in_rotation():
+            # every candidate 409'd / failed and the rotation pass never
+            # reached the leader (possible when min_v filtered it out of
+            # candidate order edge cases) — authority gets the last word
+            try:
+                allowed, token = self.leader.client.check_with_token(
+                    t, max_depth=max_depth, snaptoken=snaptoken,
+                    timeout=rpc_timeout,
+                )
+                self.leader.breaker.record_success()
+                self.leader.observe(token)
+                return allowed, token, self.leader.name
+            except Exception as e:  # noqa: BLE001
+                self.leader.breaker.record_failure()
+                last_err = e
+        if last_err is not None:
+            raise last_err
+        raise StoreUnavailableError(
+            "no HA backend in rotation", retry_after_s=1.0
+        )
+
+    def _candidates(self, min_v: Optional[int]):
+        """Yield targets in try-order: covering in-rotation followers
+        round-robin first, then (after holding for a catch-up when
+        everything is lagging) the leader, then — as pure failover
+        fodder — the remaining followers for version-free reads."""
+        followers = [f for f in self.followers if f.in_rotation()]
+        with self._mu:
+            self._rr += 1
+            rr = self._rr
+        if followers:
+            followers = followers[rr % len(followers):] + followers[
+                : rr % len(followers)
+            ]
+        if min_v is None:
+            # no pin: spread across the whole fleet, leader included
+            order = followers[:]
+            slot = rr % (len(followers) + 1)
+            order.insert(slot, self.leader)
+            for target in order:
+                if target.in_rotation() or target is self.leader:
+                    yield target
+            return
+        covering = [f for f in followers if f.applied >= min_v]
+        if not covering and followers and self.hold_s > 0:
+            # HOLD: a lagging follower is usually milliseconds behind —
+            # a brief wait keeps the read off the leader
+            self.stats["held"] += 1
+            deadline = self._clock() + self.hold_s
+            while self._clock() < deadline:
+                time.sleep(min(0.005, self.hold_s))
+                covering = [f for f in followers if f.applied >= min_v]
+                if covering:
+                    break
+        for target in covering:
+            yield target
+        # ESCALATE: the leader minted the token, it can always serve it
+        self.stats["escalated"] += 0 if covering else 1
+        yield self.leader
+        # last-ditch failover for pinned reads: non-covering followers
+        # will 409 if still behind (harmless) or answer if they caught
+        # up between the snapshot above and now
+        for target in followers:
+            if target not in covering:
+                yield target
+
+    # -- write path (leader only, single-shot) --------------------------------
+
+    def transact(self, insert=(), delete=(), timeout=None) -> list[str]:
+        with self._mu:
+            if self._write_client is None:
+                self._write_client = self._write_factory(self.write_addr)
+            client = self._write_client
+        return client.transact(
+            insert=insert, delete=delete,
+            timeout=timeout if timeout is not None else self.rpc_timeout_s,
+        )
+
+    # -- background probes -----------------------------------------------------
+
+    def _run_probes(self) -> None:
+        while not self._stop.wait(self.probe_interval_s):
+            for target in self._targets():
+                self._probe(target)
+            self._export_rotation()
+
+    def _probe(self, target: _Target) -> None:
+        """One health/version probe. Runs even against a DRAINED target
+        (that is how it gets back in): the breaker's half-open window
+        admits this probe, and its success re-closes the breaker."""
+        if target.breaker.state == CircuitBreaker.OPEN:
+            if not target.breaker.allow():
+                return  # still cooling down
+        try:
+            if self.probe_tuple is not None:
+                _, token = target.client.check_with_token(
+                    self.probe_tuple, timeout=min(1.0, self.rpc_timeout_s),
+                )
+                target.observe(token)
+            else:
+                target.client.health(timeout=min(1.0, self.rpc_timeout_s))
+        except Exception:  # noqa: BLE001
+            target.breaker.record_failure()
+        else:
+            target.breaker.record_success()
+
+    def _export_rotation(self) -> None:
+        if self.metrics is None:
+            return
+        for target in self._targets():
+            self.metrics.ha_rotation_state.labels(target.name).set(
+                1 if target.in_rotation() else 0
+            )
+
+    # -- introspection ---------------------------------------------------------
+
+    def status(self) -> dict:
+        ms = sorted(self.failover_ms)
+
+        def q(p: float) -> Optional[float]:
+            if not ms:
+                return None
+            return round(ms[min(len(ms) - 1, int(p * len(ms)))], 3)
+
+        return {
+            "targets": [
+                {
+                    "name": t.name,
+                    "addr": t.addr,
+                    "applied_version": t.applied,
+                    "breaker": t.breaker.state,
+                    "in_rotation": t.in_rotation(),
+                    "checks_answered": t.checks,
+                }
+                for t in self._targets()
+            ],
+            "stats": dict(self.stats),
+            "failover_latency_ms": {
+                "count": len(ms),
+                "p50": q(0.50),
+                "p99": q(0.99),
+                "max": round(ms[-1], 3) if ms else None,
+            },
+        }

@@ -135,6 +135,13 @@ def cmd_serve(args) -> int:
     from ..profiling import profiled
 
     config = Config.from_file(args.config) if args.config else Config()
+    # `keto-tpu serve --follower-of HOST:PORT`: run as an HA follower of
+    # that leader without editing the config file — the flag is exactly
+    # follower.enabled + follower.leader (schema-validated via set())
+    follower_of = getattr(args, "follower_of", None)
+    if follower_of:
+        config.set("follower.enabled", True)
+        config.set("follower.leader", str(follower_of))
     # env/config-driven profiling around the whole serve lifetime
     # (ref: profilex.Profile() in /root/reference/main.go:24)
     with profiled(config.get("profiling")):
@@ -745,6 +752,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="write the daemon pid here on start; removed on clean "
              "shutdown (a stale pid file outliving a clean stop lies "
              "to supervisors)",
+    )
+    p.add_argument(
+        "--follower-of", default=None, metavar="HOST:PORT",
+        help="serve as a read-only HA follower of the leader daemon at "
+             "HOST:PORT (its gRPC read listener): the tuple store "
+             "becomes a Watch-changelog-fed mirror, writes are refused "
+             "with a typed 503. Equivalent to follower.enabled=true + "
+             "follower.leader in the config file",
     )
     p.set_defaults(fn=cmd_serve)
 

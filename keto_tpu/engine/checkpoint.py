@@ -306,6 +306,50 @@ def checkpoint_info(path: str) -> Optional[dict]:
         return {"loadable": False}
 
 
+def restore_snapshot(path: str) -> Optional[GraphSnapshot]:
+    """STRICT restore for callers that asked for this checkpoint by name
+    (the HA follower's cold start, api/follower.py) instead of probing
+    an optional cache:
+
+      - missing or torn/corrupt file -> None (recover by rebuilding —
+        a crash mid-publish must never wedge a restart);
+      - intact but incompatible (format version or cross-layout) ->
+        typed CheckpointIncompatibleError, because the file the caller
+        explicitly wants CANNOT be honored by this process and silently
+        rebuilding would hide an operational mistake (e.g. pointing a
+        compact-layout follower at a bucketized leader's cache dir).
+
+    load_snapshot keeps the old degrade-to-None contract for the
+    engine's opportunistic warm-start probe."""
+    from ..errors import CheckpointIncompatibleError
+
+    info = checkpoint_info(path)
+    if info is None:
+        return None
+    if not info.get("loadable"):
+        fmt = info.get("format_version")
+        if fmt is not None and fmt != FORMAT_VERSION:
+            raise CheckpointIncompatibleError(
+                debug=(
+                    f"checkpoint {path} is format v{fmt}, this process "
+                    f"reads v{FORMAT_VERSION}"
+                )
+            )
+        layout = info.get("table_layout")
+        from .snapshot import table_layout
+
+        if layout is not None and layout != table_layout():
+            raise CheckpointIncompatibleError(
+                debug=(
+                    f"checkpoint {path} was built under the {layout!r} "
+                    f"table layout; this process probes "
+                    f"{table_layout()!r} — its tables would mis-answer"
+                )
+            )
+        return None  # torn/corrupt: recover cleanly via rebuild
+    return load_snapshot(path)
+
+
 def load_snapshot(path: str) -> Optional[GraphSnapshot]:
     """Load a snapshot; None when missing/corrupt/incompatible — a torn
     or truncated file (crash mid-write on a filesystem without the

@@ -236,6 +236,20 @@ class StoreBusyError(StoreUnavailableError):
     default_message = "the tuple store is busy (locked), retry"
 
 
+class CheckpointIncompatibleError(KetoError):
+    # A checkpoint file that is INTACT but unusable by this process —
+    # wrong format version or a cross-layout table build (bucketized vs
+    # compact place keys in different slots; probing one with the other
+    # mis-answers every lookup). Distinct from a torn/corrupt file,
+    # which silently degrades to a rebuild: an explicit restore request
+    # (the HA follower's cold start, engine/checkpoint.restore_snapshot)
+    # answering from such a file would be WRONG, so the caller gets a
+    # typed refusal to act on, never a crash and never silent garbage.
+    status = 500
+    code = "internal_server_error"
+    default_message = "checkpoint incompatible with this process"
+
+
 class CheckBatchFailedError(KetoError, RuntimeError):
     # Engine-batch failure classified into the typed error surface
     # (api/batcher.py classify_engine_error) instead of leaking the raw

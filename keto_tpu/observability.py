@@ -392,6 +392,14 @@ class Metrics:
             "and its fan-out to subscribers (watch hub tail lag)",
             registry=self.registry,
         )
+        self.watch_heartbeats_total = prom.Counter(
+            "keto_tpu_watch_heartbeats_total",
+            "In-band HEARTBEAT frames broadcast on idle watch streams "
+            "(opt-in via watch.heartbeat_s) — the liveness signal an "
+            "out-of-process follower tail uses to tell a quiet upstream "
+            "from a dead one; emitted through store outages too",
+            registry=self.registry,
+        )
         # request-scoped telemetry plane: the per-stage Check breakdown
         # (CHECK_STAGES) — one observation per stage per device batch
         # (batch-shared stages are observed once, not per rider), so a
@@ -744,6 +752,69 @@ class Metrics:
             "keto_tpu_hedge_cancelled_total",
             "Losing hedge rides cancelled before their batch launched "
             "(a cancelled pending never occupies a device batch slot)",
+            registry=self.registry,
+        )
+        # multi-daemon HA plane (api/follower.py, api/router.py,
+        # tools/ha_smoke.py): Watch-fed follower mirrors + snaptoken-safe
+        # cross-process failover (Zanzibar §2.4 multi-cluster serving)
+        self.ha_applied_version = prom.Gauge(
+            "keto_tpu_ha_applied_version",
+            "Leader store version this follower daemon has applied from "
+            "its network Watch-changelog tail, per network id — the "
+            "version its snaptoken gate enforces; compare against the "
+            "leader's keto_tpu_store_version-equivalent for fleet lag",
+            ["nid"],
+            registry=self.registry,
+        )
+        self.ha_version_lag = prom.Gauge(
+            "keto_tpu_ha_version_lag",
+            "Versions between the leader tail this follower has OBSERVED "
+            "(latest watch frame) and what it has APPLIED, per network "
+            "id — sustained nonzero means the apply path is behind, not "
+            "the network",
+            ["nid"],
+            registry=self.registry,
+        )
+        self.ha_tail_state = prom.Gauge(
+            "keto_tpu_ha_tail_state",
+            "Follower changelog-tail state (0 disconnected, 1 "
+            "bootstrapping, 2 tailing) — the rotation signal the front "
+            "router's health probes reflect",
+            ["nid"],
+            registry=self.registry,
+        )
+        self.ha_bootstrap_reads_total = prom.Counter(
+            "keto_tpu_ha_bootstrap_reads_total",
+            "Full leader store sweeps the follower performed (cold start "
+            "with no usable checkpoint, or a watch RESET gap). The HA "
+            "smoke pins this at its floor to prove steady state is "
+            "changelog-fed — zero full reads after cold start",
+            registry=self.registry,
+        )
+        self.ha_stream_reconnects_total = prom.Counter(
+            "keto_tpu_ha_stream_reconnects_total",
+            "Follower watch-stream reconnects, by cause: silent (no "
+            "frame within follower.liveness_s — the severed-connection "
+            "detector), error (transport error / stream end), reset "
+            "(server RESET forced a re-bootstrap), stale (snaptoken "
+            "ahead of the leader — leader lost state, resync)",
+            ["cause"],
+            registry=self.registry,
+        )
+        self.ha_failovers_total = prom.Counter(
+            "keto_tpu_ha_failovers_total",
+            "Requests the HA front router re-routed away from a failed "
+            "or lagging daemon mid-call (the kill -9 smoke's failover "
+            "counter; latency to the winning answer is the failover "
+            "latency the smoke bounds)",
+            registry=self.registry,
+        )
+        self.ha_rotation_state = prom.Gauge(
+            "keto_tpu_ha_rotation_state",
+            "Router rotation membership per backend daemon (1 in "
+            "rotation, 0 drained — breaker open or probes failing); "
+            "drained daemons keep being probed and rejoin on recovery",
+            ["target"],
             registry=self.registry,
         )
         # crash-recovery plane (engine/scrub.py, engine/checkpoint.py,

@@ -157,6 +157,11 @@ class Registry:
         # when serve.check.workers >= 2; the metrics listener's
         # GET /admin/replicas reads it (None = single-stack serving)
         self.replica_group = None
+        # HA follower plane (api/follower.py), attached by the daemon
+        # when follower.enabled; GET /admin/ha reads it (None on a
+        # leader — ha_status() then reports the leader-side view)
+        self.ha_plane = None
+        self._follower_store = None
 
     # -- storage --------------------------------------------------------------
 
@@ -164,7 +169,19 @@ class Registry:
         with self._lock:
             if self._manager is None:
                 dsn = self.config.dsn
-                if dsn == "memory":
+                if bool(self.config.get("follower.enabled", False)):
+                    # HA follower daemon (api/follower.py): the store is
+                    # a network-fed mirror of the LEADER's — versions
+                    # pinned to the leader's commit versions, local
+                    # writes refused with a typed 503. The DSN is
+                    # ignored: this process never owns tuples. The RAW
+                    # store reference is kept for the replication plane
+                    # (apply_remote must bypass the health guard —
+                    # replication is not request traffic).
+                    from .api.follower import FollowerStore
+
+                    self._manager = self._follower_store = FollowerStore()
+                elif dsn == "memory":
                     self._manager = MemoryManager()
                 elif dsn == "columnar":
                     # scale tier: numpy-column store (1e8-tuple ingest)
@@ -213,10 +230,51 @@ class Registry:
                         bulk_timeout_s=float(
                             self.config.get("store.bulk_timeout_ms", 120000)
                         ) / 1e3,
-                        use_executor=dsn not in ("memory", "columnar"),
+                        # in-process dict stores cannot hang — and the
+                        # follower's network-fed mirror is one of them,
+                        # whatever the (ignored) DSN says
+                        use_executor=(
+                            dsn not in ("memory", "columnar")
+                            and self._follower_store is None
+                        ),
                         metrics=self.metrics(),
                     )
             return self._manager
+
+    def follower_store(self):
+        """The RAW FollowerStore when this process is a follower
+        (follower.enabled), else None. Raw = unwrapped by Traced/
+        HealthGuard: the replication tail writes through this reference
+        (apply_remote/bootstrap_replace are infrastructure, not request
+        traffic — they must land even while the request-path breaker is
+        open)."""
+        self.relation_tuple_manager()  # ensure built
+        return self._follower_store
+
+    def ha_status(self) -> dict:
+        """The /admin/ha document: the follower plane's status when one
+        is attached, else the leader-side view (store version + watch
+        tail are the ground truth followers replicate toward)."""
+        if self.ha_plane is not None:
+            return self.ha_plane.status()
+        from .errors import StoreUnavailableError
+
+        try:
+            version = self.relation_tuple_manager().version(nid=self.nid)
+        except StoreUnavailableError:
+            version = None
+        status: dict = {
+            "role": "leader",
+            "nid": self.nid,
+            "store_version": version,
+        }
+        hub = self._watch_hub
+        if hub is not None:
+            status["watch_heartbeat_s"] = hub.heartbeat_s
+        breaker = self._store_breaker
+        if breaker is not None:
+            status["store_breaker"] = breaker.state
+        return status
 
     # -- engines --------------------------------------------------------------
 
@@ -345,6 +403,11 @@ class Registry:
             if self._watch_hub is None:
                 from .watch import WatchHub
 
+                # in-band heartbeats are OPT-IN (an explicitly set
+                # watch.heartbeat_s): the HA follower tail needs them
+                # for liveness + idle version discovery, while default
+                # single-daemon streams keep the pre-HA event mix
+                hb = self.config.get("watch.heartbeat_s")
                 self._watch_hub = WatchHub(
                     self.relation_tuple_manager(),
                     poll_interval=float(
@@ -352,6 +415,7 @@ class Registry:
                     ),
                     buffer=int(self.config.get("watch.buffer", 256)),
                     metrics=self.metrics(),
+                    heartbeat_s=float(hb) if hb is not None else None,
                 )
                 self._watch_hub.add_commit_listener(self._push_invalidate)
             return self._watch_hub

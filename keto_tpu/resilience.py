@@ -263,12 +263,19 @@ class RetryPolicy:
     WriteClient never does — a retried transact could double-apply).
 
     Retries gRPC UNAVAILABLE / RESOURCE_EXHAUSTED (the two codes this
-    server sheds with) with full-jitter exponential backoff, staying
-    inside the caller's deadline budget: a retry whose backoff sleep
-    would outlive the remaining budget gives up and re-raises instead of
-    burning the budget asleep. `counter` is an optional metrics counter
-    (e.g. Metrics.client_retries_total) incremented per retry; `stats`
-    mirrors it process-locally."""
+    server sheds with) with DECORRELATED-jitter backoff (delay ~
+    U[base, 3 * previous], capped) — unlike a fixed exponential ladder,
+    no two clients that failed at the same instant re-arrive on the
+    same schedule, so a shedding daemon is never hammered at a
+    synchronized cadence. When the server attached a `Retry-After` hint
+    (the typed 503/429 sheds carry one in gRPC trailing metadata and
+    the REST header), that hint FLOORS the backoff: the server said how
+    long the condition lasts, and retrying earlier is a wasted shed.
+    Both stay inside the caller's deadline budget: a retry whose sleep
+    would outlive the remaining budget gives up and re-raises instead
+    of burning the budget asleep. `counter` is an optional metrics
+    counter (e.g. Metrics.client_retries_total) incremented per retry;
+    `stats` mirrors it process-locally."""
 
     RETRYABLE_CODES = ("UNAVAILABLE", "RESOURCE_EXHAUSTED")
 
@@ -291,10 +298,12 @@ class RetryPolicy:
         self._rng = rng or random.Random()
         self.stats = {"attempts": 0, "retries": 0, "giveups": 0}
 
-    def _delay(self, attempt: int) -> float:
-        return self._rng.uniform(
-            0.0, min(self.cap_s, self.base_s * (2.0 ** attempt))
-        )
+    def _next_delay(self, prev: float) -> float:
+        """Decorrelated jitter (the AWS-architecture-blog variant):
+        delay ~ U[base, 3 * previous], capped. Each call() keeps its OWN
+        `prev` chain, so concurrent requests through one shared policy
+        never couple their schedules."""
+        return min(self.cap_s, self._rng.uniform(self.base_s, prev * 3.0))
 
     def _retryable(self, err) -> bool:
         code = getattr(err, "code", None)
@@ -306,6 +315,28 @@ class RetryPolicy:
             return False
         return name in self.codes
 
+    @staticmethod
+    def retry_after_hint_s(err) -> Optional[float]:
+        """The server's Retry-After hint riding a shed, in seconds:
+        gRPC errors carry it as `retry-after` trailing metadata
+        (grpc_server._attach_retry_after); typed KetoErrors carry
+        `retry_after_s` directly (REST clients mapping the header).
+        None when the error carries no hint."""
+        direct = getattr(err, "retry_after_s", None)
+        if isinstance(direct, (int, float)) and direct > 0:
+            return float(direct)
+        trailing = getattr(err, "trailing_metadata", None)
+        if not callable(trailing):
+            return None
+        try:
+            for key, value in trailing() or ():
+                if key == "retry-after":
+                    parsed = float(value)
+                    return parsed if parsed > 0 else None
+        except Exception:  # noqa: BLE001 — malformed metadata: no hint
+            return None
+        return None
+
     def call(self, fn, budget_s: Optional[float] = None):
         """Run `fn(remaining_timeout_s)` with retries. The budget is the
         TOTAL deadline across all attempts (the caller's `timeout=`);
@@ -313,6 +344,7 @@ class RetryPolicy:
         the caller-visible deadline."""
         start = time.monotonic()
         attempt = 0
+        prev_delay = self.base_s
         while True:
             self.stats["attempts"] += 1
             remaining = (
@@ -324,7 +356,12 @@ class RetryPolicy:
             except Exception as e:  # noqa: BLE001 — classified just below
                 if not self._retryable(e) or attempt + 1 >= self.max_attempts:
                     raise
-                delay = self._delay(attempt)
+                prev_delay = delay = self._next_delay(prev_delay)
+                hint = self.retry_after_hint_s(e)
+                if hint is not None:
+                    # the hint is a FLOOR, not a replacement: jitter
+                    # still spreads clients that were shed together
+                    delay = max(delay, hint)
                 if remaining is not None and delay >= max(remaining, 0.0):
                     # budget-aware: sleeping would outlive the deadline
                     self.stats["giveups"] += 1

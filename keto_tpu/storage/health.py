@@ -266,12 +266,15 @@ class StoreHealthGuard:
             # typed errors classify themselves: StoreUnavailableError
             # family (incl. the sqlite BUSY mapping) is store-health
             # evidence — EXCEPT pool backpressure (a saturated op pool
-            # on a healthy store must not trip the breaker); anything
-            # else (bad page token, malformed input) is the caller's
-            # error, not the store's
+            # on a healthy store must not trip the breaker) and the HA
+            # follower's read-only write rejection (a policy refusal
+            # from a healthy store; counting it would let stray writes
+            # poison the follower's READ path via the shared breaker);
+            # anything else (bad page token, malformed input) is the
+            # caller's error, not the store's
             if isinstance(e, StoreUnavailableError) and not getattr(
                 e, "backpressure", False
-            ):
+            ) and not getattr(e, "read_only", False):
                 self._record_failure(
                     op, "timeout" if isinstance(e, StoreTimeoutError)
                     else "error",

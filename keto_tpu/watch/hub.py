@@ -53,6 +53,13 @@ KIND_RESET = "reset"
 # the same cursors when the store recovers (a trimmed-changelog gap
 # during the outage flows through the normal RESET machinery)
 KIND_DEGRADED = "degraded"
+# stream liveness (the HA follower plane, api/follower.py): with
+# `watch.heartbeat_s` set, an idle tail emits an in-band HEARTBEAT
+# carrying the CURRENT tail snaptoken, so an out-of-process tail can
+# (a) bound dead-upstream detection — silence past the liveness window
+# means the connection is gone, not the store idle — and (b) learn the
+# store version on a stream that has never delivered a change
+KIND_HEARTBEAT = "heartbeat"
 
 
 class WatchEvent:
@@ -164,6 +171,23 @@ class Subscription:
             fn()
         return delivered
 
+    def _push_heartbeat(self, event: WatchEvent) -> None:
+        """Enqueue a liveness heartbeat ONLY when the ring has room: a
+        backed-up consumer must never be tipped into an overflow RESET
+        by a frame that carries no changes (its own backlog already
+        proves the stream live)."""
+        fns = ()
+        with self._cond:
+            if self._closed or not self._active:
+                return
+            if len(self._events) >= self.cap:
+                return
+            self._events.append(event)
+            fns = tuple(self._notify_fns)
+            self._cond.notify_all()
+        for fn in fns:
+            fn()
+
     def _force_reset(self, event: WatchEvent) -> None:
         """Changelog truncated beneath the tail (bulk load, trim): the
         gap is unrecoverable, so pending events are superseded by an
@@ -273,7 +297,7 @@ class _NidState:
 
     __slots__ = (
         "lock", "cond", "subs", "tail_version", "dirty", "pending_since",
-        "thread", "degraded",
+        "thread", "degraded", "last_emit",
     )
 
     def __init__(self, tail_version: int):
@@ -288,6 +312,9 @@ class _NidState:
         # DEGRADED marker per episode, flipped back on the first
         # successful drain)
         self.degraded = False
+        # monotonic time of the last broadcast (change or heartbeat):
+        # the idle clock the heartbeat schedule runs against
+        self.last_emit = time.monotonic()
 
 
 class WatchHub:
@@ -299,11 +326,17 @@ class WatchHub:
         poll_interval: float = DEFAULT_POLL_INTERVAL,
         buffer: int = DEFAULT_BUFFER_EVENTS,
         metrics=None,
+        heartbeat_s: Optional[float] = None,
     ):
         self.manager = manager
         self.poll_interval = max(float(poll_interval), 0.01)
         self.buffer = max(int(buffer), 1)
         self.metrics = metrics
+        # None = no in-band heartbeats (the pre-HA behavior); a period
+        # makes every idle tail emit KIND_HEARTBEAT on that schedule
+        self.heartbeat_s = (
+            max(float(heartbeat_s), 0.05) if heartbeat_s else None
+        )
         self._states: dict[str, _NidState] = {}
         self._states_lock = threading.Lock()
         self._commit_listeners: list[Callable[[str], None]] = []
@@ -561,11 +594,15 @@ class WatchHub:
 
             _faults.inject("watch_broadcast")
             delivered = 0
+            broadcast = False
             for event in self._group(nid, ops):
                 for sub in state.subs:
                     delivered += sub._push(event)
+                broadcast = True
                 if event.version > state.tail_version:
                     state.tail_version = event.version
+            if broadcast:
+                state.last_emit = time.monotonic()
             self._count_delivered(delivered)
             if state.tail_version < current:
                 state.tail_version = current
@@ -589,13 +626,18 @@ class WatchHub:
         return event
 
     def _tail_loop(self, state: _NidState, nid: str) -> None:
+        park = self.poll_interval
+        if self.heartbeat_s is not None:
+            # the park must wake often enough to keep the heartbeat
+            # schedule honest even when nothing ever commits
+            park = min(park, self.heartbeat_s / 2)
         while not self._stopped:
             with state.lock:
                 if not state.subs:
                     state.thread = None
                     return
                 if not state.dirty:
-                    state.cond.wait(self.poll_interval)
+                    state.cond.wait(park)
                 # re-check AFTER the park: stop() may have flipped the
                 # flag while this thread waited — one more drain here
                 # would race whatever the stopper tears down next (e.g.
@@ -606,6 +648,24 @@ class WatchHub:
                 try:
                     self._drain_locked(state, nid)
                     state.degraded = False  # resumed delivery IS the recovery signal
+                    if (
+                        self.heartbeat_s is not None
+                        and time.monotonic() - state.last_emit
+                        >= self.heartbeat_s
+                    ):
+                        # idle past the period: an in-band liveness
+                        # frame at the CURRENT tail — never pushed into
+                        # a full ring (see _push_heartbeat), never
+                        # advances cursors (consumers treat it as a
+                        # version announcement, not a change)
+                        event = WatchEvent(
+                            KIND_HEARTBEAT, state.tail_version,
+                            encode_snaptoken(state.tail_version, nid),
+                        )
+                        for sub in state.subs:
+                            sub._push_heartbeat(event)
+                        state.last_emit = time.monotonic()
+                        self._count_heartbeat()
                 except StoreUnavailableError:
                     # store outage: never let the tailer thread die (a
                     # dead tailer is a silently stalled stream) — push
@@ -622,6 +682,23 @@ class WatchHub:
                         for sub in state.subs:
                             sub._push(event)
                         self._count_degraded()
+                    elif (
+                        self.heartbeat_s is not None
+                        and time.monotonic() - state.last_emit
+                        >= self.heartbeat_s
+                    ):
+                        # keep heartbeating THROUGH the outage (no store
+                        # read needed): an out-of-process tail must be
+                        # able to tell a degraded-but-alive upstream
+                        # from a dead connection
+                        event = WatchEvent(
+                            KIND_HEARTBEAT, state.tail_version,
+                            encode_snaptoken(state.tail_version, nid),
+                        )
+                        for sub in state.subs:
+                            sub._push_heartbeat(event)
+                        state.last_emit = time.monotonic()
+                        self._count_heartbeat()
 
     # -- metrics helpers -------------------------------------------------------
 
@@ -633,6 +710,11 @@ class WatchHub:
 
     def _count_reset(self) -> None:
         c = getattr(self.metrics, "watch_resets_total", None)
+        if c is not None:
+            c.inc()
+
+    def _count_heartbeat(self) -> None:
+        c = getattr(self.metrics, "watch_heartbeats_total", None)
         if c is not None:
             c.inc()
 

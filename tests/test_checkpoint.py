@@ -1,6 +1,7 @@
 """Mirror checkpoint tests: snapshot save/restore + engine warm restart."""
 
 import numpy as np
+import pytest
 
 from keto_tpu.config import Config
 from keto_tpu.engine.checkpoint import (
@@ -403,3 +404,76 @@ class TestFlushFailureTolerance:
             d.registry.metrics().checkpoint_write_failures_total
             ._value.get() == 1
         )
+
+
+class TestStrictRestore:
+    """PR 20: restore_snapshot is the HA follower's cold-start path —
+    torn files degrade to None (rebuild via bootstrap), but a file that
+    is INTACT yet unreadable by this process (format bump, cross-layout
+    cache dir) raises the typed CheckpointIncompatibleError instead of
+    silently rebuilding over an operational mistake."""
+
+    def _saved(self, tmp_path):
+        snap = build_snapshot(TUPLES, NAMESPACES, K=8, version=99)
+        path = str(tmp_path / "mirror-default.npz")
+        save_snapshot(snap, path)
+        return path
+
+    def test_intact_file_restores(self, tmp_path):
+        from keto_tpu.engine.checkpoint import restore_snapshot
+
+        snap = restore_snapshot(self._saved(tmp_path))
+        assert snap is not None and snap.version == 99
+
+    def test_missing_file_is_none(self, tmp_path):
+        from keto_tpu.engine.checkpoint import restore_snapshot
+
+        assert restore_snapshot(str(tmp_path / "absent.npz")) is None
+
+    def test_torn_file_is_none_not_raise(self, tmp_path):
+        from keto_tpu.engine.checkpoint import restore_snapshot
+
+        path = self._saved(tmp_path)
+        data = open(path, "rb").read()
+        for frac in (0.25, 0.6, 0.95):
+            open(path, "wb").write(data[: int(len(data) * frac)])
+            assert restore_snapshot(path) is None
+
+    def test_garbage_file_is_none(self, tmp_path):
+        from keto_tpu.engine.checkpoint import restore_snapshot
+
+        bad = tmp_path / "garbage.npz"
+        bad.write_bytes(b"\x00" * 64)
+        assert restore_snapshot(str(bad)) is None
+
+    def test_format_version_mismatch_raises_typed(self, tmp_path, monkeypatch):
+        from keto_tpu.engine import checkpoint as cp
+        from keto_tpu.errors import CheckpointIncompatibleError
+
+        monkeypatch.setattr(cp, "FORMAT_VERSION", 999)
+        path = self._saved(tmp_path)
+        monkeypatch.undo()
+        with pytest.raises(CheckpointIncompatibleError) as ei:
+            cp.restore_snapshot(path)
+        assert "format" in str(ei.value.debug)
+
+    def test_cross_layout_raises_typed(self, tmp_path, monkeypatch):
+        # Write the checkpoint as if a bucketized-layout process (a TPU
+        # leader) had published it, then restore on this compact-layout
+        # process: the tables would mis-answer, so the restore must be
+        # refused with the typed error, not a crash and not a silent
+        # rebuild.
+        from keto_tpu.engine import checkpoint as cp
+        from keto_tpu.engine import snapshot as snapmod
+        from keto_tpu.errors import CheckpointIncompatibleError
+
+        if snapmod.table_layout() != "compact":
+            pytest.skip("needs a compact-layout host process")
+        monkeypatch.setattr(snapmod, "table_layout", lambda: "bucketized")
+        path = self._saved(tmp_path)
+        monkeypatch.undo()
+        info = cp.checkpoint_info(path)
+        assert info["loadable"] is False
+        with pytest.raises(CheckpointIncompatibleError) as ei:
+            cp.restore_snapshot(path)
+        assert "layout" in str(ei.value.debug)

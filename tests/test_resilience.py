@@ -1052,3 +1052,150 @@ class TestEngineFaultPoints:
         res = eng.check_batch_host([t("files:doc#owner@bob")])
         assert res[0].error is not None
         assert "disk gone" in str(res[0].error)
+
+
+class _FakeShedError(_FakeRpcError):
+    """UNAVAILABLE shed carrying a Retry-After hint in trailing metadata,
+    the way grpc_server._attach_retry_after publishes it."""
+
+    def __init__(self, name, retry_after=None):
+        super().__init__(name)
+        self._retry_after = retry_after
+
+    def trailing_metadata(self):
+        if self._retry_after is None:
+            return ()
+        return (("retry-after", str(self._retry_after)),)
+
+
+class TestDecorrelatedRetry:
+    """PR 20 client hardening: decorrelated-jitter backoff (no two shed
+    clients re-arrive on a synchronized cadence) and the server's
+    Retry-After hint flooring the jittered delay."""
+
+    def test_next_delay_stays_in_decorrelated_band(self):
+        import random
+
+        pol = RetryPolicy(base_s=0.05, cap_s=2.0, rng=random.Random(3))
+        prev = pol.base_s
+        for _ in range(200):
+            d = pol._next_delay(prev)
+            assert pol.base_s <= d <= min(pol.cap_s, prev * 3.0)
+            prev = d
+
+    def test_next_delay_capped(self):
+        import random
+
+        pol = RetryPolicy(base_s=0.5, cap_s=0.6, rng=random.Random(3))
+        # prev * 3 blows far past the cap; the cap must win
+        assert all(pol._next_delay(10.0) <= 0.6 for _ in range(50))
+
+    def test_schedules_decorrelate_across_clients(self):
+        # Two clients shed at the same instant must NOT re-arrive on the
+        # same schedule — that is the whole point of decorrelated jitter
+        # over a fixed exponential ladder.
+        import random
+
+        def schedule(seed):
+            sleeps = []
+            pol = RetryPolicy(
+                max_attempts=6, base_s=0.01, cap_s=5.0,
+                sleep=sleeps.append, rng=random.Random(seed),
+            )
+            with pytest.raises(_FakeRpcError):
+                pol.call(lambda r: (_ for _ in ()).throw(
+                    _FakeRpcError("UNAVAILABLE")
+                ))
+            return sleeps
+
+        a, b = schedule(1), schedule(2)
+        assert len(a) == len(b) == 5
+        assert a != b
+
+    def test_delay_chain_grows_from_own_prev(self):
+        # Each call() keeps its own prev chain: the first delay is drawn
+        # from U[base, 3*base], never from another call's history.
+        import random
+
+        sleeps = []
+        pol = RetryPolicy(
+            max_attempts=2, base_s=0.1, cap_s=9.0,
+            sleep=sleeps.append, rng=random.Random(5),
+        )
+        for _ in range(20):
+            with pytest.raises(_FakeRpcError):
+                pol.call(lambda r: (_ for _ in ()).throw(
+                    _FakeRpcError("UNAVAILABLE")
+                ))
+        assert all(0.1 <= s <= 0.3 for s in sleeps)  # 3 * base, not 3 * prev
+
+    def test_retry_after_metadata_floors_delay(self):
+        import random
+
+        sleeps = []
+        pol = RetryPolicy(
+            max_attempts=3, base_s=0.001, cap_s=2.0,
+            sleep=sleeps.append, rng=random.Random(7),
+        )
+        calls = []
+
+        def fn(remaining):
+            calls.append(1)
+            if len(calls) < 3:
+                raise _FakeShedError("UNAVAILABLE", retry_after=0.5)
+            return "ok"
+
+        assert pol.call(fn) == "ok"
+        # jitter alone would land near base_s=1ms; the hint floors it
+        assert len(sleeps) == 2
+        assert all(s >= 0.5 for s in sleeps)
+
+    def test_retry_after_attr_floors_delay(self):
+        import random
+
+        class _TypedShed(_FakeRpcError):
+            retry_after_s = 0.25
+
+        sleeps = []
+        pol = RetryPolicy(
+            max_attempts=2, base_s=0.001, cap_s=2.0,
+            sleep=sleeps.append, rng=random.Random(7),
+        )
+        calls = []
+
+        def fn(remaining):
+            calls.append(1)
+            if len(calls) < 2:
+                raise _TypedShed("RESOURCE_EXHAUSTED")
+            return "ok"
+
+        assert pol.call(fn) == "ok"
+        assert sleeps and sleeps[0] >= 0.25
+
+    def test_hint_counts_against_budget(self):
+        # A floored sleep that would outlive the caller's deadline must
+        # give up instead of burning the budget asleep.
+        sleeps = []
+        pol = RetryPolicy(max_attempts=4, base_s=0.001, sleep=sleeps.append)
+        with pytest.raises(_FakeShedError):
+            pol.call(
+                lambda r: (_ for _ in ()).throw(
+                    _FakeShedError("UNAVAILABLE", retry_after=10.0)
+                ),
+                budget_s=0.05,
+            )
+        assert not sleeps
+        assert pol.stats["giveups"] == 1
+
+    def test_hint_parsing(self):
+        hint = RetryPolicy.retry_after_hint_s
+        assert hint(_FakeShedError("UNAVAILABLE", retry_after=1.5)) == 1.5
+        assert hint(_FakeShedError("UNAVAILABLE")) is None
+        assert hint(_FakeRpcError("UNAVAILABLE")) is None
+        assert hint(_FakeShedError("UNAVAILABLE", retry_after="nonsense")) is None
+        assert hint(_FakeShedError("UNAVAILABLE", retry_after=-1)) is None
+
+        class _Typed:
+            retry_after_s = 2.0
+
+        assert hint(_Typed()) == 2.0

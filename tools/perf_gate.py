@@ -125,6 +125,40 @@ def closure_build_advisory(record: dict) -> None:
         print("perf_gate: closure: no build leg in record — skipped")
 
 
+def ha_failover_advisory(path: str = "HA_SMOKE_r20.json") -> None:
+    """Advisory HA-failover note: print the committed HA smoke
+    artifact's failover p99 (the front router's re-route latency under
+    kill -9, tools/ha_smoke.py) so a regressing failover path is LOUD
+    in the CI log next to the bench numbers. Advisory by design — the
+    smoke itself owns pass/fail on its correctness contracts, and
+    failover latency is bounded by the router's hold window, a policy
+    knob rather than a bench metric. Skips silently-with-a-line when
+    the artifact is absent (fresh clone) or carries no failover leg."""
+    p = pathlib.Path(path)
+    if not p.exists():
+        print(f"perf_gate: ha: {path} not found — skipped")
+        return
+    try:
+        doc = json.loads(p.read_text())
+    except (OSError, json.JSONDecodeError):
+        print(f"perf_gate: ha: {path} unreadable — skipped")
+        return
+    p99 = doc.get("failover_p99_ms")
+    if not isinstance(p99, (int, float)):
+        print(f"perf_gate: ha: no failover leg in {path} — skipped")
+        return
+    blackout = (doc.get("blackout_ms") or {}).get("p99")
+    extra = (
+        f" blackout p99 {blackout:.1f} ms"
+        if isinstance(blackout, (int, float)) else ""
+    )
+    print(
+        f"perf_gate: ha: failover p99 {p99:.2f} ms over "
+        f"{doc.get('n_cycles')} kill -9 cycles{extra} "
+        f"[ok={doc.get('ok')}] (advisory)"
+    )
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--record", required=True,
@@ -143,6 +177,7 @@ def main() -> int:
     record = load_record(args.record)
     slo_advisory(record, args.slo_served_p95_ms)
     closure_build_advisory(record)
+    ha_failover_advisory()
     # SKIP-ADVISORY, not error, when there is nothing honest to compare
     # against: a missing baseline artifact or a different-backend one
     # (a fresh repo clone, a first run on new hardware, a CPU run
